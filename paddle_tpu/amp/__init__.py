@@ -1,0 +1,149 @@
+"""paddle.amp — auto_cast + GradScaler.
+
+Ref: `python/paddle/amp/auto_cast.py`, `amp/grad_scaler.py:26` over `AmpScaler`
+(`fluid/dygraph/amp/loss_scaler.py:44`). On TPU the default AMP dtype is bfloat16
+(same exponent range as fp32), so dynamic loss scaling is a no-op by default — the
+GradScaler keeps the full found_inf/dynamic-scale contract for float16 use.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.amp.state import amp_state, WHITE_LIST, BLACK_LIST
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core import dtype as dtype_mod
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    st = amp_state()
+    prev = (st.enabled, st.level, st.dtype, st.custom_white, st.custom_black)
+    st.enabled = enable
+    st.level = level
+    st.dtype = np.dtype(dtype_mod.convert_dtype(dtype))
+    st.custom_white = set(custom_white_list or ())
+    st.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (st.enabled, st.level, st.dtype, st.custom_white, st.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the AMP dtype, keeping fp32 master
+    weights in the optimizer (ref: `python/paddle/amp/auto_cast.py` amp_decorate)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        d = dtype_mod.convert_dtype(dtype)
+        for m in model_list:
+            for p in m.parameters():
+                if jnp.issubdtype(p.dtype, jnp.floating):
+                    p._master = Tensor(p._data, _internal=True)  # fp32 master copy
+                    p._write(p._data.astype(d))
+        if optimizers is not None:
+            opt_list = [optimizers] if not isinstance(optimizers, (list, tuple)) \
+                else list(optimizers)
+            for opt in opt_list:
+                opt._use_master_weights = True
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaler (ref: `python/paddle/amp/grad_scaler.py:26`)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._all_params()
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p.grad is not None:
+                g = p.grad._data * inv
+                p.grad._write(g)
+                found = found or bool(jnp.any(~jnp.isfinite(g)))
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+AmpScaler = GradScaler

@@ -1,0 +1,76 @@
+"""AMP autocast state + per-op cast decisions.
+
+Ref: white/black lists at `python/paddle/fluid/dygraph/amp/auto_cast.py:44-105`
+(incl. the BF16 lists at :104); cast decisions are inlined in generated forwards in
+the reference (`eager/eager_amp_auto_cast.h`). On TPU the natural low precision is
+bfloat16, which needs no loss scaling.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtype_mod
+
+# ops computed in low precision under O1 (ref WHITE_LIST)
+WHITE_LIST = {"linear", "matmul", "bmm", "conv2d", "conv1d", "conv3d", "mv",
+              "conv2d_transpose", "einsum", "mm"}
+# ops kept in fp32 under O1 (ref BLACK_LIST — numerically sensitive)
+BLACK_LIST = {"exp", "log", "square", "log_softmax", "softmax", "mean", "sum",
+              "cross_entropy", "softmax_with_cross_entropy", "norm", "cumsum",
+              "layer_norm", "batch_norm", "reduce_mean", "reduce_sum", "pow",
+              "rsqrt", "sigmoid_cross_entropy_with_logits"}
+
+
+class _AmpState:
+    __slots__ = ("enabled", "level", "dtype", "custom_white", "custom_black")
+
+    def __init__(self):
+        self.enabled = False
+        self.level = "O1"
+        self.dtype = np.dtype(dtype_mod.bfloat16)
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def in_amp_context() -> bool:
+    return _state.enabled
+
+
+def amp_dtype():
+    return _state.dtype
+
+
+def amp_cast_inputs(op_name, *tensors):
+    """Cast float inputs per autocast policy; identity when AMP is off."""
+    if not _state.enabled:
+        return tensors if len(tensors) > 1 else tensors[0]
+    white = (WHITE_LIST | _state.custom_white) - _state.custom_black
+    black = (BLACK_LIST | _state.custom_black) - _state.custom_white
+    low = _state.dtype
+    if _state.level == "O2":
+        target = None if op_name in black else low
+    else:
+        if op_name in white:
+            target = low
+        elif op_name in black:
+            target = np.dtype(np.float32)
+        else:
+            target = None
+    if target is None:
+        return tensors if len(tensors) > 1 else tensors[0]
+    out = []
+    for t in tensors:
+        if t is not None and jnp.issubdtype(t.dtype, jnp.floating) \
+                and t.dtype != target:
+            out.append(t.astype(target))
+        else:
+            out.append(t)
+    return tuple(out) if len(out) > 1 else out[0]

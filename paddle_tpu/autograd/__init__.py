@@ -1,0 +1,142 @@
+"""paddle.autograd (ref: `python/paddle/autograd/__init__.py`): backward, grad,
+PyLayer (ref `py_layer.py:558` EagerPyLayer), hooks."""
+from __future__ import annotations
+
+from paddle_tpu.core.autograd import (  # noqa: F401
+    backward, grad, no_grad, enable_grad, set_grad_enabled, is_grad_enabled,
+    GradNode, apply,
+)
+from paddle_tpu.core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *a):
+        pass
+
+    def mark_non_differentiable(self, *a):
+        self._non_diff = a
+
+    def set_materialize_grads(self, v):
+        self.materialize_grads = v
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd function (ref: ``paddle.autograd.PyLayer``).
+
+    The subclass defines ``forward(ctx, *args)`` / ``backward(ctx, *grads)`` on
+    Tensors. Implementation: run forward under no_grad, then register one tape node
+    whose vjp calls the user's backward — the same shape as the reference's
+    PyLayer GradNode (`paddle/fluid/eager/pylayer/py_layer_node.h`).
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        import jax.numpy as jnp
+        from paddle_tpu.core import autograd as ag
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with ag.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+
+        record = ag.is_grad_enabled() and any(
+            not t.stop_gradient and jnp.issubdtype(t.dtype, jnp.inexact)
+            for t in tensor_inputs)
+        if not record:
+            return outputs
+
+        def vjp_fn(cotangents):
+            cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+            ct_tensors = [Tensor(c, stop_gradient=True, _internal=True)
+                          for c in cts]
+            with ag.no_grad():
+                grads = cls.backward(ctx, *ct_tensors)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            out_grads = []
+            gi = 0
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = grads[gi] if gi < len(grads) else None
+                    gi += 1
+                    out_grads.append(None if g is None else
+                                     (g._data if isinstance(g, Tensor) else g))
+            return tuple(out_grads)
+
+        node = ag.GradNode(vjp_fn, tensor_inputs,
+                           [(tuple(o.shape), o.dtype) for o in outs],
+                           name=cls.__name__)
+        import weakref
+        for i, o in enumerate(outs):
+            o.stop_gradient = False
+            o._grad_node = node
+            o._out_slot = i
+            node.out_refs.append(weakref.ref(o))
+        return outputs
+
+
+EagerPyLayer = PyLayer
+
+
+def hessian(func, xs, batch_axis=None):
+    """Simple dense hessian via double jax.grad on the wrapped function."""
+    import jax
+    import jax.numpy as jnp
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+
+    def wrapped(*arrs):
+        ts = [Tensor(a, stop_gradient=False, _internal=True) for a in arrs]
+        out = func(*ts) if len(ts) > 1 else func(ts[0])
+        return out._data.reshape(())
+
+    arrs = [t._data for t in xs_list]
+    H = jax.hessian(wrapped, argnums=tuple(range(len(arrs))))(*arrs)
+    if single:
+        return Tensor(jnp.asarray(H[0][0]), _internal=True)
+    return [[Tensor(jnp.asarray(h), _internal=True) for h in row] for row in H]
+
+
+def jacobian(func, xs, batch_axis=None):
+    import jax
+    import jax.numpy as jnp
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+
+    def wrapped(*arrs):
+        ts = [Tensor(a, stop_gradient=False, _internal=True) for a in arrs]
+        out = func(*ts) if len(ts) > 1 else func(ts[0])
+        return out._data
+
+    arrs = [t._data for t in xs_list]
+    J = jax.jacobian(wrapped, argnums=tuple(range(len(arrs))))(*arrs)
+    if single:
+        return Tensor(jnp.asarray(J[0]), _internal=True)
+    return [Tensor(jnp.asarray(j), _internal=True) for j in J]

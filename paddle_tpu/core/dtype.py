@@ -1,0 +1,121 @@
+"""Dtype system.
+
+Paddle-flavored dtype names mapped onto JAX/XLA dtypes. The reference keeps an enum
+``DataType`` (`paddle/phi/common/data_type.h`) plus float16/bfloat16 value types
+(`paddle/fluid/platform/bfloat16.h`); on TPU the value types are native XLA types, so this
+module only needs the name <-> numpy-dtype mapping and the default-dtype state
+(reference: `python/paddle/framework/framework.py` set_default_dtype).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical name -> numpy dtype object. bfloat16 is first-class on TPU.
+_NAME_TO_DTYPE = {
+    "bool": np.dtype(np.bool_),
+    "uint8": np.dtype(np.uint8),
+    "int8": np.dtype(np.int8),
+    "int16": np.dtype(np.int16),
+    "int32": np.dtype(np.int32),
+    "int64": np.dtype(np.int64),
+    "float16": np.dtype(np.float16),
+    "bfloat16": np.dtype(ml_dtypes.bfloat16),
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+    "complex64": np.dtype(np.complex64),
+    "complex128": np.dtype(np.complex128),
+    "float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
+    "float8_e5m2": np.dtype(ml_dtypes.float8_e5m2),
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+bool_ = _NAME_TO_DTYPE["bool"]
+uint8 = _NAME_TO_DTYPE["uint8"]
+int8 = _NAME_TO_DTYPE["int8"]
+int16 = _NAME_TO_DTYPE["int16"]
+int32 = _NAME_TO_DTYPE["int32"]
+int64 = _NAME_TO_DTYPE["int64"]
+float16 = _NAME_TO_DTYPE["float16"]
+bfloat16 = _NAME_TO_DTYPE["bfloat16"]
+float32 = _NAME_TO_DTYPE["float32"]
+float64 = _NAME_TO_DTYPE["float64"]
+complex64 = _NAME_TO_DTYPE["complex64"]
+complex128 = _NAME_TO_DTYPE["complex128"]
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"default dtype must be a floating dtype, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> np.dtype:
+    return _default_dtype
+
+
+def convert_dtype(d) -> np.dtype:
+    """Normalize any dtype spec (str / numpy / jax / Tensor dtype) to a numpy dtype."""
+    if d is None:
+        return _default_dtype
+    if isinstance(d, np.dtype):
+        return d
+    if isinstance(d, str):
+        name = _ALIASES.get(d, d)
+        if name in _NAME_TO_DTYPE:
+            return _NAME_TO_DTYPE[name]
+        return np.dtype(name)
+    if d in (float,):
+        return _default_dtype
+    if d in (int,):
+        return int64
+    if d in (bool,):
+        return bool_
+    if d in (complex,):
+        return complex64
+    # numpy scalar types, jnp.float32 etc.
+    return np.dtype(d)
+
+
+def dtype_name(d) -> str:
+    d = convert_dtype(d)
+    return d.name
+
+
+def is_floating(d) -> bool:
+    d = convert_dtype(d)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(d) -> bool:
+    d = convert_dtype(d)
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def is_complex(d) -> bool:
+    d = convert_dtype(d)
+    return jnp.issubdtype(d, jnp.complexfloating)
+
+
+def finfo(d):
+    return jnp.finfo(convert_dtype(d))
+
+
+def iinfo(d):
+    return jnp.iinfo(convert_dtype(d))

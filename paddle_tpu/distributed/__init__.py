@@ -1,0 +1,27 @@
+"""paddle.distributed — TPU-native distributed stack.
+
+Design (SURVEY.md §2.6/§2.7): the reference's NCCL ProcessGroups + c_* collective ops
++ fleet meta-optimizers collapse onto ONE mechanism — a `jax.sharding.Mesh` with
+collectives compiled by XLA over ICI/DCN. `init_parallel_env` ≈
+`jax.distributed.initialize` (coordination service ≈ TCPStore,
+`paddle/fluid/distributed/store/tcp_store.h:117`). The eager collective API operates
+on globally-sharded arrays via shard_map so `paddle.distributed.all_reduce(...)`
+keeps its signature while compiling to one XLA collective.
+"""
+from paddle_tpu.distributed.parallel import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, is_initialized, barrier,
+    ParallelEnv,
+)
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    all_reduce, all_gather, all_gather_object, reduce, broadcast, scatter,
+    reduce_scatter, alltoall, alltoall_single, send, recv, isend, irecv,
+    new_group, get_group, wait, ReduceOp, Group, split_group, destroy_process_group,
+)
+from paddle_tpu.distributed.mesh import (  # noqa: F401
+    ProcessMesh, get_mesh, set_mesh, auto_mesh, shard_tensor, shard_op,
+    default_mesh_axes,
+)
+from paddle_tpu.distributed import fleet  # noqa: F401
+from paddle_tpu.distributed.parallel_wrappers import DataParallel  # noqa: F401
+from paddle_tpu.distributed import sharding  # noqa: F401
+from paddle_tpu.distributed.spawn import spawn  # noqa: F401

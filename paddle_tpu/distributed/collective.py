@@ -1,0 +1,332 @@
+"""Collective communication API (ref: `python/paddle/distributed/collective.py` and
+`communication/*` — the eager ProcessGroup path over NCCL,
+`collective/ProcessGroupNCCL.h:46`).
+
+TPU-native dual path:
+- **in-graph** (inside shard_map/pjit with a bound axis): `jax.lax.psum` & co.,
+  compiled onto ICI — the analog of the c_* collective ops the static graph inserts
+  (`paddle/fluid/operators/collective/`).
+- **eager multi-process**: `multihost_utils.process_allgather` + local reduction —
+  the analog of ProcessGroup eager calls (correctness path; hot paths belong
+  in-graph).
+
+Groups name mesh axes instead of owning NCCL communicators.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.common import ensure_tensor
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = a set of ranks, optionally bound to a mesh axis
+    name for in-graph collectives (ref: `collective.py` Group)."""
+
+    def __init__(self, ranks=None, gid=0, axis_name=None):
+        from paddle_tpu.distributed.parallel import get_world_size
+        self.ranks = list(ranks) if ranks is not None else \
+            list(range(max(get_world_size(), 1)))
+        self.id = gid
+        self.axis_name = axis_name
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    @property
+    def rank(self):
+        from paddle_tpu.distributed.parallel import get_rank
+        r = get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self):
+        from paddle_tpu.distributed.parallel import get_rank
+        return get_rank() in self.ranks
+
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.axis_name})"
+
+
+_group_counter = 0
+_groups: dict[int, Group] = {}
+_default_group: Group | None = None
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(gid=0)
+        _groups[0] = _default_group
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    global _group_counter
+    _group_counter += 1
+    g = Group(ranks, _group_counter, axis_name=axis_name)
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid) or _get_default_group()
+
+
+def split_group(parent_group=None, split_sizes=None):
+    parent = parent_group or _get_default_group()
+    out = []
+    start = 0
+    for size in split_sizes:
+        out.append(new_group(parent.ranks[start:start + size]))
+        start += size
+    return out
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and not isinstance(tensor._data,
+                                                     jax.core.Tracer):
+        tensor._data.block_until_ready()
+
+
+def _in_trace(t: Tensor) -> bool:
+    return isinstance(t._data, jax.core.Tracer)
+
+
+def _axis(group) -> str | None:
+    if group is not None and group.axis_name:
+        return group.axis_name
+    return None
+
+
+def _multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def _proc_allgather(arr):
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(arr)
+
+
+# ------------------------------------------------------------------ collectives
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-graph: lax.psum over the group's mesh axis. Eager multi-process:
+    process allgather + local reduce. Single process: identity (1 rank)."""
+    t = ensure_tensor(tensor)
+    axis = _axis(group)
+    if _in_trace(t) and axis is not None:
+        from paddle_tpu.core.autograd import apply
+        red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}[op]
+        res = apply(lambda a: red(a, axis), t, op_name="all_reduce")
+        tensor._write(res._data)
+        if res._grad_node is not None:
+            tensor._grad_node = res._grad_node
+            tensor._out_slot = res._out_slot
+            tensor.stop_gradient = False
+        return tensor
+    if _multiprocess():
+        stacked = _proc_allgather(t._data)
+        fn = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max, ReduceOp.MIN: jnp.min,
+              ReduceOp.PROD: jnp.prod,
+              ReduceOp.AVG: jnp.mean}[op]
+        tensor._write(fn(stacked, axis=0).astype(t.dtype))
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    t = ensure_tensor(tensor)
+    ax = _axis(group)
+    if _in_trace(t) and ax is not None:
+        from paddle_tpu.core.autograd import apply
+        res = apply(lambda a: jax.lax.all_gather(a, ax), t, op_name="all_gather")
+        n = res.shape[0]
+        for i in range(n):
+            tensor_list.append(res[i])
+        return tensor_list
+    if _multiprocess():
+        stacked = _proc_allgather(t._data)
+        for i in range(stacked.shape[0]):
+            tensor_list.append(Tensor(stacked[i], _internal=True))
+    else:
+        tensor_list.append(Tensor(t._data, _internal=True))
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    import pickle
+    if not _multiprocess():
+        object_list.append(obj)
+        return object_list
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    sizes = _proc_allgather(jnp.asarray([payload.size], jnp.int64))
+    maxlen = int(np.max(np.asarray(sizes)))
+    padded = np.zeros(maxlen, np.uint8)
+    padded[: payload.size] = payload
+    gathered = np.asarray(_proc_allgather(jnp.asarray(padded)))
+    for row, size in zip(gathered, np.asarray(sizes).reshape(-1)):
+        object_list.append(pickle.loads(row[: int(size)].tobytes()))
+    return object_list
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    all_reduce(tensor, op=op, group=group)
+    return tensor
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    t = ensure_tensor(tensor)
+    ax = _axis(group)
+    if _in_trace(t) and ax is not None:
+        # in-SPMD broadcast from src: select src's shard via all_gather + index
+        from paddle_tpu.core.autograd import apply
+        res = apply(lambda a: jax.lax.all_gather(a, ax)[src], t,
+                    op_name="broadcast")
+        tensor._write(res._data)
+        return tensor
+    if _multiprocess():
+        stacked = _proc_allgather(t._data)
+        tensor._write(jnp.asarray(stacked[src]))
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    from paddle_tpu.distributed.parallel import get_rank
+    if not _multiprocess():
+        if tensor_list:
+            tensor._write(ensure_tensor(tensor_list[0])._data)
+        return tensor
+    rank = get_rank()
+    if rank == src and tensor_list:
+        stacked = jnp.stack([ensure_tensor(x)._data for x in tensor_list])
+    else:
+        shape = (len(group.ranks if group else range(jax.process_count())),) + \
+            tuple(tensor.shape)
+        stacked = jnp.zeros(shape, tensor.dtype)
+    # emulate via broadcast of the stacked buffer then local pick
+    g = _proc_allgather(stacked)
+    tensor._write(jnp.asarray(g[src][rank]))
+    return tensor
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    t0 = ensure_tensor(tensor_list[0] if isinstance(tensor_list, (list, tuple))
+                       else tensor_list)
+    ax = _axis(group)
+    if _in_trace(t0) and ax is not None:
+        from paddle_tpu.core.autograd import apply
+        stacked = [ensure_tensor(x) for x in tensor_list]
+        res = apply(lambda *arrs: jax.lax.psum_scatter(
+            jnp.concatenate(arrs, axis=0), ax, tiled=True), *stacked,
+            op_name="reduce_scatter")
+        tensor._write(res._data)
+        return tensor
+    if _multiprocess():
+        from paddle_tpu.distributed.parallel import get_rank
+        local = jnp.stack([ensure_tensor(x)._data for x in tensor_list])
+        summed = jnp.sum(_proc_allgather(local), axis=0)
+        tensor._write(summed[get_rank()])
+    else:
+        tensor._write(t0._data)
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    if out_tensor_list is None:
+        out_tensor_list = []
+    if not _multiprocess():
+        for t in in_tensor_list:
+            out_tensor_list.append(ensure_tensor(t))
+        return out_tensor_list
+    from paddle_tpu.distributed.parallel import get_rank
+    local = jnp.stack([ensure_tensor(x)._data for x in in_tensor_list])
+    gathered = _proc_allgather(local)  # [P, P, ...]
+    rank = get_rank()
+    for p in range(gathered.shape[0]):
+        out_tensor_list.append(Tensor(jnp.asarray(gathered[p][rank]),
+                                      _internal=True))
+    return out_tensor_list
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    t = ensure_tensor(in_tensor)
+    ax = _axis(group)
+    if _in_trace(t) and ax is not None:
+        from paddle_tpu.core.autograd import apply
+        n = group.nranks
+        res = apply(lambda a: jax.lax.all_to_all(
+            a.reshape((n, -1) + a.shape[1:]), ax, split_axis=0, concat_axis=0,
+            tiled=False).reshape(a.shape), t, op_name="alltoall_single")
+        if out_tensor is not None:
+            out_tensor._write(res._data)
+            return out_tensor
+        return res
+    if out_tensor is not None and not _multiprocess():
+        out_tensor._write(t._data)
+        return out_tensor
+    if _multiprocess():
+        from paddle_tpu.distributed.parallel import get_rank, get_world_size
+        n = get_world_size()
+        chunks = jnp.stack(jnp.split(t._data, n, axis=0))
+        gathered = _proc_allgather(chunks)  # [P, P, chunk...]
+        rank = get_rank()
+        mine = jnp.concatenate([jnp.asarray(gathered[p][rank])
+                                for p in range(n)], axis=0)
+        if out_tensor is not None:
+            out_tensor._write(mine)
+            return out_tensor
+        return Tensor(mine, _internal=True)
+    return t
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv between processes is expressed with "
+        "jax.lax.ppermute inside shard_map on TPU (see distributed.fleet "
+        "pipeline runtime); eager cross-process p2p is not supported")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "use ppermute-based pipeline runtime (distributed.fleet.meta_parallel)")
+
+
+def isend(tensor, dst, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=None, group=None):
+    return recv(tensor, src, group)

@@ -1,0 +1,21 @@
+"""paddle.distributed.fleet — hybrid-parallel facade.
+
+Ref: `python/paddle/distributed/fleet/fleet.py` (Fleet singleton, init :168,
+distributed_optimizer :1032), topology (`fleet/base/topology.py:53,139`),
+DistributedStrategy (`fleet/base/distributed_strategy.py:111`).
+"""
+from paddle_tpu.distributed.fleet.base import (  # noqa: F401
+    DistributedStrategy, CommunicateTopology, HybridCommunicateGroup,
+    PaddleCloudRoleMaker, UserDefinedRoleMaker,
+)
+from paddle_tpu.distributed.fleet.fleet import (  # noqa: F401
+    Fleet, init, distributed_model, distributed_optimizer, get_hybrid_communicate_group,
+    worker_index, worker_num, is_first_worker, barrier_worker,
+)
+from paddle_tpu.distributed.fleet import meta_parallel  # noqa: F401
+from paddle_tpu.distributed.fleet.meta_parallel import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, PipelineLayer, LayerDesc, SharedLayerDesc,
+    TensorParallel, PipelineParallel, get_rng_state_tracker,
+)
+from paddle_tpu.distributed.fleet.recompute import recompute, recompute_sequential  # noqa: F401

@@ -1,0 +1,242 @@
+"""Fleet base objects: DistributedStrategy, topology, role makers.
+
+Ref: `framework/distributed_strategy.proto` (29 messages) /
+`fleet/base/distributed_strategy.py:111`; `fleet/base/topology.py:53,139`.
+The 4-D dp×mp×pp×sharding topology maps onto mesh axes (see distributed.mesh).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.distributed.mesh import auto_mesh, get_mesh, set_mesh
+from paddle_tpu.distributed.collective import new_group
+
+
+class DistributedStrategy:
+    """Attribute-bag mirroring the reference's strategy proto fields used by the
+    collective path (PS-only fields are accepted but inert)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.sharding_configs = {"stage": 1, "offload": False}
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_fp16": False,
+                            "custom_white_list": [], "custom_black_list": []}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.sharding = False
+        self.pipeline = False
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = True
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.heter_ccl_mode = False
+        self.is_fl_ps_mode = False
+        self.a_sync = False
+        self.a_sync_configs = {}
+        self.auto = False
+        self.semi_auto = False
+        self.without_graph_optimization = True
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+    def __repr__(self):
+        return f"DistributedStrategy({self.hybrid_configs})"
+
+
+class CommunicateTopology:
+    """ref: `fleet/base/topology.py:53` — named N-D rank grid."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        self._world = int(np.prod(self._dims))
+        arr = np.arange(self._world).reshape(self._dims)
+        self._rank_grid = arr
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return int(self._rank_grid[coord])
+
+    def get_coord(self, rank):
+        coord = np.unravel_index(rank, self._dims)
+        return tuple(int(c) for c in coord)
+
+    def get_axis_list(self, axis_name, index):
+        ax = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[ax] = index
+        return sorted(int(r) for r in self._rank_grid[tuple(sl)].reshape(-1))
+
+    def get_comm_list(self, axis_name):
+        """All rank-groups that communicate along axis_name."""
+        ax = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._rank_grid, ax, -1)
+        return [sorted(int(r) for r in row)
+                for row in moved.reshape(-1, self._dims[ax])]
+
+
+class HybridCommunicateGroup:
+    """ref: `fleet/base/topology.py:139` — creates per-strategy comm groups
+    (:346-402). Here each group is a named mesh axis; the jax Mesh is installed
+    globally so layers/sharding pick it up."""
+
+    AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sdp", "model": "mp",
+                "sep": "sp"}
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        from paddle_tpu.distributed.parallel import get_rank
+        self.global_rank = get_rank()
+        names = topology.get_hybrid_group_names()
+        self._dp_degree = topology.get_dim("data") if "data" in names else 1
+        self._pp_degree = topology.get_dim("pipe") if "pipe" in names else 1
+        self._sharding_degree = topology.get_dim("sharding") \
+            if "sharding" in names else 1
+        self._mp_degree = topology.get_dim("model") if "model" in names else 1
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+
+        import jax
+        n_dev = len(jax.devices())
+        need = (self._dp_degree * self._pp_degree * self._sharding_degree *
+                self._mp_degree * self._sep_degree)
+        if need == n_dev:
+            auto_mesh(dp=self._dp_degree, mp=self._mp_degree, pp=self._pp_degree,
+                      sp=self._sep_degree, sdp=self._sharding_degree)
+
+        coord = self._topo.get_coord(self.global_rank) \
+            if self.global_rank < self._topo.world_size() else \
+            (0,) * len(self._topo._dims)
+        self._coord = dict(zip(self._topo.get_hybrid_group_names(), coord))
+
+        self._dp_group = new_group(
+            self._topo.get_axis_list("data", 0) if "data" in names else [0],
+            axis_name="dp")
+        self._mp_group = new_group(
+            self._topo.get_axis_list("model", 0) if "model" in names else [0],
+            axis_name="mp")
+        self._pp_group = new_group(
+            self._topo.get_axis_list("pipe", 0) if "pipe" in names else [0],
+            axis_name="pp")
+        self._sharding_group = new_group(
+            self._topo.get_axis_list("sharding", 0) if "sharding" in names
+            else [0], axis_name="sdp")
+
+    @property
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._mp_degree > 1:
+            return "tensor"
+        if self._sharding_degree > 1:
+            return "sharding"
+        return "data"
+
+    # degrees
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # ranks within each axis
+    def get_data_parallel_rank(self):
+        return self._coord.get("data", 0)
+
+    def get_model_parallel_rank(self):
+        return self._coord.get("model", 0)
+
+    def get_stage_id(self):
+        return self._coord.get("pipe", 0)
+
+    def get_sharding_parallel_rank(self):
+        return self._coord.get("sharding", 0)
+
+    # groups
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_check_parallel_group(self, *a, **k):
+        return self._mp_group
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        coord = dict(self._coord)
+        coord["pipe"] = stage_id
+        return self._topo.get_rank(**coord)
+
+    def get_p2p_groups(self):
+        return None
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0]
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0]
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_num(self):
+        from paddle_tpu.distributed.parallel import get_world_size
+        return get_world_size()
+
+    def _worker_index(self):
+        from paddle_tpu.distributed.parallel import get_rank
+        return get_rank()
+
+    def _is_first_worker(self):
+        return self._worker_index() == 0
+
+
+UserDefinedRoleMaker = PaddleCloudRoleMaker

@@ -1,0 +1,107 @@
+"""Fleet facade (ref: `python/paddle/distributed/fleet/fleet.py` — init :168,
+distributed_model, distributed_optimizer :1032)."""
+from __future__ import annotations
+
+from paddle_tpu.distributed.fleet.base import (
+    DistributedStrategy, CommunicateTopology, HybridCommunicateGroup,
+    PaddleCloudRoleMaker,
+)
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker = None
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level=2):
+        from paddle_tpu.distributed.parallel import init_parallel_env
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        hc = self._strategy.hybrid_configs
+        topo = CommunicateTopology(
+            hybrid_group_names=["data", "pipe", "sharding", "model"],
+            dims=[hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+                  hc.get("sharding_degree", 1), hc.get("mp_degree", 1)])
+        self._hcg = HybridCommunicateGroup(topo)
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def worker_index(self):
+        return self._role_maker._worker_index
+
+    @property
+    def worker_num(self):
+        return self._role_maker._worker_num
+
+    def is_first_worker(self):
+        return self._role_maker._is_first_worker()
+
+    def barrier_worker(self):
+        from paddle_tpu.distributed.parallel import barrier
+        barrier()
+
+    def distributed_model(self, model):
+        """Wrap per the active parallel mode (ref fleet.distributed_model)."""
+        from paddle_tpu.distributed.fleet import meta_parallel as mpu
+        mode = self._hcg.get_parallel_mode()
+        if mode == "pipeline":
+            return mpu.PipelineParallel(model, self._hcg, self._strategy)
+        if mode == "tensor":
+            return mpu.TensorParallel(model, self._hcg, self._strategy)
+        from paddle_tpu.distributed.parallel_wrappers import DataParallel
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            HybridParallelOptimizer)
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       strategy or self._strategy)
+
+    def distributed_scaler(self, scaler):
+        return scaler
+
+
+_fleet_singleton = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level=2):
+    return _fleet_singleton.init(role_maker, is_collective, strategy, log_level)
+
+
+def distributed_model(model):
+    return _fleet_singleton.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _fleet_singleton.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group():
+    return _fleet_singleton.get_hybrid_communicate_group()
+
+
+def worker_index():
+    from paddle_tpu.distributed.parallel import get_rank
+    return get_rank()
+
+
+def worker_num():
+    from paddle_tpu.distributed.parallel import get_world_size
+    return get_world_size()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def barrier_worker():
+    from paddle_tpu.distributed.parallel import barrier
+    barrier()

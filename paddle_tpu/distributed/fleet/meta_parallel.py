@@ -1,0 +1,411 @@
+"""Hybrid-parallel layers & runtimes.
+
+Ref: Megatron-style TP layers `fleet/layers/mpu/mp_layers.py`
+(VocabParallelEmbedding:38, ColumnParallelLinear:176, RowParallelLinear:335,
+ParallelCrossEntropy:501), TP RNG `layers/mpu/random.py:34`, pipeline
+`meta_parallel/parallel_layers/pp_layers.py:209` + runtime
+`meta_parallel/pipeline_parallel.py:33` (1F1B at :119).
+
+TPU-native: TP layers hold the FULL logical weight with a NamedSharding over the
+'mp' mesh axis — GSPMD inserts the identity/allreduce pair the reference codes as
+`_c_identity`/`_mp_allreduce` (`mp_ops.py:33,235`). The pipeline runtime does
+micro-batch accumulation (GPipe-equivalent loss semantics, loss-parity oracle as in
+`hybrid_parallel_pp_*` tests); stage placement over the 'pp' axis is annotation.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from paddle_tpu.core.tensor import Tensor, Parameter
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.framework.param_attr import ParamAttr
+from paddle_tpu.distributed.mesh import get_mesh
+from paddle_tpu.ops.common import ensure_tensor
+
+
+def _mesh_axis_size(axis):
+    mesh = get_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]
+
+
+def _place_param(p: Parameter, spec: PartitionSpec):
+    mesh = get_mesh()
+    if mesh is None:
+        return
+    if not isinstance(p._data, jax.core.Tracer):
+        p._write(jax.device_put(p._data, NamedSharding(mesh, spec)))
+
+
+def _constrain(t: Tensor, spec: PartitionSpec) -> Tensor:
+    mesh = get_mesh()
+    if mesh is None or not isinstance(t._data, jax.core.Tracer):
+        return t
+    from paddle_tpu.core.autograd import apply
+    sh = NamedSharding(mesh, spec)
+    return apply(lambda a: jax.lax.with_sharding_constraint(a, sh), t,
+                 op_name="sharding_constraint")
+
+
+# --------------------------------------------------------------------- TP RNG
+
+
+class RNGStatesTracker:
+    """ref: `fleet/layers/mpu/random.py:34` — named RNG states so dropout inside
+    TP regions is per-rank while data-parallel regions stay replicated."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def add(self, name, seed):
+        from paddle_tpu.ops.random import Generator
+        if name in self.states_:
+            raise ValueError(f"rng state {name} already exists")
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = states
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states_:
+            self.add(name, np.random.randint(1, 2**31 - 1))
+        from paddle_tpu.ops import random as rnd
+        prev = rnd._default_generator
+        rnd._default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            rnd._default_generator = prev
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import paddle_tpu
+    base = seed or np.random.randint(1, 2**20)
+    paddle_tpu.seed(base)
+    tracker = get_rng_state_tracker()
+    tracker.states_.clear()
+    tracker.add("model_parallel_rng", base + 1024)
+
+
+# --------------------------------------------------------------------- TP layers
+
+
+class VocabParallelEmbedding(Layer):
+    """ref `mp_layers.py:38`: embedding table sharded over vocab; out-of-shard
+    lookups masked then allreduced — GSPMD derives that from the sharding."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num = num_embeddings
+        attr = ParamAttr._to_attr(weight_attr)
+        if attr is None:
+            attr = ParamAttr(initializer=I.XavierNormal())
+        elif isinstance(attr, ParamAttr) and attr.initializer is None:
+            attr.initializer = I.XavierNormal()
+        self.weight = self.create_parameter((num_embeddings, embedding_dim),
+                                            attr=attr)
+        _place_param(self.weight, PartitionSpec("mp", None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """ref `mp_layers.py:176`: W [in, out] sharded on out; gather_output
+    controls whether the result is gathered back (replicated)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=ParamAttr._to_attr(weight_attr))
+        _place_param(self.weight, PartitionSpec(None, "mp"))
+        if has_bias is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            _place_param(self.bias, PartitionSpec("mp"))
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _constrain(out, PartitionSpec())
+        nd = out.ndim
+        return _constrain(out, PartitionSpec(*([None] * (nd - 1) + ["mp"])))
+
+
+class RowParallelLinear(Layer):
+    """ref `mp_layers.py:335`: W [in, out] sharded on in; partial results are
+    psum'd (GSPMD emits the allreduce)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=ParamAttr._to_attr(weight_attr))
+        _place_param(self.weight, PartitionSpec("mp", None))
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        x = ensure_tensor(x)
+        if self.input_is_parallel:
+            nd = x.ndim
+            x = _constrain(x, PartitionSpec(*([None] * (nd - 1) + ["mp"])))
+        out = F.linear(x, self.weight, self.bias)
+        return _constrain(out, PartitionSpec())
+
+
+class ParallelCrossEntropy(Layer):
+    """ref `mp_layers.py:501` (`c_softmax_with_cross_entropy`): with logits
+    sharded over classes GSPMD computes the softmax reduction across 'mp'."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+class TensorParallel(Layer):
+    """Dygraph wrapper (ref `meta_parallel/tensor_parallel.py:27`): in the
+    reference it broadcasts params inside mp group at init; sharded params here
+    are already consistent by construction."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+# --------------------------------------------------------------------- pipeline
+
+
+class LayerDesc:
+    """ref `pp_layers.py` LayerDesc — lazy layer construction per stage."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """ref `pp_layers.py` SharedLayerDesc — layers shared across stages (e.g.
+    embedding/output head weight tying)."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """ref `pp_layers.py:93` — uniform / param-count segmentation."""
+
+    def __init__(self, layers, num_parts, method="uniform"):
+        self.layers = layers
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self):
+        n = len(self.layers)
+        if self.method == "uniform":
+            base = n // self.num_parts
+            extra = n % self.num_parts
+            bounds = [0]
+            for i in range(self.num_parts):
+                bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+            return bounds
+        if self.method.startswith("layer:"):
+            cls_name = self.method.split(":", 1)[1]
+            flags = [1 if type(l).__name__ == cls_name or (
+                isinstance(l, LayerDesc) and l.layer_cls.__name__ == cls_name)
+                else 0 for l in self.layers]
+            total = sum(flags)
+            per = total // self.num_parts
+            bounds = [0]
+            count = 0
+            for i, f in enumerate(flags):
+                count += f
+                if len(bounds) < self.num_parts and count >= per * len(bounds):
+                    bounds.append(i + 1)
+            while len(bounds) <= self.num_parts:
+                bounds.append(n)
+            return bounds[: self.num_parts + 1]
+        raise ValueError(f"unknown segment method {self.method}")
+
+
+class PipelineLayer(Layer):
+    """ref `pp_layers.py:209`. Holds the full layer list; segments map to pp
+    stages. Single-program SPMD execution runs all stages (stage placement is a
+    sharding/placement concern, not a control-flow one on TPU)."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, num_virtual_pipeline_stages=None,
+                 **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or (
+            topology.get_dim("pipe") if topology else 1)
+        self._shared = {}
+        built = []
+        for desc in layers:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.key in self._shared:
+                    layer = self._shared[desc.key]
+                else:
+                    layer = desc.build_layer()
+                    self._shared[desc.key] = layer
+                built.append((layer, desc.forward_func))
+            elif isinstance(desc, LayerDesc):
+                built.append((desc.build_layer(), None))
+            else:
+                built.append((desc, None))
+        self.run_funcs = built
+        from paddle_tpu.nn.layers.container import LayerList
+        self._layers_list = LayerList([l for l, _ in built])
+        self._segments = SegmentLayers(
+            [l for l, _ in built], self._num_stages, seg_method).do_segment()
+        self._recompute_interval = recompute_interval
+
+    def get_stage_layers(self, stage_id):
+        lo, hi = self._segments[stage_id], self._segments[stage_id + 1]
+        return self.run_funcs[lo:hi]
+
+    def forward(self, x):
+        from paddle_tpu.distributed.fleet.recompute import recompute
+        for i, (layer, ffunc) in enumerate(self.run_funcs):
+            fn = (lambda inp, _l=layer, _f=ffunc:
+                  _f(_l, inp) if _f is not None else _l(inp))
+            if self._recompute_interval and i % self._recompute_interval == 0 \
+                    and self.training:
+                x = recompute(fn, x)
+            else:
+                x = fn(x)
+        return x
+
+
+class PipelineParallel(Layer):
+    """Pipeline runtime (ref `pipeline_parallel.py:33`): `train_batch` splits the
+    batch into micro-batches and accumulates grads — identical loss semantics to
+    the reference's 1F1B (`forward_backward_pipeline` :119), with XLA scheduling
+    the overlap. Use `to_static` around train_batch for the compiled path."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        acc = 1
+        if strategy is not None:
+            acc = strategy.pipeline_configs.get("accumulate_steps", 1)
+        self._accumulate_steps = acc
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from paddle_tpu.ops.manipulation import split
+        x, y = data
+        n_micro = self._accumulate_steps
+        losses = []
+        micro_xs = split(x, n_micro, axis=0) if n_micro > 1 else [x]
+        micro_ys = split(y, n_micro, axis=0) if n_micro > 1 else [y]
+        for mx, my in zip(micro_xs, micro_ys):
+            out = self._layers(mx)
+            loss_fn = getattr(self._layers, "_loss_fn", None)
+            loss = loss_fn(out, my) if loss_fn is not None else out
+            scaled = loss / n_micro
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            losses.append(loss)
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        from paddle_tpu.ops.math import add_n
+        total = add_n([l.detach() for l in losses])
+        return total / n_micro
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x)
+        loss_fn = getattr(self._layers, "_loss_fn", None)
+        if compute_loss and loss_fn is not None:
+            return loss_fn(out, y)
+        return out
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+class HybridParallelOptimizer:
+    """ref `dygraph_optimizer/hybrid_parallel_optimizer.py:187` — wraps the inner
+    optimizer with group-aware grad sync/clip. Grad sync is compiled into the
+    program by GSPMD, so this wrapper only preserves API (clip already group-
+    correct because grads are global arrays)."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    def minimize(self, *a, **k):
+        return self._inner_opt.minimize(*a, **k)

@@ -1,0 +1,111 @@
+"""Activation recompute (ref: `fleet/recompute/recompute.py:223` RecomputeFunction
+PyLayer with RNG-state replay; api :385, sequential :496).
+
+TPU-native: `jax.checkpoint` (rematerialization) applied to the op's primal inside
+the tape — XLA recomputes the forward in backward instead of saving activations.
+RNG determinism comes free: the PRNG key is captured functionally, so replay is
+exact (the reference must save/restore CUDA RNG state by hand).
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.common import ensure_tensor
+
+
+def recompute(function, *args, **kwargs):
+    """Run `function(*args)` with rematerialized backward."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    tensor_args = []
+    spec = []
+    for a in args:
+        if isinstance(a, Tensor):
+            spec.append(("t", len(tensor_args)))
+            tensor_args.append(a)
+        else:
+            spec.append(("c", a))
+
+    # capture layer params read inside `function` as explicit tensor inputs so
+    # the checkpointed region differentiates w.r.t. them too
+    from paddle_tpu.core import tensor as tensor_mod
+    extra: dict[int, Tensor] = {}
+
+    def read_hook(t):
+        if id(t) not in extra and all(t is not ta for ta in tensor_args):
+            extra[id(t)] = t
+
+    def run(arrs_main, arrs_extra, extra_list):
+        saved = [(t, t._data) for t in extra_list]
+        try:
+            for t, a in zip(extra_list, arrs_extra):
+                t._data = a
+            call_args = []
+            for kind, v in spec:
+                if kind == "t":
+                    call_args.append(Tensor(arrs_main[v], stop_gradient=False,
+                                            _internal=True))
+                else:
+                    call_args.append(v)
+            out = function(*call_args, **kwargs)
+            multi = isinstance(out, (tuple, list))
+            outs = [o._data for o in (out if multi else [out])]
+            return tuple(outs) if multi else outs[0]
+        finally:
+            for t, a in saved:
+                t._data = a
+
+    # discover extra params with one hooked dry trace via jax.eval_shape
+    prev = tensor_mod.set_capture_hooks(read_hook, None)
+    try:
+        jax.eval_shape(
+            lambda *arrs: run(arrs, [], []),
+            *[t._data for t in tensor_args])
+    except Exception:
+        pass
+    finally:
+        tensor_mod.set_capture_hooks(*prev)
+
+    extra_list = list(extra.values())
+    n_main = len(tensor_args)
+
+    @jax.checkpoint
+    def prim(*arrs):
+        return run(arrs[:n_main], arrs[n_main:], extra_list)
+
+    return apply(prim, *tensor_args, *extra_list, op_name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """ref `recompute.py:496` — recompute a Sequential in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    from paddle_tpu.nn.layers.container import Sequential
+    if isinstance(functions, Sequential):
+        layers = list(functions)
+    else:
+        layers = list(functions)
+    n = len(layers)
+    seg_size = max(n // max(segments, 1), 1)
+    out = args[0] if len(args) == 1 else args
+
+    def run_segment(lo, hi):
+        def seg_fn(x):
+            for l in layers[lo:hi]:
+                x = l(x)
+            return x
+        return seg_fn
+
+    x = out
+    for lo in range(0, n, seg_size):
+        hi = min(lo + seg_size, n)
+        x = recompute(run_segment(lo, hi), x, **kwargs)
+    return x
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """ref `recompute_hybrid.py:69` — in the reference, saved activations are
+    additionally partitioned across the mp group; with remat there are no saved
+    activations to partition, so this is recompute (kept for API parity)."""
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,159 @@
+"""Device mesh management — the substrate for every parallelism strategy.
+
+Ref analog: `CommunicateTopology`/`HybridCommunicateGroup`
+(`python/paddle/distributed/fleet/base/topology.py:53,139`) which carve NCCL comm
+groups out of a 4-D dp×mp×pp×sharding grid. Here the grid IS a
+`jax.sharding.Mesh`; "comm groups" are mesh axes, and collectives ride ICI because
+XLA lays them out that way.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from paddle_tpu.core.tensor import Tensor
+
+P = PartitionSpec
+
+_global_mesh: Mesh | None = None
+
+# canonical axis order for hybrid parallelism (outer -> inner, DCN -> ICI)
+AXIS_ORDER = ("pp", "dp", "sdp", "ep", "mp", "sp")
+
+
+def default_mesh_axes():
+    return AXIS_ORDER
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh | None:
+    return _global_mesh
+
+
+def auto_mesh(dp=1, mp=1, pp=1, sp=1, ep=1, sdp=1, devices=None) -> Mesh:
+    """Build (and install) a mesh with the canonical hybrid axes, sized so that
+    the product covers the device count (dp auto-grows if every axis is 1)."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    sizes = {"pp": pp, "dp": dp, "sdp": sdp, "ep": ep, "mp": mp, "sp": sp}
+    prod = int(np.prod(list(sizes.values())))
+    if prod == 1 and n > 1:
+        sizes["dp"] = n
+        prod = n
+    if prod != n:
+        raise ValueError(
+            f"mesh axes product {prod} != device count {n}; pass explicit sizes")
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    arr = np.asarray(devs).reshape(shape)
+    mesh = Mesh(arr, AXIS_ORDER)
+    return set_mesh(mesh)
+
+
+class ProcessMesh:
+    """User-facing mesh annotation (ref: `auto_parallel/process_mesh.py`)."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+            self._shape = tuple(arr.shape)
+            self._process_ids = arr.reshape(-1).tolist()
+        else:
+            self._shape = tuple(shape or ())
+            self._process_ids = list(process_ids or range(int(np.prod(self._shape))))
+        self._dim_names = list(dim_names) if dim_names is not None else [
+            f"d{i}" for i in range(len(self._shape))]
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def to_jax(self) -> Mesh:
+        devs = np.asarray(jax.devices())[np.asarray(self._process_ids)]
+        return Mesh(devs.reshape(self._shape), tuple(self._dim_names))
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and
+                self._shape == other._shape and
+                self._process_ids == other._process_ids)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+
+def _to_jax_mesh(mesh):
+    if isinstance(mesh, ProcessMesh):
+        return mesh.to_jax()
+    return mesh
+
+
+def shard_tensor(x, mesh=None, placements=None, process_mesh=None, shard_spec=None):
+    """Place a Tensor with a NamedSharding (ref: `auto_parallel/interface.py`
+    shard_tensor annotations; here it's a physical device_put or an in-graph
+    sharding constraint)."""
+    mesh = _to_jax_mesh(mesh if mesh is not None else
+                        (process_mesh if process_mesh is not None
+                         else get_mesh()))
+    spec = placements if placements is not None else shard_spec
+    if isinstance(spec, (list, tuple)):
+        spec = PartitionSpec(*[None if s in (None, "replicate") else s
+                               for s in spec])
+    elif spec is None:
+        spec = PartitionSpec()
+    sharding = NamedSharding(mesh, spec)
+    arr = x._data if isinstance(x, Tensor) else x
+    if isinstance(arr, jax.core.Tracer):
+        out = jax.lax.with_sharding_constraint(arr, sharding)
+    else:
+        out = jax.device_put(arr, sharding)
+    if isinstance(x, Tensor):
+        t = Tensor(out, stop_gradient=x.stop_gradient, _internal=True)
+        t._grad_node = x._grad_node
+        t._out_slot = x._out_slot
+        return t
+    return out
+
+
+def shard_op(fn, mesh=None, in_specs=None, out_specs=None):
+    """Annotate an op's outputs with shardings (ref shard_op); with GSPMD this is
+    just a sharding constraint on the results."""
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        if out_specs is None:
+            return out
+        if isinstance(out, (tuple, list)):
+            return type(out)(shard_tensor(o, mesh, s)
+                             for o, s in zip(out, out_specs))
+        return shard_tensor(out, mesh, out_specs)
+
+    return wrapped
+
+
+@contextlib.contextmanager
+def mesh_context(mesh: Mesh):
+    prev = get_mesh()
+    set_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_mesh(prev)

@@ -1,0 +1,107 @@
+"""Process/env bringup (ref: `python/paddle/distributed/parallel.py:100`
+init_parallel_env — TCPStore + ProcessGroupNCCL + global Group + barrier).
+
+TPU-native: `jax.distributed.initialize` joins the multi-controller JAX cluster
+(its coordination service plays the TCPStore role); afterwards every process sees
+the global device set and collectives compile into programs. Single-process
+multi-device needs no init at all.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+
+_initialized = False
+
+
+class ParallelEnv:
+    """ref: `python/paddle/fluid/dygraph/parallel.py` ParallelEnv."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                        os.environ.get("RANK", "0")))
+        self._world_size = int(os.environ.get(
+            "PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", "1")))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._endpoints = eps.split(",") if eps else []
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def dev_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus", "0"))
+
+    @property
+    def trainer_endpoints(self):
+        return self._endpoints
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+
+def init_parallel_env():
+    """Join the cluster. Multi-process: jax.distributed.initialize using the
+    launch env (coordinator = PADDLE_MASTER / first endpoint). Single-process:
+    no-op — all local devices are already visible."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    env = ParallelEnv()
+    if env.world_size > 1 and jax.process_count() == 1:
+        coordinator = os.environ.get("PADDLE_MASTER") or (
+            env.trainer_endpoints[0] if env.trainer_endpoints else None)
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=env.world_size,
+            process_id=env.rank,
+        )
+    _initialized = True
+    return env
+
+
+def is_initialized():
+    return _initialized or jax.process_count() > 1
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.world_size
+    ws = os.environ.get("PADDLE_TRAINERS_NUM")
+    if ws is not None:
+        return int(ws)
+    return jax.process_count()
+
+
+def barrier(group=None):
+    """Block until all processes arrive (compiled psum over one scalar)."""
+    if jax.process_count() == 1:
+        import jax.numpy as jnp
+        jnp.zeros(()).block_until_ready()
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("paddle_tpu_barrier")

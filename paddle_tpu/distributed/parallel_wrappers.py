@@ -1,0 +1,75 @@
+"""DataParallel wrapper (ref: `python/paddle/fluid/dygraph/parallel.py:457` +
+EagerReducer `paddle/fluid/distributed/collective/reducer.cc:89`).
+
+TPU-native: there is no reducer. Wrapping a Layer in DataParallel marks the batch
+dimension of its inputs as sharded over the 'dp' mesh axis; under a captured train
+step GSPMD partitions the graph and inserts the gradient psum automatically —
+overlapped with backward by XLA's scheduler, which is exactly what
+MarkVarReady/FusedAllReduceSchedule (:769/:1033) hand-build in the reference.
+Eager single-process multi-device runs the same way through jit.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.distributed.mesh import get_mesh, auto_mesh
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        mesh = get_mesh()
+        if mesh is None and len(jax.devices()) > 1:
+            mesh = auto_mesh(dp=len(jax.devices()))
+        self._mesh = mesh
+        if self._mesh is not None and "dp" in self._mesh.axis_names:
+            # params replicated across dp (ref: param broadcast at init,
+            # `parallel.py` sync_params_buffers)
+            repl = NamedSharding(self._mesh, PartitionSpec())
+            for p in layers.parameters():
+                if not isinstance(p._data, jax.core.Tracer):
+                    p._write(jax.device_put(p._data, repl))
+
+    def _shard_input(self, x):
+        if self._mesh is None or "dp" not in self._mesh.axis_names:
+            return x
+        if not isinstance(x, Tensor):
+            return x
+        spec = PartitionSpec("dp", *([None] * (x.ndim - 1)))
+        sharding = NamedSharding(self._mesh, spec)
+        if isinstance(x._data, jax.core.Tracer):
+            arr = jax.lax.with_sharding_constraint(x._data, sharding)
+        else:
+            arr = jax.device_put(x._data, sharding)
+        t = Tensor(arr, stop_gradient=x.stop_gradient, _internal=True)
+        t._grad_node = x._grad_node
+        t._out_slot = x._out_slot
+        return t
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_input(x) for x in inputs)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    # pass-throughs so DataParallel is a drop-in (ref parallel.py)
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    @property
+    def parameters_(self):
+        return self._layers.parameters()

@@ -1,0 +1,92 @@
+"""ZeRO-style sharding (ref: `python/paddle/distributed/sharding/group_sharded.py:54`
+group_sharded_parallel + GroupSharded stages 2/3 under meta_parallel/sharding/).
+
+TPU-native: stage 1/2 = optimizer-state (and grad) arrays laid out sharded over the
+'dp'/'sdp' mesh axis; stage 3 = parameters themselves sharded, with XLA's SPMD
+partitioner materializing the all-gathers the reference hand-codes as forward hooks
+(`group_sharded_stage3.py:185`). Under a captured train step this is pure sharding
+annotation — ~50 lines vs the reference's ~2.5k.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.mesh import get_mesh, auto_mesh
+
+
+def _shard_spec_for(shape, mesh, axis):
+    """Shard the largest dim divisible by the axis size; replicate otherwise."""
+    size = mesh.shape[axis]
+    dims = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for d in dims:
+        if shape[d] % size == 0 and shape[d] >= size:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return PartitionSpec(*spec)
+    return PartitionSpec()
+
+
+def _place(t: Tensor, sharding):
+    if not isinstance(t._data, jax.core.Tracer):
+        t._write(jax.device_put(t._data, sharding))
+
+
+def shard_optimizer_states(optimizer, mesh=None, axis="dp"):
+    """Stage-1/2: lay optimizer accumulators out sharded over the data axis."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return optimizer
+    orig_accumulator = optimizer._accumulator
+
+    def sharded_accumulator(name, p, init=None, dtype=None):
+        t = orig_accumulator(name, p, init=init, dtype=dtype)
+        spec = _shard_spec_for(tuple(t._data.shape), mesh, axis)
+        _place(t, NamedSharding(mesh, spec))
+        return t
+
+    optimizer._accumulator = sharded_accumulator
+    return optimizer
+
+
+def shard_parameters(model, mesh=None, axis="dp"):
+    """Stage-3: shard the parameter arrays themselves."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return model
+    for p in model.parameters():
+        spec = _shard_spec_for(tuple(p._data.shape), mesh, axis)
+        _place(p, NamedSharding(mesh, spec))
+    return model
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2**23,
+                           segment_size=2**20, sync_comm=False,
+                           dp_group=None, exclude_layer=None):
+    """ref signature: `distributed/sharding/group_sharded.py:54`.
+    level: 'os' (stage1), 'os_g' (stage2), 'p_g_os' (stage3)."""
+    mesh = get_mesh()
+    if mesh is None and len(jax.devices()) > 1:
+        mesh = auto_mesh(dp=len(jax.devices()))
+    if mesh is None:
+        return model, optimizer, scaler
+    if level in ("os", "os_g", "p_g_os"):
+        shard_optimizer_states(optimizer, mesh)
+    if level == "p_g_os":
+        shard_parameters(model, mesh)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """ref: `group_sharded.py:222` — gather shards and save one logical ckpt.
+    Global arrays already hold the full logical value, so plain save works."""
+    import os
+    from paddle_tpu.framework import io as fio
+    os.makedirs(output, exist_ok=True) if not output.endswith(".pdparams") else None
+    base = output if not os.path.isdir(output) else os.path.join(output, "model")
+    fio.save(model.state_dict(), base + ".pdparams")
+    if optimizer is not None:
+        fio.save(optimizer.state_dict(), base + ".pdopt")

@@ -1,0 +1,36 @@
+"""paddle.distributed.spawn (ref: `python/paddle/distributed/spawn.py`).
+
+On TPU a host owns all its local chips through one process, so the common case is
+nprocs=1 with in-process multi-device parallelism; multi-host spawn delegates to
+the launch module's pod builder.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+
+def _worker(func, rank, nprocs, args):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    if nprocs <= 1:
+        func(*args)
+        return None
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker, args=(func, rank, nprocs, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"spawned rank failed with {p.exitcode}")
+    return procs

@@ -1,0 +1,288 @@
+"""paddle.distribution (ref: `python/paddle/distribution/`)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.ops.common import ensure_tensor
+from paddle_tpu.ops.random import default_generator
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from paddle_tpu.ops.math import exp
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc, dtype="float32") if not isinstance(
+            loc, Tensor) else loc
+        self.scale = ensure_tensor(scale, dtype="float32") if not isinstance(
+            scale, Tensor) else scale
+        super().__init__(tuple(np.broadcast_shapes(tuple(self.loc.shape),
+                                                   tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        from paddle_tpu.ops.math import square
+        return square(self.scale)
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=(), seed=0):
+        key = default_generator().next_key()
+        shp = tuple(shape) + self._batch_shape
+        eps = jax.random.normal(key, shp, jnp.float32)
+        return apply(lambda l, s: l + s * eps, self.loc, self.scale,
+                     op_name="normal_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            lambda v, l, s: -((v - l) ** 2) / (2 * s * s) - jnp.log(s) -
+            0.5 * math.log(2 * math.pi), value, self.loc, self.scale,
+            op_name="normal_log_prob")
+
+    def entropy(self):
+        return apply(lambda s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                     self.scale, op_name="normal_entropy")
+
+    def cdf(self, value):
+        value = ensure_tensor(value)
+        return apply(lambda v, l, s: 0.5 * (1 + jax.scipy.special.erf(
+            (v - l) / (s * math.sqrt(2)))), value, self.loc, self.scale,
+            op_name="normal_cdf")
+
+    def kl_divergence(self, other):
+        return apply(
+            lambda l1, s1, l2, s2: jnp.log(s2 / s1) +
+            (s1 * s1 + (l1 - l2) ** 2) / (2 * s2 * s2) - 0.5,
+            self.loc, self.scale, other.loc, other.scale, op_name="normal_kl")
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = ensure_tensor(low, dtype="float32") if not isinstance(
+            low, Tensor) else low
+        self.high = ensure_tensor(high, dtype="float32") if not isinstance(
+            high, Tensor) else high
+        super().__init__(tuple(np.broadcast_shapes(tuple(self.low.shape),
+                                                   tuple(self.high.shape))))
+
+    def sample(self, shape=(), seed=0):
+        key = default_generator().next_key()
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(key, shp, jnp.float32)
+        return apply(lambda lo, hi: lo + (hi - lo) * u, self.low, self.high,
+                     op_name="uniform_sample")
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(lambda v, lo, hi: jnp.where(
+            (v >= lo) & (v < hi), -jnp.log(hi - lo), -jnp.inf),
+            value, self.low, self.high, op_name="uniform_log_prob")
+
+    def entropy(self):
+        return apply(lambda lo, hi: jnp.log(hi - lo), self.low, self.high,
+                     op_name="uniform_entropy")
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = ensure_tensor(logits)
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    def sample(self, shape=()):
+        key = default_generator().next_key()
+        shp = tuple(shape)
+        return Tensor(jax.random.categorical(
+            key, self.logits._data, shape=shp + tuple(self.logits.shape[:-1])),
+            _internal=True)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(lambda lg, v: jnp.take_along_axis(
+            jax.nn.log_softmax(lg, -1), v[..., None].astype(jnp.int32),
+            axis=-1)[..., 0], self.logits, value, op_name="categorical_log_prob")
+
+    def probs(self, value):
+        from paddle_tpu.ops.math import exp
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        return apply(lambda lg: -jnp.sum(
+            jax.nn.softmax(lg, -1) * jax.nn.log_softmax(lg, -1), axis=-1),
+            self.logits, op_name="categorical_entropy")
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_t = ensure_tensor(probs)
+        super().__init__(tuple(self.probs_t.shape))
+
+    def sample(self, shape=()):
+        key = default_generator().next_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.bernoulli(
+            key, self.probs_t._data, shp).astype(jnp.float32), _internal=True)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(lambda p, v: v * jnp.log(jnp.clip(p, 1e-12)) +
+                     (1 - v) * jnp.log(jnp.clip(1 - p, 1e-12)),
+                     self.probs_t, value, op_name="bernoulli_log_prob")
+
+    def entropy(self):
+        return apply(lambda p: -(p * jnp.log(jnp.clip(p, 1e-12)) +
+                                 (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12))),
+                     self.probs_t, op_name="bernoulli_entropy")
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = ensure_tensor(alpha, dtype="float32") if not isinstance(
+            alpha, Tensor) else alpha
+        self.beta = ensure_tensor(beta, dtype="float32") if not isinstance(
+            beta, Tensor) else beta
+        super().__init__(tuple(np.broadcast_shapes(tuple(self.alpha.shape),
+                                                   tuple(self.beta.shape))))
+
+    def sample(self, shape=()):
+        key = default_generator().next_key()
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.beta(key, self.alpha._data, self.beta._data,
+                                      shp or None), _internal=True)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(lambda v, a, b: (a - 1) * jnp.log(v) +
+                     (b - 1) * jnp.log1p(-v) - (
+                         jax.scipy.special.gammaln(a) +
+                         jax.scipy.special.gammaln(b) -
+                         jax.scipy.special.gammaln(a + b)),
+                     value, self.alpha, self.beta, op_name="beta_log_prob")
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = ensure_tensor(concentration)
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         tuple(self.concentration.shape[-1:]))
+
+    def sample(self, shape=()):
+        key = default_generator().next_key()
+        return Tensor(jax.random.dirichlet(
+            key, self.concentration._data, tuple(shape) + self._batch_shape),
+            _internal=True)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            lambda v, c: jnp.sum((c - 1) * jnp.log(v), -1) +
+            jax.scipy.special.gammaln(jnp.sum(c, -1)) -
+            jnp.sum(jax.scipy.special.gammaln(c), -1),
+            value, self.concentration, op_name="dirichlet_log_prob")
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_t = ensure_tensor(probs)
+        super().__init__(tuple(self.probs_t.shape[:-1]),
+                         tuple(self.probs_t.shape[-1:]))
+
+    def sample(self, shape=()):
+        key = default_generator().next_key()
+        p = self.probs_t._data
+        n = self.total_count
+        cat = jax.random.categorical(
+            key, jnp.log(p), shape=tuple(shape) + (n,) + tuple(p.shape[:-1]))
+        onehot = jax.nn.one_hot(cat, p.shape[-1])
+        return Tensor(jnp.sum(onehot, axis=len(tuple(shape))), _internal=True)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            lambda v, p: jax.scipy.special.gammaln(jnp.sum(v, -1) + 1) -
+            jnp.sum(jax.scipy.special.gammaln(v + 1), -1) +
+            jnp.sum(v * jnp.log(jnp.clip(p, 1e-12)), -1),
+            value, self.probs_t, op_name="multinomial_log_prob")
+
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is not None:
+        return fn(p, q)
+    if hasattr(p, "kl_divergence") and type(p) is type(q):
+        return p.kl_divergence(q)
+    raise NotImplementedError(f"no KL registered for {type(p)} / {type(q)}")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return apply(lambda lp, lq: jnp.sum(
+        jax.nn.softmax(lp, -1) * (jax.nn.log_softmax(lp, -1) -
+                                  jax.nn.log_softmax(lq, -1)), -1),
+        p.logits, q.logits, op_name="categorical_kl")

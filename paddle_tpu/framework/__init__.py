@@ -1,0 +1,13 @@
+"""Framework utilities: save/load, flags, ParamAttr, seeding."""
+from paddle_tpu.framework.io import save, load  # noqa: F401
+from paddle_tpu.framework.flags import get_flags, set_flags, define_flag  # noqa: F401
+from paddle_tpu.framework.param_attr import ParamAttr  # noqa: F401
+from paddle_tpu.ops.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from paddle_tpu.core.dtype import (  # noqa: F401
+    set_default_dtype, get_default_dtype,
+)
+from paddle_tpu.core.tensor import Parameter  # noqa: F401
+
+
+def random_seed(s):
+    return seed(s)

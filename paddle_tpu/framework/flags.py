@@ -1,0 +1,89 @@
+"""Runtime flag registry (ref: gflags system `paddle/fluid/platform/flags.cc` with
+`ExportedFlagInfoMap`, python `get_flags/set_flags` at
+`python/paddle/fluid/framework.py:7611,7636`).
+
+Flags are read from env ``FLAGS_*`` at import and mutable at runtime.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass
+class FlagInfo:
+    name: str
+    default: Any
+    doc: str
+    parser: Callable[[str], Any]
+    value: Any = None
+    on_change: Callable[[Any], None] | None = None
+
+
+_REGISTRY: dict[str, FlagInfo] = {}
+
+
+def _parse_bool(s):
+    return str(s).lower() in ("1", "true", "yes", "on")
+
+
+def define_flag(name, default, doc="", parser=None, on_change=None):
+    if parser is None:
+        if isinstance(default, bool):
+            parser = _parse_bool
+        elif isinstance(default, int):
+            parser = int
+        elif isinstance(default, float):
+            parser = float
+        else:
+            parser = str
+    info = FlagInfo(name, default, doc, parser, default, on_change)
+    env = os.environ.get(f"FLAGS_{name}")
+    if env is not None:
+        info.value = parser(env)
+    _REGISTRY[name] = info
+    return info
+
+
+def get_flags(flags):
+    single = isinstance(flags, str)
+    names = [flags] if single else list(flags)
+    out = {}
+    for n in names:
+        n = n.removeprefix("FLAGS_")
+        if n not in _REGISTRY:
+            raise ValueError(f"unknown flag {n}")
+        out[f"FLAGS_{n}"] = _REGISTRY[n].value
+    return out
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        n = k.removeprefix("FLAGS_")
+        if n not in _REGISTRY:
+            raise ValueError(f"unknown flag {n}")
+        info = _REGISTRY[n]
+        info.value = info.parser(v) if isinstance(v, str) else v
+        if info.on_change:
+            info.on_change(info.value)
+
+
+def flag_value(name):
+    return _REGISTRY[name].value
+
+
+# ---- core flags (TPU-meaningful subset of the reference's 77) -------------------
+define_flag("check_nan_inf", False,
+            "check outputs of every op for nan/inf (ref FLAGS_check_nan_inf)")
+define_flag("benchmark", False, "sync after each op for timing")
+define_flag("paddle_num_threads", 1, "host compute threads")
+define_flag("use_bfloat16_matmul", False,
+            "run fp32 matmuls in bf16 on the MXU (TPU-specific speed knob)")
+define_flag("seed", 0, "global random seed (0 = nondeterministic)")
+define_flag("log_level", "INFO", "framework log level")
+define_flag("allocator_strategy", "xla",
+            "kept for compat; XLA/PJRT owns device memory on TPU")
+define_flag("eager_delete_tensor_gb", 0.0, "kept for compat; XLA GC is automatic")
+define_flag("tpu_donate_buffers", True,
+            "donate param/opt-state buffers in captured train steps")

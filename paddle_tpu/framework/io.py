@@ -1,0 +1,113 @@
+"""paddle.save / paddle.load (ref: `python/paddle/framework/io.py:639,881`).
+
+Serialization: nested python structures are pickled with tensors swapped for a
+placeholder; tensor payloads go in a sidecar .npz-style container written with numpy
+(ref analog: `phi/core/serialization.cc` tensor codec). Single-file on-disk format.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, Parameter
+
+_MAGIC = b"PDTPU001"
+
+
+class _TensorRef:
+    __slots__ = ("idx", "is_param", "stop_gradient", "name")
+
+    def __init__(self, idx, is_param, stop_gradient, name):
+        self.idx = idx
+        self.is_param = is_param
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _pack(obj):
+    tensors = []
+
+    def convert(o):
+        if isinstance(o, Tensor):
+            tensors.append(np.asarray(o._data))
+            return _TensorRef(len(tensors) - 1, isinstance(o, Parameter),
+                              o.stop_gradient, o.name)
+        if isinstance(o, dict):
+            return {k: convert(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            converted = [convert(v) for v in o]
+            return type(o)(converted) if not isinstance(o, tuple) else tuple(converted)
+        return o
+
+    return convert(obj), tensors
+
+
+def _unpack(obj, tensors, return_numpy=False):
+    def convert(o):
+        if isinstance(o, _TensorRef):
+            arr = tensors[o.idx]
+            if return_numpy:
+                return arr
+            import jax.numpy as jnp
+            cls = Parameter if o.is_param else Tensor
+            if o.is_param:
+                t = Parameter(jnp.asarray(arr), trainable=not o.stop_gradient)
+            else:
+                t = Tensor(jnp.asarray(arr), stop_gradient=o.stop_gradient,
+                           _internal=True)
+            t.name = o.name
+            return t
+        if isinstance(o, dict):
+            return {k: convert(v) for k, v in o.items()}
+        if isinstance(o, list):
+            return [convert(v) for v in o]
+        if isinstance(o, tuple):
+            return tuple(convert(v) for v in o)
+        return o
+
+    return convert(obj)
+
+
+def save(obj, path, protocol=4, **configs):
+    """Save a nested structure of Tensors/state_dicts to one file."""
+    if hasattr(obj, "state_dict") and callable(obj.state_dict) and not isinstance(
+            obj, dict):
+        obj = obj.state_dict()
+    tree, tensors = _pack(obj)
+    meta = pickle.dumps(tree, protocol=protocol)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(meta)))
+        f.write(meta)
+        f.write(struct.pack("<I", len(tensors)))
+        for arr in tensors:
+            buf = _io.BytesIO()
+            np.save(buf, arr, allow_pickle=False)
+            payload = buf.getvalue()
+            f.write(struct.pack("<Q", len(payload)))
+            f.write(payload)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            # fall back to plain pickle (interop with files saved by other tools)
+            f.seek(0)
+            return pickle.load(f)
+        (meta_len,) = struct.unpack("<Q", f.read(8))
+        tree = pickle.loads(f.read(meta_len))
+        (n,) = struct.unpack("<I", f.read(4))
+        tensors = []
+        for _ in range(n):
+            (plen,) = struct.unpack("<Q", f.read(8))
+            buf = _io.BytesIO(f.read(plen))
+            tensors.append(np.load(buf, allow_pickle=False))
+    return _unpack(tree, tensors, return_numpy=return_numpy)

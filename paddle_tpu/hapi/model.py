@@ -1,0 +1,219 @@
+"""High-level Model API (ref: `python/paddle/hapi/model.py:1004` — Model.fit :1696,
+train_batch :1145; the dygraph adapter :732 is the only execution path here)."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core.autograd import no_grad
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+        return self
+
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            return outputs
+        if isinstance(labels, (list, tuple)):
+            return self._loss(outputs, *labels)
+        return self._loss(outputs, labels)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(outputs, labels))
+            metrics.append(m.accumulate())
+        return ([float(loss.numpy())], metrics) if metrics else \
+            [float(loss.numpy())]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(outputs, labels))
+            metrics.append(m.accumulate())
+        return ([float(loss.numpy())], metrics) if metrics else \
+            [float(loss.numpy())]
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        out = self.network(*inputs)
+        return [out.numpy()] if isinstance(out, Tensor) else \
+            [o.numpy() for o in out]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from paddle_tpu.hapi.callbacks import config_callbacks
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=self._len_or_none(train_loader),
+                                log_freq=log_freq, save_freq=save_freq,
+                                save_dir=save_dir, verbose=verbose,
+                                metrics=["loss"] + self._metric_names())
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_batch_begin("train", step, logs)
+                ins, labels = self._split_batch(batch)
+                result = self.train_batch(ins, labels)
+                logs = self._result_to_logs(result, step, batch_size)
+                cbks.on_batch_end("train", step, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, batch_size)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+        cbks.on_end("train", logs if "logs" in dir() else {})
+        return self
+
+    def _run_eval(self, eval_loader, batch_size):
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in eval_loader:
+            ins, labels = self._split_batch(batch)
+            result = self.eval_batch(ins, labels)
+            loss = result[0] if isinstance(result, tuple) else result
+            losses.append(loss[0])
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            name = m.name()
+            acc = m.accumulate()
+            if isinstance(name, list):
+                for n, a in zip(name, acc if isinstance(acc, list) else [acc]):
+                    logs[n] = a
+            else:
+                logs[name] = acc
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        if isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+        return self._run_eval(eval_loader, batch_size)
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, predict=True)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    def _split_batch(self, batch, predict=False):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), batch[-1]
+        return [batch], None
+
+    def _metric_names(self):
+        names = []
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    def _result_to_logs(self, result, step, batch_size):
+        logs = {"step": step, "batch_size": batch_size}
+        if isinstance(result, tuple):
+            loss, metrics = result
+            logs["loss"] = loss[0]
+            for name, v in zip(self._metric_names(), metrics):
+                logs[name] = v
+        else:
+            logs["loss"] = result[0]
+        return logs
+
+    def _len_or_none(self, loader):
+        try:
+            return len(loader)
+        except Exception:
+            return None
+
+    def save(self, path, training=True):
+        from paddle_tpu.framework import io as fio
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+        from paddle_tpu.framework import io as fio
+        self.network.set_state_dict(fio.load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fio.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from paddle_tpu.hapi import summary as s
+        return s(self.network, input_size, dtypes=dtype)

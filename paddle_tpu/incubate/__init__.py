@@ -1,0 +1,19 @@
+"""paddle.incubate (ref: `python/paddle/incubate/`) — fused transformer APIs, MoE,
+autograd prims. Fused ops route to the Pallas kernels / XLA fusions."""
+from paddle_tpu.incubate import nn  # noqa: F401
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Fused causal-masked softmax (ref `incubate/operators/`)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.autograd import apply
+    from paddle_tpu.ops.common import ensure_tensor
+    x = ensure_tensor(x)
+
+    def prim(a):
+        q, k = a.shape[-2], a.shape[-1]
+        mask = jnp.tril(jnp.ones((q, k), bool), k=k - q)
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
+
+    return apply(prim, x, op_name="softmax_mask_fuse_upper_triangle")

@@ -1,0 +1,18 @@
+"""paddle.jit — dygraph-to-static on TPU.
+
+Reference analog (CS5 in SURVEY.md): `@to_static` AST-transforms Python into a
+ProgramDesc and runs it as one `run_program` op
+(`python/paddle/jit/dy2static/program_translator.py:283`,
+`paddle/fluid/operators/run_program_op.cc`).
+
+TPU-native design: no AST rewriting. The SAME imperative code (Layer forward,
+loss.backward(), optimizer.step()) is *re-traced under jax.jit*: because the tape
+autograd is built from jax.vjp closures it traces straight through, and every Tensor
+mutation (param update, RNG state split, BN running stats) is captured by read/write
+hooks and threaded as explicit state inputs/outputs of one compiled, donated XLA
+program. Steady state = one executable replay, the same shape as InterpreterCore's
+instruction replay (`new_executor/interpretercore.cc:211`) but compiled.
+"""
+from paddle_tpu.jit.static_function import to_static, StaticFunction, not_to_static  # noqa: F401
+from paddle_tpu.jit.save_load import save, load, TranslatedLayer  # noqa: F401
+from paddle_tpu.jit.static_function import ignore_module  # noqa: F401

@@ -1,0 +1,126 @@
+"""jit.save / jit.load (ref: `python/paddle/fluid/dygraph/jit.py` ->
+TranslatedLayer in `fluid/dygraph/io.py`).
+
+Artifact = state_dict + the jax export of the captured forward (AOT StableHLO via
+jax.export when available), so a saved model reloads without the original python
+class — the same contract as the reference's Program+params artifact.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.framework import io as fio
+from paddle_tpu.nn.layer import Layer
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, t, name=None):
+        return cls(tuple(t.shape), str(t.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize a Layer (params + exported forward graph)."""
+    from paddle_tpu.core import dtype as dtype_mod
+    state = layer.state_dict() if isinstance(layer, Layer) else layer
+    fio.save(state, path + ".pdiparams")
+
+    exported_blob = None
+    spec_meta = None
+    if input_spec is not None and isinstance(layer, Layer):
+        specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+                 for s in input_spec]
+        spec_meta = [(s.shape, str(np.dtype(dtype_mod.convert_dtype(s.dtype))))
+                     for s in specs]
+        try:
+            from jax import export as jax_export
+            params = {k: v._data for k, v in state.items()}
+
+            def pure_forward(params, *xs):
+                saved = {k: t._data for k, t in state.items()}
+                try:
+                    for k, t in state.items():
+                        t._data = params[k]
+                    outs = layer(*[Tensor(x, _internal=True) for x in xs])
+                    multi = isinstance(outs, (tuple, list))
+                    return [o._data for o in (outs if multi else [outs])]
+                finally:
+                    for k, t in state.items():
+                        t._data = saved[k]
+
+            args = [jax.ShapeDtypeStruct(
+                tuple(1 if d == -1 else d for d in s.shape),
+                np.dtype(dtype_mod.convert_dtype(s.dtype))) for s in specs]
+            exp = jax_export.export(jax.jit(pure_forward))(
+                {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for k, v in params.items()}, *args)
+            exported_blob = exp.serialize()
+        except Exception:
+            exported_blob = None  # fall back to state-dict-only artifact
+
+    meta = {"class": type(layer).__name__, "input_spec": spec_meta,
+            "has_export": exported_blob is not None}
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+        if exported_blob is not None:
+            f.write(exported_blob)
+
+
+class TranslatedLayer(Layer):
+    """Runs a deserialized exported computation (ref `TranslatedLayer`)."""
+
+    def __init__(self, state_dict, exported=None):
+        super().__init__()
+        self._state = state_dict
+        for k, v in state_dict.items():
+            safe = k.replace(".", "__")
+            if isinstance(v, Tensor):
+                self.register_buffer(safe, v)
+        self._exported = exported
+
+    def forward(self, *inputs):
+        if self._exported is None:
+            raise RuntimeError(
+                "this artifact holds parameters only (no exported graph); "
+                "rebuild the Layer class and call set_state_dict")
+        params = {k: v._data for k, v in self._state.items()}
+        arrs = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in inputs]
+        outs = self._exported.call(params, *arrs)
+        wrapped = [Tensor(o, _internal=True) for o in outs]
+        return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
+
+    def state_dict(self, *a, **k):
+        return dict(self._state)
+
+
+def load(path, **configs):
+    state = fio.load(path + ".pdiparams")
+    exported = None
+    model_path = path + ".pdmodel"
+    if os.path.exists(model_path):
+        with open(model_path, "rb") as f:
+            meta = pickle.load(f)
+            if meta.get("has_export"):
+                blob = f.read()
+                try:
+                    from jax import export as jax_export
+                    exported = jax_export.deserialize(blob)
+                except Exception:
+                    exported = None
+    return TranslatedLayer(state, exported)

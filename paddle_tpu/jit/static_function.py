@@ -1,0 +1,268 @@
+"""StaticFunction: whole-program capture of imperative code into one jitted XLA
+computation (see package docstring; ref `program_translator.py:283,399,904,1040`).
+
+Capture protocol:
+1. cold call: run the function once with read/write hooks installed on Tensor.
+   Every Tensor whose concrete array is *read* becomes a state input; every Tensor
+   *written* becomes a state output. RNG state and BN running stats participate
+   automatically because they are themselves Tensors.
+2. build ``pure(state_arrays, arg_arrays) -> (out_arrays, new_state_arrays)`` that
+   replays the python under jax.jit (donating state buffers), keyed by input
+   shapes/dtypes like ProgramCache (`program_translator.py:1040`).
+3. steady state: call the compiled executable, write state back into the same
+   Tensor objects.
+"""
+from __future__ import annotations
+
+import functools
+import weakref
+from typing import Any
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import tensor as tensor_mod
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.framework.flags import flag_value
+
+_IGNORED_MODULES: set = set()
+
+
+def ignore_module(modules):
+    _IGNORED_MODULES.update(modules)
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    fn._not_to_static = True
+    return fn
+
+
+class _CaptureSet:
+    """Read/write sets observed during a capture run."""
+
+    def __init__(self):
+        self.reads: dict[int, Tensor] = {}
+        self.writes: dict[int, Tensor] = {}
+        self.order: list[int] = []
+
+    def on_read(self, t: Tensor):
+        key = id(t)
+        if key not in self.reads:
+            self.reads[key] = t
+            self.order.append(key)
+
+    def on_write(self, t: Tensor):
+        key = id(t)
+        self.writes[key] = t
+        if key not in self.reads:
+            # written-then-read later in the fn: treat as state too so the final
+            # value escapes
+            self.reads.setdefault(key, t)
+            self.order.append(key)
+
+
+def _tree_flatten_tensors(obj):
+    """Flatten nested python structures, extracting Tensors; returns
+    (arrays, treedef-rebuilder)."""
+    tensors = []
+
+    def rec(o):
+        if isinstance(o, Tensor):
+            tensors.append(o)
+            return ("__T__", len(tensors) - 1)
+        if isinstance(o, dict):
+            return {k: rec(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            items = [rec(v) for v in o]
+            return ("__L__", type(o).__name__, items)
+        return ("__C__", o)
+
+    spec = rec(obj)
+
+    def rebuild(spec, values, wrap):
+        if isinstance(spec, tuple) and spec and spec[0] == "__T__":
+            return wrap(values[spec[1]])
+        if isinstance(spec, tuple) and spec and spec[0] == "__C__":
+            return spec[1]
+        if isinstance(spec, tuple) and spec and spec[0] == "__L__":
+            seq = [rebuild(s, values, wrap) for s in spec[2]]
+            return tuple(seq) if spec[1] == "tuple" else seq
+        if isinstance(spec, dict):
+            return {k: rebuild(v, values, wrap) for k, v in spec.items()}
+        return spec
+
+    return tensors, spec, rebuild
+
+
+def _sig_of(args, kwargs):
+    parts = []
+
+    def rec(o):
+        if isinstance(o, Tensor):
+            parts.append(("T", tuple(o._data.shape), str(o.dtype),
+                          o.stop_gradient))
+        elif isinstance(o, (list, tuple)):
+            parts.append(("L", len(o)))
+            for v in o:
+                rec(v)
+        elif isinstance(o, dict):
+            parts.append(("D", tuple(sorted(o))))
+            for k in sorted(o):
+                rec(o[k])
+        else:
+            parts.append(("C", repr(o)))
+
+    rec(args)
+    rec(kwargs)
+    return tuple(parts)
+
+
+class _Compiled:
+    __slots__ = ("jitted", "state_tensors", "out_spec", "out_rebuild",
+                 "n_out_tensors", "out_stop_grads")
+
+    def __init__(self, jitted, state_tensors, out_spec, out_rebuild,
+                 n_out_tensors, out_stop_grads):
+        self.jitted = jitted
+        self.state_tensors = state_tensors
+        self.out_spec = out_spec
+        self.out_rebuild = out_rebuild
+        self.n_out_tensors = n_out_tensors
+        self.out_stop_grads = out_stop_grads
+
+
+class StaticFunction:
+    def __init__(self, function, input_spec=None, build_strategy=None,
+                 backend=None, donate_state=None, **kwargs):
+        self._fn = function
+        self._cache: dict[Any, _Compiled] = {}
+        self._input_spec = input_spec
+        self._donate = flag_value("tpu_donate_buffers") if donate_state is None \
+            else donate_state
+        functools.update_wrapper(self, function)
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = functools.partial(self.__call__, instance)
+        bound.__wrapped__ = self._fn
+        return bound
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._fn)
+
+    def concrete_program(self, *args, **kwargs):
+        key = _sig_of(args, kwargs)
+        return self._cache.get(key)
+
+    def __call__(self, *args, **kwargs):
+        key = _sig_of(args, kwargs)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._capture(key, args, kwargs)
+        arg_tensors, _, _ = _tree_flatten_tensors((args, kwargs))
+        state_in = [t._data for t in compiled.state_tensors]
+        arg_in = [t._data for t in arg_tensors]
+        outs = compiled.jitted(state_in, arg_in)
+        out_arrays, new_state = outs
+        for t, arr in zip(compiled.state_tensors, new_state):
+            t._data = arr  # direct rebind; hooks not needed outside capture
+        values = list(out_arrays)
+
+        def wrap(i_arr):
+            idx, arr = i_arr
+            t = Tensor(arr, stop_gradient=compiled.out_stop_grads[idx],
+                       _internal=True)
+            return t
+
+        wrapped = [wrap((i, a)) for i, a in enumerate(values)]
+        return compiled.out_rebuild(compiled.out_spec, wrapped, lambda t: t)
+
+    # ------------------------------------------------------------------ capture
+
+    def _capture(self, key, args, kwargs):
+        fn = self._fn
+        cap = _CaptureSet()
+        arg_tensors, _, _ = _tree_flatten_tensors((args, kwargs))
+        arg_ids = {id(t) for t in arg_tensors}
+
+        prev = tensor_mod.set_capture_hooks(
+            lambda t: (id(t) not in arg_ids) and cap.on_read(t),
+            lambda t: (id(t) not in arg_ids) and cap.on_write(t))
+        prev_active = tensor_mod.set_capture_active(True)
+        try:
+            # phase 1: eager probe run records read/write sets (also warms any
+            # data-dependent python control flow for this input signature)
+            result = fn(*args, **kwargs)
+        finally:
+            tensor_mod.set_capture_hooks(*prev)
+            tensor_mod.set_capture_active(prev_active)
+
+        state_tensors = [cap.reads[k] for k in cap.order]
+        written_ids = set(cap.writes)
+        out_tensors, out_spec, out_rebuild = _tree_flatten_tensors(result)
+        out_stop_grads = [t.stop_gradient for t in out_tensors]
+
+        # phase 2: build the pure function and jit it
+        def pure(state_arrays, arg_arrays):
+            saved_state = [t._data for t in state_tensors]
+            saved_args = [t._data for t in arg_tensors]
+            saved_nodes = [(t._grad_node, t._out_slot, t._grad)
+                           for t in state_tensors + arg_tensors]
+            for t, a in zip(state_tensors, state_arrays):
+                t._data = a
+                t._grad_node = None
+                t._grad = None
+            for t, a in zip(arg_tensors, arg_arrays):
+                t._data = a
+                t._grad_node = None
+            prev_active = tensor_mod.set_capture_active(True)
+            try:
+                res = fn(*args, **kwargs)
+                res_tensors, _, _ = _tree_flatten_tensors(res)
+                out_arrays = [t._data for t in res_tensors]
+                new_state = [t._data for t in state_tensors]
+                return out_arrays, new_state
+            finally:
+                tensor_mod.set_capture_active(prev_active)
+                for t, a in zip(state_tensors, saved_state):
+                    t._data = a
+                for t, a in zip(arg_tensors, saved_args):
+                    t._data = a
+                for t, (n, s, g) in zip(state_tensors + arg_tensors, saved_nodes):
+                    t._grad_node = n
+                    t._out_slot = s
+                    t._grad = g
+
+        donate = (0,) if self._donate else ()
+        jitted = jax.jit(pure, donate_argnums=donate)
+        compiled = _Compiled(jitted, state_tensors, out_spec, out_rebuild,
+                             len(out_tensors), out_stop_grads)
+        self._cache[key] = compiled
+        return compiled
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    """Decorator/wrapper turning imperative code into one compiled XLA program."""
+    def decorate(fn):
+        if isinstance(fn, StaticFunction):
+            return fn
+        from paddle_tpu.nn.layer import Layer
+        if isinstance(fn, Layer):
+            layer = fn
+            layer.forward = StaticFunction(layer.forward.__func__).__get__(
+                layer, type(layer))
+            return layer
+        return StaticFunction(fn, input_spec=input_spec,
+                              build_strategy=build_strategy, backend=backend,
+                              **kwargs)
+
+    if function is not None:
+        return decorate(function)
+    return decorate

@@ -1,0 +1,2 @@
+"""Pallas/Mosaic TPU kernels — the hot-op tier (SURVEY.md §7 native component 2,
+counterpart of `paddle/fluid/operators/fused/`)."""

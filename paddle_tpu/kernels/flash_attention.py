@@ -1,0 +1,109 @@
+"""Flash attention for TPU.
+
+Counterpart of `paddle/fluid/operators/fused/fused_attention_op.cu` — which is
+non-flash (`fmha_ref.h`), so this is strictly beyond reference parity (SURVEY.md
+§5.7 requires it). Strategy:
+
+1. Pallas TPU flash kernel (jax.experimental.pallas.ops.tpu.flash_attention) when
+   shapes are TPU-tileable (seq multiple of block, head_dim aligned);
+2. otherwise a blockwise online-softmax attention in pure lax (still O(S) memory
+   via jax.checkpoint-friendly scan), which XLA fuses well.
+
+Layout note: paddle uses [B, S, H, D]; the pallas op uses [B, H, S, D].
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_PALLAS_OK = None
+
+
+def _try_pallas():
+    global _PALLAS_OK, _pallas_fa
+    if _PALLAS_OK is None:
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as _fa, BlockSizes)
+            _pallas_fa = _fa
+            _PALLAS_OK = jax.default_backend() == "tpu"
+        except Exception:
+            _PALLAS_OK = False
+    return _PALLAS_OK
+
+
+def _blockwise_attention(q, k, v, causal, scale, block_k=512):
+    """Online-softmax attention scanning over K blocks (lax fallback)."""
+    # q,k,v: [B, H, S, D]
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    s = scale if scale is not None else 1.0 / math.sqrt(D)
+    q = q * s
+    nblocks = max((Sk + block_k - 1) // block_k, 1)
+    pad = nblocks * block_k - Sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = k.reshape(B, H, nblocks, block_k, D)
+    vb = v.reshape(B, H, nblocks, block_k, D)
+    q_idx = jnp.arange(Sq)
+
+    def body(carry, blk):
+        m_prev, l_prev, acc = carry
+        kk, vv, base = blk
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, kk,
+                            preferred_element_type=jnp.float32)
+        kpos = base + jnp.arange(block_k)
+        valid = kpos < Sk
+        if causal:
+            valid = valid[None, :] & (kpos[None, :] <= (
+                q_idx + (Sk - Sq))[:, None])
+            logits = jnp.where(valid[None, None], logits, -jnp.inf)
+        else:
+            logits = jnp.where(valid[None, None, None], logits, -jnp.inf)
+        m_cur = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # guard fully-masked rows
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(logits - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(vv.dtype), vv,
+            preferred_element_type=jnp.float32)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    bases = jnp.arange(nblocks) * block_k
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m0, l0, acc0),
+        (jnp.moveaxis(kb, 2, 0), jnp.moveaxis(vb, 2, 0), bases))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def flash_attention_fn(causal=False, scale=None):
+    """Returns a pure fn(q, k, v) on paddle-layout [B, S, H, D] tensors."""
+
+    def fn(q, k, v):
+        # -> [B, H, S, D]
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        S, D = qt.shape[2], qt.shape[3]
+        use_pallas = (_try_pallas() and S % 128 == 0 and D % 128 == 0
+                      and qt.dtype in (jnp.float32, jnp.bfloat16))
+        if use_pallas:
+            sm = scale if scale is not None else 1.0 / math.sqrt(D)
+            out = _pallas_fa(qt * sm, kt, vt, causal=causal, sm_scale=1.0)
+        else:
+            out = _blockwise_attention(qt, kt, vt, causal, scale)
+        return jnp.swapaxes(out, 1, 2)
+
+    return fn

@@ -1,0 +1,47 @@
+"""paddle.nn surface (ref: `python/paddle/nn/__init__.py`)."""
+from paddle_tpu.nn.layer import Layer  # noqa: F401
+from paddle_tpu.nn import functional  # noqa: F401
+from paddle_tpu.nn import initializer  # noqa: F401
+from paddle_tpu.nn.layers.container import (  # noqa: F401
+    Sequential, LayerList, ParameterList, LayerDict,
+)
+from paddle_tpu.nn.layers.common import (  # noqa: F401
+    Linear, Identity, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Flatten, Unflatten, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    Bilinear, CosineSimilarity, PairwiseDistance, Pad1D, Pad2D, Pad3D, ZeroPad2D,
+    PixelShuffle, PixelUnshuffle, ChannelShuffle,
+)
+from paddle_tpu.nn.layers.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+)
+from paddle_tpu.nn.layers.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
+    GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LocalResponseNorm,
+    SpectralNorm,
+)
+from paddle_tpu.nn.layers.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+)
+from paddle_tpu.nn.layers.activation import (  # noqa: F401
+    ReLU, ReLU6, Sigmoid, Tanh, LeakyReLU, ELU, CELU, SELU, GELU, Hardshrink,
+    Hardsigmoid, Hardswish, Hardtanh, Mish, Silu, Swish, Softplus, Softshrink,
+    Softsign, Tanhshrink, ThresholdedReLU, LogSigmoid, Softmax, LogSoftmax,
+    Maxout, GLU, PReLU, RReLU,
+)
+from paddle_tpu.nn.layers.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, MarginRankingLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, CTCLoss,
+)
+from paddle_tpu.nn.layers.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from paddle_tpu.nn.layers.rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM,
+    GRU,
+)
+from paddle_tpu.nn import utils  # noqa: F401
+from paddle_tpu.nn.clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401

@@ -1,0 +1,15 @@
+"""paddle.nn.functional surface (ref: `python/paddle/nn/functional/__init__.py`)."""
+from paddle_tpu.nn.functional.activation import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.common import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.conv import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose, conv3d_transpose,
+)
+from paddle_tpu.nn.functional.pooling import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.norm import (  # noqa: F401
+    batch_norm, layer_norm, instance_norm, group_norm, local_response_norm,
+    spectral_norm,
+)
+from paddle_tpu.nn.functional.loss import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.attention import (  # noqa: F401
+    scaled_dot_product_attention, sequence_mask,
+)

@@ -1,0 +1,175 @@
+"""Activation functionals (ref: `python/paddle/nn/functional/activation.py`).
+
+All map to jax.nn / jnp primitives that XLA fuses into surrounding matmuls — the
+reference needs dedicated CUDA kernels per activation (`phi/kernels/gpu/activation_kernel.cu`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.ops.common import ensure_tensor, unary
+
+relu = unary(jax.nn.relu, "relu")
+relu6 = unary(lambda a: jnp.clip(a, 0, 6), "relu6")
+sigmoid = unary(jax.nn.sigmoid, "sigmoid")
+tanh = unary(jnp.tanh, "tanh")
+softplus_ = jax.nn.softplus
+silu = unary(jax.nn.silu, "silu")
+swish = silu
+mish = unary(lambda a: a * jnp.tanh(jax.nn.softplus(a)), "mish")
+hardswish = unary(lambda a: a * jnp.clip(a + 3, 0, 6) / 6, "hardswish")
+hardsigmoid = unary(lambda a: jnp.clip(a / 6 + 0.5, 0, 1), "hardsigmoid")
+tanhshrink = unary(lambda a: a - jnp.tanh(a), "tanhshrink")
+
+
+def relu_(x):
+    from paddle_tpu.ops.common import rebind, inplace_guard
+    inplace_guard(x)
+    return rebind(x, relu(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), x,
+                 op_name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def prim(a, w):
+        if w.size > 1:
+            ax = 1 if data_format == "NCHW" else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[ax] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, a * w)
+
+    return apply(prim, x, weight, op_name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.333, training=False, name=None):
+    x = ensure_tensor(x)
+    if training:
+        from paddle_tpu.ops.random import default_generator
+        key = default_generator().next_key()
+        return apply(lambda a: jnp.where(
+            a >= 0, a, a * jax.random.uniform(key, a.shape, a.dtype, lower, upper)),
+            x, op_name="rrelu")
+    mid = (lower + upper) / 2.0
+    return apply(lambda a: jnp.where(a >= 0, a, a * mid), x, op_name="rrelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jax.nn.elu(a, alpha), x, op_name="elu")
+
+
+def elu_(x, alpha=1.0, name=None):
+    from paddle_tpu.ops.common import rebind, inplace_guard
+    inplace_guard(x)
+    return rebind(x, elu(x, alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jax.nn.celu(a, alpha), x, op_name="celu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x,
+                 op_name="selu")
+
+
+def gelu(x, approximate=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), x,
+                 op_name="gelu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.clip(a, min, max), x, op_name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0).astype(a.dtype),
+                 x, op_name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.where(a > threshold, a - threshold,
+                                     jnp.where(a < -threshold, a + threshold, 0.0)
+                                     ).astype(a.dtype), x, op_name="softshrink")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.where(a * beta > threshold, a,
+                                     jax.nn.softplus(a * beta) / beta), x,
+                 op_name="softplus")
+
+
+def softsign(x, name=None):
+    x = ensure_tensor(x)
+    return apply(jax.nn.soft_sign, x, op_name="softsign")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.where(a > threshold, a, value).astype(a.dtype), x,
+                 op_name="thresholded_relu")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply(lambda a: jax.nn.softmax(a, axis=axis), x, op_name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from paddle_tpu.ops.common import rebind, inplace_guard
+    inplace_guard(x)
+    return rebind(x, softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply(lambda a: jax.nn.log_softmax(a, axis=axis), x,
+                 op_name="log_softmax")
+
+
+def log_sigmoid(x, name=None):
+    x = ensure_tensor(x)
+    return apply(jax.nn.log_sigmoid, x, op_name="log_sigmoid")
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return apply(prim, x, op_name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jax.nn.glu(a, axis=axis), x, op_name="glu")
+
+
+def tanh_(x):
+    from paddle_tpu.ops.common import rebind, inplace_guard
+    inplace_guard(x)
+    return rebind(x, tanh(x))

@@ -1,0 +1,66 @@
+"""Attention functionals.
+
+`scaled_dot_product_attention` routes to the Pallas flash-attention kernel on TPU
+when shapes allow (ref counterpart: `paddle/fluid/operators/fused/fused_attention_op.cu`
+which uses non-flash fmha_ref.h — flash here is strictly better), with an XLA
+fallback that fuses fine for short sequences.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.ops.common import ensure_tensor
+
+
+def _sdpa_xla(q, k, v, mask, dropout_p, is_causal, scale):
+    # q,k,v: [B, S, H, D] (paddle convention)
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
+    if is_causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, scale=None, training=True,
+                                 name=None):
+    query, key, value = (ensure_tensor(query), ensure_tensor(key),
+                         ensure_tensor(value))
+    use_flash = attn_mask is None and dropout_p == 0.0
+    if use_flash:
+        try:
+            from paddle_tpu.kernels.flash_attention import flash_attention_fn
+            fn = flash_attention_fn(causal=is_causal, scale=scale)
+            return apply(fn, query, key, value, op_name="flash_attention")
+        except Exception:
+            pass
+    ts = [query, key, value]
+    has_mask = attn_mask is not None
+    if has_mask:
+        ts.append(ensure_tensor(attn_mask))
+
+    def prim(q, k, v, *m):
+        return _sdpa_xla(q, k, v, m[0] if m else None, dropout_p, is_causal, scale)
+
+    return apply(prim, *ts, op_name="scaled_dot_product_attention")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    ml = int(maxlen) if maxlen is not None else int(jnp.max(x._data))
+    from paddle_tpu.core import dtype as dtype_mod
+    d = dtype_mod.convert_dtype(dtype)
+    return apply(lambda a: (jnp.arange(ml) < a[..., None]).astype(d), x,
+                 op_name="sequence_mask")

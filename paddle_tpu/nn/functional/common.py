@@ -1,0 +1,365 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate, one-hot, etc.
+(ref: `python/paddle/nn/functional/common.py` — `linear` at :1822 dispatches to
+`_C_ops.linear`; here it is one fused XLA dot+bias).
+"""
+from __future__ import annotations
+
+import builtins
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.common import ensure_tensor
+from paddle_tpu.amp.state import amp_cast_inputs
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W shaped [in, out] (paddle convention)."""
+    x, weight = amp_cast_inputs("linear", ensure_tensor(x), ensure_tensor(weight))
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        if bias.dtype != x.dtype:
+            bias = bias.astype(x.dtype)
+        return apply(lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias,
+                     op_name="linear")
+    return apply(jnp.matmul, x, weight, op_name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda a: a * (1 - p), x, op_name="dropout_infer")
+        return x
+    if p == 1.0:
+        return apply(lambda a: jnp.zeros_like(a), x, op_name="dropout")
+    from paddle_tpu.ops.random import default_generator
+    key = default_generator().next_key()
+    ax = None if axis is None else tuple(axis) if isinstance(axis, (list, tuple)) \
+        else (axis,)
+
+    def prim(a):
+        shape = a.shape if ax is None else tuple(
+            a.shape[i] if i in ax else 1 for i in range(a.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply(prim, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p=p, axis=list(ax), training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, axis=list(ax), training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    from paddle_tpu.ops.random import default_generator
+    key = default_generator().next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def prim(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        A = (1.0 / math.sqrt((1 - p) * (1 + p * alpha_p ** 2)))
+        B = -A * alpha_p * p
+        return (A * jnp.where(keep, a, alpha_p) + B).astype(a.dtype)
+
+    return apply(prim, x, op_name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Lookup rows of ``weight`` (ref `phi/kernels/embedding_kernel.h`; the
+    vocab-parallel variant lives in distributed.fleet)."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def prim(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out).astype(w.dtype)
+        return out
+
+    return apply(prim, x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    from paddle_tpu.ops.manipulation import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = ensure_tensor(label)
+    if prior_dist is not None:
+        pd = ensure_tensor(prior_dist)
+        return apply(lambda l, p: (1 - epsilon) * l + epsilon * p, label, pd,
+                     op_name="label_smooth")
+    return apply(lambda l: (1 - epsilon) * l + epsilon / l.shape[-1], label,
+                 op_name="label_smooth")
+
+
+_PAD_MODE = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+
+    def prim(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            # full-rank pad, paddle flat format [before0, after0, before1, ...]
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial spatial pad on the last dims per data_format, reversed pairs
+            n_spatial = len(pad) // 2
+            widths = [(0, 0)] * nd
+            channels_last = data_format.endswith("C")
+            for i in range(n_spatial):
+                lo, hi = pad[2 * i], pad[2 * i + 1]
+                if channels_last:
+                    dim = nd - 2 - i
+                else:
+                    dim = nd - 1 - i
+                widths[dim] = (lo, hi)
+        if mode == "constant":
+            return jnp.pad(a, widths, constant_values=value)
+        return jnp.pad(a, widths, mode=_PAD_MODE[mode])
+
+    return apply(prim, x, op_name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (ref `phi/kernels/funcs/im2col.cu:87`). Output [N, C*kh*kw, L]."""
+    x = ensure_tensor(x)
+
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    dh, dw = pair(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pt = pb = pl = pr = p
+    elif len(p) == 2:
+        pt, pb, pl, pr = p[0], p[0], p[1], p[1]
+    else:
+        pt, pl, pb, pr = p
+
+    def prim(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        oh = (a.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (a.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (kh, kw), (sh, sw), "VALID", rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * kh * kw, oh * ow)
+
+    return apply(prim, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    x = ensure_tensor(x)
+
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    dh, dw = pair(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pt = pb = pl = pr = p
+    elif len(p) == 2:
+        pt, pb, pl, pr = p[0], p[0], p[1], p[1]
+    else:
+        pt, pl, pb, pr = p
+
+    def prim(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        hh, ww = oh + pt + pb, ow + pl + pr
+        lh = (hh - (dh * (kh - 1) + 1)) // sh + 1
+        lw = (ww - (dw * (kw - 1) + 1)) // sw + 1
+        a = a.reshape(n, c, kh, kw, lh, lw)
+        out = jnp.zeros((n, c, hh, ww), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                patch = a[:, :, i, j]
+                out = out.at[:, :,
+                             i * dh: i * dh + lh * sh: sh,
+                             j * dw: j * dw + lw * sw: sw].add(patch)
+        return out[:, :, pt: pt + oh, pl: pl + ow]
+
+    return apply(prim, x, op_name="fold")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    """ref: `python/paddle/nn/functional/common.py` interpolate -> jax.image."""
+    x = ensure_tensor(x)
+    channels_last = data_format.endswith("C")
+    n_spatial = x.ndim - 2
+
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_spatial = [int(s._data) if isinstance(s, Tensor) else int(s)
+                       for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scales = [scale_factor] * n_spatial
+        else:
+            scales = list(scale_factor)
+        spatial = x.shape[2:] if not channels_last else x.shape[1:-1]
+        out_spatial = [int(s * f) for s, f in zip(spatial, scales)]
+
+    jmode = {"nearest": "nearest", "bilinear": "bilinear", "trilinear": "trilinear",
+             "bicubic": "bicubic", "linear": "linear", "area": "linear"}[mode]
+
+    def prim(a):
+        if channels_last:
+            out_shape = (a.shape[0],) + tuple(out_spatial) + (a.shape[-1],)
+        else:
+            out_shape = a.shape[:2] + tuple(out_spatial)
+        if mode == "nearest" or not align_corners:
+            return jax.image.resize(a, out_shape, jmode).astype(a.dtype)
+        # align_corners resize via explicit coordinate map
+        spatial_axes = list(range(2, a.ndim)) if not channels_last else \
+            list(range(1, a.ndim - 1))
+        out = a
+        for ax, osz in zip(spatial_axes, out_spatial):
+            isz = out.shape[ax]
+            if isz == osz:
+                continue
+            idx = jnp.linspace(0.0, isz - 1, osz)
+            lo = jnp.clip(jnp.floor(idx).astype(jnp.int32), 0, isz - 1)
+            hi = jnp.clip(lo + 1, 0, isz - 1)
+            w = (idx - lo).astype(a.dtype)
+            shape = [1] * out.ndim
+            shape[ax] = osz
+            w = w.reshape(shape)
+            out = (jnp.take(out, lo, axis=ax) * (1 - w) +
+                   jnp.take(out, hi, axis=ax) * w)
+        return out.astype(a.dtype)
+
+    return apply(prim, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+
+    def prim(a, b, w):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        return out
+
+    out = apply(prim, x1, x2, weight, op_name="bilinear")
+    if bias is not None:
+        out = out + ensure_tensor(bias)
+    return out
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def prim(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply(prim, x1, x2, op_name="cosine_similarity")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = upscale_factor
+
+    def prim(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply(prim, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = downscale_factor
+
+    def prim(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+
+    return apply(prim, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            a = a.transpose(0, 2, 1, 3, 4)
+            return a.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        a = a.transpose(0, 1, 2, 4, 3)
+        return a.reshape(n, h, w, c)
+
+    return apply(prim, x, op_name="channel_shuffle")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+
+    return apply(prim, x, op_name="normalize")

@@ -1,0 +1,177 @@
+"""Convolution functionals (ref: `python/paddle/nn/functional/conv.py`; cuDNN kernels
+`phi/kernels/gpudnn/conv_kernel.cu` -> here a single `lax.conv_general_dilated`,
+which XLA maps onto the MXU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.common import ensure_tensor
+from paddle_tpu.amp.state import amp_cast_inputs
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # nested [[lo, hi], ...]
+    return [tuple(int(q) for q in p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n_spatial,
+          data_format, op_name):
+    x, weight = amp_cast_inputs(op_name, ensure_tensor(x), ensure_tensor(weight))
+    strides = _tuple(stride, n_spatial)
+    dilations = _tuple(dilation, n_spatial)
+    pads = _padding(padding, n_spatial)
+    channels_last = data_format.endswith("C")
+    spatial = "DHW"[-n_spatial:] if n_spatial > 1 else "W"
+    if channels_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, out_spec))
+
+    def prim(a, w):
+        return jax.lax.conv_general_dilated(
+            a, w, strides, pads, rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=a.dtype if a.dtype != jnp.float64 else None)
+
+    out = apply(prim, x, weight, op_name=op_name)
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        if bias.dtype != out.dtype:
+            bias = bias.astype(out.dtype)
+        shape = [1] * (n_spatial + 2)
+        shape[-1 if channels_last else 1] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, fmt,
+                 "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n_spatial, data_format, op_name, output_size=None):
+    x, weight = amp_cast_inputs(op_name, ensure_tensor(x), ensure_tensor(weight))
+    strides = _tuple(stride, n_spatial)
+    dilations = _tuple(dilation, n_spatial)
+    out_pads = _tuple(output_padding, n_spatial)
+    channels_last = data_format.endswith("C")
+    spatial = "DHW"[-n_spatial:] if n_spatial > 1 else "W"
+    lhs_spec = ("N" + spatial + "C") if channels_last else ("NC" + spatial)
+    # paddle transpose-conv weights are [in, out/groups, *k] = IOHW
+    rhs_spec = "IO" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, lhs_spec))
+
+    if isinstance(padding, str):
+        pads = padding.upper()
+    else:
+        pads = _padding(padding, n_spatial)
+
+    # Computed as grad-of-conv: dilate the input by the stride, flip the kernel.
+    # output_padding extends the high side, matching the reference semantics.
+    def prim2(a, w):
+        if isinstance(pads, str):
+            out = jax.lax.conv_transpose(
+                a, w, strides, padding=pads, rhs_dilation=dilations,
+                dimension_numbers=dn, transpose_kernel=True,
+                feature_group_count=groups)
+            return out
+        # compute as grad-of-conv: dilate input by stride, flip kernel
+        pad_cfg = []
+        for i, (lo, hi) in enumerate(pads):
+            k = (w.shape[2 + i] - 1) * dilations[i] + 1
+            pad_cfg.append((k - 1 - lo, k - 1 - hi + out_pads[i]))
+        w_flipped = jnp.flip(w, axis=tuple(range(2, w.ndim)))
+        # IOHW -> OIHW with groups: [I, O/g, *k] -> [O, I/g, *k]
+        i_dim, og = w.shape[0], w.shape[1]
+        wf = w_flipped.reshape((groups, i_dim // groups) + w.shape[1:])
+        wf = jnp.moveaxis(wf, 2, 1)  # [g, O/g, I/g, *k]
+        wf = wf.reshape((og * groups, i_dim // groups) + w.shape[2:])
+        dn2 = jax.lax.conv_dimension_numbers(
+            tuple(a.shape), tuple(wf.shape), (lhs_spec, "OI" + spatial, lhs_spec))
+        return jax.lax.conv_general_dilated(
+            a, wf, window_strides=(1,) * n_spatial, padding=pad_cfg,
+            lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=dn2, feature_group_count=groups)
+
+    out = apply(prim2, x, weight, op_name=op_name)
+    if output_size is not None:
+        want = [int(s) for s in (output_size if isinstance(output_size, (list, tuple))
+                                 else [output_size] * n_spatial)]
+        have = out.shape[2:] if not channels_last else out.shape[1:-1]
+        if list(have) != want:
+            extra = [w0 - h for w0, h in zip(want, have)]
+            widths = [(0, 0), (0, 0)] + [(0, e) for e in extra] if not channels_last \
+                else [(0, 0)] + [(0, e) for e in extra] + [(0, 0)]
+            out = apply(lambda a: jnp.pad(a, widths), out, op_name="output_size_pad")
+    if bias is not None:
+        bias = ensure_tensor(bias)
+        if bias.dtype != out.dtype:
+            bias = bias.astype(out.dtype)
+        shape = [1] * (n_spatial + 2)
+        shape[-1 if channels_last else 1] = bias.shape[0]
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, fmt, "conv1d_transpose",
+                           output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, "conv2d_transpose",
+                           output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, "conv3d_transpose",
+                           output_size)

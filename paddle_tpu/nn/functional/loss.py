@@ -1,0 +1,327 @@
+"""Loss functionals (ref: `python/paddle/nn/functional/loss.py`).
+
+`cross_entropy` fuses log_softmax+gather like the reference's
+`softmax_with_cross_entropy` kernel (`phi/kernels/gpu/cross_entropy_kernel.cu`);
+the tensor-parallel variant lives in distributed (≈ `c_softmax_with_cross_entropy`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.common import ensure_tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    has_w = weight is not None
+    ts = [input, label] + ([ensure_tensor(weight)] if has_w else [])
+
+    def prim(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-15))
+        nclass = logits.shape[axis]
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape):
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            li = lab
+            if li.ndim == logits.ndim:
+                li = jnp.squeeze(li, axis=axis)
+            li = li.astype(jnp.int32)
+            valid = li != ignore_index
+            safe = jnp.where(valid, li, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis)
+            if label_smoothing > 0:
+                smooth_loss = -jnp.mean(logp, axis=axis)
+                loss = -(1 - label_smoothing) * picked + \
+                    label_smoothing * smooth_loss
+            else:
+                loss = -picked
+            if w:
+                wv = jnp.take(w[0], safe)
+                loss = loss * wv
+                if reduction == "mean":
+                    denom = jnp.sum(jnp.where(valid, wv, 0.0))
+                    return jnp.sum(jnp.where(valid, loss, 0.0)) / denom
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+        return _reduce(loss, reduction)
+
+    return apply(prim, *ts, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        from paddle_tpu.nn.functional.activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    has_w = weight is not None
+    ts = [input, label] + ([ensure_tensor(weight)] if has_w else [])
+
+    def prim(logp, lab, *w):
+        li = lab.astype(jnp.int32)
+        valid = li != ignore_index
+        safe = jnp.where(valid, li, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        loss = -jnp.squeeze(picked, 1)
+        if w:
+            wv = jnp.take(w[0], safe)
+            loss = loss * wv
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.sum(jnp.where(valid, wv, 0.0))
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+        return _reduce(loss, reduction)
+
+    return apply(prim, *ts, op_name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply(lambda a, b: _reduce((a - b) ** 2, reduction), input, label,
+                 op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label,
+                 op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def prim(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return apply(prim, input, label, op_name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    has_w = weight is not None
+    ts = [input, label] + ([ensure_tensor(weight)] if has_w else [])
+
+    def prim(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    return apply(prim, *ts, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    ts = [logit, label]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        ts.append(ensure_tensor(weight))
+    if has_pw:
+        ts.append(ensure_tensor(pos_weight))
+
+    def prim(z, y, *rest):
+        it = iter(rest)
+        w = next(it) if has_w else None
+        pw = next(it) if has_pw else None
+        max_val = jnp.clip(-z, 0, None)
+        if pw is not None:
+            log_wt = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_wt * (jnp.log(
+                jnp.exp(-max_val) + jnp.exp(-z - max_val)) + max_val)
+        else:
+            loss = (1 - y) * z + max_val + jnp.log(
+                jnp.exp(-max_val) + jnp.exp(-z - max_val))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply(prim, *ts, op_name="binary_cross_entropy_with_logits")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    ts = [logit, label] + ([ensure_tensor(normalizer)]
+                           if normalizer is not None else [])
+
+    def prim(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.clip(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    return apply(prim, *ts, op_name="sigmoid_focal_loss")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def prim(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply(prim, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    input, other, label = (ensure_tensor(input), ensure_tensor(other),
+                           ensure_tensor(label))
+    return apply(lambda a, b, y: _reduce(
+        jnp.clip(-y * (a - b) + margin, 0, None), reduction),
+        input, other, label, op_name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply(lambda a, y: _reduce(jnp.where(
+        y == 1, a, jnp.clip(margin - a, 0, None)), reduction),
+        input, label, op_name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    input1, input2, label = (ensure_tensor(input1), ensure_tensor(input2),
+                             ensure_tensor(label))
+
+    def prim(a, b, y):
+        cos = jnp.sum(a * b, -1) / (jnp.linalg.norm(a, axis=-1) *
+                                    jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.clip(cos - margin, 0, None))
+        return _reduce(loss, reduction)
+
+    return apply(prim, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    input, positive, negative = (ensure_tensor(input), ensure_tensor(positive),
+                                 ensure_tensor(negative))
+
+    def prim(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v) ** p + epsilon, axis=-1) ** (1.0 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        return _reduce(jnp.clip(d_ap - d_an + margin, 0, None), reduction)
+
+    return apply(prim, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def square_error_cost(input, label):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply(lambda a, b: (a - b) ** 2, input, label,
+                 op_name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply(lambda p, y: -y * jnp.log(p + epsilon) -
+                 (1 - y) * jnp.log(1 - p + epsilon), input, label,
+                 op_name="log_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC (ref `warpctc` integration) via a scan over the alpha lattice."""
+    log_probs = ensure_tensor(log_probs)
+    labels = ensure_tensor(labels)
+    input_lengths = ensure_tensor(input_lengths)
+    label_lengths = ensure_tensor(label_lengths)
+
+    def prim(lp, lab, in_len, lab_len):
+        # lp: [T, B, C] logits -> log-softmax
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        ext = 2 * S + 1
+        NEG = -1e30
+        # extended label sequence: blank l1 blank l2 ... blank
+        ext_lab = jnp.full((B, ext), blank, jnp.int32)
+        ext_lab = ext_lab.at[:, 1::2].set(lab.astype(jnp.int32))
+        same_as_prev2 = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             ext_lab[:, 2:] == ext_lab[:, :-2]], axis=1)
+
+        def emit(t):
+            return jnp.take_along_axis(lp[t], ext_lab, axis=1)  # [B, ext]
+
+        alpha0 = jnp.full((B, ext), NEG)
+        alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+        alpha0 = alpha0.at[:, 1].set(emit(0)[:, 1])
+
+        def step(alpha, t):
+            prev1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+            prev2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+            prev2 = jnp.where(same_as_prev2 |
+                              (ext_lab == blank), NEG, prev2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+            new_alpha = merged + emit(t)
+            # freeze past input_lengths
+            new_alpha = jnp.where(t < in_len[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        end1 = 2 * lab_len.astype(jnp.int32)          # final blank
+        end2 = 2 * lab_len.astype(jnp.int32) - 1      # final label
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(alpha, end1[:, None], axis=1)[:, 0],
+            jnp.take_along_axis(alpha, jnp.maximum(end2, 0)[:, None],
+                                axis=1)[:, 0])
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len, 1))
+        return _reduce(loss, reduction)
+
+    return apply(prim, log_probs, labels, input_lengths, label_lengths,
+                 op_name="ctc_loss")

@@ -1,0 +1,201 @@
+"""Normalization functionals (ref: `python/paddle/nn/functional/norm.py`;
+`phi/kernels/gpu/batch_norm_kernel.cu`, `layer_norm_kernel.cu` -> fused XLA graphs).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply, no_grad
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.common import ensure_tensor
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    x = ensure_tensor(x)
+    channels_last = data_format.endswith("C") and len(data_format) > 2
+    ch_axis = (x.ndim - 1) if channels_last else (1 if x.ndim > 1 else 0)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    inputs = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        inputs.append(ensure_tensor(weight))
+    if has_b:
+        inputs.append(ensure_tensor(bias))
+
+    if use_batch_stats:
+        def prim(a, *wb):
+            m = jnp.mean(a, axis=reduce_axes)
+            v = jnp.var(a, axis=reduce_axes)
+            shape = [1] * a.ndim
+            shape[ch_axis] = a.shape[ch_axis]
+            out = (a - m.reshape(shape)) / jnp.sqrt(v.reshape(shape) + epsilon)
+            it = iter(wb)
+            if has_w:
+                out = out * next(it).reshape(shape)
+            if has_b:
+                out = out + next(it).reshape(shape)
+            return out, m, v
+
+        out, batch_mean, batch_var = apply(prim, *inputs, op_name="batch_norm")
+        # update running stats out-of-graph (matches reference in-place update)
+        if running_mean is not None:
+            with no_grad():
+                n = int(np.prod([x.shape[i] for i in reduce_axes]))
+                unbiased = batch_var._data * (n / max(n - 1, 1))
+                running_mean._write(momentum * running_mean._read() +
+                                    (1 - momentum) * batch_mean._data)
+                running_var._write(momentum * running_var._read() +
+                                   (1 - momentum) * unbiased)
+        return out
+
+    rm, rv = ensure_tensor(running_mean), ensure_tensor(running_var)
+
+    def prim(a, m, v, *wb):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        out = (a - m.reshape(shape)) / jnp.sqrt(v.reshape(shape) + epsilon)
+        it = iter(wb)
+        if has_w:
+            out = out * next(it).reshape(shape)
+        if has_b:
+            out = out + next(it).reshape(shape)
+        return out
+
+    return apply(prim, x, rm, rv, *inputs[1:], op_name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_norm = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - n_norm, x.ndim))
+
+    inputs = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        inputs.append(ensure_tensor(weight))
+    if has_b:
+        inputs.append(ensure_tensor(bias))
+
+    def prim(a, *wb):
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) / jnp.sqrt(v + epsilon)
+        it = iter(wb)
+        if has_w:
+            out = out * next(it)
+        if has_b:
+            out = out + next(it)
+        return out
+
+    return apply(prim, *inputs, op_name="layer_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    x = ensure_tensor(x)
+    axes = tuple(range(2, x.ndim))
+    inputs = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        inputs.append(ensure_tensor(weight))
+    if has_b:
+        inputs.append(ensure_tensor(bias))
+
+    def prim(a, *wb):
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) / jnp.sqrt(v + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        it = iter(wb)
+        if has_w:
+            out = out * next(it).reshape(shape)
+        if has_b:
+            out = out + next(it).reshape(shape)
+        return out
+
+    return apply(prim, *inputs, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    channels_last = data_format.endswith("C") and len(data_format) > 2
+    inputs = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        inputs.append(ensure_tensor(weight))
+    if has_b:
+        inputs.append(ensure_tensor(bias))
+
+    def prim(a, *wb):
+        src = jnp.moveaxis(a, -1, 1) if channels_last else a
+        n, c = src.shape[0], src.shape[1]
+        spatial = src.shape[2:]
+        g = src.reshape((n, num_groups, c // num_groups) + spatial)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        v = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) / jnp.sqrt(v + epsilon)).reshape(src.shape)
+        shape = [1, c] + [1] * len(spatial)
+        it = iter(wb)
+        if has_w:
+            out = out * next(it).reshape(shape)
+        if has_b:
+            out = out + next(it).reshape(shape)
+        return jnp.moveaxis(out, 1, -1) if channels_last else out
+
+    return apply(prim, *inputs, op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    channels_last = data_format.endswith("C") and len(data_format) > 2
+
+    def prim(a):
+        src = jnp.moveaxis(a, -1, 1) if channels_last else a
+        sq = src * src
+        c = src.shape[1]
+        half = size // 2
+        pad = [(0, 0)] * src.ndim
+        pad[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad)
+        acc = jnp.zeros_like(src)
+        for i in range(size):
+            acc = acc + jnp.take(padded, jnp.arange(i, i + c), axis=1)
+        out = src / jnp.power(k + alpha * acc / size, beta)
+        return jnp.moveaxis(out, 1, -1) if channels_last else out
+
+    return apply(prim, x, op_name="local_response_norm")
+
+
+def spectral_norm(weight, weight_u, weight_v, dim=0, power_iters=1, eps=1e-12,
+                  name=None):
+    weight = ensure_tensor(weight)
+    u, v = ensure_tensor(weight_u), ensure_tensor(weight_v)
+
+    def prim(w, u0, v0):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        uu, vv = u0, v0
+        for _ in range(power_iters):
+            vv = wm.T @ uu
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            uu = wm @ vv
+            uu = uu / (jnp.linalg.norm(uu) + eps)
+        sigma = uu @ wm @ vv
+        return w / sigma
+
+    return apply(prim, weight, u, v, op_name="spectral_norm")

@@ -1,0 +1,208 @@
+"""Pooling functionals (ref: `python/paddle/nn/functional/pooling.py`;
+`phi/kernels/funcs/pooling.cu` -> `lax.reduce_window`)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.ops.common import ensure_tensor
+
+
+def _tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _pads(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    p = list(padding)
+    if len(p) == n:
+        return [(int(q), int(q)) for q in p]
+    if len(p) == 2 * n:
+        return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+    return [tuple(int(q) for q in pair) for pair in p]
+
+
+def _pool(x, ksize, stride, padding, n_spatial, data_format, kind,
+          ceil_mode=False, exclusive=True, count_include_pad=False):
+    x = ensure_tensor(x)
+    k = _tuple(ksize, n_spatial)
+    s = _tuple(stride if stride is not None else ksize, n_spatial)
+    pads = _pads(padding, n_spatial)
+    channels_last = data_format.endswith("C")
+    if channels_last:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pad_full = ([(0, 0)] + list(pads) + [(0, 0)]) if not isinstance(pads, str) \
+            else pads
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pad_full = ([(0, 0), (0, 0)] + list(pads)) if not isinstance(pads, str) \
+            else pads
+
+    if ceil_mode and not isinstance(pad_full, str):
+        # extend high padding so truncated windows are kept
+        spatial_dims = range(1, 1 + n_spatial) if channels_last else \
+            range(2, 2 + n_spatial)
+        pad_full = list(pad_full)
+        for i, d in enumerate(spatial_dims):
+            size = x.shape[d] + pads[i][0] + pads[i][1]
+            rem = (size - k[i]) % s[i]
+            if rem != 0:
+                lo, hi = pad_full[d]
+                pad_full[d] = (lo, hi + (s[i] - rem))
+
+    def prim(a):
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else \
+                jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window, strides,
+                                         pad_full)
+        # avg
+        ones = jnp.ones_like(a)
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides,
+                                       pad_full)
+        if exclusive and not count_include_pad:
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                           pad_full)
+        else:
+            counts = float(np.prod(k))
+        return (summed / counts).astype(a.dtype)
+
+    return apply(prim, x, op_name=f"{kind}_pool{n_spatial}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    out = _pool(x, kernel_size, stride, padding, 1, fmt, "max", ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 1, fmt)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 2, data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, data_format, "max", ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 3, data_format)
+    return out
+
+
+def _pool_mask(x, out, ksize, stride, padding, n_spatial, data_format):
+    """Indices of max elements (flat spatial index), computed via comparison."""
+    x, out = ensure_tensor(x), ensure_tensor(out)
+    k = _tuple(ksize, n_spatial)
+    s = _tuple(stride if stride is not None else ksize, n_spatial)
+
+    def prim(a, o):
+        # brute-force: for each output pos, recompute argmax via one-hot trick
+        n, c = a.shape[0], a.shape[1]
+        spatial = a.shape[2:]
+        flat_idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+        idx = jnp.broadcast_to(flat_idx, a.shape).astype(jnp.float64)
+        # large-negative trick: reduce-window argmax = max over (value*K + index)
+        K = 1e9
+        packed = a.astype(jnp.float64) * K - idx
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = _pads(padding, n_spatial)
+        pad_full = [(0, 0), (0, 0)] + list(pads)
+        best = jax.lax.reduce_window(packed, -jnp.inf, jax.lax.max, window,
+                                     strides, pad_full)
+        recovered = (-(best - jax.lax.reduce_window(
+            a.astype(jnp.float64) * K, -jnp.inf, jax.lax.max, window, strides,
+            pad_full)))
+        return recovered.astype(jnp.int64)
+
+    return apply(prim, x, out, op_name="max_pool_mask")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _pool(x, kernel_size, stride, padding, 1, fmt, "avg", ceil_mode,
+                 exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", ceil_mode,
+                 exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", ceil_mode,
+                 exclusive)
+
+
+def _adaptive_pool(x, output_size, n_spatial, data_format, kind):
+    x = ensure_tensor(x)
+    channels_last = data_format.endswith("C")
+    out_sz = _tuple(output_size, n_spatial)
+    in_spatial = tuple(x.shape[1:-1] if channels_last else x.shape[2:])
+    out_sz = tuple(o if o is not None else i for o, i in zip(out_sz, in_spatial))
+
+    def prim(a):
+        src = a if not channels_last else jnp.moveaxis(a, -1, 1)
+        for d, (isz, osz) in enumerate(zip(in_spatial, out_sz)):
+            ax = 2 + d
+            # adaptive windows: start = floor(i*isz/osz), end = ceil((i+1)*isz/osz)
+            starts = (np.arange(osz) * isz) // osz
+            ends = -(-((np.arange(osz) + 1) * isz) // osz)
+            pieces = []
+            for st, en in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(src, int(st), int(en), axis=ax)
+                if kind == "max":
+                    pieces.append(jnp.max(seg, axis=ax, keepdims=True))
+                else:
+                    pieces.append(jnp.mean(seg, axis=ax, keepdims=True))
+            src = jnp.concatenate(pieces, axis=ax)
+        return src if not channels_last else jnp.moveaxis(src, 1, -1)
+
+    return apply(prim, x, op_name=f"adaptive_{kind}_pool{n_spatial}d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "NCW", "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "NCHW", "max")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+    return (out, None) if return_mask else out

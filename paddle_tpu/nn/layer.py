@@ -1,0 +1,321 @@
+"""nn.Layer — the module system.
+
+Ref: `python/paddle/fluid/dygraph/layers.py:108` (Layer with parameters/buffers/
+sublayers, forward hooks, state_dict, train/eval). Parameters are mutable Tensors
+(rebinding immutable jax arrays), which is what lets the same Layer object run both
+eagerly and inside a captured/jitted train step.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterator
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, Parameter
+from paddle_tpu.core import dtype as dtype_mod
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hook_id: int):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype
+        self._parameters: dict[str, Parameter] = collections.OrderedDict()
+        self._buffers: dict[str, Tensor] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._sub_layers: dict[str, "Layer"] = collections.OrderedDict()
+        self._forward_pre_hooks: dict[int, Callable] = collections.OrderedDict()
+        self._forward_post_hooks: dict[int, Callable] = collections.OrderedDict()
+        self._hook_counter = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------- registration
+
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        elif buffers is not None and name in buffers:
+            if value is None:
+                buffers[name] = None
+            elif isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                buffers[name].set_value(value)
+        else:
+            if params is not None and name in params and value is None:
+                params.pop(name)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter requires a Parameter")
+        self._parameters[str(name)] = parameter
+        return parameter
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[str(name)] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(str(name))
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """ref: `layers.py` create_parameter — honors ParamAttr initializer."""
+        from paddle_tpu.nn import initializer as I
+        from paddle_tpu.framework.param_attr import ParamAttr
+        dtype = dtype_mod.convert_dtype(dtype or self._dtype)
+        init = None
+        trainable = True
+        if isinstance(attr, ParamAttr):
+            init = attr.initializer
+            trainable = attr.trainable
+        elif callable(attr):
+            init = attr
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        data = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(data, trainable=trainable)
+        if isinstance(attr, ParamAttr) and attr.name:
+            p.name = attr.name
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        return Tensor(jnp.zeros((), dtype_mod.convert_dtype(dtype or self._dtype)),
+                      _internal=True)
+
+    # ------------------------------------------------------------- iteration
+
+    def parameters(self, include_sublayers=True) -> list:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True
+                         ) -> Iterator[tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+
+    def buffers(self, include_sublayers=True) -> list:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+
+    def _traverse(self, prefix="", include_sublayers=True):
+        yield prefix, self
+        if include_sublayers:
+            for name, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{name}" if prefix else name
+                yield from sub._traverse(sub_prefix, True)
+
+    def children(self) -> Iterator["Layer"]:
+        for _, sub in self.named_children():
+            yield sub
+
+    def named_children(self):
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def sublayers(self, include_self=False) -> list:
+        out = []
+        for _, layer in self._traverse("", True):
+            out.append(layer)
+        return out if include_self else out[1:]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        for name, layer in self._traverse(prefix, True):
+            if not include_self and layer is self:
+                continue
+            yield name, layer
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    def full_name(self):
+        return self._name_scope
+
+    # ------------------------------------------------------------- modes
+
+    def train(self):
+        self.training = True
+        for sub in self.sublayers():
+            sub.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for sub in self.sublayers():
+            sub.training = False
+        return self
+
+    # ------------------------------------------------------------- hooks
+
+    def register_forward_pre_hook(self, hook):
+        self._hook_counter += 1
+        self._forward_pre_hooks[self._hook_counter] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_counter)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_counter += 1
+        self._forward_post_hooks[self._hook_counter] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_counter)
+
+    # ------------------------------------------------------------- call
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in tuple(self._forward_pre_hooks.values()):
+            res = hook(self, inputs)
+            if res is not None:
+                inputs = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in tuple(self._forward_post_hooks.values()):
+            res = hook(self, inputs, out)
+            if res is not None:
+                out = res
+        return out
+
+    # ------------------------------------------------------------- state
+
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[structured_name_prefix + name] = p
+        for name, layer in self._traverse("", include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                full = f"{name}.{bname}" if name else bname
+                dest[structured_name_prefix + full] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Load values into existing parameters/buffers (shape-checked)."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for key, target in own.items():
+            if key in state_dict:
+                v = state_dict[key]
+                arr = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                if tuple(arr.shape) != tuple(target._data.shape):
+                    raise ValueError(
+                        f"shape mismatch for {key}: checkpoint {tuple(arr.shape)} vs "
+                        f"parameter {tuple(target._data.shape)}")
+                target._write(arr.astype(target.dtype))
+            else:
+                missing.append(key)
+        for key in state_dict:
+            if key not in own:
+                unexpected.append(key)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------- dtype/device
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            d = dtype_mod.convert_dtype(dtype)
+            for p in self.parameters():
+                p._write(p._data.astype(d))
+            for b in self.buffers():
+                if jnp.issubdtype(b.dtype, jnp.floating):
+                    b._write(b._data.astype(d))
+            self._dtype = d
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            body = repr(sub).split("\n")
+            body = [body[0]] + ["  " + ln for ln in body[1:]]
+            lines.append(f"({name}): " + "\n".join(body))
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    def extra_repr(self):
+        return ""

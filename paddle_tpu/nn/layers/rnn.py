@@ -1,0 +1,372 @@
+"""RNN layers (ref: `python/paddle/nn/layer/rnn.py` — RNNCellBase, SimpleRNNCell,
+LSTMCell, GRUCell, RNN, SimpleRNN, LSTM, GRU). Recurrence is a `lax.scan` inside one
+traced op, which XLA unrolls/fuses — no per-step python dispatch in the hot path.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.framework.param_attr import ParamAttr
+from paddle_tpu.ops.common import ensure_tensor
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        from paddle_tpu.ops.creation import full
+        B = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and isinstance(shape[0], (list, tuple)):
+            return tuple(full([B] + list(s), init_value,
+                              dtype or batch_ref.dtype) for s in shape)
+        return full([B] + list(shape), init_value, dtype or batch_ref.dtype)
+
+
+def _uniform_attr(attr, hidden_size):
+    std = 1.0 / math.sqrt(hidden_size)
+    a = ParamAttr._to_attr(attr)
+    if a is None:
+        return ParamAttr(initializer=I.Uniform(-std, std))
+    if isinstance(a, ParamAttr) and a.initializer is None:
+        a.initializer = I.Uniform(-std, std)
+    return a
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter(
+            (hidden_size, input_size), attr=_uniform_attr(weight_ih_attr,
+                                                          hidden_size))
+        self.weight_hh = self.create_parameter(
+            (hidden_size, hidden_size), attr=_uniform_attr(weight_hh_attr,
+                                                           hidden_size))
+        self.bias_ih = self.create_parameter(
+            (hidden_size,), attr=_uniform_attr(bias_ih_attr, hidden_size),
+            is_bias=True)
+        self.bias_hh = self.create_parameter(
+            (hidden_size,), attr=_uniform_attr(bias_hh_attr, hidden_size),
+            is_bias=True)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def prim(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+
+        h = apply(prim, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, op_name="simple_rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.weight_ih = self.create_parameter(
+            (4 * hidden_size, input_size),
+            attr=_uniform_attr(weight_ih_attr, hidden_size))
+        self.weight_hh = self.create_parameter(
+            (4 * hidden_size, hidden_size),
+            attr=_uniform_attr(weight_hh_attr, hidden_size))
+        self.bias_ih = self.create_parameter(
+            (4 * hidden_size,), attr=_uniform_attr(bias_ih_attr, hidden_size),
+            is_bias=True)
+        self.bias_hh = self.create_parameter(
+            (4 * hidden_size,), attr=_uniform_attr(bias_hh_attr, hidden_size),
+            is_bias=True)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        h0, c0 = states
+
+        def prim(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = f * c + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+
+        new_h, new_c = apply(prim, inputs, h0, c0, self.weight_ih, self.weight_hh,
+                             self.bias_ih, self.bias_hh, op_name="lstm_cell")
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.weight_ih = self.create_parameter(
+            (3 * hidden_size, input_size),
+            attr=_uniform_attr(weight_ih_attr, hidden_size))
+        self.weight_hh = self.create_parameter(
+            (3 * hidden_size, hidden_size),
+            attr=_uniform_attr(weight_hh_attr, hidden_size))
+        self.bias_ih = self.create_parameter(
+            (3 * hidden_size,), attr=_uniform_attr(bias_ih_attr, hidden_size),
+            is_bias=True)
+        self.bias_hh = self.create_parameter(
+            (3 * hidden_size,), attr=_uniform_attr(bias_hh_attr, hidden_size),
+            is_bias=True)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def prim(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        h = apply(prim, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, op_name="gru_cell")
+        return h, h
+
+
+class RNN(Layer):
+    """Wrap a cell into a scan over time (ref rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        # simple per-step python loop through the cell keeps arbitrary cells
+        # correct; the fused multi-layer classes below use one lax.scan instead.
+        from paddle_tpu.ops.manipulation import stack, flip
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        outs = []
+        for t in order:
+            xt = inputs[t] if self.time_major else inputs[:, t]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = stack(outs, axis=time_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from paddle_tpu.ops.manipulation import concat
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw, sequence_length)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Fused multi-layer multi-direction RNN executed as lax.scan per layer."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.num_directions = 2 if direction in ("bidirect", "bidirectional") else 1
+        g = {"LSTM": 4, "GRU": 3}.get(self.MODE, 1)
+        self._gates = g
+        self.weight_ih_list = []
+        self.weight_hh_list = []
+        self.bias_ih_list = []
+        self.bias_hh_list = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 else \
+                    hidden_size * self.num_directions
+                wi = self.create_parameter(
+                    (g * hidden_size, in_sz),
+                    attr=_uniform_attr(weight_ih_attr, hidden_size))
+                wh = self.create_parameter(
+                    (g * hidden_size, hidden_size),
+                    attr=_uniform_attr(weight_hh_attr, hidden_size))
+                bi = self.create_parameter(
+                    (g * hidden_size,),
+                    attr=_uniform_attr(bias_ih_attr, hidden_size), is_bias=True)
+                bh = self.create_parameter(
+                    (g * hidden_size,),
+                    attr=_uniform_attr(bias_hh_attr, hidden_size), is_bias=True)
+                sfx = f"{layer}" + ("_reverse" if d else "")
+                self.add_parameter(f"weight_ih_l{sfx}", wi)
+                self.add_parameter(f"weight_hh_l{sfx}", wh)
+                self.add_parameter(f"bias_ih_l{sfx}", bi)
+                self.add_parameter(f"bias_hh_l{sfx}", bh)
+                self.weight_ih_list.append(wi)
+                self.weight_hh_list.append(wh)
+                self.bias_ih_list.append(bi)
+                self.bias_hh_list.append(bh)
+
+    def _cell_step(self, mode):
+        if mode == "LSTM":
+            def step(x, hc, wi, wh, bi, bh):
+                h, c = hc
+                gates = x @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                           jax.nn.sigmoid(o))
+                g = jnp.tanh(g)
+                c = f * c + i * g
+                h = o * jnp.tanh(c)
+                return h, (h, c)
+        elif mode == "GRU":
+            def step(x, h, wi, wh, bi, bh):
+                gi = x @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                z = jax.nn.sigmoid(iz + hz)
+                c = jnp.tanh(ic + r * hc)
+                h = (1 - z) * c + z * h
+                return h, h
+        else:
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+            def step(x, h, wi, wh, bi, bh):
+                h = act(x @ wi.T + bi + h @ wh.T + bh)
+                return h, h
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        inputs = ensure_tensor(inputs)
+        mode = self.MODE
+        is_lstm = mode == "LSTM"
+        nd, nl, H = self.num_directions, self.num_layers, self.hidden_size
+        time_major = self.time_major
+        B = inputs.shape[0 if time_major else 1]
+
+        from paddle_tpu.ops.creation import zeros
+        if initial_states is None:
+            if is_lstm:
+                initial_states = (zeros([nl * nd, B, H], inputs.dtype),
+                                  zeros([nl * nd, B, H], inputs.dtype))
+            else:
+                initial_states = zeros([nl * nd, B, H], inputs.dtype)
+        step_fn = self._cell_step(mode)
+        params = (self.weight_ih_list + self.weight_hh_list + self.bias_ih_list +
+                  self.bias_hh_list)
+        n = nl * nd
+        state_ts = list(initial_states) if is_lstm else [initial_states]
+
+        def prim(x, *arrs):
+            states = arrs[:len(state_ts)]
+            ws = arrs[len(state_ts):]
+            wi_l = ws[:n]
+            wh_l = ws[n:2 * n]
+            bi_l = ws[2 * n:3 * n]
+            bh_l = ws[3 * n:4 * n]
+            seq = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, *]
+            out = seq
+            final_h = []
+            final_c = []
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    idx = layer * nd + d
+                    h0 = states[0][idx]
+                    state0 = (h0, states[1][idx]) if is_lstm else h0
+                    src = out if d == 0 else jnp.flip(out, axis=0)
+
+                    def scan_fn(carry, xt, _wi=wi_l[idx], _wh=wh_l[idx],
+                                _bi=bi_l[idx], _bh=bh_l[idx]):
+                        y, new_carry = step_fn(xt, carry, _wi, _wh, _bi, _bh)
+                        return new_carry, y
+
+                    carry, ys = jax.lax.scan(scan_fn, state0, src)
+                    if d == 1:
+                        ys = jnp.flip(ys, axis=0)
+                    dir_outs.append(ys)
+                    if is_lstm:
+                        final_h.append(carry[0])
+                        final_c.append(carry[1])
+                    else:
+                        final_h.append(carry)
+                out = dir_outs[0] if nd == 1 else jnp.concatenate(dir_outs, -1)
+            y = out if time_major else jnp.swapaxes(out, 0, 1)
+            if is_lstm:
+                return y, jnp.stack(final_h), jnp.stack(final_c)
+            return y, jnp.stack(final_h)
+
+        res = apply(prim, inputs, *state_ts, *params, op_name=mode.lower())
+        if is_lstm:
+            y, h, c = res
+            return y, (h, c)
+        y, h = res
+        return y, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

@@ -1,0 +1,110 @@
+"""nn.utils (ref: `python/paddle/nn/utils/` — weight_norm, spectral_norm helpers,
+parameter vector utils)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, Parameter
+from paddle_tpu.nn.layer import Layer
+
+
+def parameters_to_vector(parameters, name=None):
+    arrs = [p._data.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(arrs), _internal=True)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p._data.shape))
+        p._write(vec._data[offset:offset + n].reshape(p._data.shape)
+                 .astype(p.dtype))
+        offset += n
+
+
+def _norm_except_dim(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+class _WeightNorm:
+    """Reparameterize weight = g * v / ||v|| via a forward-pre-hook
+    (ref: `python/paddle/nn/utils/weight_norm_hook.py`)."""
+
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim if dim is not None else -1
+
+    def compute_weight(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        from paddle_tpu.core.autograd import apply
+        dim = self.dim
+
+        def prim(gg, vv):
+            if dim == -1:
+                norm = jnp.sqrt(jnp.sum(vv * vv))
+            else:
+                axes = tuple(i for i in range(vv.ndim) if i != dim)
+                norm = jnp.sqrt(jnp.sum(vv * vv, axis=axes, keepdims=True))
+            return vv * (gg / norm)
+
+        return apply(prim, g, v, op_name="weight_norm")
+
+    def __call__(self, layer, inputs):
+        w = self.compute_weight(layer)
+        object.__setattr__(layer, "_weight_norm_computed", w)
+        layer._parameters.pop(self.name, None)
+        layer.__dict__[self.name] = w
+
+
+def weight_norm(layer: Layer, name="weight", dim=0):
+    w = layer._parameters[name]
+    fn = _WeightNorm(name, dim)
+    dimv = dim if dim is not None else -1
+    if dimv == -1:
+        norm = jnp.sqrt(jnp.sum(w._data * w._data))
+    else:
+        norm = _norm_except_dim(w._data, dimv)
+    g = Parameter(jnp.asarray(norm), trainable=True)
+    v = Parameter(w._data, trainable=True)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    handle = layer.register_forward_pre_hook(fn)
+    layer._weight_norm_hook = (fn, handle)
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name="weight"):
+    fn, handle = layer._weight_norm_hook
+    w = fn.compute_weight(layer)
+    handle.remove()
+    layer.__dict__.pop(name, None)
+    layer._parameters.pop(name + "_g", None)
+    layer._parameters.pop(name + "_v", None)
+    layer.add_parameter(name, Parameter(w._data, trainable=True))
+    return layer
+
+
+def spectral_norm(layer: Layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    from paddle_tpu.nn.layers.norm import SpectralNorm as _SN
+    w = layer._parameters[name]
+    if dim is None:
+        dim = 0
+    sn = _SN(tuple(w._data.shape), dim=dim, power_iters=n_power_iterations,
+             epsilon=eps)
+
+    def hook(l, inputs):
+        normed = sn(getattr(l, name + "_orig"))
+        l._parameters.pop(name, None)
+        l.__dict__[name] = normed
+
+    orig = Parameter(w._data, trainable=True)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", orig)
+    layer.add_sublayer(name + "_sn", sn)
+    layer.register_forward_pre_hook(hook)
+    return layer

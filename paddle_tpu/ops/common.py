@@ -1,0 +1,111 @@
+"""Shared op-dispatch helpers.
+
+The reference generates its op surface from yaml (`paddle/phi/api/yaml/ops.yaml` via
+`api_gen.py`); here the equivalent is a set of small wrapper factories that route pure
+jax functions through the autograd dispatcher (`paddle_tpu.core.autograd.apply`).
+Python scalars stay *static* (baked into the traced prim) so they never force an extra
+vjp input or a dtype promotion — the weak-typing analog of phi's Scalar attribute
+(`paddle/phi/common/scalar.h`).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply, is_grad_enabled
+from paddle_tpu.core.tensor import Tensor, _is_scalar
+from paddle_tpu.core import dtype as dtype_mod
+
+
+def ensure_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x, dtype=dtype)
+
+
+def promote_pair(x: Tensor, y: Tensor):
+    """Paddle-style promotion: int tensor meeting a float tensor casts to the float
+    dtype (jnp with x64 would promote f32+i64 -> f64, which is wrong on TPU)."""
+    dx, dy = x.dtype, y.dtype
+    if dx == dy:
+        return x, y
+    fx, fy = dtype_mod.is_floating(dx), dtype_mod.is_floating(dy)
+    if fx and not fy:
+        return x, y.astype(dx)
+    if fy and not fx:
+        return x.astype(dy), y
+    common = np.promote_types(dx, dy)
+    if dx != common:
+        x = x.astype(common)
+    if dy != common:
+        y = y.astype(common)
+    return x, y
+
+
+def unary(jfn, name=None):
+    opname = name or jfn.__name__
+
+    def op(x, name=None):
+        x = ensure_tensor(x)
+        return apply(jfn, x, op_name=opname)
+
+    op.__name__ = opname
+    op.__doc__ = f"Elementwise ``{opname}`` (TPU-native analog of paddle.{opname})."
+    return op
+
+
+def binary(jfn, name=None, promote=True):
+    opname = name or jfn.__name__
+
+    def op(x, y, name=None):
+        xs, ys = _is_scalar(x), _is_scalar(y)
+        if xs and ys:
+            return Tensor(jfn(jnp.asarray(x), jnp.asarray(y)), _internal=True)
+        if ys:
+            xt = ensure_tensor(x)
+            return apply(lambda a: jfn(a, y), xt, op_name=opname)
+        if xs:
+            yt = ensure_tensor(y)
+            return apply(lambda b: jfn(x, b), yt, op_name=opname)
+        xt, yt = ensure_tensor(x), ensure_tensor(y)
+        if promote:
+            xt, yt = promote_pair(xt, yt)
+        return apply(jfn, xt, yt, op_name=opname)
+
+    op.__name__ = opname
+    op.__doc__ = f"Elementwise ``{opname}`` with broadcasting (paddle.{opname})."
+    return op
+
+
+def make_inplace(op):
+    """Create the trailing-underscore in-place variant of a functional op."""
+
+    def op_(x, *args, **kwargs):
+        inplace_guard(x)
+        res = op(x, *args, **kwargs)
+        return rebind(x, res)
+
+    op_.__name__ = op.__name__ + "_"
+    op_.__doc__ = f"In-place variant of ``{op.__name__}``."
+    return op_
+
+
+def inplace_guard(x: Tensor):
+    if is_grad_enabled() and not x.stop_gradient and x._grad_node is None:
+        raise RuntimeError(
+            "in-place operation on a leaf Tensor that requires grad is not allowed "
+            "(matches the reference dygraph restriction); wrap in paddle.no_grad() "
+            "or operate on a non-leaf")
+
+
+def rebind(x: Tensor, res: Tensor) -> Tensor:
+    """Make ``x`` observe the result of a functional op in-place (autograd-correct:
+    x adopts the result's grad node)."""
+    x._write(res._data)
+    if res._grad_node is not None:
+        x._grad_node = res._grad_node
+        x._out_slot = res._out_slot
+        x.stop_gradient = False
+    return x

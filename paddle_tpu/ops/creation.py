@@ -1,0 +1,252 @@
+"""Tensor creation ops (ref: `python/paddle/tensor/creation.py`)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor, to_tensor, _is_scalar
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.ops.common import ensure_tensor
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_arg(shape), dtype_mod.convert_dtype(dtype)),
+                  _internal=True)
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_arg(shape), dtype_mod.convert_dtype(dtype)),
+                  _internal=True)
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = dtype_mod.bool_
+        elif isinstance(fill_value, int):
+            dtype = dtype_mod.int64
+        else:
+            dtype = dtype_mod.get_default_dtype()
+    return Tensor(jnp.full(_shape_arg(shape), fill_value,
+                           dtype_mod.convert_dtype(dtype)), _internal=True)
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    d = dtype_mod.convert_dtype(dtype) if dtype is not None else x.dtype
+    return Tensor(jnp.zeros(x._data.shape, d), _internal=True)
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    d = dtype_mod.convert_dtype(dtype) if dtype is not None else x.dtype
+    return Tensor(jnp.ones(x._data.shape, d), _internal=True)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    d = dtype_mod.convert_dtype(dtype) if dtype is not None else x.dtype
+    return Tensor(jnp.full(x._data.shape, fill_value, d), _internal=True)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (dtype_mod.int64 if all(isinstance(v, (int, np.integer))
+                                        for v in (start, end, step))
+                 else dtype_mod.get_default_dtype())
+    return Tensor(jnp.arange(start, end, step, dtype_mod.convert_dtype(dtype)),
+                  _internal=True)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)),
+                               dtype=dtype_mod.convert_dtype(dtype)), _internal=True)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.logspace(val(start), val(stop), int(val(num)), base=val(base),
+                               dtype=dtype_mod.convert_dtype(dtype)), _internal=True)
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=dtype_mod.convert_dtype(dtype)), _internal=True)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    ts = [ensure_tensor(a) for a in args]
+    return apply(lambda *arrs: tuple(jnp.meshgrid(*arrs, indexing="ij")), *ts,
+                 op_name="meshgrid")
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + builtins_abs(offset)
+            out = jnp.full((n, n), padding_value, a.dtype)
+            idx = jnp.arange(a.shape[0])
+            if offset >= 0:
+                return out.at[idx, idx + offset].set(a)
+            return out.at[idx - offset, idx].set(a)
+        return jnp.diag(a, k=offset)
+
+    return apply(prim, x, op_name="diag")
+
+
+builtins_abs = abs
+
+
+def diagflat(x, offset=0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.diagflat(a, k=offset), x, op_name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        n = a.shape[-1] + builtins_abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        if offset >= 0:
+            base = base.at[..., idx, idx + offset].set(a)
+        else:
+            base = base.at[..., idx - offset, idx].set(a)
+        # move the two new axes to dim1/dim2
+        nd = base.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            base = jnp.moveaxis(base, (nd - 2, nd - 1), (d1, d2))
+        return base
+
+    return apply(prim, x, op_name="diag_embed")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+                 x, op_name="diagonal")
+
+
+def tril(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.tril(a, k=diagonal), x, op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.triu(a, k=diagonal), x, op_name="triu")
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(int(row), k=offset, m=int(col))
+    return Tensor(jnp.stack([r, c]).astype(dtype_mod.convert_dtype(dtype)),
+                  _internal=True)
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = jnp.triu_indices(int(row), k=offset, m=int(col))
+    return Tensor(jnp.stack([r, c]).astype(dtype_mod.convert_dtype(dtype)),
+                  _internal=True)
+
+
+def assign(x, output=None):
+    """Copy input into output (or a fresh tensor). Ref: paddle.assign."""
+    if not isinstance(x, Tensor):
+        x = Tensor(np.asarray(x))
+    out = apply(lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.inexact) else a,
+                x, op_name="assign")
+    if output is not None:
+        from paddle_tpu.ops.common import rebind
+        return rebind(output, out)
+    return out
+
+
+def clone(x, name=None):
+    return ensure_tensor(x).clone()
+
+
+def numel(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x.size, jnp.int64), _internal=True)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x.size == 0), _internal=True)
+
+
+def complex(real, imag, name=None):
+    real, imag = ensure_tensor(real), ensure_tensor(imag)
+    return apply(jax.lax.complex, real, imag, op_name="complex")
+
+
+def polar(abs, angle, name=None):
+    abs, angle = ensure_tensor(abs), ensure_tensor(angle)
+    return apply(lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+                 abs, angle, op_name="polar")
+
+
+def as_complex(x, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x,
+                 op_name="as_complex")
+
+
+def as_real(x, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x,
+                 op_name="as_real")
+
+
+def cast(x, dtype):
+    x = ensure_tensor(x)
+    d = dtype_mod.convert_dtype(dtype)
+    if x.dtype == d:
+        return x
+    return apply(lambda a: a.astype(d), x, op_name="cast")
+
+
+def cast_(x, dtype):
+    from paddle_tpu.ops.common import rebind, inplace_guard
+    inplace_guard(x)
+    return rebind(x, cast(x, dtype))

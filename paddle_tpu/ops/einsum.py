@@ -1,0 +1,13 @@
+"""Einsum (ref: `python/paddle/tensor/einsum.py` — reimplements contraction planning;
+here XLA's native einsum lowers straight onto the MXU)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.ops.common import ensure_tensor
+
+
+def einsum(equation, *operands):
+    ts = [ensure_tensor(o) for o in operands]
+    return apply(lambda *arrs: jnp.einsum(equation, *arrs), *ts, op_name="einsum")

@@ -1,0 +1,92 @@
+"""Tensor __getitem__/__setitem__ (ref: `paddle/fluid/pybind/eager_method.cc`
+slice handling + `set_value` op).
+
+Tensor-valued indices are passed as real op inputs (not baked constants) so indexing
+stays correct under static capture; python ints/slices stay static.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.common import ensure_tensor, rebind, inplace_guard
+
+
+def _decompose(idx):
+    """Split an index expression into (static spec, tensor inputs)."""
+    items = idx if isinstance(idx, tuple) else (idx,)
+    spec = []
+    tensors = []
+
+    def conv(it):
+        if isinstance(it, Tensor):
+            spec_entry = ("t", len(tensors))
+            tensors.append(it)
+            return spec_entry
+        if isinstance(it, np.ndarray):
+            spec_entry = ("t", len(tensors))
+            tensors.append(Tensor(it))
+            return spec_entry
+        if isinstance(it, builtins.slice):
+            def stat(v):
+                return int(v._data) if isinstance(v, Tensor) else v
+            return ("sl", (stat(it.start), stat(it.stop), stat(it.step)))
+        if it is None or it is Ellipsis or isinstance(it, (int, np.integer, bool)):
+            return ("s", it if not isinstance(it, np.integer) else int(it))
+        if isinstance(it, (list, tuple)):
+            arr = np.asarray(it)
+            spec_entry = ("t", len(tensors))
+            tensors.append(Tensor(arr))
+            return spec_entry
+        raise TypeError(f"unsupported index type: {type(it)}")
+
+    for it in items:
+        spec.append(conv(it))
+    return spec, tensors, isinstance(idx, tuple)
+
+
+def _rebuild(spec, arrays, was_tuple):
+    out = []
+    for kind, v in spec:
+        if kind == "t":
+            out.append(arrays[v])
+        elif kind == "sl":
+            out.append(builtins.slice(*v))
+        else:
+            out.append(v)
+    return tuple(out) if (was_tuple or len(out) > 1) else out[0]
+
+
+def getitem(x, idx):
+    x = ensure_tensor(x)
+    spec, tensors, was_tuple = _decompose(idx)
+
+    def prim(a, *idx_arrays):
+        return a[_rebuild(spec, idx_arrays, was_tuple)]
+
+    return apply(prim, x, *tensors, op_name="getitem")
+
+
+def setitem(x, idx, value):
+    inplace_guard(x)
+    x = ensure_tensor(x)
+    spec, tensors, was_tuple = _decompose(idx)
+    if isinstance(value, (int, float, bool)):
+        def prim(a, *idx_arrays):
+            return a.at[_rebuild(spec, idx_arrays, was_tuple)].set(
+                jnp.asarray(value, a.dtype))
+
+        res = apply(prim, x, *tensors, op_name="setitem")
+    else:
+        v = ensure_tensor(value)
+
+        def prim(a, vv, *idx_arrays):
+            return a.at[_rebuild(spec, idx_arrays, was_tuple)].set(
+                vv.astype(a.dtype))
+
+        res = apply(prim, x, v, *tensors, op_name="setitem")
+    return rebind(x, res)

@@ -1,0 +1,318 @@
+"""Linear algebra ops (ref: `python/paddle/tensor/linalg.py`; kernels route to
+cuSOLVER/cuBLAS in the reference — here XLA's MXU matmuls and host solvers)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.common import ensure_tensor, promote_pair
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    x, y = promote_pair(x, y)
+
+    def prim(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply(prim, x, y, op_name="matmul")
+
+
+def bmm(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(jnp.matmul, x, y, op_name="bmm")
+
+
+def mv(x, vec, name=None):
+    x, vec = ensure_tensor(x), ensure_tensor(vec)
+    return apply(jnp.matmul, x, vec, op_name="mv")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axis if axis is None else (tuple(axis) if isinstance(axis, (list, tuple))
+                                    else int(axis))
+    pp = "fro" if p is None else p
+
+    def prim(a):
+        if pp == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(a * a))
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if pp == "nuc":
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return jnp.sum(s, axis=-1)
+        if pp == float("inf"):
+            r = jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+            return r
+        if pp == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        r = jnp.sum(jnp.abs(a) ** pp, axis=ax, keepdims=keepdim) ** (1.0 / pp)
+        return r
+
+    return apply(prim, x, op_name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis),
+                                           keepdims=keepdim), x, op_name="matrix_norm")
+
+
+def dist(x, y, p=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def prim(a, b):
+        d = a - b
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return apply(prim, x, y, op_name="dist")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def prim(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(d * d, axis=-1))
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+    return apply(prim, x, y, op_name="cdist")
+
+
+def cholesky(x, upper=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.cholesky(
+        jnp.swapaxes(a, -1, -2) if upper else a).swapaxes(-1, -2) if upper
+        else jnp.linalg.cholesky(a), x, op_name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def prim(b, L):
+        Lc = jnp.swapaxes(L, -1, -2) if upper else L
+        return jax.scipy.linalg.cho_solve((Lc, True), b)
+
+    return apply(prim, x, y, op_name="cholesky_solve")
+
+
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    if mode == "r":
+        return apply(lambda a: jnp.linalg.qr(a, mode="r"), x, op_name="qr")
+    return apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, op_name="qr")
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+                 x, op_name="svd")
+
+
+def svdvals(x, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.svd(a, compute_uv=False), x, op_name="svdvals")
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    w, v = np.linalg.eig(arr)
+    return (Tensor(jnp.asarray(w), _internal=True),
+            Tensor(jnp.asarray(v), _internal=True))
+
+
+def eigvals(x, name=None):
+    x = ensure_tensor(x)
+    w = np.linalg.eigvals(np.asarray(x._data))
+    return Tensor(jnp.asarray(w), _internal=True)
+
+
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x, op_name="eigh")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x, op_name="eigvalsh")
+
+
+def inv(x, name=None):
+    x = ensure_tensor(x)
+    return apply(jnp.linalg.inv, x, op_name="inverse")
+
+
+inverse = inv
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x,
+                 op_name="pinv")
+
+
+def det(x, name=None):
+    x = ensure_tensor(x)
+    return apply(jnp.linalg.det, x, op_name="det")
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: tuple(jnp.linalg.slogdet(a)), x, op_name="slogdet")
+
+
+def solve(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def prim(a, b):
+        return jax.lax.linalg.triangular_solve(
+            a, b, left_side=True, lower=not upper, transpose_a=transpose,
+            unit_diagonal=unitriangular)
+
+    return apply(prim, x, y, op_name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    sol, res, rank, sv = np.linalg.lstsq(np.asarray(x._data), np.asarray(y._data),
+                                         rcond=rcond)
+    return (Tensor(jnp.asarray(sol), _internal=True),
+            Tensor(jnp.asarray(res), _internal=True),
+            Tensor(jnp.asarray(rank), _internal=True),
+            Tensor(jnp.asarray(sv), _internal=True))
+
+
+def matrix_power(x, n, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), x, op_name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.matrix_rank(a, tol=tol), x,
+                 op_name="matrix_rank")
+
+
+def cond(x, p=None, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.linalg.cond(a, p=p), x, op_name="cond")
+
+
+def multi_dot(x, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return apply(lambda *arrs: jnp.linalg.multi_dot(arrs), *ts, op_name="multi_dot")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x,
+                 op_name="trace")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, (piv + 1).astype(jnp.int32)
+
+    out = apply(prim, x, op_name="lu")
+    if get_infos:
+        info = Tensor(jnp.zeros(x.shape[:-2] or (1,), jnp.int32), _internal=True)
+        return out[0], out[1], info
+    return out
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def prim(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+        # pivots (1-based sequential swaps) -> permutation matrix
+        perm = jnp.arange(m)
+        piv0 = piv - 1
+
+        def body(i, p):
+            j = piv0[..., i]
+            pi, pj = p[i], p[j]
+            p = p.at[i].set(pj)
+            p = p.at[j].set(pi)
+            return p
+
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        P = jnp.eye(m, dtype=lu_.dtype)[perm].swapaxes(-1, -2)
+        return P, L, U
+
+    return apply(prim, x, y, op_name="lu_unpack")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, op_name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = ensure_tensor(x)
+    fw = None if fweights is None else np.asarray(ensure_tensor(fweights)._data)
+    aw = None if aweights is None else np.asarray(ensure_tensor(aweights)._data)
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), x, op_name="cov")
+
+
+def householder_product(x, tau, name=None):
+    x, tau = ensure_tensor(x), ensure_tensor(tau)
+
+    def prim(a, t):
+        return jax.lax.linalg.householder_product(a, t)
+
+    return apply(prim, x, tau, op_name="householder_product")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = ensure_tensor(x)
+    m, n = x.shape[-2], x.shape[-1]
+    k = q if q is not None else min(6, m, n)
+
+    def prim(a):
+        if center:
+            a = a - a.mean(axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+
+    return apply(prim, x, op_name="pca_lowrank")
+
+
+def matrix_exp(x, name=None):
+    x = ensure_tensor(x)
+    return apply(jax.scipy.linalg.expm, x, op_name="matrix_exp")

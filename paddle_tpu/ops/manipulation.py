@@ -1,0 +1,642 @@
+"""Shape/layout manipulation ops (ref: `python/paddle/tensor/manipulation.py`)."""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.ops.common import ensure_tensor, make_inplace, rebind, inplace_guard
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        v = v.tolist()
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return [int(x._data) if isinstance(x, Tensor) else int(x) for x in v]
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    shp = tuple(_ints(shape))
+    return apply(lambda a: jnp.reshape(a, shp), x, op_name="reshape")
+
+
+reshape_ = make_inplace(reshape)
+view = reshape
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = builtins.max(x.ndim, 1)
+    s = start_axis % nd
+    e = stop_axis % nd
+
+    def prim(a):
+        if a.ndim == 0:
+            return a.reshape(1)
+        shp = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return a.reshape(shp)
+
+    return apply(prim, x, op_name="flatten")
+
+
+flatten_ = make_inplace(flatten)
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = _ints(axis)
+        if isinstance(axes, int):
+            axes = [axes]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return apply(prim, x, op_name="squeeze")
+
+
+squeeze_ = make_inplace(squeeze)
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    axes = _ints(axis)
+    if isinstance(axes, int):
+        axes = [axes]
+    return apply(lambda a: jnp.expand_dims(a, tuple(axes)), x, op_name="unsqueeze")
+
+
+unsqueeze_ = make_inplace(unsqueeze)
+
+
+def transpose(x, perm, name=None):
+    x = ensure_tensor(x)
+    p = tuple(_ints(perm))
+    return apply(lambda a: jnp.transpose(a, p), x, op_name="transpose")
+
+
+def t(x, name=None):
+    x = ensure_tensor(x)
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports ndim<=2; use transpose")
+    return apply(lambda a: a.T, x, op_name="t")
+
+
+def matrix_transpose(x):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.swapaxes(a, -1, -2), x, op_name="matrix_transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    x = ensure_tensor(x)
+    s, d = _ints(source), _ints(destination)
+    return apply(lambda a: jnp.moveaxis(a, s, d), x, op_name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.swapaxes(a, int(axis0), int(axis1)), x,
+                 op_name="swapaxes")
+
+
+def concat(x, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis._data)
+    from paddle_tpu.ops.common import promote_pair
+    # promote all to a common dtype
+    common = ts[0].dtype
+    for t2 in ts[1:]:
+        common = np.promote_types(common, t2.dtype)
+    ts = [t2 if t2.dtype == common else t2.astype(common) for t2 in ts]
+    return apply(lambda *arrs: jnp.concatenate(arrs, axis=axis), *ts, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return apply(lambda *arrs: jnp.stack(arrs, axis=axis), *ts, op_name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = ensure_tensor(x)
+    n = num if num is not None else x.shape[axis]
+
+    def prim(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+
+    return list(apply(prim, x, op_name="unstack"))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis._data)
+    axis = int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {dim} along axis {axis} is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s._data) if isinstance(s, Tensor) else int(s)
+                    for s in num_or_sections]
+        n_neg = builtins.sum(1 for s in sections if s < 0)
+        if n_neg:
+            known = builtins.sum(s for s in sections if s >= 0)
+            sections = [s if s >= 0 else dim - known for s in sections]
+    offsets = np.cumsum([0] + sections[:-1]).tolist()
+
+    def prim(a):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=axis)
+                     for o, s in zip(offsets, sections))
+
+    out = apply(prim, x, op_name="split")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = ensure_tensor(x)
+    dim = x.shape[int(axis)]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, rem = divmod(dim, n)
+        sections = [base + (1 if i < rem else 0) for i in range(n)]
+    else:
+        idx = [int(i) for i in num_or_indices]
+        sections = []
+        prev = 0
+        for i in idx:
+            sections.append(builtins.min(i, dim) - prev)
+            prev = builtins.min(i, dim)
+        sections.append(dim - prev)
+    return split(x, sections, axis=axis)
+
+
+def tile(x, repeat_times, name=None):
+    x = ensure_tensor(x)
+    reps = _ints(repeat_times)
+    if isinstance(reps, int):
+        reps = [reps]
+    return apply(lambda a: jnp.tile(a, tuple(reps)), x, op_name="tile")
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    shp = _ints(shape)
+    if isinstance(shp, int):
+        shp = [shp]
+
+    def prim(a):
+        tgt = list(shp)
+        # -1 means keep original dim; only legal where a source dim exists
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                if i < off:
+                    raise ValueError(
+                        f"expand: -1 at position {i} has no corresponding input "
+                        f"dim (input ndim {a.ndim}, target ndim {len(tgt)})")
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(tgt))
+
+    return apply(prim, x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    shp = tuple(y.shape)
+    return apply(lambda a: jnp.broadcast_to(a, shp), x, op_name="expand_as")
+
+
+def broadcast_to(x, shape, name=None):
+    x = ensure_tensor(x)
+    shp = tuple(_ints(shape))
+    return apply(lambda a: jnp.broadcast_to(a, shp), x, op_name="broadcast_to")
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    return list(apply(lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)), *ts,
+                      op_name="broadcast_tensors"))
+
+
+def flip(x, axis, name=None):
+    x = ensure_tensor(x)
+    axes = _ints(axis)
+    if isinstance(axes, int):
+        axes = [axes]
+    return apply(lambda a: jnp.flip(a, tuple(axes)), x, op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, op_name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = ensure_tensor(x)
+    sh = _ints(shifts)
+    ax = None if axis is None else _ints(axis)
+    return apply(lambda a: jnp.roll(a, sh, ax), x, op_name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    """Gather rows along axis by a 1-D index (ref: `phi/kernels/gather_kernel.h`)."""
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis._data)
+    return apply(lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i,
+                                       axis=axis), x, index, op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def prim(a, i):
+        idx_depth = i.shape[-1]
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+
+    return apply(prim, x, index, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """Scatter updates into x at rows `index` (ref: `phi/kernels/scatter_kernel.h`)."""
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def prim(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u, mode="drop")
+        zeroed = a.at[i].set(jnp.zeros_like(u), mode="drop")
+        return zeroed.at[i].add(u, mode="drop")
+
+    return apply(prim, x, index, updates, op_name="scatter")
+
+
+scatter_ = make_inplace(scatter)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def prim(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u, mode="drop")
+
+    return apply(prim, x, index, updates, op_name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = ensure_tensor(index), ensure_tensor(updates)
+    shp = tuple(_ints(shape))
+
+    def prim(i, u):
+        base = jnp.zeros(shp, u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return base.at[idx].add(u, mode="drop")
+
+    return apply(prim, index, updates, op_name="scatter_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return apply(lambda a, i: jnp.take(a, i, axis=axis), x, index,
+                 op_name="index_select")
+
+
+def index_sample(x, index):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index,
+                 op_name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = ensure_tensor(x), ensure_tensor(index), ensure_tensor(value)
+
+    def prim(a, i, v):
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = am.at[i].add(vm, mode="drop")
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply(prim, x, index, value, op_name="index_add")
+
+
+index_add_ = make_inplace(index_add)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    idx_ts = [ensure_tensor(i) for i in indices]
+    value = ensure_tensor(value)
+
+    def prim(a, v, *idx):
+        if accumulate:
+            return a.at[tuple(idx)].add(v)
+        return a.at[tuple(idx)].set(v)
+
+    return apply(prim, x, value, *idx_ts, op_name="index_put")
+
+
+index_put_ = make_inplace(index_put)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices,
+                 op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    values = ensure_tensor(values)
+
+    def prim(a, i, v):
+        v = jnp.broadcast_to(v, i.shape) if v.ndim else jnp.full(i.shape, v, a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        am = jnp.moveaxis(a, axis, 0)
+        im = jnp.moveaxis(i, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        # build full nd indices
+        other = jnp.indices(im.shape)[1:]
+        idx = (im,) + tuple(other)
+        if reduce in ("add", "sum"):
+            out = am.at[idx].add(vm)
+        elif reduce in ("mul", "multiply"):
+            out = am.at[idx].multiply(vm)
+        elif reduce == "amax":
+            out = am.at[idx].max(vm)
+        elif reduce == "amin":
+            out = am.at[idx].min(vm)
+        else:
+            raise ValueError(f"unsupported reduce {reduce}")
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply(prim, arr, indices, values, op_name="put_along_axis")
+
+
+put_along_axis_ = make_inplace(put_along_axis)
+
+
+def take(x, index, mode="raise", name=None):
+    import jax as _jax
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    if mode == "raise" and not isinstance(index._data, _jax.core.Tracer):
+        idx_np = np.asarray(index._data)
+        if idx_np.size and (idx_np.min() < -x.size or idx_np.max() >= x.size):
+            raise IndexError(
+                f"take: index out of range for tensor of {x.size} elements "
+                f"(got min={idx_np.min()}, max={idx_np.max()})")
+    jmode = {"raise": "clip", "wrap": "wrap", "clip": "clip"}[mode]
+    return apply(lambda a, i: jnp.take(a.reshape(-1), i.reshape(-1), mode=jmode)
+                 .reshape(i.shape), x, index, op_name="take")
+
+
+def masked_select(x, mask, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    # dynamic output shape: eager-only (like reference's masked_select on GPU)
+    return apply(lambda a, m: jnp.broadcast_to(a, m.shape)[m], x, mask,
+                 op_name="masked_select")
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    if isinstance(value, Tensor):
+        return apply(lambda a, m, v: jnp.where(m, v.astype(a.dtype), a), x, mask,
+                     value, op_name="masked_fill")
+    return apply(lambda a, m: jnp.where(m, jnp.asarray(value, a.dtype), a), x, mask,
+                 op_name="masked_fill")
+
+
+masked_fill_ = make_inplace(masked_fill)
+
+
+def masked_scatter(x, mask, value, name=None):
+    x, mask, value = ensure_tensor(x), ensure_tensor(mask), ensure_tensor(value)
+
+    def prim(a, m, v):
+        mb = jnp.broadcast_to(m, a.shape).reshape(-1)
+        af = a.reshape(-1)
+        # position of each True among Trues
+        pos = jnp.cumsum(mb) - 1
+        vals = v.reshape(-1)[jnp.clip(pos, 0, v.size - 1)]
+        return jnp.where(mb, vals, af).reshape(a.shape)
+
+    return apply(prim, x, mask, value, op_name="masked_scatter")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        return apply(lambda a, r: jnp.repeat(a, r, axis=axis,
+                                             total_repeat_length=int(np.asarray(
+                                                 repeats._data).sum())),
+                     x, repeats, op_name="repeat_interleave")
+    return apply(lambda a: jnp.repeat(a, repeats, axis=axis), x,
+                 op_name="repeat_interleave")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    # dynamic-shape op: runs on host values (eager only), like reference CPU fallback
+    arr = np.asarray(x._data)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res), _internal=True)
+    outs = [Tensor(jnp.asarray(r), _internal=True) for r in res]
+    # paddle returns (out, index, inverse, counts) subset in that order
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.ones(arr.shape[0], bool)
+        keep[1:] = arr[1:] != arr[:-1]
+    else:
+        keep = np.ones(arr.shape[axis], bool)
+        sl1 = [slice(None)] * arr.ndim
+        sl0 = [slice(None)] * arr.ndim
+        sl1[axis] = slice(1, None)
+        sl0[axis] = slice(None, -1)
+        diffs = (arr[tuple(sl1)] != arr[tuple(sl0)])
+        keep[1:] = diffs.reshape(diffs.shape[axis] if arr.ndim == 1 else
+                                 (diffs.shape[axis],) + tuple(
+                                     s for i, s in enumerate(diffs.shape)
+                                     if i != axis)).reshape(
+            keep.shape[0] - 1, -1).any(axis=1)
+    out = np.compress(keep, arr, axis=0 if axis is None else axis)
+    outs = [Tensor(jnp.asarray(out), _internal=True)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64)), _internal=True))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        cnt = np.diff(np.append(idx, keep.shape[0]))
+        outs.append(Tensor(jnp.asarray(cnt.astype(np.int64)), _internal=True))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unbind(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    n = x.shape[axis]
+
+    def prim(a):
+        return tuple(jnp.squeeze(s, axis)
+                     for s in jnp.split(a, n, axis=axis))
+
+    return list(apply(prim, x, op_name="unbind"))
+
+
+def slice(input, axes, starts, ends):
+    input = ensure_tensor(input)
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+
+    def prim(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(s, e)
+        return a[tuple(idx)]
+
+    return apply(prim, input, op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+    axes, starts, ends, strides = (_ints(axes), _ints(starts), _ints(ends),
+                                   _ints(strides))
+
+    def prim(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(s, e, st)
+        return a[tuple(idx)]
+
+    return apply(prim, x, op_name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    shp = _ints(shape)
+    offs = [0] * x.ndim if offsets is None else _ints(offsets)
+
+    def prim(a):
+        idx = tuple(builtins.slice(o, o + (s if s != -1 else a.shape[i] - o))
+                    for i, (o, s) in enumerate(zip(offs, shp)))
+        return a[idx]
+
+    return apply(prim, x, op_name="crop")
+
+
+def tolist(x):
+    return ensure_tensor(x).tolist()
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    n = int(builtins.max(int(np.asarray(x._data).max(initial=0)) + 1, minlength))
+    if weights is not None:
+        w = ensure_tensor(weights)
+        return apply(lambda a, ww: jnp.bincount(a, ww, length=n), x, w,
+                     op_name="bincount")
+    return apply(lambda a: jnp.bincount(a, length=n), x, op_name="bincount")
+
+
+def one_hot(x, num_classes, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jax.nn.one_hot(a, num_classes,
+                                          dtype=dtype_mod.get_default_dtype()),
+                 x, op_name="one_hot")
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    input = ensure_tensor(input)
+    arr = np.asarray(input._data)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    w = None if weight is None else np.asarray(ensure_tensor(weight)._data)
+    h, _ = np.histogram(arr, bins=bins, range=(lo, hi), weights=w, density=density)
+    return Tensor(jnp.asarray(h if density else h.astype(np.int64)), _internal=True)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    x = ensure_tensor(x)
+    arr = np.asarray(x._data)
+    w = None if weights is None else np.asarray(ensure_tensor(weights)._data)
+    h, edges = np.histogramdd(arr, bins=bins, range=ranges, density=density,
+                              weights=w)
+    return (Tensor(jnp.asarray(h), _internal=True),
+            [Tensor(jnp.asarray(e), _internal=True) for e in edges])
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    x = ensure_tensor(x)
+    arr = np.lib.stride_tricks.as_strided(
+        np.asarray(x._data).reshape(-1)[offset:],
+        shape=tuple(shape),
+        strides=tuple(s * x.dtype.itemsize for s in stride))
+    return Tensor(jnp.asarray(arr.copy()), _internal=True)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(t, [1]) if ensure_tensor(t).ndim == 0 else ensure_tensor(t)
+            for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for t in inputs:
+        t = ensure_tensor(t)
+        outs.append(apply(jnp.atleast_2d, t, op_name="atleast_2d"))
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for t in inputs:
+        t = ensure_tensor(t)
+        outs.append(apply(jnp.atleast_3d, t, op_name="atleast_3d"))
+    return outs[0] if len(outs) == 1 else outs
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    input = ensure_tensor(input)
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def prim(a):
+        lo = shard_id * shard_size
+        hi = (shard_id + 1) * shard_size
+        in_shard = (a >= lo) & (a < hi)
+        return jnp.where(in_shard, a - lo, ignore_value)
+
+    return apply(prim, input, op_name="shard_index")

@@ -1,0 +1,546 @@
+"""Math ops (ref: `python/paddle/tensor/math.py`, kernels in `paddle/phi/kernels`).
+
+Each op is a thin wrapper routing a pure jnp function through the autograd dispatcher;
+XLA supplies the fused TPU kernels the reference implements per-backend by hand.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor, _is_scalar
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.ops.common import (
+    ensure_tensor, unary, binary, make_inplace, promote_pair, rebind, inplace_guard,
+)
+
+# ------------------------------------------------------------------ unary elementwise
+
+abs = unary(jnp.abs, "abs")
+acos = unary(jnp.arccos, "acos")
+asin = unary(jnp.arcsin, "asin")
+atan = unary(jnp.arctan, "atan")
+acosh = unary(jnp.arccosh, "acosh")
+asinh = unary(jnp.arcsinh, "asinh")
+atanh = unary(jnp.arctanh, "atanh")
+ceil = unary(jnp.ceil, "ceil")
+cos = unary(jnp.cos, "cos")
+cosh = unary(jnp.cosh, "cosh")
+exp = unary(jnp.exp, "exp")
+expm1 = unary(jnp.expm1, "expm1")
+floor = unary(jnp.floor, "floor")
+log = unary(jnp.log, "log")
+log2 = unary(jnp.log2, "log2")
+log10 = unary(jnp.log10, "log10")
+log1p = unary(jnp.log1p, "log1p")
+neg = unary(jnp.negative, "neg")
+negative = neg
+reciprocal = unary(jnp.reciprocal, "reciprocal")
+round = unary(jnp.round, "round")
+rsqrt = unary(jax.lax.rsqrt, "rsqrt")
+sigmoid = unary(jax.nn.sigmoid, "sigmoid")
+sign = unary(jnp.sign, "sign")
+sgn = sign
+sin = unary(jnp.sin, "sin")
+sinh = unary(jnp.sinh, "sinh")
+sqrt = unary(jnp.sqrt, "sqrt")
+square = unary(jnp.square, "square")
+tan = unary(jnp.tan, "tan")
+tanh = unary(jnp.tanh, "tanh")
+trunc = unary(jnp.trunc, "trunc")
+erf = unary(jax.scipy.special.erf, "erf")
+erfinv = unary(jax.scipy.special.erfinv, "erfinv")
+digamma = unary(jax.scipy.special.digamma, "digamma")
+lgamma = unary(jax.scipy.special.gammaln, "lgamma")
+gammaln = lgamma
+i0 = unary(jax.scipy.special.i0, "i0")
+i0e = unary(jax.scipy.special.i0e, "i0e")
+i1 = unary(jax.scipy.special.i1, "i1")
+i1e = unary(jax.scipy.special.i1e, "i1e")
+angle = unary(jnp.angle, "angle")
+conj = unary(jnp.conj, "conj")
+real = unary(jnp.real, "real")
+imag = unary(jnp.imag, "imag")
+isnan = unary(jnp.isnan, "isnan")
+isinf = unary(jnp.isinf, "isinf")
+isfinite = unary(jnp.isfinite, "isfinite")
+logical_not = unary(jnp.logical_not, "logical_not")
+bitwise_not = unary(jnp.bitwise_not, "bitwise_not")
+logit = unary(jax.scipy.special.logit, "logit")
+frac = unary(lambda a: a - jnp.trunc(a), "frac")
+deg2rad = unary(jnp.deg2rad, "deg2rad")
+rad2deg = unary(jnp.rad2deg, "rad2deg")
+
+# in-place unary variants (dygraph API parity: paddle.exp_, tanh_ ...)
+exp_ = make_inplace(exp)
+sqrt_ = make_inplace(sqrt)
+rsqrt_ = make_inplace(rsqrt)
+reciprocal_ = make_inplace(reciprocal)
+ceil_ = make_inplace(ceil)
+floor_ = make_inplace(floor)
+round_ = make_inplace(round)
+abs_ = make_inplace(abs)
+sigmoid_ = make_inplace(sigmoid)
+tanh_ = make_inplace(tanh)
+square_ = make_inplace(square)
+neg_ = make_inplace(neg)
+
+# ------------------------------------------------------------------ binary elementwise
+
+add = binary(jnp.add, "add")
+subtract = binary(jnp.subtract, "subtract")
+multiply = binary(jnp.multiply, "multiply")
+mul = multiply
+divide = binary(jnp.true_divide, "divide")
+div = divide
+floor_divide = binary(jnp.floor_divide, "floor_divide")
+remainder = binary(jnp.remainder, "remainder")
+mod = remainder
+floor_mod = remainder
+fmod = binary(jnp.fmod, "fmod")
+pow = binary(jnp.power, "pow")
+maximum = binary(jnp.maximum, "maximum")
+minimum = binary(jnp.minimum, "minimum")
+fmax = binary(jnp.fmax, "fmax")
+fmin = binary(jnp.fmin, "fmin")
+atan2 = binary(jnp.arctan2, "atan2")
+logaddexp = binary(jnp.logaddexp, "logaddexp")
+heaviside = binary(jnp.heaviside, "heaviside")
+nextafter = binary(jnp.nextafter, "nextafter")
+gcd = binary(jnp.gcd, "gcd")
+lcm = binary(jnp.lcm, "lcm")
+hypot = binary(jnp.hypot, "hypot")
+copysign = binary(jnp.copysign, "copysign")
+ldexp = binary(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), "ldexp")
+logical_and = binary(jnp.logical_and, "logical_and", promote=False)
+logical_or = binary(jnp.logical_or, "logical_or", promote=False)
+logical_xor = binary(jnp.logical_xor, "logical_xor", promote=False)
+bitwise_and = binary(jnp.bitwise_and, "bitwise_and", promote=False)
+bitwise_or = binary(jnp.bitwise_or, "bitwise_or", promote=False)
+bitwise_xor = binary(jnp.bitwise_xor, "bitwise_xor", promote=False)
+equal = binary(jnp.equal, "equal", promote=False)
+not_equal = binary(jnp.not_equal, "not_equal", promote=False)
+less_than = binary(jnp.less, "less_than", promote=False)
+less_equal = binary(jnp.less_equal, "less_equal", promote=False)
+greater_than = binary(jnp.greater, "greater_than", promote=False)
+greater_equal = binary(jnp.greater_equal, "greater_equal", promote=False)
+
+add_ = make_inplace(add)
+subtract_ = make_inplace(subtract)
+multiply_ = make_inplace(multiply)
+divide_ = make_inplace(divide)
+remainder_ = make_inplace(remainder)
+floor_divide_ = make_inplace(floor_divide)
+pow_ = make_inplace(pow)
+
+# ------------------------------------------------------------------ scalar-attr ops
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """y = scale*x + bias (ref kernel: `paddle/phi/kernels/scale_kernel.h`)."""
+    x = ensure_tensor(x)
+    s = float(scale) if _is_scalar(scale) else scale
+    if isinstance(s, Tensor):
+        if bias_after_scale:
+            out = apply(lambda a, sc: a * sc + bias, x, s, op_name="scale")
+        else:
+            out = apply(lambda a, sc: (a + bias) * sc, x, s, op_name="scale")
+        return out
+    if bias_after_scale:
+        return apply(lambda a: a * s + bias, x, op_name="scale")
+    return apply(lambda a: (a + bias) * s, x, op_name="scale")
+
+
+scale_ = make_inplace(scale)
+
+
+def clip(x, min=None, max=None, name=None):
+    x = ensure_tensor(x)
+    lo = min._data if isinstance(min, Tensor) else min
+    hi = max._data if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, lo, hi), x, op_name="clip")
+
+
+clip_ = make_inplace(clip)
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), x, y, weight, op_name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), x, y, op_name="lerp")
+
+
+lerp_ = make_inplace(lerp)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x, op_name="stanh")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = ensure_tensor(x)
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                 x, op_name="nan_to_num")
+
+
+def multiply_no_nan(x, y):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda a, b: jnp.where(b == 0, 0.0, a * b).astype(a.dtype),
+                 x, y, op_name="multiply_no_nan")
+
+
+# ------------------------------------------------------------------ reductions
+
+
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(jfn, name, bool_to_int64=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        x = ensure_tensor(x)
+        ax = _axis_arg(axis)
+
+        def prim(a):
+            r = jfn(a, axis=ax, keepdims=keepdim)
+            if dtype is not None:
+                r = r.astype(dtype_mod.convert_dtype(dtype))
+            elif bool_to_int64 and a.dtype == jnp.bool_:
+                r = r.astype(jnp.int64)
+            return r
+
+        return apply(prim, x, op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+sum = _reduce(jnp.sum, "sum", bool_to_int64=True)
+mean = _reduce(jnp.mean, "mean")
+prod = _reduce(jnp.prod, "prod")
+amax = _reduce(jnp.max, "amax")
+amin = _reduce(jnp.min, "amin")
+nansum = _reduce(jnp.nansum, "nansum")
+nanmean = _reduce(jnp.nanmean, "nanmean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x, op_name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x, op_name="min")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x, op_name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x, op_name="any")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+                 x, op_name="logsumexp")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim)
+                 .astype(jnp.int64), x, op_name="count_nonzero")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    ddof = 1 if unbiased else 0
+    return apply(lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                 x, op_name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    ddof = 1 if unbiased else 0
+    return apply(lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                 x, op_name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+    ax = None if axis is None else int(axis)
+    if mode == "avg":
+        return apply(lambda a: jnp.median(a, axis=ax, keepdims=keepdim),
+                     x, op_name="median")
+
+    def prim(a):
+        n = a.shape[ax] if ax is not None else a.size
+        flat = a if ax is not None else a.reshape(-1)
+        axx = ax if ax is not None else 0
+        srt = jnp.sort(flat, axis=axx)
+        idx = (n - 1) // 2
+        r = jnp.take(srt, idx, axis=axx)
+        if keepdim and ax is not None:
+            r = jnp.expand_dims(r, axx)
+        return r
+
+    return apply(prim, x, op_name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _axis_arg(axis)
+    return apply(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim),
+                 x, op_name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = ensure_tensor(x)
+    ax = None if axis is None else int(axis)
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(lambda a: jnp.quantile(a, qv, axis=ax, keepdims=keepdim,
+                                        method=interpolation), x, op_name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = None if axis is None else int(axis)
+    qv = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(lambda a: jnp.nanquantile(a, qv, axis=ax, keepdims=keepdim),
+                 x, op_name="nanquantile")
+
+
+# ------------------------------------------------------------------ cumulative
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        aa = a.reshape(-1) if axis is None else a
+        r = jnp.cumsum(aa, axis=0 if axis is None else int(axis))
+        return r.astype(dtype_mod.convert_dtype(dtype)) if dtype else r
+
+    return apply(prim, x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        r = jnp.cumprod(a, axis=int(dim))
+        return r.astype(dtype_mod.convert_dtype(dtype)) if dtype else r
+
+    return apply(prim, x, op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    ax = 0 if axis is None else int(axis)
+
+    def prim(a):
+        aa = a.reshape(-1) if axis is None else a
+        vals = jax.lax.cummax(aa, axis=ax)
+        iota = jax.lax.broadcasted_iota(jnp.int64, aa.shape, ax)
+        idx = jax.lax.cummax(jnp.where(aa == vals, iota, -1), axis=ax)
+        return vals, idx.astype(dtype_mod.convert_dtype(dtype))
+
+    return apply(prim, x, op_name="cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    ax = 0 if axis is None else int(axis)
+
+    def prim(a):
+        aa = a.reshape(-1) if axis is None else a
+        vals = jax.lax.cummin(aa, axis=ax)
+        iota = jax.lax.broadcasted_iota(jnp.int64, aa.shape, ax)
+        idx = jax.lax.cummax(jnp.where(aa == vals, iota, -1), axis=ax)
+        return vals, idx.astype(dtype_mod.convert_dtype(dtype))
+
+    return apply(prim, x, op_name="cummin")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        aa = a.reshape(-1) if axis is None else a
+        return jax.lax.cumlogsumexp(aa, axis=0 if axis is None else int(axis))
+
+    return apply(prim, x, op_name="logcumsumexp")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = ensure_tensor(x)
+    extras = []
+    spec = []
+    for t in (prepend, append):
+        if t is None:
+            spec.append(False)
+        else:
+            spec.append(True)
+            extras.append(ensure_tensor(t))
+
+    def prim(a, *ex):
+        it = iter(ex)
+        p = next(it) if spec[0] else None
+        ap = next(it) if spec[1] else None
+        kw = {}
+        if p is not None:
+            kw["prepend"] = p
+        if ap is not None:
+            kw["append"] = ap
+        return jnp.diff(a, n=n, axis=axis, **kw)
+
+    return apply(prim, x, *extras, op_name="diff")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+    if x is not None:
+        xt = ensure_tensor(x)
+        return apply(lambda a, b: jax.scipy.integrate.trapezoid(a, b, axis=axis),
+                     y, xt, op_name="trapezoid")
+    d = 1.0 if dx is None else dx
+    return apply(lambda a: jax.scipy.integrate.trapezoid(a, dx=d, axis=axis),
+                 y, op_name="trapezoid")
+
+
+cumulative_trapezoid = None  # assigned below
+
+
+def _cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+
+    def _cumtrap(a, b=None, d=1.0):
+        sl1 = [slice(None)] * a.ndim
+        sl0 = [slice(None)] * a.ndim
+        sl1[axis] = slice(1, None)
+        sl0[axis] = slice(None, -1)
+        avg = (a[tuple(sl1)] + a[tuple(sl0)]) / 2.0
+        if b is not None:
+            step = b[tuple(sl1)] - b[tuple(sl0)]
+        else:
+            step = d
+        return jnp.cumsum(avg * step, axis=axis)
+
+    if x is not None:
+        return apply(lambda a, b: _cumtrap(a, b), y, ensure_tensor(x),
+                     op_name="cumulative_trapezoid")
+    return apply(lambda a: _cumtrap(a, d=(1.0 if dx is None else dx)), y,
+                 op_name="cumulative_trapezoid")
+
+
+cumulative_trapezoid = _cumulative_trapezoid
+
+
+# ------------------------------------------------------------------ misc math
+
+
+def increment(x, value=1.0, name=None):
+    inplace_guard(x)
+    res = apply(lambda a: a + value, x, op_name="increment")
+    return rebind(x, res)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan), x, y, op_name="isclose")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan), x, y,
+                 op_name="allclose")
+
+
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda a, b: jnp.array_equal(a, b), x, y, op_name="equal_all")
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (ref: `paddle/phi/kernels/add_n_kernel.h`)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    ts = [ensure_tensor(t) for t in inputs]
+
+    def prim(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+
+    return apply(prim, *ts, op_name="add_n")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y,
+                 op_name="addmm")
+
+
+def inner(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(jnp.inner, x, y, op_name="inner")
+
+
+def outer(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda a, b: jnp.outer(a, b), x, y, op_name="outer")
+
+
+def kron(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(jnp.kron, x, y, op_name="kron")
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if axis == 9:  # paddle default: first axis of size 3
+        ax = next((i for i, s in enumerate(x.shape) if s == 3), None)
+        if ax is None:
+            raise ValueError(
+                f"cross: no dimension of size 3 in shape {x.shape}; pass axis=")
+    else:
+        ax = axis
+    return apply(lambda a, b: jnp.cross(a, b, axis=ax), x, y, op_name="cross")
+
+
+def dot(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y, op_name="dot")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def rsub(x, y, alpha=1):
+    return subtract(y, multiply(x, alpha) if alpha != 1 else x)

@@ -1,0 +1,230 @@
+"""Random ops and the global generator (ref: `python/paddle/tensor/random.py`,
+generator state `paddle/phi/core/generator.h`).
+
+The generator state is itself a Tensor holding a JAX PRNG key, so reads/writes flow
+through the static-capture hooks: a ``to_static`` train step threads RNG state in and
+out of the compiled program instead of baking one key at trace time (the same problem
+the reference solves with per-device generator state + seed offsets in
+`paddle/phi/kernels/gpu/dropout_kernel.cu`).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.ops.common import ensure_tensor, rebind
+
+
+class Generator:
+    """Stateful PRNG (ref: ``paddle.framework.Generator``)."""
+
+    def __init__(self, seed=0):
+        self._state = Tensor(jax.random.key_data(jax.random.PRNGKey(seed)),
+                             _internal=True)
+        self._seed = seed
+
+    def manual_seed(self, seed):
+        self._seed = int(seed)
+        self._state._write(jax.random.key_data(jax.random.PRNGKey(self._seed)))
+        return self
+
+    def initial_seed(self):
+        return self._seed
+
+    def get_state(self):
+        return self._state
+
+    def set_state(self, state):
+        self._state._write(state._data if isinstance(state, Tensor)
+                           else jnp.asarray(state))
+
+    def next_key(self):
+        """Split the state; returns a raw jax key array for immediate use."""
+        data = self._state._read()
+        key = jax.random.wrap_key_data(data)
+        new_key, sub = jax.random.split(key)
+        self._state._write(jax.random.key_data(new_key))
+        return sub
+
+
+_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(value):
+    """Set the global RNG seed (ref: ``paddle.seed``)."""
+    _default_generator.manual_seed(value)
+    return _default_generator
+
+
+def get_rng_state():
+    return [_default_generator.get_state()]
+
+
+def set_rng_state(state_list):
+    _default_generator.set_state(state_list[0])
+
+
+def _float_dtype(dtype):
+    return dtype_mod.convert_dtype(dtype) if dtype is not None \
+        else dtype_mod.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None):
+    key = _default_generator.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _float_dtype(dtype)),
+                  _internal=True)
+
+
+def randn(shape, dtype=None, name=None):
+    key = _default_generator.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _float_dtype(dtype)),
+                  _internal=True)
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = _default_generator.next_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = ensure_tensor(mean) if not isinstance(mean, (int, float)) else mean
+        s = ensure_tensor(std) if not isinstance(std, (int, float)) else std
+        shp = _shape(shape) if shape is not None else \
+            (tuple(m.shape) if isinstance(m, Tensor) else tuple(s.shape))
+        ts = [t for t in (m, s) if isinstance(t, Tensor)]
+
+        def prim(*arrs):
+            it = iter(arrs)
+            mm = next(it) if isinstance(m, Tensor) else m
+            ss = next(it) if isinstance(s, Tensor) else s
+            return mm + ss * jax.random.normal(key, shp,
+                                               dtype_mod.get_default_dtype())
+
+        return apply(prim, *ts, op_name="normal")
+    shp = _shape(shape) if shape is not None else ()
+    out = mean + std * jax.random.normal(key, shp, dtype_mod.get_default_dtype())
+    return Tensor(out, _internal=True)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = _default_generator.next_key() if seed == 0 else jax.random.PRNGKey(seed)
+    d = _float_dtype(dtype)
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return Tensor(jax.random.uniform(key, _shape(shape), d, lo, hi), _internal=True)
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    res = uniform(x.shape, dtype=x.dtype, min=min, max=max, seed=seed)
+    x._write(res._data)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    key = _default_generator.next_key()
+    x._write(mean + std * jax.random.normal(key, tuple(x.shape), x.dtype))
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = _default_generator.next_key()
+    return Tensor(jax.random.randint(key, _shape(shape), int(low), int(high),
+                                     dtype_mod.convert_dtype(dtype)), _internal=True)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    d = dtype if dtype is not None else x.dtype
+    return randint(low, high, tuple(x.shape), d)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = _default_generator.next_key()
+    return Tensor(jax.random.permutation(key, int(n))
+                  .astype(dtype_mod.convert_dtype(dtype)), _internal=True)
+
+
+def shuffle(x, axis=0):
+    x = ensure_tensor(x)
+    key = _default_generator.next_key()
+    return apply(lambda a: jax.random.permutation(key, a, axis=axis,
+                                                  independent=False),
+                 x, op_name="shuffle")
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    key = _default_generator.next_key()
+    return apply(lambda a: jax.random.bernoulli(key, a, a.shape).astype(a.dtype),
+                 x, op_name="bernoulli")
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = _default_generator.next_key()
+    x._write(jax.random.bernoulli(key, p, tuple(x.shape)).astype(x.dtype))
+    return x
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    key = _default_generator.next_key()
+    return apply(lambda a: jax.random.poisson(key, a, a.shape).astype(a.dtype),
+                 x, op_name="poisson")
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    key = _default_generator.next_key()
+
+    def prim(a):
+        p = a / jnp.sum(a, axis=-1, keepdims=True)
+        if a.ndim == 1:
+            return jax.random.choice(key, a.shape[-1], (num_samples,),
+                                     replace=replacement, p=p).astype(jnp.int64)
+        ks = jax.random.split(key, a.shape[0])
+        return jax.vmap(lambda k, pp: jax.random.choice(
+            k, a.shape[-1], (num_samples,), replace=replacement, p=pp)
+        )(ks, p).astype(jnp.int64)
+
+    return apply(prim, x, op_name="multinomial")
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = _default_generator.next_key()
+    x._write(jax.random.exponential(key, tuple(x.shape), x.dtype) / lam)
+    return x
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = ensure_tensor(x)
+    key = _default_generator.next_key()
+
+    def prim(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            hard_y = jnp.zeros_like(y)
+            hard_y = jnp.put_along_axis(hard_y, idx, 1.0, axis=axis, inplace=False)
+            y = hard_y + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply(prim, x, op_name="gumbel_softmax")

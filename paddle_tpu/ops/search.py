@@ -1,0 +1,175 @@
+"""Search / sort ops (ref: `python/paddle/tensor/search.py`)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core import dtype as dtype_mod
+from paddle_tpu.ops.common import ensure_tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    d = dtype_mod.convert_dtype(dtype)
+
+    def prim(a):
+        r = jnp.argmax(a, axis=None if axis is None else int(axis), keepdims=keepdim)
+        return r.astype(d)
+
+    return apply(prim, x, op_name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    d = dtype_mod.convert_dtype(dtype)
+
+    def prim(a):
+        r = jnp.argmin(a, axis=None if axis is None else int(axis), keepdims=keepdim)
+        return r.astype(d)
+
+    return apply(prim, x, op_name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable,
+                          descending=descending)
+        return idx.astype(jnp.int64)
+
+    return apply(prim, x, op_name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        r = jnp.sort(a, axis=axis, stable=stable, descending=descending)
+        return r
+
+    return apply(prim, x, op_name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k._data)
+
+    def prim(a):
+        ax = axis % a.ndim
+        src = a if largest else -a
+        moved = jnp.moveaxis(src, ax, -1)
+        vals, idx = jax.lax.top_k(moved, k)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+
+    return apply(prim, x, op_name="topk")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        ax = axis % a.ndim
+        srt = jnp.sort(a, axis=ax)
+        idx = jnp.argsort(a, axis=ax, stable=True)
+        v = jnp.take(srt, k - 1, axis=ax)
+        i = jnp.take(idx, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            v, i = jnp.expand_dims(v, ax), jnp.expand_dims(i, ax)
+        return v, i
+
+    return apply(prim, x, op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        ax = axis % a.ndim
+        srt = jnp.sort(a, axis=ax)
+        sidx = jnp.argsort(a, axis=ax, stable=True)
+        n = a.shape[ax]
+        same = jnp.concatenate(
+            [jnp.ones_like(jnp.take(srt, jnp.array([0]), axis=ax), dtype=jnp.int32),
+             (jnp.take(srt, jnp.arange(1, n), axis=ax) ==
+              jnp.take(srt, jnp.arange(n - 1), axis=ax)).astype(jnp.int32)], axis=ax)
+        run = jax.lax.associative_scan(
+            lambda p, q: p * q + q, same, axis=ax)
+        best = jnp.argmax(run, axis=ax, keepdims=True)
+        v = jnp.take_along_axis(srt, best, axis=ax)
+        i = jnp.take_along_axis(sidx, best, axis=ax).astype(jnp.int64)
+        if not keepdim:
+            v, i = jnp.squeeze(v, ax), jnp.squeeze(i, ax)
+        return v, i
+
+    return apply(prim, x, op_name="mode")
+
+
+def nonzero(x, as_tuple=False):
+    x = ensure_tensor(x)
+    # dynamic output shape: host fallback (eager only)
+    arr = np.asarray(x._data)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64)), _internal=True)
+                     for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)), _internal=True)
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    from paddle_tpu.ops.common import promote_pair
+    x, y = promote_pair(x, y)
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y, op_name="where")
+
+
+def where_(condition, x=None, y=None, name=None):
+    from paddle_tpu.ops.common import rebind, inplace_guard
+    inplace_guard(x)
+    return rebind(x, where(condition, x, y))
+
+
+def masked_fill(x, mask, value):
+    from paddle_tpu.ops import manipulation
+    return manipulation.masked_fill(x, mask, value)
+
+
+def index_fill(x, index, axis, value, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def prim(a, i):
+        am = jnp.moveaxis(a, axis, 0)
+        am = am.at[i].set(value)
+        return jnp.moveaxis(am, 0, axis)
+
+    return apply(prim, x, index, op_name="index_fill")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    sorted_sequence, values = ensure_tensor(sorted_sequence), ensure_tensor(values)
+    side = "right" if right else "left"
+
+    def prim(s, v):
+        if s.ndim == 1:
+            r = jnp.searchsorted(s, v, side=side)
+        else:
+            r = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side)
+                         )(s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1]))
+            r = r.reshape(v.shape)
+        return r.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply(prim, sorted_sequence, values, op_name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)

@@ -1,0 +1,7 @@
+"""paddle.optimizer (ref: `python/paddle/optimizer/__init__.py`)."""
+from paddle_tpu.optimizer.optimizer import Optimizer  # noqa: F401
+from paddle_tpu.optimizer.optimizers import (  # noqa: F401
+    SGD, Momentum, Adam, AdamW, Adagrad, Adamax, Adadelta, RMSProp, Lamb,
+    LarsMomentum,
+)
+from paddle_tpu.optimizer import lr  # noqa: F401

@@ -1,0 +1,201 @@
+"""Optimizer base (ref: `python/paddle/optimizer/optimizer.py:98`).
+
+The per-param update is one fused jitted jax function over (param, grad, state)
+arrays — the analog of the reference's fused CUDA optimizer kernels
+(`phi/kernels/gpu/adam_kernel.cu` etc.), supplied here by XLA fusion. All updates run
+under no_grad and rebind param storage in place, so the same optimizer object works
+eagerly and inside a captured train step.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, Parameter
+from paddle_tpu.core.autograd import no_grad
+from paddle_tpu.nn.clip import ClipGradBase
+from paddle_tpu.optimizer.lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in dygraph mode (pass model.parameters())")
+        self._parameter_list = list(parameters)
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for group in self._param_groups:
+                flat.extend(group["params"])
+            self._parameter_list = flat
+        self._learning_rate = learning_rate
+        if isinstance(weight_decay, float) or isinstance(weight_decay, int):
+            self._weight_decay = float(weight_decay)
+        elif weight_decay is None:
+            self._weight_decay = 0.0
+        else:
+            # L2Decay-like object with a coeff attribute
+            self._weight_decay = float(getattr(weight_decay, "_coeff",
+                                               getattr(weight_decay, "coeff", 0.0)))
+        self._grad_clip = grad_clip
+        self._accumulators: dict[str, dict[int, Tensor]] = collections.defaultdict(
+            dict)
+        self._global_step = 0
+        self._use_master_weights = False
+        self._master_weights: dict[int, Tensor] = {}
+        self._name = name or type(self).__name__
+        # lr lives in a Tensor so captured train steps thread it as state: the
+        # scheduler updates it *outside* the compiled program (analog of the
+        # reference feeding lr as a Variable into optimizer ops)
+        self._lr_tensor = Tensor(jnp.asarray(self.get_lr(), jnp.float32),
+                                 _internal=True)
+        # step count as state too (adam bias correction inside captured steps)
+        self._step_tensor = Tensor(jnp.asarray(0, jnp.int64), _internal=True)
+        if isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate._bind_optimizer(self)
+
+    # ------------------------------------------------------------------ lr
+
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when the lr is an LRScheduler; call "
+                               "scheduler.step() instead")
+        self._learning_rate = float(value)
+        self._sync_lr_tensor(self._learning_rate)
+
+    def _sync_lr_tensor(self, value):
+        from paddle_tpu.core import tensor as tensor_mod
+        if not tensor_mod.in_capture():
+            self._lr_tensor._write(jnp.asarray(float(value), jnp.float32))
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+        scheduler._bind_optimizer(self)
+
+    # ------------------------------------------------------------------ state
+
+    def _all_params(self):
+        return self._parameter_list
+
+    def _accumulator(self, name, p, init=None, dtype=None):
+        store = self._accumulators[name]
+        key = id(p)
+        if key not in store:
+            d = dtype or (jnp.float32 if self._use_master_weights else p.dtype)
+            arr = jnp.zeros(p._data.shape, d) if init is None else init
+            store[key] = Tensor(arr, _internal=True)
+        return store[key]
+
+    def _master(self, p):
+        key = id(p)
+        if key not in self._master_weights:
+            self._master_weights[key] = Tensor(p._data.astype(jnp.float32),
+                                               _internal=True)
+        return self._master_weights[key]
+
+    # ------------------------------------------------------------------ step
+
+    def _param_group_of(self, p):
+        if self._param_groups is None:
+            return None
+        for g in self._param_groups:
+            if any(q is p for q in g["params"]):
+                return g
+        return None
+
+    @no_grad()
+    def step(self):
+        from paddle_tpu.core import tensor as tensor_mod
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._global_step += 1
+        if not tensor_mod.in_capture():
+            # sync python-side lr/step into the state tensors; inside a captured
+            # step these writes would bake constants, so they happen out-of-graph
+            self._lr_tensor._write(jnp.asarray(self.get_lr(), jnp.float32))
+            self._step_tensor._write(jnp.asarray(self._global_step, jnp.int64))
+        else:
+            self._step_tensor._write(self._step_tensor._read() + 1)
+        lr_arr = self._lr_tensor._read()
+        t_arr = self._step_tensor._read().astype(jnp.float32)
+        for p, g in params_grads:
+            if g is None:
+                continue
+            group = self._param_group_of(p)
+            lr = lr_arr
+            wd = self._weight_decay
+            if group is not None:
+                lr = lr * float(group.get("learning_rate", 1.0))
+                gwd = group.get("weight_decay", wd)
+                wd = float(gwd) if gwd is not None else wd
+            if hasattr(p, "optimize_attr"):
+                lr = lr * float(getattr(p, "optimize_attr", {}).get(
+                    "learning_rate", 1.0))
+            self._append_optimize_op(p, g, lr, wd, t_arr)
+
+    def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        """Static-graph-style convenience: backward already run via loss.backward()
+        in dygraph; here minimize = backward + step (ref Optimizer.minimize)."""
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # ------------------------------------------------------------------ ckpt
+
+    def state_dict(self):
+        sd = {}
+        for name, store in self._accumulators.items():
+            for i, p in enumerate(self._parameter_list):
+                if id(p) in store:
+                    sd[f"{name}_{i}"] = store[id(p)]
+        for i, p in enumerate(self._parameter_list):
+            if id(p) in self._master_weights:
+                sd[f"master_{i}"] = self._master_weights[id(p)]
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        sd["global_step"] = self._global_step
+        return sd
+
+    def set_state_dict(self, state_dict):
+        for name, store in list(self._accumulators.items()):
+            for i, p in enumerate(self._parameter_list):
+                key = f"{name}_{i}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                    store[id(p)] = Tensor(arr, _internal=True)
+        for i, p in enumerate(self._parameter_list):
+            key = f"master_{i}"
+            if key in state_dict:
+                v = state_dict[key]
+                self._master_weights[id(p)] = Tensor(
+                    v._data if isinstance(v, Tensor) else jnp.asarray(v),
+                    _internal=True)
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
+                                                       LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        self._global_step = int(state_dict.get("global_step", 0))
+
+    load_state_dict = set_state_dict

@@ -1,0 +1,342 @@
+"""Concrete optimizers (ref: `python/paddle/optimizer/{sgd,momentum,adam,adamw,...}.py`;
+fused-kernel analogs of `_C_ops.adam_` at `adam.py:376`, `_C_ops.adamw_` at
+`adamw.py:496`). Each update body is a pure jax fn jitted once and reused."""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+
+@jax.jit
+def _sgd_update(p, g, lr, wd):
+    g = g + wd * p
+    return p - lr * g.astype(p.dtype)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
+        p._write(_sgd_update(p._read(), grad._read().astype(p.dtype),
+                             jnp.asarray(lr, p.dtype),
+                             jnp.asarray(weight_decay, p.dtype)))
+
+
+@partial(jax.jit, static_argnames=("use_nesterov",))
+def _momentum_update(p, g, velocity, lr, mu, wd, use_nesterov):
+    g = (g + wd * p).astype(p.dtype)
+    v = mu * velocity + g
+    if use_nesterov:
+        new_p = p - (g + mu * v) * lr
+    else:
+        new_p = p - lr * v
+    return new_p, v
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
+        vel = self._accumulator("velocity", p, dtype=p.dtype)
+        new_p, new_v = _momentum_update(
+            p._read(), grad._read().astype(p.dtype), vel._read(),
+            jnp.asarray(lr, p.dtype), jnp.asarray(self._momentum, p.dtype),
+            jnp.asarray(weight_decay, p.dtype), self._use_nesterov)
+        p._write(new_p)
+        vel._write(new_v)
+
+
+@partial(jax.jit, static_argnames=("decouple", "amsgrad"))
+def _adam_update(p, g, m, v, vhat, lr, beta1, beta2, eps, t, wd, decouple=False,
+                 amsgrad=False):
+    g32 = g.astype(m.dtype)
+    p32 = p.astype(m.dtype)
+    if not decouple:
+        g32 = g32 + wd * p32
+    m = beta1 * m + (1 - beta1) * g32
+    v = beta2 * v + (1 - beta2) * g32 * g32
+    mhat = m / (1 - beta1 ** t)
+    vv = v / (1 - beta2 ** t)
+    if amsgrad:
+        vhat = jnp.maximum(vhat, vv)
+        denom = jnp.sqrt(vhat) + eps
+    else:
+        denom = jnp.sqrt(vv) + eps
+    upd = mhat / denom
+    if decouple:
+        upd = upd + wd * p32
+    new_p = (p32 - lr * upd).astype(p.dtype)
+    return new_p, m, v, vhat
+
+
+class Adam(Optimizer):
+    _decoupled = False
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
+        m = self._accumulator("moment1", p, dtype=jnp.float32)
+        v = self._accumulator("moment2", p, dtype=jnp.float32)
+        if self._amsgrad:
+            vhat = self._accumulator("moment2_max", p, dtype=jnp.float32)
+            vhat_in = vhat._read()
+        else:
+            vhat = None
+            vhat_in = jnp.zeros((), jnp.float32)  # unused under static amsgrad=False
+        t_arr = t if t is not None else jnp.asarray(self._global_step,
+                                                   jnp.float32)
+        new_p, new_m, new_v, new_vhat = _adam_update(
+            p._read(), grad._read(), m._read(), v._read(), vhat_in,
+            jnp.asarray(lr, jnp.float32), jnp.asarray(self._beta1, jnp.float32),
+            jnp.asarray(self._beta2, jnp.float32),
+            jnp.asarray(self._epsilon, jnp.float32),
+            jnp.asarray(t_arr, jnp.float32),
+            jnp.asarray(weight_decay, jnp.float32),
+            decouple=self._decoupled, amsgrad=self._amsgrad)
+        p._write(new_p)
+        m._write(new_m)
+        v._write(new_v)
+        if self._amsgrad:
+            vhat._write(new_vhat)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref `python/paddle/optimizer/adamw.py`)."""
+
+    _decoupled = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            weight_decay = 0.0
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        super()._append_optimize_op(p, grad, lr, weight_decay, t)
+
+
+@jax.jit
+def _adagrad_update(p, g, moment, lr, eps, wd):
+    g32 = g.astype(moment.dtype)
+    p32 = p.astype(moment.dtype)
+    g32 = g32 + wd * p32
+    moment = moment + g32 * g32
+    new_p = (p32 - lr * g32 / (jnp.sqrt(moment) + eps)).astype(p.dtype)
+    return new_p, moment
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
+        mom = self._accumulator(
+            "moment", p, init=jnp.full(p._data.shape, self._init_acc, jnp.float32))
+        new_p, new_m = _adagrad_update(
+            p._read(), grad._read(), mom._read(), jnp.asarray(lr, jnp.float32),
+            jnp.asarray(self._epsilon, jnp.float32),
+            jnp.asarray(weight_decay, jnp.float32))
+        p._write(new_p)
+        mom._write(new_m)
+
+
+@jax.jit
+def _adamax_update(p, g, m, inf_norm, lr, beta1, beta2, eps, t, wd):
+    g32 = g.astype(m.dtype)
+    p32 = p.astype(m.dtype)
+    g32 = g32 + wd * p32
+    m = beta1 * m + (1 - beta1) * g32
+    inf_norm = jnp.maximum(beta2 * inf_norm, jnp.abs(g32))
+    new_p = (p32 - (lr / (1 - beta1 ** t)) * m / (inf_norm + eps)).astype(p.dtype)
+    return new_p, m, inf_norm
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
+        m = self._accumulator("moment", p, dtype=jnp.float32)
+        inf = self._accumulator("inf_norm", p, dtype=jnp.float32)
+        new_p, new_m, new_inf = _adamax_update(
+            p._read(), grad._read(), m._read(), inf._read(),
+            jnp.asarray(lr, jnp.float32), jnp.asarray(self._beta1, jnp.float32),
+            jnp.asarray(self._beta2, jnp.float32),
+            jnp.asarray(self._epsilon, jnp.float32),
+            jnp.asarray(t if t is not None else self._global_step, jnp.float32),
+            jnp.asarray(weight_decay, jnp.float32))
+        p._write(new_p)
+        m._write(new_m)
+        inf._write(new_inf)
+
+
+@jax.jit
+def _adadelta_update(p, g, avg_sq, avg_upd, rho, eps, lr, wd):
+    g32 = g.astype(avg_sq.dtype)
+    p32 = p.astype(avg_sq.dtype)
+    g32 = g32 + wd * p32
+    avg_sq = rho * avg_sq + (1 - rho) * g32 * g32
+    upd = jnp.sqrt(avg_upd + eps) / jnp.sqrt(avg_sq + eps) * g32
+    avg_upd = rho * avg_upd + (1 - rho) * upd * upd
+    return (p32 - lr * upd).astype(p.dtype), avg_sq, avg_upd
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
+        sq = self._accumulator("avg_squared_grad", p, dtype=jnp.float32)
+        up = self._accumulator("avg_squared_update", p, dtype=jnp.float32)
+        new_p, new_sq, new_up = _adadelta_update(
+            p._read(), grad._read(), sq._read(), up._read(),
+            jnp.asarray(self._rho, jnp.float32),
+            jnp.asarray(self._epsilon, jnp.float32),
+            jnp.asarray(lr, jnp.float32), jnp.asarray(weight_decay, jnp.float32))
+        p._write(new_p)
+        sq._write(new_sq)
+        up._write(new_up)
+
+
+@partial(jax.jit, static_argnames=("centered",))
+def _rmsprop_update(p, g, mean_sq, mom, mean_g, lr, rho, eps, momentum, wd,
+                    centered=False):
+    g32 = g.astype(mean_sq.dtype)
+    p32 = p.astype(mean_sq.dtype)
+    g32 = g32 + wd * p32
+    mean_sq = rho * mean_sq + (1 - rho) * g32 * g32
+    if centered:
+        mean_g = rho * mean_g + (1 - rho) * g32
+        denom = jnp.sqrt(mean_sq - mean_g * mean_g + eps)
+    else:
+        denom = jnp.sqrt(mean_sq + eps)
+    mom = momentum * mom + lr * g32 / denom
+    return (p32 - mom).astype(p.dtype), mean_sq, mom, mean_g
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
+        msq = self._accumulator("mean_square", p, dtype=jnp.float32)
+        mom = self._accumulator("momentum", p, dtype=jnp.float32)
+        mg = self._accumulator("mean_grad", p, dtype=jnp.float32)
+        new_p, new_msq, new_mom, new_mg = _rmsprop_update(
+            p._read(), grad._read(), msq._read(), mom._read(), mg._read(),
+            jnp.asarray(lr, jnp.float32), jnp.asarray(self._rho, jnp.float32),
+            jnp.asarray(self._epsilon, jnp.float32),
+            jnp.asarray(self._momentum, jnp.float32),
+            jnp.asarray(weight_decay, jnp.float32), centered=self._centered)
+        p._write(new_p)
+        msq._write(new_msq)
+        mom._write(new_mom)
+        mg._write(new_mg)
+
+
+@jax.jit
+def _lamb_update(p, g, m, v, lr, beta1, beta2, eps, t, wd):
+    g32 = g.astype(m.dtype)
+    p32 = p.astype(m.dtype)
+    m = beta1 * m + (1 - beta1) * g32
+    v = beta2 * v + (1 - beta2) * g32 * g32
+    mhat = m / (1 - beta1 ** t)
+    vhat = v / (1 - beta2 ** t)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p32
+    w_norm = jnp.linalg.norm(p32)
+    r_norm = jnp.linalg.norm(r)
+    trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return (p32 - lr * trust * r).astype(p.dtype), m, v
+
+
+class Lamb(Optimizer):
+    """LAMB (ref `python/paddle/optimizer/lamb.py`; dist variant
+    `meta_optimizers/lamb_optimizer.py`)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            weight_decay = 0.0
+        m = self._accumulator("moment1", p, dtype=jnp.float32)
+        v = self._accumulator("moment2", p, dtype=jnp.float32)
+        new_p, new_m, new_v = _lamb_update(
+            p._read(), grad._read(), m._read(), v._read(), jnp.asarray(lr, jnp.float32),
+            jnp.asarray(self._beta1, jnp.float32),
+            jnp.asarray(self._beta2, jnp.float32),
+            jnp.asarray(self._epsilon, jnp.float32),
+            jnp.asarray(t if t is not None else self._global_step, jnp.float32),
+            jnp.asarray(weight_decay, jnp.float32))
+        p._write(new_p)
+        m._write(new_m)
+        v._write(new_v)
+
+
+class LarsMomentum(Momentum):
+    """LARS (ref `meta_optimizers/lars_optimizer.py`, op `lars_momentum_op`)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None):
+        super().__init__(learning_rate, momentum, parameters,
+                         weight_decay=lars_weight_decay, grad_clip=grad_clip)
+        self._lars_coeff = lars_coeff
+        self._lars_epsilon = epsilon
+
+    def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
+        w_norm = jnp.linalg.norm(p._read().astype(jnp.float32))
+        g_norm = jnp.linalg.norm(grad._read().astype(jnp.float32))
+        scaled = lr * self._lars_coeff * w_norm / (
+            g_norm + weight_decay * w_norm + self._lars_epsilon)
+        local_lr = jnp.where((w_norm > 0) & (g_norm > 0), scaled,
+                             jnp.asarray(lr, jnp.float32))
+        super()._append_optimize_op(p, grad, local_lr, weight_decay, t)

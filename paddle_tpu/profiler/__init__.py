@@ -1,0 +1,179 @@
+"""paddle.profiler (ref: `python/paddle/profiler/profiler.py:339` — step-scheduled
+Profiler, RecordEvent at `profiler/utils.py:37`, chrome-trace export at :210).
+
+TPU-native: host annotations are jax.profiler TraceAnnotations (XPlane), device
+activity comes from the XLA/TPU profiler; export lands a TensorBoard-compatible
+trace directory instead of the reference's CUPTI chrome json.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import time
+
+import jax
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Build the CLOSED/READY/RECORD step state machine (ref make_scheduler)."""
+    period = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof.export(dir_name)
+    return handler
+
+
+def export_protobuf(dir_name, worker_name=None):
+    return export_chrome_tracing(dir_name)
+
+
+class RecordEvent:
+    """Host-side named range (≈ platform::RecordEvent -> TraceMe)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *a):
+        self.end()
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        if callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            start, end = scheduler
+            self._scheduler = make_scheduler(closed=max(start, 0), ready=0,
+                                             record=end - start, repeat=1)
+        else:
+            self._scheduler = None  # always record
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._running = False
+        self._logdir = None
+        self._step_times = []
+        self._last_step_time = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+    def start(self):
+        self._last_step_time = time.perf_counter()
+        if self._timer_only:
+            return
+        self._logdir = os.environ.get("PADDLE_TPU_PROFILE_DIR",
+                                      "/tmp/paddle_tpu_profile")
+        os.makedirs(self._logdir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(self._logdir)
+            self._running = True
+        except Exception:
+            self._running = False
+
+    def stop(self):
+        if self._running:
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._running = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_time is not None:
+            self._step_times.append((now - self._last_step_time, num_samples))
+        self._last_step_time = now
+        self._step += 1
+
+    def step_info(self, unit="samples"):
+        if not self._step_times:
+            return ""
+        dt, n = self._step_times[-1]
+        ips = (n / dt) if (n and dt > 0) else (1.0 / dt if dt > 0 else 0.0)
+        return (f"step_time: {dt * 1000:.2f} ms, ips: {ips:.2f} {unit}/s")
+
+    def export(self, path=None, format=None):
+        """Trace already lands in the logdir (TensorBoard/XPlane format)."""
+        return self._logdir
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        if not self._step_times:
+            return "no steps recorded"
+        times = [t for t, _ in self._step_times]
+        import statistics
+        return (f"steps: {len(times)}, mean: {statistics.mean(times) * 1e3:.2f} ms"
+                f", p50: {statistics.median(times) * 1e3:.2f} ms, "
+                f"min: {min(times) * 1e3:.2f} ms, max: {max(times) * 1e3:.2f} ms")
+
+
+@contextlib.contextmanager
+def profile(*args, **kwargs):
+    p = Profiler(*args, **kwargs)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+def load_profiler_result(path):
+    raise NotImplementedError(
+        "TPU traces are XPlane directories; open them with TensorBoard's "
+        "profile plugin")

@@ -1,0 +1,63 @@
+"""paddle.sparse (ref: `python/paddle/sparse` over `phi/kernels/sparse/`).
+
+COO/CSR tensors carried as (indices, values) with dense fallbacks through
+jax.experimental.sparse (BCOO) where profitable; sparse NN layers land with the
+sparse tower milestone.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.common import ensure_tensor
+
+
+class SparseCooTensor(Tensor):
+    """ref: `paddle/phi/core/sparse_coo_tensor.h`."""
+
+    def __init__(self, indices, values, shape, stop_gradient=True):
+        self._indices = ensure_tensor(indices)
+        self._values = ensure_tensor(values)
+        dense = jnp.zeros(tuple(int(s) for s in shape), self._values.dtype)
+        idx = tuple(self._indices._data)
+        dense = dense.at[idx].add(self._values._data)
+        super().__init__(dense, stop_gradient=stop_gradient, _internal=True)
+        self._dense_shape = tuple(int(s) for s in shape)
+
+    def indices(self):
+        return self._indices
+
+    def values(self):
+        return self._values
+
+    def to_dense(self):
+        return Tensor(self._data, _internal=True)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    if shape is None:
+        idx = np.asarray(ensure_tensor(indices).numpy())
+        vshape = tuple(np.asarray(ensure_tensor(values).numpy()).shape[1:])
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1)) + vshape
+    return SparseCooTensor(indices, values, shape, stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows_np = np.asarray(ensure_tensor(crows).numpy())
+    cols_np = np.asarray(ensure_tensor(cols).numpy())
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    indices = np.stack([rows, cols_np])
+    return SparseCooTensor(indices, values, shape, stop_gradient)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)

@@ -1,0 +1,13 @@
+"""paddle.vision (ref: `python/paddle/vision/`)."""
+from paddle_tpu.vision import models  # noqa: F401
+from paddle_tpu.vision import transforms  # noqa: F401
+from paddle_tpu.vision import datasets  # noqa: F401
+from paddle_tpu.vision import ops  # noqa: F401
+
+
+def set_image_backend(backend):
+    pass
+
+
+def get_image_backend():
+    return "numpy"

@@ -1,0 +1,173 @@
+"""Vision datasets (ref: `python/paddle/vision/datasets/`).
+
+Zero-egress environment: datasets read local files when present (same on-disk
+formats as the reference) and raise a clear error otherwise. `FakeData` provides
+deterministic synthetic data for tests/benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic images + labels (for tests and warm-up benches)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None, dtype="float32"):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(self.dtype)
+        label = np.array(rng.randint(0, self.num_classes), np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files (ref: `vision/datasets/mnist.py`)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None,
+                 root=os.path.expanduser("~/.cache/paddle_tpu/mnist")):
+        self.transform = transform
+        name = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(root,
+                                                f"{name}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(root,
+                                                f"{name}-labels-idx1-ubyte.gz")
+        if not (os.path.exists(image_path) and os.path.exists(label_path)):
+            raise FileNotFoundError(
+                f"MNIST files not found at {image_path}; no network egress — "
+                "place idx .gz files locally or use vision.datasets.FakeData")
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        with gzip.open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[:, :, None]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local tar.gz (ref: `vision/datasets/cifar.py`)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None,
+                 root=os.path.expanduser("~/.cache/paddle_tpu")):
+        data_file = data_file or os.path.join(root, "cifar-10-python.tar.gz")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"CIFAR archive not found at {data_file}; no network egress — "
+                "place it locally or use vision.datasets.FakeData")
+        self.transform = transform
+        images, labels = [], []
+        with tarfile.open(data_file) as tar:
+            names = [m for m in tar.getmembers()
+                     if ("data_batch" in m.name if mode == "train"
+                         else "test_batch" in m.name)]
+            for m in sorted(names, key=lambda m: m.name):
+                d = pickle.load(tar.extractfile(m), encoding="bytes")
+                images.append(d[b"data"])
+                labels.extend(d[b"labels"])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0).astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None,
+                 root=os.path.expanduser("~/.cache/paddle_tpu")):
+        data_file = data_file or os.path.join(root, "cifar-100-python.tar.gz")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"CIFAR-100 archive not found at {data_file}; no egress")
+        self.transform = transform
+        with tarfile.open(data_file) as tar:
+            name = "train" if mode == "train" else "test"
+            for m in tar.getmembers():
+                if m.name.endswith(name):
+                    d = pickle.load(tar.extractfile(m), encoding="bytes")
+                    self.images = d[b"data"].reshape(-1, 3, 32, 32)
+                    self.labels = np.asarray(d[b"fine_labels"], np.int64)
+
+
+class DatasetFolder(Dataset):
+    """Images under class-named subfolders (ref `vision/datasets/folder.py`)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".png", ".jpg", ".jpeg", ".bmp", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError("PIL unavailable; use .npy images") from e
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+ImageFolder = DatasetFolder

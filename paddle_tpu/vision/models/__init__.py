@@ -1,0 +1,7 @@
+"""Model zoo (ref: `python/paddle/vision/models/__init__.py`)."""
+from paddle_tpu.vision.models.lenet import LeNet  # noqa: F401
+from paddle_tpu.vision.models.resnet import (  # noqa: F401
+    ResNet, BasicBlock, BottleneckBlock, resnet18, resnet34, resnet50, resnet101,
+    resnet152, resnext50_32x4d, resnext101_32x4d, wide_resnet50_2,
+    wide_resnet101_2,
+)

@@ -1,0 +1,89 @@
+"""Vision ops (ref: `python/paddle/vision/ops.py` — roi_align, nms, deform_conv;
+the CUDA kernels map to jax/XLA compositions)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.common import ensure_tensor
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None):
+    """Host-side NMS (dynamic output; eager-only like the reference CPU path)."""
+    b = np.asarray(ensure_tensor(boxes).numpy())
+    s = np.asarray(ensure_tensor(scores).numpy()) if scores is not None else \
+        np.arange(len(b))[::-1].astype(np.float32)
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        iou = inter / (areas[i] + areas - inter + 1e-10)
+        suppressed |= iou > iou_threshold
+        suppressed[i] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep), _internal=True)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0):
+    raise NotImplementedError("box_coder: planned (detection tower)")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    x = ensure_tensor(x)
+    boxes = ensure_tensor(boxes)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    boxes_per_img = np.asarray(ensure_tensor(boxes_num).numpy())
+    img_idx = np.repeat(np.arange(len(boxes_per_img)), boxes_per_img)
+
+    def prim(feat, bxs):
+        def one_box(b, img_i):
+            x1, y1, x2, y2 = b * spatial_scale
+            if aligned:
+                x1, y1, x2, y2 = x1 - 0.5, y1 - 0.5, x2 - 0.5, y2 - 0.5
+            ys = y1 + (jnp.arange(oh) + 0.5) * (y2 - y1) / oh
+            xs = x1 + (jnp.arange(ow) + 0.5) * (x2 - x1) / ow
+            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+            fm = feat[img_i]  # [C, H, W]
+            H, W = fm.shape[1], fm.shape[2]
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1)
+            x1i = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(yy, 0, H - 1) - y0
+            wx = jnp.clip(xx, 0, W - 1) - x0
+            v00 = fm[:, y0, x0]
+            v01 = fm[:, y0, x1i]
+            v10 = fm[:, y1i, x0]
+            v11 = fm[:, y1i, x1i]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                    v10 * wy * (1 - wx) + v11 * wy * wx)
+
+        outs = [one_box(bxs[i], int(img_idx[i])) for i in range(bxs.shape[0])]
+        return jnp.stack(outs) if outs else jnp.zeros(
+            (0, feat.shape[1], oh, ow), feat.dtype)
+
+    return apply(prim, x, boxes, op_name="roi_align")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    raise NotImplementedError("deform_conv2d: planned (detection tower)")

@@ -1,0 +1,31 @@
+"""Test harness config.
+
+Mirrors the reference's distributed-test trick (SURVEY.md §4): tests run on the XLA
+CPU backend with 8 virtual devices (`--xla_force_host_platform_device_count=8`), so
+every parallelism strategy executes real collectives without TPU hardware — the
+"fake multi-device backend" the reference lacks.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force CPU even if the env preset a platform
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the container's sitecustomize pre-registers the TPU PJRT plugin and pins
+# JAX_PLATFORMS=axon; the config override wins over the env var
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

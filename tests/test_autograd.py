@@ -1,0 +1,153 @@
+"""Autograd: backward, accumulation, hooks, paddle.grad, double grad, PyLayer."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain_backward():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x  # x^3, dy/dx = 3x^2 = 12
+    y.backward()
+    assert x.grad.item() == pytest.approx(12.0)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    assert x.grad.item() == pytest.approx(5.0)
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    w = paddle.to_tensor([2.0], stop_gradient=True)
+    y = (x * w).sum()
+    y.backward()
+    assert x.grad is not None
+    assert w.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y._grad_node is None
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    assert x.grad.item() == pytest.approx(4.0)
+
+
+def test_double_backward_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert seen and seen[0][0] == pytest.approx(3.0)
+    assert x.grad.item() == pytest.approx(6.0)
+
+
+def test_functional_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = paddle.grad([y], [x])
+    np.testing.assert_allclose(gx.numpy(), 3 * x.numpy() ** 2)
+    assert x.grad is None  # functional API doesn't touch .grad
+
+
+def test_second_order_grad():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (g1,) = paddle.grad([y], [x], create_graph=True)
+    assert g1.item() == pytest.approx(12.0)
+    (g2,) = paddle.grad([g1], [x])
+    assert g2.item() == pytest.approx(12.0)  # d2(x^3)/dx2 = 6x
+
+
+def test_grad_unused_input():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    z = paddle.to_tensor(1.0, stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad([y], [x, z])
+    gx, gz = paddle.grad([(x * 2)], [x, z], allow_unused=True)
+    assert gz is None
+
+
+def test_non_scalar_backward_fills_ones():
+    # reference semantics: implicit initial grad is ones for any shape
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+    x.clear_grad()
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 3.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2, 6])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor([[3.0, 1.0], [2.0, 4.0]], stop_gradient=False)
+    vals, idx = x.topk(1, axis=1)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0], [0, 1]])
+
+
+def test_pylayer():
+    class Double(paddle.PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            ctx.save_for_backward(a)
+            return a * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            (a,) = ctx.saved_tensor()
+            return g * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+
+def test_branching_graph():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    a = x * 2
+    b = x * 5
+    (a + b).backward()
+    assert x.grad.item() == pytest.approx(7.0)
+
+
+def test_grad_through_indexing():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x[1:].sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 1, 1])

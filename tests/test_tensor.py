@@ -1,0 +1,119 @@
+"""Tensor basics: creation, dtype rules, arithmetic, indexing, in-place."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_dtypes():
+    t = paddle.to_tensor([1.0, 2.0])
+    assert t.dtype == np.float32
+    t = paddle.to_tensor([1, 2])
+    assert t.dtype == np.int64
+    t = paddle.to_tensor(np.zeros((2, 3), np.float64))
+    assert t.dtype == np.float64
+    t = paddle.to_tensor(True)
+    assert t.dtype == np.bool_
+    t = paddle.to_tensor([1, 2], dtype="float32")
+    assert t.dtype == np.float32
+
+
+def test_shape_props():
+    t = paddle.zeros([2, 3, 4])
+    assert t.shape == [2, 3, 4]
+    assert t.ndim == 3
+    assert t.size == 24
+    assert t.numpy().shape == (2, 3, 4)
+
+
+def test_arithmetic_broadcast():
+    a = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    b = paddle.to_tensor([10.0, 20.0])
+    np.testing.assert_allclose((a + b).numpy(), [[11, 22], [13, 24]])
+    np.testing.assert_allclose((a * 2).numpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((2 * a).numpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((a - b).numpy(), [[-9, -18], [-7, -16]])
+    np.testing.assert_allclose((1.0 / a).numpy(), 1.0 / a.numpy())
+
+
+def test_scalar_no_promotion():
+    a = paddle.ones([2], dtype="float32")
+    assert (a + 0.5).dtype == np.float32
+    assert (a * 3).dtype == np.float32
+    i = paddle.ones([2], dtype="int32")
+    assert (i + 1).dtype == np.int32
+
+
+def test_int_float_promotion():
+    f = paddle.ones([2], dtype="float32")
+    i = paddle.ones([2], dtype="int64")
+    assert (f + i).dtype == np.float32
+
+
+def test_matmul():
+    a = paddle.rand([3, 4])
+    b = paddle.rand([4, 5])
+    np.testing.assert_allclose((a @ b).numpy(), a.numpy() @ b.numpy(),
+                               rtol=1e-5)
+
+
+def test_comparison():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+
+
+def test_indexing():
+    a = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    np.testing.assert_allclose(a[0].numpy(), a.numpy()[0])
+    np.testing.assert_allclose(a[:, 1].numpy(), a.numpy()[:, 1])
+    np.testing.assert_allclose(a[0, 1:3, ::2].numpy(), a.numpy()[0, 1:3, ::2])
+    idx = paddle.to_tensor([0, 1])
+    np.testing.assert_allclose(a[idx].numpy(), a.numpy()[[0, 1]])
+    mask = a > 10
+    np.testing.assert_allclose(a[mask].numpy(), a.numpy()[a.numpy() > 10])
+
+
+def test_setitem():
+    a = paddle.zeros([3, 3])
+    a[1] = 5.0
+    assert a.numpy()[1].tolist() == [5, 5, 5]
+    a[0, 0] = 1.0
+    assert a.numpy()[0, 0] == 1
+
+
+def test_inplace_ops():
+    a = paddle.ones([3])
+    a.add_(paddle.ones([3]))
+    np.testing.assert_allclose(a.numpy(), [2, 2, 2])
+    a.scale_(2.0)
+    np.testing.assert_allclose(a.numpy(), [4, 4, 4])
+
+
+def test_item_and_casts():
+    a = paddle.to_tensor(3.5)
+    assert a.item() == pytest.approx(3.5)
+    assert float(a) == pytest.approx(3.5)
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+
+
+def test_clone_detach():
+    a = paddle.rand([2, 2])
+    a.stop_gradient = False
+    c = a.clone()
+    assert not c.stop_gradient
+    d = a.detach()
+    assert d.stop_gradient
+    np.testing.assert_allclose(d.numpy(), a.numpy())
+
+
+def test_save_load(tmp_path):
+    obj = {"w": paddle.rand([3, 3]), "step": 7, "nested": [paddle.ones([2])]}
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    assert loaded["step"] == 7
+    np.testing.assert_allclose(loaded["w"].numpy(), obj["w"].numpy())
+    np.testing.assert_allclose(loaded["nested"][0].numpy(), 1.0)
