@@ -1,0 +1,87 @@
+"""Benchmark: GPT-2 small causal-LM training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "gpt2s_train_tokens_per_sec_per_chip", "value": N, "unit":
+   "tokens/s", "vs_baseline": R}
+
+vs_baseline: the reference repo publishes no absolute numbers (BASELINE.md), so the
+baseline is the operational target from BASELINE.json — >=0.8x the per-chip MFU of
+an A100 GPU backend. Assuming the reference hits 45% MFU on A100 for GPT-2-class
+training (typical for its fused-kernel path), the target per-chip MFU is
+0.8 * 0.45 = 0.36; vs_baseline = measured_MFU / 0.36.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    batch, seq = 8, 1024
+    cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, max_position_embeddings=seq,
+                    hidden_dropout=0.0, attention_dropout=0.0, recompute=True)
+    model = GPTForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+
+    def batch_data():
+        ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
+        return (paddle.to_tensor(ids[:, :-1].astype(np.int32)),
+                paddle.to_tensor(ids[:, 1:].astype(np.int64)))
+
+    x, y = batch_data()
+    loss = train_step(x, y)          # compile
+    float(loss)
+    # warmup
+    for _ in range(2):
+        loss = train_step(x, y)
+    float(loss)
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = train_step(x, y)
+    float(loss)                      # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    flops_per_token = 6.0 * n_params
+    platform = jax.default_backend()
+    peak = 197e12 if platform != "cpu" else 1e12  # v5e bf16 peak
+    mfu = tokens_per_sec * flops_per_token / peak
+    target_mfu = 0.8 * 0.45
+    print(json.dumps({
+        "metric": "gpt2s_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / target_mfu, 3),
+    }))
+    print(f"# n_params={n_params/1e6:.1f}M loss={float(loss):.3f} "
+          f"step={dt/iters*1e3:.1f}ms mfu={mfu:.3f} platform={platform}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

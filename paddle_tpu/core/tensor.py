@@ -12,12 +12,23 @@ reference's dygraph/static duality into one code path.
 """
 from __future__ import annotations
 
+import itertools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core import autograd
 from paddle_tpu.core import dtype as dtype_mod
+
+# tensor-creation clock: lets jit capture distinguish pre-existing state tensors
+# (params, buffers, RNG/optimizer state) from temporaries created during a probe run
+_creation_clock = 0
+
+
+def current_stamp() -> int:
+    return _creation_clock
+
 
 _ops_cache = None
 
@@ -63,11 +74,12 @@ def _is_scalar(x) -> bool:
 
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "_grad", "_grad_node", "_out_slot",
-                 "_hooks", "_hook_counter", "name", "persistable", "__weakref__",
-                 "__dict__")
+                 "_hooks", "_hook_counter", "name", "persistable", "_stamp",
+                 "__weakref__", "__dict__")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True,
                  _internal=False):
+        global _creation_clock
         if _internal:
             self._data = data
         else:
@@ -86,6 +98,8 @@ class Tensor:
         self._hook_counter = 0
         self.name = ""
         self.persistable = False
+        _creation_clock += 1
+        self._stamp = _creation_clock
 
     # ----------------------------------------------------------------- data access
 
@@ -95,10 +109,12 @@ class Tensor:
         return self._data
 
     def _write(self, new_array):
-        """Rebind the payload (in-place op / optimizer update / set_value)."""
-        self._data = new_array
+        """Rebind the payload (in-place op / optimizer update / set_value).
+        The hook fires BEFORE the rebind so capture can snapshot the old value
+        (probe runs are rolled back to keep exactly-once step semantics)."""
         if _write_hook is not None:
             _write_hook(self)
+        self._data = new_array
 
     @property
     def data(self):

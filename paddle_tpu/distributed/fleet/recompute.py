@@ -1,24 +1,73 @@
 """Activation recompute (ref: `fleet/recompute/recompute.py:223` RecomputeFunction
 PyLayer with RNG-state replay; api :385, sequential :496).
 
-TPU-native: `jax.checkpoint` (rematerialization) applied to the op's primal inside
-the tape — XLA recomputes the forward in backward instead of saving activations.
-RNG determinism comes free: the PRNG key is captured functionally, so replay is
-exact (the reference must save/restore CUDA RNG state by hand).
+TPU-native: `jax.checkpoint` (rematerialization) applied to the region's primal —
+XLA recomputes the forward during backward instead of saving activations. RNG
+determinism under replay is handled by passing a PRNG key as an explicit input to
+the checkpointed region and running a scoped generator from it, so the remat replay
+sees the identical key (the reference must save/restore CUDA RNG state by hand at
+`recompute.py:129-151`).
 """
 from __future__ import annotations
 
 import jax
 
-from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.autograd import apply, no_grad
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.ops.common import ensure_tensor
 
 
+def _collect_layer_state(layer):
+    """Params + float buffers of a Layer — the non-arg tensors the region reads."""
+    extras = list(layer.parameters())
+    for b in layer.buffers():
+        if b is not None:
+            extras.append(b)
+    return extras
+
+
+def _probe_extras(function, tensor_args, call_args_builder, kwargs):
+    """Hook-based discovery for non-Layer callables: run the function once under
+    abstract evaluation with read/write hooks; restore every written tensor."""
+    from paddle_tpu.core import tensor as tensor_mod
+    extras: dict[int, Tensor] = {}
+    written: dict[int, tuple] = {}
+
+    def read_hook(t):
+        if id(t) not in extras and all(t is not ta for ta in tensor_args):
+            extras[id(t)] = t
+
+    def write_hook(t):
+        if id(t) not in written:
+            written[id(t)] = (t, t._data)
+
+    prev = tensor_mod.set_capture_hooks(read_hook, write_hook)
+    try:
+        with no_grad():
+            jax.eval_shape(lambda *arrs: [
+                o._data for o in _aslist(function(*call_args_builder(arrs),
+                                                  **kwargs))],
+                *[t._data for t in tensor_args])
+    except Exception:
+        pass
+    finally:
+        tensor_mod.set_capture_hooks(*prev)
+        for t, old in written.values():
+            t._data = old
+    return [t for t in extras.values()]
+
+
+def _aslist(out):
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
 def recompute(function, *args, **kwargs):
-    """Run `function(*args)` with rematerialized backward."""
-    preserve = kwargs.pop("preserve_rng_state", True)
-    use_reentrant = kwargs.pop("use_reentrant", True)
+    """Run ``function(*args)`` with rematerialized backward."""
+    kwargs.pop("preserve_rng_state", None)
+    kwargs.pop("use_reentrant", None)
+    from paddle_tpu.nn.layer import Layer
+    from paddle_tpu.ops import random as rnd
+
     tensor_args = []
     spec = []
     for a in args:
@@ -28,67 +77,63 @@ def recompute(function, *args, **kwargs):
         else:
             spec.append(("c", a))
 
-    # capture layer params read inside `function` as explicit tensor inputs so
-    # the checkpointed region differentiates w.r.t. them too
-    from paddle_tpu.core import tensor as tensor_mod
-    extra: dict[int, Tensor] = {}
+    def build_call_args(arrs):
+        out = []
+        for kind, v in spec:
+            if kind == "t":
+                out.append(Tensor(arrs[v], stop_gradient=False, _internal=True))
+            else:
+                out.append(v)
+        return out
 
-    def read_hook(t):
-        if id(t) not in extra and all(t is not ta for ta in tensor_args):
-            extra[id(t)] = t
+    if isinstance(function, Layer):
+        extra_list = _collect_layer_state(function)
+    else:
+        extra_list = _probe_extras(function, tensor_args, build_call_args, kwargs)
 
-    def run(arrs_main, arrs_extra, extra_list):
-        saved = [(t, t._data) for t in extra_list]
-        try:
-            for t, a in zip(extra_list, arrs_extra):
-                t._data = a
-            call_args = []
-            for kind, v in spec:
-                if kind == "t":
-                    call_args.append(Tensor(arrs_main[v], stop_gradient=False,
-                                            _internal=True))
-                else:
-                    call_args.append(v)
-            out = function(*call_args, **kwargs)
-            multi = isinstance(out, (tuple, list))
-            outs = [o._data for o in (out if multi else [out])]
-            return tuple(outs) if multi else outs[0]
-        finally:
-            for t, a in saved:
-                t._data = a
+    # advance the global generator ONCE, outside the region; the region runs a
+    # scoped generator seeded from that key, passed as a real input so the remat
+    # replay and any outer capture see a consistent value.
+    key_data = rnd.default_generator().next_key()
+    key_t = Tensor(jax.random.key_data(key_data), _internal=True)
 
-    # discover extra params with one hooked dry trace via jax.eval_shape
-    prev = tensor_mod.set_capture_hooks(read_hook, None)
-    try:
-        jax.eval_shape(
-            lambda *arrs: run(arrs, [], []),
-            *[t._data for t in tensor_args])
-    except Exception:
-        pass
-    finally:
-        tensor_mod.set_capture_hooks(*prev)
-
-    extra_list = list(extra.values())
     n_main = len(tensor_args)
+    n_extra = len(extra_list)
 
     @jax.checkpoint
     def prim(*arrs):
-        return run(arrs[:n_main], arrs[n_main:], extra_list)
+        arrs_main = arrs[:n_main]
+        arrs_extra = arrs[n_main:n_main + n_extra]
+        key_arr = arrs[n_main + n_extra]
+        saved = [(t, t._data) for t in extra_list]
+        gen = rnd.Generator.__new__(rnd.Generator)
+        gen._state = Tensor(key_arr, _internal=True)
+        gen._seed = 0
+        prev_gen = rnd._default_generator
+        rnd._default_generator = gen
+        try:
+            for t, a in zip(extra_list, arrs_extra):
+                t._data = a
+            # inner tape recording is pointless: the outer jax.vjp of this prim
+            # differentiates the whole region functionally
+            with no_grad():
+                out = function(*build_call_args(arrs_main), **kwargs)
+            outs = [o._data for o in _aslist(out)]
+            return tuple(outs) if isinstance(out, (tuple, list)) else outs[0]
+        finally:
+            rnd._default_generator = prev_gen
+            for t, a in saved:
+                t._data = a
 
-    return apply(prim, *tensor_args, *extra_list, op_name="recompute")
+    return apply(prim, *tensor_args, *extra_list, key_t, op_name="recompute")
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):
     """ref `recompute.py:496` — recompute a Sequential in segments."""
     segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
-    from paddle_tpu.nn.layers.container import Sequential
-    if isinstance(functions, Sequential):
-        layers = list(functions)
-    else:
-        layers = list(functions)
+    layers = list(functions)
     n = len(layers)
     seg_size = max(n // max(segments, 1), 1)
-    out = args[0] if len(args) == 1 else args
 
     def run_segment(lo, hi):
         def seg_fn(x):
@@ -97,7 +142,7 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
             return x
         return seg_fn
 
-    x = out
+    x = args[0] if len(args) == 1 else args
     for lo in range(0, n, seg_size):
         hi = min(lo + seg_size, n)
         x = recompute(run_segment(lo, hi), x, **kwargs)

@@ -41,27 +41,44 @@ def not_to_static(fn=None):
 
 
 class _CaptureSet:
-    """Read/write sets observed during a capture run."""
+    """Read/write sets observed during a capture run. Only tensors that existed
+    BEFORE the probe started are state — temporaries created inside the probe are
+    recomputed by the traced program (and under remat may hold inner tracers)."""
 
-    def __init__(self):
+    def __init__(self, start_stamp: int):
+        self.start_stamp = start_stamp
         self.reads: dict[int, Tensor] = {}
         self.writes: dict[int, Tensor] = {}
+        self.old_values: dict[int, Any] = {}
         self.order: list[int] = []
 
     def on_read(self, t: Tensor):
+        if t._stamp > self.start_stamp and not t.persistable:
+            return
         key = id(t)
         if key not in self.reads:
             self.reads[key] = t
             self.order.append(key)
 
     def on_write(self, t: Tensor):
+        if t._stamp > self.start_stamp and not t.persistable:
+            return
         key = id(t)
+        if key not in self.writes:
+            # hook fires pre-rebind: snapshot so the probe can be rolled back
+            # (the compiled first call must BE step one, not step two)
+            self.old_values[key] = t._data
         self.writes[key] = t
         if key not in self.reads:
             # written-then-read later in the fn: treat as state too so the final
             # value escapes
             self.reads.setdefault(key, t)
             self.order.append(key)
+
+    def rollback(self):
+        for key, t in self.writes.items():
+            if key in self.old_values:
+                t._data = self.old_values[key]
 
 
 def _tree_flatten_tensors(obj):
@@ -187,7 +204,7 @@ class StaticFunction:
 
     def _capture(self, key, args, kwargs):
         fn = self._fn
-        cap = _CaptureSet()
+        cap = _CaptureSet(tensor_mod.current_stamp())
         arg_tensors, _, _ = _tree_flatten_tensors((args, kwargs))
         arg_ids = {id(t) for t in arg_tensors}
 
@@ -202,6 +219,9 @@ class StaticFunction:
         finally:
             tensor_mod.set_capture_hooks(*prev)
             tensor_mod.set_capture_active(prev_active)
+            # roll the probe's state mutations back: the first compiled call must
+            # observe pre-call state (exactly-once step semantics)
+            cap.rollback()
 
         state_tensors = [cap.reads[k] for k in cap.order]
         written_ids = set(cap.writes)

@@ -97,7 +97,7 @@ def flash_attention_fn(causal=False, scale=None):
         kt = jnp.swapaxes(k, 1, 2)
         vt = jnp.swapaxes(v, 1, 2)
         S, D = qt.shape[2], qt.shape[3]
-        use_pallas = (_try_pallas() and S % 128 == 0 and D % 128 == 0
+        use_pallas = (_try_pallas() and S % 128 == 0 and D % 64 == 0
                       and qt.dtype in (jnp.float32, jnp.bfloat16))
         if use_pallas:
             sm = scale if scale is not None else 1.0 / math.sqrt(D)

@@ -1,0 +1,4 @@
+"""Flagship model families (ref: the reference trains these via external suites —
+ERNIE/PaddleNLP GPT & BERT on fleet; SURVEY.md §6 config ladder items 3 & 5)."""
+from paddle_tpu.models.gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt2_small, gpt2_345m  # noqa: F401
+from paddle_tpu.models.bert import BertConfig, BertModel, BertForSequenceClassification, BertForPretraining  # noqa: F401

@@ -1,0 +1,232 @@
+"""GPT-2 family, TPU-first.
+
+Counterpart of the reference's fleet GPT fixture
+(`python/paddle/fluid/tests/unittests/auto_parallel_gpt_model.py`) and the
+PaddleNLP GPT-345M hybrid-parallel config (BASELINE.md item 5). Design:
+
+- TP via the fleet mpu layers (full logical weights + 'mp' shardings; GSPMD
+  inserts the collectives the reference codes as `_c_identity`/`_mp_allreduce`).
+- Sequence parallelism: activations carry a ('dp', 'sp') batch/sequence sharding
+  constraint between blocks — beyond the reference (SURVEY.md §5.7).
+- Attention = scaled_dot_product_attention -> Pallas flash kernel on TPU.
+- Whole train step is meant to run under `paddle_tpu.jit.to_static` (one donated
+  XLA program; the analog of CS5's run_program).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+from jax.sharding import PartitionSpec
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    _constrain,
+)
+from paddle_tpu.distributed.mesh import get_mesh
+from paddle_tpu.framework.param_attr import ParamAttr
+from paddle_tpu.nn import initializer as I
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304          # 50257 padded to a TPU-friendly multiple
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    initializer_range: float = 0.02
+    use_flash: bool = True
+    seq_parallel: bool = False       # constrain activations over the 'sp' axis
+    recompute: bool = False          # rematerialize each block (jax.checkpoint)
+
+
+def _sp_constrain(x, cfg):
+    """[B, S, H] activations: batch over dp, sequence over sp."""
+    if not cfg.seq_parallel or get_mesh() is None:
+        return x
+    return _constrain(x, PartitionSpec("dp", "sp", None))
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        winit = ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+        self.qkv_proj = ColumnParallelLinear(
+            cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=winit,
+            gather_output=False)
+        self.out_proj = RowParallelLinear(
+            cfg.hidden_size, cfg.hidden_size, weight_attr=winit,
+            input_is_parallel=True)
+        self.attn_drop_p = cfg.attention_dropout
+        self.resid_drop = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, x, cache=None):
+        B, S = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)                       # [B, S, 3H] (mp-sharded)
+        qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(axis=2)
+        if cache is not None:
+            pk, pv = cache
+            k = paddle.concat([pk, k], axis=1)
+            v = paddle.concat([pv, v], axis=1)
+            cache = (k, v)
+        drop = self.attn_drop_p if self.training else 0.0
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=drop, is_causal=True, training=self.training)
+        out = out.reshape([B, S, -1])
+        out = self.out_proj(out)
+        out = self.resid_drop(out)
+        return out if cache is None else (out, cache)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        winit = ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+        self.fc_in = ColumnParallelLinear(cfg.hidden_size, cfg.intermediate_size,
+                                          weight_attr=winit, gather_output=False)
+        self.fc_out = RowParallelLinear(cfg.intermediate_size, cfg.hidden_size,
+                                        weight_attr=winit, input_is_parallel=True)
+        self.drop = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, x):
+        return self.drop(self.fc_out(F.gelu(self.fc_in(x), approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x, cache=None):
+        if cache is None:
+            x = x + self.attn(self.ln_1(x))
+        else:
+            a, cache = self.attn(self.ln_1(x), cache)
+            x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        x = _sp_constrain(x, self.cfg)
+        return x if cache is None else (x, cache)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        winit = ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+        self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
+                                          weight_attr=winit)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size,
+                                weight_attr=winit)
+        self.drop = nn.Dropout(cfg.hidden_dropout)
+        self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        S = input_ids.shape[1]
+        if position_ids is None:
+            past = 0 if caches is None else caches[0][0].shape[1]
+            position_ids = paddle.arange(past, past + S, dtype="int64")
+            position_ids = position_ids.unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        x = _sp_constrain(x, self.cfg)
+        new_caches = [] if caches is not None else None
+        use_remat = self.cfg.recompute and self.training and caches is None
+        for i, block in enumerate(self.h):
+            if caches is None:
+                if use_remat:
+                    from paddle_tpu.distributed.fleet.recompute import recompute
+                    x = recompute(block, x)
+                else:
+                    x = block(x)
+            else:
+                x, c = block(x, caches[i])
+                new_caches.append(c)
+        x = self.ln_f(x)
+        return x if caches is None else (x, new_caches)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+
+    def forward(self, input_ids, labels=None, loss_mask=None):
+        h = self.gpt(input_ids)
+        # tied lm head: logits = h @ wte^T (vocab-sharded over mp like the
+        # reference's parallel lm head + ParallelCrossEntropy)
+        logits = paddle.matmul(h, self.gpt.wte.weight, transpose_y=True)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.cfg.vocab_size]).astype("float32"),
+            labels.reshape([-1]), reduction="none")
+        if loss_mask is not None:
+            m = loss_mask.reshape([-1]).astype("float32")
+            loss = (loss * m).sum() / m.sum()
+        else:
+            loss = loss.mean()
+        return logits, loss
+
+    @paddle.no_grad()
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0):
+        """Greedy/sampled decode with KV caches (inference path)."""
+        self.eval()
+        x = input_ids
+        caches = None
+        out_ids = [x]
+        cur = x
+        for _ in range(max_new_tokens):
+            if caches is None:
+                h, caches = self.gpt(cur, caches=[
+                    (paddle.zeros([x.shape[0], 0, self.cfg.num_heads,
+                                   self.cfg.hidden_size // self.cfg.num_heads]),
+                     paddle.zeros([x.shape[0], 0, self.cfg.num_heads,
+                                   self.cfg.hidden_size // self.cfg.num_heads]))
+                    for _ in range(self.cfg.num_layers)])
+            else:
+                h, caches = self.gpt(cur, caches=caches)
+            logits = paddle.matmul(h[:, -1], self.gpt.wte.weight,
+                                   transpose_y=True)
+            if temperature != 1.0:
+                logits = logits / temperature
+            if top_k:
+                vals, _ = logits.topk(top_k, axis=-1)
+                kth = vals[:, -1:]
+                logits = paddle.where(logits < kth,
+                                      paddle.full_like(logits, -1e30), logits)
+            if top_k or temperature != 1.0:
+                probs = F.softmax(logits, axis=-1)
+                nxt = paddle.multinomial(probs, 1)
+            else:
+                nxt = logits.argmax(axis=-1, keepdim=True)
+            out_ids.append(nxt)
+            cur = nxt
+        return paddle.concat(out_ids, axis=1)
+
+
+def gpt2_small(**kwargs):
+    return GPTForCausalLM(GPTConfig(**kwargs))
+
+
+def gpt2_345m(**kwargs):
+    cfg = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                    intermediate_size=4096, **kwargs)
+    return GPTForCausalLM(cfg)

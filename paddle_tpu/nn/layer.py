@@ -100,6 +100,8 @@ class Layer:
         return parameter
 
     def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None:
+            tensor.persistable = True
         self._buffers[str(name)] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(str(name))

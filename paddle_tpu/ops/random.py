@@ -25,6 +25,7 @@ class Generator:
     def __init__(self, seed=0):
         self._state = Tensor(jax.random.key_data(jax.random.PRNGKey(seed)),
                              _internal=True)
+        self._state.persistable = True
         self._seed = seed
 
     def manual_seed(self, seed):

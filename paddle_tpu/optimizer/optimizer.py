@@ -55,8 +55,10 @@ class Optimizer:
         # reference feeding lr as a Variable into optimizer ops)
         self._lr_tensor = Tensor(jnp.asarray(self.get_lr(), jnp.float32),
                                  _internal=True)
+        self._lr_tensor.persistable = True
         # step count as state too (adam bias correction inside captured steps)
         self._step_tensor = Tensor(jnp.asarray(0, jnp.int64), _internal=True)
+        self._step_tensor.persistable = True
         if isinstance(self._learning_rate, LRScheduler):
             self._learning_rate._bind_optimizer(self)
 
@@ -94,14 +96,17 @@ class Optimizer:
         if key not in store:
             d = dtype or (jnp.float32 if self._use_master_weights else p.dtype)
             arr = jnp.zeros(p._data.shape, d) if init is None else init
-            store[key] = Tensor(arr, _internal=True)
+            t = Tensor(arr, _internal=True)
+            t.persistable = True
+            store[key] = t
         return store[key]
 
     def _master(self, p):
         key = id(p)
         if key not in self._master_weights:
-            self._master_weights[key] = Tensor(p._data.astype(jnp.float32),
-                                               _internal=True)
+            mt = Tensor(p._data.astype(jnp.float32), _internal=True)
+            mt.persistable = True
+            self._master_weights[key] = mt
         return self._master_weights[key]
 
     # ------------------------------------------------------------------ step
