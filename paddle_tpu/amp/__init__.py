@@ -59,8 +59,20 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
     return models, optimizers
 
 
+class _OptState:
+    """Per-optimizer scaler state (ref `amp/grad_scaler.py` OptimizerState)."""
+    INIT, UNSCALED, STEPPED = 0, 1, 2
+
+
 class GradScaler:
-    """Dynamic loss scaler (ref: `python/paddle/amp/grad_scaler.py:26`)."""
+    """Dynamic loss scaler (ref: `python/paddle/amp/grad_scaler.py:26`).
+
+    Tracks per-optimizer INIT/UNSCALED/STEPPED state like the reference, so the
+    documented ``unscale_(); clip; step(); update()`` pattern never
+    double-unscales, and step-after-step raises instead of silently corrupting
+    training. ``update()`` resets states and is left to the caller (``minimize``
+    bundles step + update). Eager-only: found_inf concretizes the grads, so use
+    bf16 autocast (no scaler) inside ``to_static`` steps."""
 
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
@@ -74,7 +86,9 @@ class GradScaler:
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
-        self._found_inf = False
+        self._found_inf = False            # any optimizer overflowed this round
+        self._optimizer_states: dict[int, int] = {}
+        self._found_inf_per_opt: dict[int, bool] = {}
 
     def scale(self, var):
         if not self._enable:
@@ -84,6 +98,13 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        st = self._optimizer_states.get(id(optimizer), _OptState.INIT)
+        if st == _OptState.UNSCALED:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer since "
+                "the last update()")
+        if st == _OptState.STEPPED:
+            raise RuntimeError("unscale_() is being called after step()")
         params = optimizer._all_params()
         inv = 1.0 / self._scale
         found = False
@@ -92,36 +113,49 @@ class GradScaler:
                 g = p.grad._data * inv
                 p.grad._write(g)
                 found = found or bool(jnp.any(~jnp.isfinite(g)))
-        self._found_inf = found
+        self._found_inf_per_opt[id(optimizer)] = found
+        self._found_inf = self._found_inf or found
+        self._optimizer_states[id(optimizer)] = _OptState.UNSCALED
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        st = self._optimizer_states.get(id(optimizer), _OptState.INIT)
+        if st == _OptState.STEPPED:
+            raise RuntimeError(
+                "step() has already been called since the last update()")
+        if st == _OptState.INIT:
+            self.unscale_(optimizer)
+        # skip decision is per optimizer: another optimizer's finite unscale
+        # must not launder THIS optimizer's inf grads into a step
+        if not self._found_inf_per_opt.get(id(optimizer), self._found_inf):
             optimizer.step()
-        self.update()
+        self._optimizer_states[id(optimizer)] = _OptState.STEPPED
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
+        self.update()
 
     def update(self):
-        if not (self._enable and self._dynamic):
+        if not self._enable:
             return
-        if self._found_inf:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every_n:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
-                self._bad_steps = 0
-        else:
-            self._good_steps += 1
-            self._bad_steps = 0
-            if self._good_steps >= self._incr_every_n_steps:
-                self._scale *= self._incr_ratio
+        if self._dynamic:
+            if self._found_inf:
+                self._bad_steps += 1
                 self._good_steps = 0
+                if self._bad_steps >= self._decr_every_n:
+                    self._scale = max(self._scale * self._decr_ratio, 1.0)
+                    self._bad_steps = 0
+            else:
+                self._good_steps += 1
+                self._bad_steps = 0
+                if self._good_steps >= self._incr_every_n_steps:
+                    self._scale *= self._incr_ratio
+                    self._good_steps = 0
         self._found_inf = False
+        self._optimizer_states.clear()
+        self._found_inf_per_opt.clear()
 
     def is_enable(self):
         return self._enable

@@ -364,6 +364,7 @@ class PipelineParallel(Layer):
             losses.append(loss)
         if scaler is not None:
             scaler.step(optimizer)
+            scaler.update()
         else:
             optimizer.step()
         optimizer.clear_grad()

@@ -51,11 +51,20 @@ class _CaptureSet:
         self.writes: dict[int, Tensor] = {}
         self.old_values: dict[int, Any] = {}
         self.order: list[int] = []
+        # pre-probe .grad of every state tensor: the probe's backward mutates
+        # grads, and grads are themselves step state (grad accumulation across
+        # compiled calls), so they are snapshotted, rolled back, and threaded
+        self.old_grads: dict[int, Any] = {}
+
+    def _note(self, t: Tensor, key: int):
+        if key not in self.old_grads:
+            self.old_grads[key] = t._grad
 
     def on_read(self, t: Tensor):
         if t._stamp > self.start_stamp and not t.persistable:
             return
         key = id(t)
+        self._note(t, key)
         if key not in self.reads:
             self.reads[key] = t
             self.order.append(key)
@@ -64,6 +73,7 @@ class _CaptureSet:
         if t._stamp > self.start_stamp and not t.persistable:
             return
         key = id(t)
+        self._note(t, key)
         if key not in self.writes:
             # hook fires pre-rebind: snapshot so the probe can be rolled back
             # (the compiled first call must BE step one, not step two)
@@ -79,6 +89,9 @@ class _CaptureSet:
         for key, t in self.writes.items():
             if key in self.old_values:
                 t._data = self.old_values[key]
+        for key, t in self.reads.items():
+            if key in self.old_grads:
+                t._grad = self.old_grads[key]
 
 
 def _tree_flatten_tensors(obj):
@@ -139,16 +152,24 @@ def _sig_of(args, kwargs):
 
 class _Compiled:
     __slots__ = ("jitted", "state_tensors", "out_spec", "out_rebuild",
-                 "n_out_tensors", "out_stop_grads")
+                 "n_out_tensors", "out_stop_grads", "grad_mask")
 
     def __init__(self, jitted, state_tensors, out_spec, out_rebuild,
-                 n_out_tensors, out_stop_grads):
+                 n_out_tensors, out_stop_grads, grad_mask):
         self.jitted = jitted
         self.state_tensors = state_tensors
         self.out_spec = out_spec
         self.out_rebuild = out_rebuild
         self.n_out_tensors = n_out_tensors
         self.out_stop_grads = out_stop_grads
+        # which state tensors carried a .grad when this variant was captured;
+        # a different pattern at call time (e.g. first vs subsequent micro-step
+        # of a grad-accumulation loop) selects/captures a different variant
+        self.grad_mask = grad_mask
+
+    def mask_matches(self):
+        return self.grad_mask == tuple(
+            t._grad is not None for t in self.state_tensors)
 
 
 class StaticFunction:
@@ -175,20 +196,30 @@ class StaticFunction:
 
     def concrete_program(self, *args, **kwargs):
         key = _sig_of(args, kwargs)
-        return self._cache.get(key)
+        variants = self._cache.get(key)
+        return variants[-1] if variants else None
 
     def __call__(self, *args, **kwargs):
         key = _sig_of(args, kwargs)
-        compiled = self._cache.get(key)
+        compiled = None
+        for cand in self._cache.get(key, ()):
+            if cand.mask_matches():
+                compiled = cand
+                break
         if compiled is None:
             compiled = self._capture(key, args, kwargs)
         arg_tensors, _, _ = _tree_flatten_tensors((args, kwargs))
         state_in = [t._data for t in compiled.state_tensors]
+        grad_in = [t._grad._data for t, m in zip(compiled.state_tensors,
+                                                 compiled.grad_mask) if m]
         arg_in = [t._data for t in arg_tensors]
-        outs = compiled.jitted(state_in, arg_in)
-        out_arrays, new_state = outs
+        outs = compiled.jitted(state_in, grad_in, arg_in)
+        out_arrays, new_state, new_grads = outs
         for t, arr in zip(compiled.state_tensors, new_state):
             t._data = arr  # direct rebind; hooks not needed outside capture
+        for t, g in zip(compiled.state_tensors, new_grads):
+            t._grad = None if g is None else Tensor(g, stop_gradient=True,
+                                                    _internal=True)
         values = list(out_arrays)
 
         def wrap(i_arr):
@@ -227,17 +258,22 @@ class StaticFunction:
         written_ids = set(cap.writes)
         out_tensors, out_spec, out_rebuild = _tree_flatten_tensors(result)
         out_stop_grads = [t.stop_gradient for t in out_tensors]
+        # pre-probe grad presence (the probe's own grads were rolled back above)
+        grad_mask = tuple(cap.old_grads.get(id(t)) is not None
+                          for t in state_tensors)
 
         # phase 2: build the pure function and jit it
-        def pure(state_arrays, arg_arrays):
+        def pure(state_arrays, grad_arrays, arg_arrays):
             saved_state = [t._data for t in state_tensors]
             saved_args = [t._data for t in arg_tensors]
             saved_nodes = [(t._grad_node, t._out_slot, t._grad)
                            for t in state_tensors + arg_tensors]
-            for t, a in zip(state_tensors, state_arrays):
+            gi = iter(grad_arrays)
+            for t, a, m in zip(state_tensors, state_arrays, grad_mask):
                 t._data = a
                 t._grad_node = None
-                t._grad = None
+                t._grad = Tensor(next(gi), stop_gradient=True,
+                                 _internal=True) if m else None
             for t, a in zip(arg_tensors, arg_arrays):
                 t._data = a
                 t._grad_node = None
@@ -247,7 +283,11 @@ class StaticFunction:
                 res_tensors, _, _ = _tree_flatten_tensors(res)
                 out_arrays = [t._data for t in res_tensors]
                 new_state = [t._data for t in state_tensors]
-                return out_arrays, new_state
+                # grads escape as state too: accumulation across compiled calls
+                # and post-call `.grad` inspection both see live values
+                new_grads = [None if t._grad is None else t._grad._data
+                             for t in state_tensors]
+                return out_arrays, new_state, new_grads
             finally:
                 tensor_mod.set_capture_active(prev_active)
                 for t, a in zip(state_tensors, saved_state):
@@ -262,8 +302,8 @@ class StaticFunction:
         donate = (0,) if self._donate else ()
         jitted = jax.jit(pure, donate_argnums=donate)
         compiled = _Compiled(jitted, state_tensors, out_spec, out_rebuild,
-                             len(out_tensors), out_stop_grads)
-        self._cache[key] = compiled
+                             len(out_tensors), out_stop_grads, grad_mask)
+        self._cache.setdefault(key, []).append(compiled)
         return compiled
 
 

@@ -56,7 +56,10 @@ class ClipGradByGlobalNorm(ClipGradBase):
         return jnp.sqrt(sum(sq))
 
     def _dygraph_clip(self, params_grads):
-        grads = [g for _, g in params_grads if g is not None]
+        # params with need_clip=False stay out of the norm sum too (ref
+        # _dygraph_clip filters before computing the norm)
+        grads = [g for p, g in params_grads
+                 if g is not None and getattr(p, "need_clip", True)]
         if not grads:
             return params_grads
         global_norm = self._global_norm(grads)

@@ -8,12 +8,15 @@ eagerly and inside a captured/jitted train step.
 from __future__ import annotations
 
 import collections
+import itertools
 from typing import Callable, Iterator
 
 import numpy as np
 import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor, Parameter
+
+_param_name_counter = itertools.count()
 from paddle_tpu.core import dtype as dtype_mod
 
 
@@ -124,8 +127,17 @@ class Layer:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         data = init(tuple(int(s) for s in shape), dtype)
         p = Parameter(data, trainable=trainable)
+        if isinstance(attr, ParamAttr):
+            p.need_clip = attr.need_clip
+            if attr.learning_rate != 1.0:
+                p.optimize_attr = {"learning_rate": attr.learning_rate}
         if isinstance(attr, ParamAttr) and attr.name:
             p.name = attr.name
+        else:
+            # unique auto-name (ref framework.py unique_name): optimizer/ckpt
+            # state is keyed by param name, so every param needs one
+            kind = "b" if is_bias else "w"
+            p.name = f"{self._name_scope}_{next(_param_name_counter)}.{kind}_0"
         return p
 
     def create_tensor(self, name=None, persistable=None, dtype=None):

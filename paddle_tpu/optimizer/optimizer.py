@@ -104,10 +104,30 @@ class Optimizer:
     def _master(self, p):
         key = id(p)
         if key not in self._master_weights:
-            mt = Tensor(p._data.astype(jnp.float32), _internal=True)
+            # amp.decorate(level="O2") stashes the pre-cast fp32 copy on the
+            # param; prefer it so the master doesn't inherit bf16 rounding
+            src = getattr(p, "_master", None)
+            arr = src._data if src is not None else p._data
+            mt = Tensor(arr.astype(jnp.float32), _internal=True)
             mt.persistable = True
             self._master_weights[key] = mt
         return self._master_weights[key]
+
+    def _update_src(self, p):
+        """The tensor the update math runs on: the param itself, or its fp32
+        master copy under O2 multi-precision (ref adamw multi_precision path) —
+        low-precision params otherwise round away small updates in the
+        per-step down-cast."""
+        if self._use_master_weights and p._data.dtype != jnp.float32:
+            return self._master(p)
+        return p
+
+    def _commit(self, p, src, new_arr):
+        """Write the updated value back: master keeps fp32, param gets the
+        down-cast copy."""
+        src._write(new_arr)
+        if src is not p:
+            p._write(new_arr.astype(p._data.dtype))
 
     # ------------------------------------------------------------------ step
 
@@ -169,35 +189,94 @@ class Optimizer:
 
     # ------------------------------------------------------------------ ckpt
 
+    def _param_keys(self):
+        """Stable per-param checkpoint keys: the reference keys accumulators by
+        parameter NAME (`<param_name>_moment1_0`), so state survives parameter
+        lists built in a different order. Unnamed/duplicate names fall back to
+        positional keys."""
+        keys, seen = [], set()
+        for i, p in enumerate(self._parameter_list):
+            k = getattr(p, "name", "") or f"param_{i}"
+            if k in seen:
+                k = f"{k}__{i}"
+            seen.add(k)
+            keys.append(k)
+        return keys
+
     def state_dict(self):
         sd = {}
+        pkeys = self._param_keys()
         for name, store in self._accumulators.items():
-            for i, p in enumerate(self._parameter_list):
+            for pk, p in zip(pkeys, self._parameter_list):
                 if id(p) in store:
-                    sd[f"{name}_{i}"] = store[id(p)]
-        for i, p in enumerate(self._parameter_list):
+                    sd[f"{pk}_{name}_0"] = store[id(p)]
+        for pk, p in zip(pkeys, self._parameter_list):
             if id(p) in self._master_weights:
-                sd[f"master_{i}"] = self._master_weights[id(p)]
+                sd[f"{pk}_master_0"] = self._master_weights[id(p)]
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         sd["global_step"] = self._global_step
+        # manifest of per-param key prefixes in parameter-list order: lets load
+        # align state positionally when auto-generated names differ between the
+        # saving and loading process (the name counter is construction-order
+        # global, so any extra Layer built first shifts every name)
+        sd["__param_keys__"] = pkeys
         return sd
 
     def set_state_dict(self, state_dict):
-        for name, store in list(self._accumulators.items()):
+        # accumulator names are parsed out of the checkpoint keys, so loading
+        # into a freshly built optimizer (no accumulators yet) works
+        pkeys = self._param_keys()
+
+        def as_tensor(v):
+            arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            t = Tensor(arr, _internal=True)
+            t.persistable = True
+            return t
+
+        def store(p, name, v):
+            if name == "master":
+                self._master_weights[id(p)] = as_tensor(v)
+            else:
+                self._accumulators[name][id(p)] = as_tensor(v)
+
+        saved_keys = state_dict.get("__param_keys__")
+        if saved_keys is None and not any(
+                k.startswith(f"{pk}_") and k.endswith("_0")
+                for pk in pkeys for k in state_dict):
+            # legacy positional f"{name}_{i}" keys (round-1 checkpoints)
             for i, p in enumerate(self._parameter_list):
-                key = f"{name}_{i}"
-                if key in state_dict:
-                    v = state_dict[key]
-                    arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
-                    store[id(p)] = Tensor(arr, _internal=True)
-        for i, p in enumerate(self._parameter_list):
-            key = f"master_{i}"
-            if key in state_dict:
-                v = state_dict[key]
-                self._master_weights[id(p)] = Tensor(
-                    v._data if isinstance(v, Tensor) else jnp.asarray(v),
-                    _internal=True)
+                for key, v in state_dict.items():
+                    if key in ("LR_Scheduler", "global_step"):
+                        continue
+                    if key == f"master_{i}":
+                        self._master_weights[id(p)] = as_tensor(v)
+                    elif key.endswith(f"_{i}"):
+                        name = key[: -(len(str(i)) + 1)]
+                        self._accumulators[name][id(p)] = as_tensor(v)
+        else:
+            # group saved entries per param key; longest-prefix match so a key
+            # that is a prefix of another ('w' vs 'w__1') can't steal entries
+            groups = saved_keys if saved_keys is not None else pkeys
+            by_param = {pk: {} for pk in groups}
+            ordered = sorted(by_param, key=len, reverse=True)
+            for key, v in state_dict.items():
+                if key in ("LR_Scheduler", "global_step", "__param_keys__") \
+                        or not key.endswith("_0"):
+                    continue
+                for pk in ordered:
+                    if key.startswith(f"{pk}_"):
+                        by_param[pk][key[len(pk) + 1:-2]] = v
+                        break
+            for i, (pk, p) in enumerate(zip(pkeys, self._parameter_list)):
+                entries = by_param.get(pk)
+                if not entries and saved_keys is not None \
+                        and i < len(saved_keys):
+                    # names differ between save/load: align positionally via
+                    # the manifest order
+                    entries = by_param.get(saved_keys[i], {})
+                for name, v in (entries or {}).items():
+                    store(p, name, v)
         if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
                                                        LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])

@@ -25,9 +25,10 @@ class SGD(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
 
     def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
-        p._write(_sgd_update(p._read(), grad._read().astype(p.dtype),
-                             jnp.asarray(lr, p.dtype),
-                             jnp.asarray(weight_decay, p.dtype)))
+        src = self._update_src(p)
+        self._commit(p, src, _sgd_update(
+            src._read(), grad._read().astype(src.dtype),
+            jnp.asarray(lr, src.dtype), jnp.asarray(weight_decay, src.dtype)))
 
 
 @partial(jax.jit, static_argnames=("use_nesterov",))
@@ -50,12 +51,13 @@ class Momentum(Optimizer):
         self._use_nesterov = use_nesterov
 
     def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
-        vel = self._accumulator("velocity", p, dtype=p.dtype)
+        src = self._update_src(p)
+        vel = self._accumulator("velocity", p, dtype=src.dtype)
         new_p, new_v = _momentum_update(
-            p._read(), grad._read().astype(p.dtype), vel._read(),
-            jnp.asarray(lr, p.dtype), jnp.asarray(self._momentum, p.dtype),
-            jnp.asarray(weight_decay, p.dtype), self._use_nesterov)
-        p._write(new_p)
+            src._read(), grad._read().astype(src.dtype), vel._read(),
+            jnp.asarray(lr, src.dtype), jnp.asarray(self._momentum, src.dtype),
+            jnp.asarray(weight_decay, src.dtype), self._use_nesterov)
+        self._commit(p, src, new_p)
         vel._write(new_v)
 
 
@@ -106,15 +108,16 @@ class Adam(Optimizer):
             vhat_in = jnp.zeros((), jnp.float32)  # unused under static amsgrad=False
         t_arr = t if t is not None else jnp.asarray(self._global_step,
                                                    jnp.float32)
+        src = self._update_src(p)
         new_p, new_m, new_v, new_vhat = _adam_update(
-            p._read(), grad._read(), m._read(), v._read(), vhat_in,
+            src._read(), grad._read(), m._read(), v._read(), vhat_in,
             jnp.asarray(lr, jnp.float32), jnp.asarray(self._beta1, jnp.float32),
             jnp.asarray(self._beta2, jnp.float32),
             jnp.asarray(self._epsilon, jnp.float32),
             jnp.asarray(t_arr, jnp.float32),
             jnp.asarray(weight_decay, jnp.float32),
             decouple=self._decoupled, amsgrad=self._amsgrad)
-        p._write(new_p)
+        self._commit(p, src, new_p)
         m._write(new_m)
         v._write(new_v)
         if self._amsgrad:
@@ -166,11 +169,12 @@ class Adagrad(Optimizer):
     def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
         mom = self._accumulator(
             "moment", p, init=jnp.full(p._data.shape, self._init_acc, jnp.float32))
+        src = self._update_src(p)
         new_p, new_m = _adagrad_update(
-            p._read(), grad._read(), mom._read(), jnp.asarray(lr, jnp.float32),
+            src._read(), grad._read(), mom._read(), jnp.asarray(lr, jnp.float32),
             jnp.asarray(self._epsilon, jnp.float32),
             jnp.asarray(weight_decay, jnp.float32))
-        p._write(new_p)
+        self._commit(p, src, new_p)
         mom._write(new_m)
 
 
@@ -194,14 +198,15 @@ class Adamax(Optimizer):
     def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
         m = self._accumulator("moment", p, dtype=jnp.float32)
         inf = self._accumulator("inf_norm", p, dtype=jnp.float32)
+        src = self._update_src(p)
         new_p, new_m, new_inf = _adamax_update(
-            p._read(), grad._read(), m._read(), inf._read(),
+            src._read(), grad._read(), m._read(), inf._read(),
             jnp.asarray(lr, jnp.float32), jnp.asarray(self._beta1, jnp.float32),
             jnp.asarray(self._beta2, jnp.float32),
             jnp.asarray(self._epsilon, jnp.float32),
             jnp.asarray(t if t is not None else self._global_step, jnp.float32),
             jnp.asarray(weight_decay, jnp.float32))
-        p._write(new_p)
+        self._commit(p, src, new_p)
         m._write(new_m)
         inf._write(new_inf)
 
@@ -226,12 +231,13 @@ class Adadelta(Optimizer):
     def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
         sq = self._accumulator("avg_squared_grad", p, dtype=jnp.float32)
         up = self._accumulator("avg_squared_update", p, dtype=jnp.float32)
+        src = self._update_src(p)
         new_p, new_sq, new_up = _adadelta_update(
-            p._read(), grad._read(), sq._read(), up._read(),
+            src._read(), grad._read(), sq._read(), up._read(),
             jnp.asarray(self._rho, jnp.float32),
             jnp.asarray(self._epsilon, jnp.float32),
             jnp.asarray(lr, jnp.float32), jnp.asarray(weight_decay, jnp.float32))
-        p._write(new_p)
+        self._commit(p, src, new_p)
         sq._write(new_sq)
         up._write(new_up)
 
@@ -264,13 +270,14 @@ class RMSProp(Optimizer):
         msq = self._accumulator("mean_square", p, dtype=jnp.float32)
         mom = self._accumulator("momentum", p, dtype=jnp.float32)
         mg = self._accumulator("mean_grad", p, dtype=jnp.float32)
+        src = self._update_src(p)
         new_p, new_msq, new_mom, new_mg = _rmsprop_update(
-            p._read(), grad._read(), msq._read(), mom._read(), mg._read(),
+            src._read(), grad._read(), msq._read(), mom._read(), mg._read(),
             jnp.asarray(lr, jnp.float32), jnp.asarray(self._rho, jnp.float32),
             jnp.asarray(self._epsilon, jnp.float32),
             jnp.asarray(self._momentum, jnp.float32),
             jnp.asarray(weight_decay, jnp.float32), centered=self._centered)
-        p._write(new_p)
+        self._commit(p, src, new_p)
         msq._write(new_msq)
         mom._write(new_mom)
         mg._write(new_mg)
@@ -309,14 +316,15 @@ class Lamb(Optimizer):
             weight_decay = 0.0
         m = self._accumulator("moment1", p, dtype=jnp.float32)
         v = self._accumulator("moment2", p, dtype=jnp.float32)
+        src = self._update_src(p)
         new_p, new_m, new_v = _lamb_update(
-            p._read(), grad._read(), m._read(), v._read(), jnp.asarray(lr, jnp.float32),
+            src._read(), grad._read(), m._read(), v._read(), jnp.asarray(lr, jnp.float32),
             jnp.asarray(self._beta1, jnp.float32),
             jnp.asarray(self._beta2, jnp.float32),
             jnp.asarray(self._epsilon, jnp.float32),
             jnp.asarray(t if t is not None else self._global_step, jnp.float32),
             jnp.asarray(weight_decay, jnp.float32))
-        p._write(new_p)
+        self._commit(p, src, new_p)
         m._write(new_m)
         v._write(new_v)
 

@@ -1,0 +1,236 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+GradScaler per-optimizer state machine, O2 master weights, .grad threading
+through `to_static` capture, name-keyed optimizer state_dicts, and
+need_clip-aware global-norm clipping."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+
+
+class TestGradScalerStateMachine:
+    def _setup(self):
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+        x = paddle.randn([2, 4])
+        return model, opt, scaler, x
+
+    def test_documented_pattern_single_unscale(self):
+        """scaler.unscale_(opt); clip; scaler.step(opt); scaler.update() must
+        unscale exactly once (the round-1 bug double-unscaled)."""
+        model, opt, scaler, x = self._setup()
+        scaler.scale(model(x).sum()).backward()
+        g_scaled = np.array(model.weight.grad._data)
+        scaler.unscale_(opt)
+        g1 = np.array(model.weight.grad._data)
+        np.testing.assert_allclose(g1, g_scaled / 8.0, rtol=1e-6)
+        scaler.step(opt)  # must NOT unscale again
+        scaler.update()
+        np.testing.assert_allclose(np.array(model.weight.grad._data), g1,
+                                   rtol=1e-6)
+
+    def test_double_unscale_raises(self):
+        model, opt, scaler, x = self._setup()
+        scaler.scale(model(x).sum()).backward()
+        scaler.unscale_(opt)
+        with pytest.raises(RuntimeError):
+            scaler.unscale_(opt)
+
+    def test_step_after_step_raises(self):
+        model, opt, scaler, x = self._setup()
+        scaler.scale(model(x).sum()).backward()
+        scaler.step(opt)
+        with pytest.raises(RuntimeError):
+            scaler.step(opt)
+
+    def test_update_resets_state(self):
+        model, opt, scaler, x = self._setup()
+        for _ in range(2):
+            scaler.scale(model(x).sum()).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+
+    def test_inf_grad_skips_step_and_shrinks_scale(self):
+        model, opt, scaler, x = self._setup()
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                       decr_every_n_nan_or_inf=1)
+        w0 = np.array(model.weight._data)
+        scaler.scale(model(x).sum()).backward()
+        model.weight.grad._write(jnp.full_like(model.weight.grad._data,
+                                               np.inf))
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_array_equal(np.array(model.weight._data), w0)
+        assert scaler.get_init_loss_scaling() == 4.0
+
+
+class TestO2MasterWeights:
+    def test_master_weights_accumulate_small_updates(self):
+        """bf16 params round away lr*grad updates; the fp32 master must not."""
+        paddle.seed(0)
+        model = nn.Linear(8, 8)
+        opt = paddle.optimizer.SGD(learning_rate=1e-4,
+                                   parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
+        assert str(model.weight.dtype) == "bfloat16"
+        x = paddle.randn([4, 8])
+        for _ in range(10):
+            (model(x) ** 2).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        master = opt._master_weights[id(model.weight)]
+        assert master._data.dtype == jnp.float32
+        # param is the down-cast of the master, not an independently drifted copy
+        np.testing.assert_array_equal(
+            np.array(master._data.astype(jnp.bfloat16)),
+            np.array(model.weight._data))
+
+    def test_adam_master_matches_fp32_run(self):
+        paddle.seed(0)
+        ref = nn.Linear(6, 6)
+        paddle.seed(0)
+        low = nn.Linear(6, 6)
+        ref_opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=ref.parameters())
+        low_opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=low.parameters())
+        low, low_opt = paddle.amp.decorate(low, low_opt, level="O2",
+                                           dtype="bfloat16")
+        x32 = paddle.randn([4, 6])
+        for _ in range(5):
+            (ref(x32) ** 2).sum().backward()
+            ref_opt.step()
+            ref_opt.clear_grad()
+            (low(x32) ** 2).sum().backward()
+            low_opt.step()
+            low_opt.clear_grad()
+        master = np.array(low_opt._master_weights[id(low.weight)]._data)
+        # master tracks the fp32 trajectory to bf16-forward accuracy
+        np.testing.assert_allclose(master, np.array(ref.weight._data),
+                                   rtol=0.1, atol=0.02)
+
+
+class TestGradCaptureThreading:
+    def test_grad_accumulation_across_compiled_calls(self):
+        """backward-only compiled micro-steps must accumulate .grad across
+        calls exactly like eager (the round-1 capture recomputed from None)."""
+        paddle.seed(7)
+        lin = nn.Linear(4, 4)
+        paddle.seed(7)
+        lin_e = nn.Linear(4, 4)
+        x = paddle.randn([2, 4])
+
+        @paddle.jit.to_static
+        def micro(x):
+            loss = lin(x).sum()
+            loss.backward()
+            return loss
+
+        for i in range(3):
+            micro(x)
+            lin_e(x).sum().backward()
+            np.testing.assert_allclose(np.array(lin.weight.grad._data),
+                                       np.array(lin_e.weight.grad._data),
+                                       rtol=1e-5)
+
+    def test_grad_live_after_compiled_step(self):
+        """After a compiled call, .grad reflects this call, not the probe."""
+        lin = nn.Linear(4, 4)
+
+        @paddle.jit.to_static
+        def micro(x):
+            loss = (lin(x) ** 2).sum()
+            loss.backward()
+            return loss
+
+        x1 = paddle.ones([2, 4])
+        micro(x1)
+        g1 = np.array(lin.weight.grad._data)
+        for p in lin.parameters():
+            p.clear_grad()
+        x2 = paddle.full([2, 4], 2.0)
+        micro(x2)
+        g2 = np.array(lin.weight.grad._data)
+        assert not np.allclose(g1, g2), "grad is stale across compiled calls"
+
+    def test_accumulate_then_step(self):
+        """grad-accumulation train loop: N backward micro-steps + one step."""
+        paddle.seed(3)
+        lin = nn.Linear(4, 2)
+        paddle.seed(3)
+        lin_e = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        opt_e = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=lin_e.parameters())
+
+        @paddle.jit.to_static
+        def micro(x):
+            loss = lin(x).sum()
+            loss.backward()
+            return loss
+
+        xs = [paddle.randn([2, 4]) for _ in range(2)]
+        for x in xs:
+            micro(x)
+        opt.step()
+        opt.clear_grad()
+        for x in xs:
+            lin_e(x).sum().backward()
+        opt_e.step()
+        opt_e.clear_grad()
+        np.testing.assert_allclose(np.array(lin.weight._data),
+                                   np.array(lin_e.weight._data), rtol=1e-5)
+
+
+class TestOptimizerStateDictKeys:
+    def test_name_keyed_and_fresh_load(self):
+        m = nn.Linear(8, 8)
+        x = paddle.randn([4, 8])
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        (m(x) ** 2).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        sd = opt.state_dict()
+        assert any(k.endswith("_moment1_0") and m.weight.name in k
+                   for k in sd), sorted(sd)
+        fresh = paddle.optimizer.Adam(learning_rate=1e-3,
+                                      parameters=m.parameters())
+        fresh.set_state_dict(sd)
+        np.testing.assert_allclose(
+            np.array(fresh._accumulators["moment1"][id(m.weight)]._data),
+            np.array(opt._accumulators["moment1"][id(m.weight)]._data))
+
+    def test_legacy_positional_load(self):
+        m = nn.Linear(8, 8)
+        legacy = {"moment1_0": np.ones((8, 8), np.float32),
+                  "moment2_0": np.full((8, 8), 2.0, np.float32)}
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        opt.set_state_dict(legacy)
+        np.testing.assert_array_equal(
+            np.array(opt._accumulators["moment1"][id(m.weight)]._data), 1.0)
+
+
+class TestClipNeedClip:
+    def test_need_clip_false_excluded_from_global_norm(self):
+        a = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+        b.need_clip = False
+        ga = Tensor(jnp.ones(4) * 3, _internal=True)
+        gb = Tensor(jnp.ones(4) * 1000, _internal=True)
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(a, ga), (b, gb)])
+        # norm computed over `a` only (||ga|| = 6): ga scaled to unit norm,
+        # gb untouched
+        np.testing.assert_allclose(
+            float(jnp.linalg.norm(out[0][1]._data)), 1.0, rtol=1e-5)
+        np.testing.assert_array_equal(np.array(out[1][1]._data), 1000.0)
