@@ -87,3 +87,6 @@ define_flag("allocator_strategy", "xla",
 define_flag("eager_delete_tensor_gb", 0.0, "kept for compat; XLA GC is automatic")
 define_flag("tpu_donate_buffers", True,
             "donate param/opt-state buffers in captured train steps")
+define_flag("tpu_use_mosaic_flash", False,
+            "use the Pallas/Mosaic flash-attention kernel instead of XLA fused "
+            "attention (profiled slower on v5e at GPT-2 shapes; flip per model)")

@@ -239,23 +239,54 @@ class StaticFunction:
         arg_tensors, _, _ = _tree_flatten_tensors((args, kwargs))
         arg_ids = {id(t) for t in arg_tensors}
 
-        prev = tensor_mod.set_capture_hooks(
-            lambda t: (id(t) not in arg_ids) and cap.on_read(t),
-            lambda t: (id(t) not in arg_ids) and cap.on_write(t))
-        prev_active = tensor_mod.set_capture_active(True)
+        # phase 1: ABSTRACT probe — replay fn under jax.eval_shape with the arg
+        # arrays as tracers, recording read/write sets through the hooks. State
+        # tensors enter the trace as constants (no copies, no FLOPs, and none of
+        # the O(model) vjp-residual memory an eager probe would pin in HBM —
+        # an un-remat'd GPT-2-small probe at 8x1024 OOMs a 16 GB chip eagerly).
+        # Nothing may depend on concrete probe values anyway: phase 2 re-traces
+        # the same fn under jit, where every value is abstract.
+        result_box = []
+
+        def probe(arg_arrays):
+            saved = [(t._data, t._grad_node, t._out_slot, t._grad)
+                     for t in arg_tensors]
+            for t, a in zip(arg_tensors, arg_arrays):
+                t._data = a
+                t._grad_node = None
+            prev = tensor_mod.set_capture_hooks(
+                lambda t: (id(t) not in arg_ids) and cap.on_read(t),
+                lambda t: (id(t) not in arg_ids) and cap.on_write(t))
+            prev_active = tensor_mod.set_capture_active(True)
+            try:
+                result_box.append(fn(*args, **kwargs))
+                return ()
+            finally:
+                tensor_mod.set_capture_hooks(*prev)
+                tensor_mod.set_capture_active(prev_active)
+                for t, (a, n, s, g) in zip(arg_tensors, saved):
+                    t._data = a
+                    t._grad_node = n
+                    t._out_slot = s
+                    t._grad = g
+
         try:
-            # phase 1: eager probe run records read/write sets (also warms any
-            # data-dependent python control flow for this input signature)
-            result = fn(*args, **kwargs)
+            jax.eval_shape(probe, [t._data for t in arg_tensors])
         finally:
-            tensor_mod.set_capture_hooks(*prev)
-            tensor_mod.set_capture_active(prev_active)
-            # roll the probe's state mutations back: the first compiled call must
-            # observe pre-call state (exactly-once step semantics)
+            # roll the probe's state mutations back (tracer writes must not
+            # escape; the first compiled call must observe pre-call state)
             cap.rollback()
+        result = result_box[0]
 
         state_tensors = [cap.reads[k] for k in cap.order]
-        written_ids = set(cap.writes)
+        for t in state_tensors:
+            if isinstance(t._data, jax.core.Tracer):
+                raise RuntimeError(
+                    "to_static capture: a persistable tensor created during "
+                    "the capture probe holds a tracer (shape "
+                    f"{t._data.shape}). Lazily-initialized step state must be "
+                    "created under jax.ensure_compile_time_eval() so its "
+                    "initial value is concrete (see Optimizer._accumulator).")
         out_tensors, out_spec, out_rebuild = _tree_flatten_tensors(result)
         out_stop_grads = [t.stop_gradient for t in out_tensors]
         # pre-probe grad presence (the probe's own grads were rolled back above)
@@ -299,7 +330,9 @@ class StaticFunction:
                     t._out_slot = s
                     t._grad = g
 
-        donate = (0,) if self._donate else ()
+        # donate threaded grads too: a grad-accumulation micro-step otherwise
+        # keeps old+new full-model grad sets live and copies O(model) per call
+        donate = (0, 1) if self._donate else ()
         jitted = jax.jit(pure, donate_argnums=donate)
         compiled = _Compiled(jitted, state_tensors, out_spec, out_rebuild,
                              len(out_tensors), out_stop_grads, grad_mask)

@@ -13,6 +13,7 @@ Layout note: paddle uses [B, S, H, D]; the pallas op uses [B, H, S, D].
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 
@@ -23,16 +24,55 @@ _PALLAS_OK = None
 
 
 def _try_pallas():
-    global _PALLAS_OK, _pallas_fa
+    global _PALLAS_OK, _fa_mod
     if _PALLAS_OK is None:
         try:
-            from jax.experimental.pallas.ops.tpu.flash_attention import (
-                flash_attention as _fa, BlockSizes)
-            _pallas_fa = _fa
+            from jax.experimental.pallas.ops.tpu import flash_attention as _m
+            _fa_mod = _m
             _PALLAS_OK = jax.default_backend() == "tpu"
         except Exception:
             _PALLAS_OK = False
     return _PALLAS_OK
+
+
+def _x64_off():
+    """The Mosaic flash kernel mixes int32 iota with weakly-typed python ints,
+    which breaks under jax_enable_x64 (paddle enables x64 globally for int64
+    tensor semantics). Trace the kernel's fwd AND bwd under x64-disabled
+    promotion rules; array dtypes themselves are unaffected."""
+    if jax.config.jax_enable_x64:
+        return jax.enable_x64(False)
+    return contextlib.nullcontext()
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _pallas_flash(q, k, v, causal, sm_scale):
+    out, _ = _pallas_flash_fwd(q, k, v, causal, sm_scale)
+    return out
+
+
+def _pallas_flash_fwd(q, k, v, causal, sm_scale):
+    _try_pallas()
+    bs = _fa_mod.BlockSizes.get_default(
+        q.shape[0], q.shape[1], q.shape[2], k.shape[2], q.shape[3])
+    with _x64_off():
+        o, res = _fa_mod._flash_attention_fwd(
+            q, k, v, None, None, False, causal, sm_scale, bs, False)
+    return o, res
+
+
+def _pallas_flash_bwd(causal, sm_scale, res, do):
+    _try_pallas()
+    q, k = res[0], res[1]
+    bs = _fa_mod.BlockSizes.get_default(
+        q.shape[0], q.shape[1], q.shape[2], k.shape[2], q.shape[3])
+    with _x64_off():
+        dq, dk, dv, _, _ = _fa_mod._flash_attention_bwd(
+            False, causal, sm_scale, bs, False, res, do)
+    return dq, dk, dv
+
+
+_pallas_flash.defvjp(_pallas_flash_fwd, _pallas_flash_bwd)
 
 
 def _blockwise_attention(q, k, v, causal, scale, block_k=512):
@@ -92,16 +132,22 @@ def flash_attention_fn(causal=False, scale=None):
     """Returns a pure fn(q, k, v) on paddle-layout [B, S, H, D] tensors."""
 
     def fn(q, k, v):
+        from paddle_tpu.framework.flags import flag_value
         # -> [B, H, S, D]
         qt = jnp.swapaxes(q, 1, 2)
         kt = jnp.swapaxes(k, 1, 2)
         vt = jnp.swapaxes(v, 1, 2)
         S, D = qt.shape[2], qt.shape[3]
-        use_pallas = (_try_pallas() and S % 128 == 0 and D % 64 == 0
+        # The Mosaic kernel is opt-in: profiled on the current v5e runtime, its
+        # bwd_dkv/bwd_dq kernels are ~4x slower than XLA's fused attention at
+        # GPT-2 shapes (see BENCH notes). XLA's blockwise online-softmax keeps
+        # O(S) memory for long sequences; plain fused attention wins below 2k.
+        use_pallas = (flag_value("tpu_use_mosaic_flash") and _try_pallas()
+                      and S % 128 == 0 and D % 64 == 0
                       and qt.dtype in (jnp.float32, jnp.bfloat16))
         if use_pallas:
             sm = scale if scale is not None else 1.0 / math.sqrt(D)
-            out = _pallas_fa(qt * sm, kt, vt, causal=causal, sm_scale=1.0)
+            out = _pallas_flash(qt, kt, vt, causal, sm)
         else:
             out = _blockwise_attention(qt, kt, vt, causal, scale)
         return jnp.swapaxes(out, 1, 2)

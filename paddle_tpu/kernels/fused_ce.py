@@ -1,0 +1,109 @@
+"""Fused LM-head + softmax cross-entropy (TPU memory/bandwidth kernel).
+
+Counterpart of the reference's fused ``c_softmax_with_cross_entropy`` idea
+(`paddle/fluid/operators/collective/c_softmax_with_cross_entropy_op.cc`) but
+designed for XLA: the ``[N, V]`` logits tensor (e.g. 8192 x 50304, ~0.8 GB in
+bf16 and double that in f32) is never materialized in HBM. The vocab dimension
+is processed in chunks under ``lax.scan`` with an online logsumexp; the
+backward pass recomputes each chunk's logits and feeds the two grad matmuls
+directly. Costs one extra LM-head matmul (~10% of model FLOPs) and saves
+~2.5 GB of HBM traffic + residency per step on GPT-2-small at 8x1024 —
+which is what lets the whole model train without full-block remat.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _pick_chunks(v: int) -> int:
+    """Largest chunk count <= 8 that divides the (padded) vocab."""
+    for nc in (8, 6, 4, 3, 2):
+        if v % nc == 0:
+            return nc
+    return 1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def fused_linear_cross_entropy(h, w, labels):
+    loss, _ = _flce_fwd(h, w, labels)
+    return loss
+
+
+def _chunk_logits(h, w_c):
+    """[N,H] x [vc,H] -> [N,vc] in bf16 with f32 accumulation (MXU-friendly)."""
+    return jax.lax.dot_general(
+        h.astype(jnp.bfloat16), w_c.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+
+
+def _flce_fwd(h, w, labels):
+    n, hid = h.shape
+    v = w.shape[0]
+    nc = _pick_chunks(v)
+    vc = v // nc
+    wb = w.reshape(nc, vc, hid)
+    labels = labels.astype(jnp.int32)
+
+    def body(carry, inp):
+        m, l, picked = carry
+        w_c, base = inp
+        logits = _chunk_logits(h, w_c)                      # [N, vc] f32
+        m_c = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_c)
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        idx = labels - base
+        in_chunk = (idx >= 0) & (idx < vc)
+        safe = jnp.clip(idx, 0, vc - 1)
+        got = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+        picked = jnp.where(in_chunk, got, picked)
+        return (m_new, l, picked), None
+
+    m0 = jnp.full((n,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((n,), jnp.float32)
+    p0 = jnp.zeros((n,), jnp.float32)
+    bases = jnp.arange(nc, dtype=jnp.int32) * vc
+    (m, l, picked), _ = jax.lax.scan(body, (m0, l0, p0), (wb, bases))
+    lse = m + jnp.log(l)
+    loss = lse - picked
+    return loss, (h, w, labels, lse)
+
+
+def _flce_bwd(res, dloss):
+    h, w, labels, lse = res
+    n, hid = h.shape
+    v = w.shape[0]
+    nc = _pick_chunks(v)
+    vc = v // nc
+    wb = w.reshape(nc, vc, hid)
+    bases = jnp.arange(nc, dtype=jnp.int32) * vc
+    dl = dloss.astype(jnp.float32)
+
+    def body(dh, inp):
+        w_c, base = inp
+        logits = _chunk_logits(h, w_c)                      # recompute [N, vc]
+        p = jnp.exp(logits - lse[:, None])                  # softmax chunk
+        idx = labels - base
+        in_chunk = (idx >= 0) & (idx < vc)
+        onehot = (jnp.arange(vc, dtype=jnp.int32)[None, :] ==
+                  idx[:, None]) & in_chunk[:, None]
+        dlogits = ((p - onehot.astype(jnp.float32)) *
+                   dl[:, None]).astype(jnp.bfloat16)        # [N, vc]
+        dh = dh + jax.lax.dot_general(
+            dlogits, w_c.astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dw_c = jax.lax.dot_general(
+            dlogits, h.astype(jnp.bfloat16),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dh, dw_c
+
+    dh0 = jnp.zeros((n, hid), jnp.float32)
+    dh, dwb = jax.lax.scan(body, dh0, (wb, bases))
+    dw = dwb.reshape(v, hid).astype(w.dtype)
+    return dh.astype(h.dtype), dw, None
+
+
+fused_linear_cross_entropy.defvjp(_flce_fwd, _flce_bwd)

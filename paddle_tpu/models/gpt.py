@@ -46,6 +46,7 @@ class GPTConfig:
     use_flash: bool = True
     seq_parallel: bool = False       # constrain activations over the 'sp' axis
     recompute: bool = False          # rematerialize each block (jax.checkpoint)
+    fused_ce: bool = True            # chunked lm-head+CE, no [N,V] logits in HBM
 
 
 def _sp_constrain(x, cfg):
@@ -172,12 +173,27 @@ class GPTForCausalLM(nn.Layer):
         h = self.gpt(input_ids)
         # tied lm head: logits = h @ wte^T (vocab-sharded over mp like the
         # reference's parallel lm head + ParallelCrossEntropy)
-        logits = paddle.matmul(h, self.gpt.wte.weight, transpose_y=True)
+        mesh = get_mesh()
+        use_fused = (labels is not None and self.cfg.fused_ce
+                     and (mesh is None or mesh.shape.get("mp", 1) == 1))
+        if use_fused:
+            from paddle_tpu.core.autograd import apply
+            from paddle_tpu.kernels.fused_ce import fused_linear_cross_entropy
+            n = h.shape[0] * h.shape[1]
+            loss = apply(
+                lambda hh, ww, ll: fused_linear_cross_entropy(
+                    hh.reshape(n, -1), ww, ll.reshape(-1)),
+                h, self.gpt.wte.weight, labels,
+                op_name="fused_linear_cross_entropy")
+            logits = None
+        else:
+            logits = paddle.matmul(h, self.gpt.wte.weight, transpose_y=True)
         if labels is None:
             return logits
-        loss = F.cross_entropy(
-            logits.reshape([-1, self.cfg.vocab_size]).astype("float32"),
-            labels.reshape([-1]), reduction="none")
+        if not use_fused:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.cfg.vocab_size]).astype("float32"),
+                labels.reshape([-1]), reduction="none")
         if loss_mask is not None:
             m = loss_mask.reshape([-1]).astype("float32")
             loss = (loss * m).sum() / m.sum()

@@ -94,9 +94,14 @@ class Optimizer:
         store = self._accumulators[name]
         key = id(p)
         if key not in store:
-            d = dtype or (jnp.float32 if self._use_master_weights else p.dtype)
-            arr = jnp.zeros(p._data.shape, d) if init is None else init
-            t = Tensor(arr, _internal=True)
+            # ensure_compile_time_eval: lazy state creation may run inside the
+            # abstract capture probe (static_function phase 1); the initial
+            # value must be a concrete array, not a tracer, to survive the trace
+            with jax.ensure_compile_time_eval():
+                d = dtype or (jnp.float32 if self._use_master_weights
+                              else p.dtype)
+                arr = jnp.zeros(p._data.shape, d) if init is None else init
+                t = Tensor(jnp.asarray(arr), _internal=True)
             t.persistable = True
             store[key] = t
         return store[key]
@@ -107,8 +112,9 @@ class Optimizer:
             # amp.decorate(level="O2") stashes the pre-cast fp32 copy on the
             # param; prefer it so the master doesn't inherit bf16 rounding
             src = getattr(p, "_master", None)
-            arr = src._data if src is not None else p._data
-            mt = Tensor(arr.astype(jnp.float32), _internal=True)
+            with jax.ensure_compile_time_eval():
+                arr = src._data if src is not None else p._data
+                mt = Tensor(arr.astype(jnp.float32), _internal=True)
             mt.persistable = True
             self._master_weights[key] = mt
         return self._master_weights[key]

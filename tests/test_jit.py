@@ -166,3 +166,23 @@ def test_recompute_inside_capture():
     x = paddle.rand([4, 6])
     losses = [float(step(x)) for _ in range(5)]
     assert all(np.isfinite(losses))
+
+
+def test_arg_with_grad_through_capture():
+    """A non-stop-gradient *argument* must not leak the probe's tracer grad
+    (regression: the abstract capture probe now snapshots/restores arg .grad)."""
+    lin = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = lin(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = paddle.randn([2, 4])
+    x.stop_gradient = False
+    losses = [float(step(x)) for _ in range(3)]
+    assert all(np.isfinite(losses))
