@@ -123,24 +123,49 @@ def _needs_grad(t) -> bool:
     return (not t.stop_gradient) and jnp.issubdtype(t.dtype, jnp.inexact)
 
 
+def _x64_off_scope():
+    if jax.config.jax_enable_x64:
+        return jax.enable_x64(False)
+    import contextlib
+    return contextlib.nullcontext()
+
+
 def apply(prim: Callable, *inputs, op_name: str = "", n_outputs: int | None = None,
-          **static_kwargs):
+          x64_off: bool = False, **static_kwargs):
     """Execute ``prim(*arrays, **static_kwargs)`` with autograd recording.
 
     ``prim`` must be a pure jax function of the positional arrays. Returns Tensor or
     tuple of Tensors. The single dispatch point — the analog of the generated
     ``*_ad_func`` forwards (`eager/auto_code_generator/generator/eager_gen.py`).
+
+    ``x64_off``: trace this op's forward AND backward under x64-disabled dtype
+    promotion — required by Pallas kernels (splash/flash attention) that mix
+    int32 iota with weak python ints, which breaks under paddle's global
+    jax_enable_x64. The backward scope matters because vjp_fn traces the
+    custom-vjp bwd rule at backward time, long after the forward scope exits.
     """
     T = _tensor_mod()
     arrays = [t._read() for t in inputs]
     record = _grad_enabled and any(_needs_grad(t) for t in inputs)
     fn = functools.partial(prim, **static_kwargs) if static_kwargs else prim
+    if x64_off:
+        inner = fn
+
+        def fn(*a):
+            with _x64_off_scope():
+                return inner(*a)
 
     if not record:
         out = fn(*arrays)
         return _wrap_outputs(out, node=None, stop_gradient=True)
 
-    out, vjp_fn = jax.vjp(fn, *arrays)
+    out, raw_vjp_fn = jax.vjp(fn, *arrays)
+    if x64_off:
+        def vjp_fn(cts, _raw=raw_vjp_fn):
+            with _x64_off_scope():
+                return _raw(cts)
+    else:
+        vjp_fn = raw_vjp_fn
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
     node = GradNode(

@@ -87,6 +87,10 @@ define_flag("allocator_strategy", "xla",
 define_flag("eager_delete_tensor_gb", 0.0, "kept for compat; XLA GC is automatic")
 define_flag("tpu_donate_buffers", True,
             "donate param/opt-state buffers in captured train steps")
-define_flag("tpu_use_mosaic_flash", False,
-            "use the Pallas/Mosaic flash-attention kernel instead of XLA fused "
-            "attention (profiled slower on v5e at GPT-2 shapes; flip per model)")
+define_flag("tpu_fused_optimizer", True,
+            "multi-tensor optimizer path: one fused update over concatenated "
+            "flat param/state buffers per dtype group (ref fused adam kernels)")
+define_flag("tpu_flash_impl", "auto",
+            "flash-attention backend: auto | splash (Pallas splash kernel) | "
+            "mosaic (legacy Pallas flash) | xla (pure-XLA flash-style custom "
+            "vjp, also the fallback for non-tileable shapes)")

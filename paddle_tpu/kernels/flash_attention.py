@@ -36,13 +36,12 @@ def _try_pallas():
 
 
 def _x64_off():
-    """The Mosaic flash kernel mixes int32 iota with weakly-typed python ints,
-    which breaks under jax_enable_x64 (paddle enables x64 globally for int64
-    tensor semantics). Trace the kernel's fwd AND bwd under x64-disabled
-    promotion rules; array dtypes themselves are unaffected."""
-    if jax.config.jax_enable_x64:
-        return jax.enable_x64(False)
-    return contextlib.nullcontext()
+    """Pallas kernels mix int32 iota with weakly-typed python ints, which
+    breaks under jax_enable_x64 (paddle enables x64 globally for int64 tensor
+    semantics) — trace them under x64-disabled promotion rules. Single shared
+    helper lives in autograd (also used by apply(x64_off=True))."""
+    from paddle_tpu.core.autograd import _x64_off_scope
+    return _x64_off_scope()
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -128,6 +127,156 @@ def _blockwise_attention(q, k, v, causal, scale, block_k=512):
     return out.astype(q.dtype)
 
 
+_SPLASH_CACHE: dict = {}
+
+
+def _splash_kernel(n_heads, S, causal):
+    """Cached Splash (Pallas) MHA kernel — the production TPU flash attention.
+    Created under ensure_compile_time_eval so the precomputed mask-info arrays
+    stay concrete even when first touched inside an abstract capture probe."""
+    key = (n_heads, S, causal)
+    if key not in _SPLASH_CACHE:
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sk, splash_attention_mask as sm)
+        with jax.ensure_compile_time_eval(), _x64_off():
+            mask = sm.MultiHeadMask(
+                [sm.CausalMask((S, S)) if causal else sm.FullMask((S, S))
+                 for _ in range(n_heads)])
+            _SPLASH_CACHE[key] = sk.make_splash_mha(
+                mask, head_shards=1, q_seq_shards=1)
+    return _SPLASH_CACHE[key]
+
+
+def _splash_attention(q, k, v, causal, scale):
+    """q,k,v: [B,H,S,D]; caller must hold an x64-off scope across fwd+bwd
+    traces (see autograd.apply(x64_off=True))."""
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    kern = _splash_kernel(q.shape[1], q.shape[2], causal)
+    return jax.vmap(kern)((q * s).astype(q.dtype), k, v)
+
+
+def _qblocks(S):
+    """Static q-block size: single block up to 2k, else 1k blocks (bounds the
+    transient [Bq, S] logits while staying unrolled — lax.scan variants hit
+    pathological compile paths on the current TPU toolchain)."""
+    return S if S <= 2048 else 1024
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _xla_flash(q, k, v, causal, scale):
+    out, _ = _xla_flash_fwd(q, k, v, causal, scale)
+    return out
+
+
+def _block_logits(qb, k, scale):
+    # [B,H,Bq,D] x [B,H,Sk,D] -> [B,H,Bq,Sk]; bf16 inputs materialize bf16
+    # logits (halves the S^2 HBM traffic, reductions still accumulate f32)
+    acc = jnp.bfloat16 if qb.dtype == jnp.bfloat16 else jnp.float32
+    return jax.lax.dot_general(
+        qb * scale, k, (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=acc)
+
+
+def _causal_mask(bq, kend, q0, sq_total, sk_total):
+    """kend: K prefix length kept for this q block (absolute positions 0..kend);
+    the causal offset is measured against the FULL k length (decode caches make
+    Sk > Sq)."""
+    qpos = q0 + jnp.arange(bq)
+    kpos = jnp.arange(kend)
+    return kpos[None, :] <= (qpos[:, None] + (sk_total - sq_total))
+
+
+def _xla_flash_fwd(q, k, v, causal, scale):
+    """Flash-style attention in pure XLA: the [S,S] probability matrix exists
+    only transiently inside each q-block; residuals are (q, k, v, out, lse).
+    Counterpart of the reference's fused_attention fmha path, but online-safe
+    (ref `operators/fused/fused_attention_op.cu` is non-flash)."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    s = scale if scale is not None else 1.0 / math.sqrt(D)
+    bq = _qblocks(Sq)
+    outs, lses = [], []
+    for q0 in range(0, Sq, bq):
+        qb = q[:, :, q0:q0 + bq]
+        # causal: later K positions can't be attended by this q block — slice
+        # them off entirely (real FLOP/traffic saving, not just masking)
+        kend = min(q0 + bq + (Sk - Sq), Sk) if causal else Sk
+        kb, vb = k[:, :, :kend], v[:, :, :kend]
+        logits = _block_logits(qb, kb, s)                   # bf16 [B,H,Bq,kend]
+        if causal:
+            m = _causal_mask(qb.shape[2], kend, q0, Sq, Sk)
+            logits = jnp.where(m[None, None], logits,
+                               jnp.asarray(-1e30, logits.dtype))
+        mx = jnp.max(logits, axis=-1, keepdims=True)        # exact in bf16
+        z = logits.astype(jnp.float32) - mx.astype(jnp.float32)
+        l = jnp.sum(jnp.exp(z), axis=-1, keepdims=True)     # f32 accumulation
+        p = jnp.exp(z).astype(v.dtype)                      # bf16 for the MXU
+        acc = jax.lax.dot_general(
+            p, vb, (((3,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32)
+        outs.append((acc / l).astype(q.dtype))              # normalize post-dot
+        lses.append((mx.astype(jnp.float32) + jnp.log(l))[..., 0])
+    out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=2)
+    lse = lses[0] if len(lses) == 1 else jnp.concatenate(lses, axis=2)
+    return out, (q, k, v, out, lse)
+
+
+def _xla_flash_bwd(causal, scale, res, do):
+    q, k, v, out, lse = res
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    s = scale if scale is not None else 1.0 / math.sqrt(D)
+    bq = _qblocks(Sq)
+    dqs = []
+    dk = jnp.zeros((B, H, Sk, D), jnp.float32)
+    dv = jnp.zeros((B, H, Sk, D), jnp.float32)
+    for q0 in range(0, Sq, bq):
+        qb = q[:, :, q0:q0 + bq]
+        dob = do[:, :, q0:q0 + bq]
+        ob = out[:, :, q0:q0 + bq]
+        lseb = lse[:, :, q0:q0 + bq]
+        kend = min(q0 + bq + (Sk - Sq), Sk) if causal else Sk
+        kb, vb = k[:, :, :kend], v[:, :, :kend]
+        logits = _block_logits(qb, kb, s)
+        if causal:
+            m = _causal_mask(qb.shape[2], kend, q0, Sq, Sk)
+            logits = jnp.where(m[None, None], logits,
+                               jnp.asarray(-1e30, logits.dtype))
+        # p recomputed from lse: [B,H,Bq,kend] bf16, never a residual
+        p = jnp.exp(logits.astype(jnp.float32) -
+                    lseb[..., None]).astype(v.dtype)
+        # dv += p^T do ; dp = do v^T ; ds = p*(dp - di) ; dq = ds k ; dk += ds^T q
+        dvc = jax.lax.dot_general(
+            p, dob, (((2,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            dob, vb, (((3,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=(jnp.bfloat16 if v.dtype == jnp.bfloat16
+                                    else jnp.float32))
+        di = jnp.sum(dob.astype(jnp.float32) * ob.astype(jnp.float32),
+                     axis=-1, keepdims=True)
+        ds = (p.astype(jnp.float32) *
+              (dp.astype(jnp.float32) - di)).astype(q.dtype)
+        dqs.append(jax.lax.dot_general(
+            ds, kb, (((3,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32) * s)
+        dkc = jax.lax.dot_general(
+            ds, qb, (((2,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32) * s
+        if kend == Sk:
+            dk = dk + dkc
+            dv = dv + dvc
+        else:
+            pad = ((0, 0), (0, 0), (0, Sk - kend), (0, 0))
+            dk = dk + jnp.pad(dkc, pad)
+            dv = dv + jnp.pad(dvc, pad)
+    dq = dqs[0] if len(dqs) == 1 else jnp.concatenate(dqs, axis=2)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_xla_flash.defvjp(_xla_flash_fwd, _xla_flash_bwd)
+
+
 def flash_attention_fn(causal=False, scale=None):
     """Returns a pure fn(q, k, v) on paddle-layout [B, S, H, D] tensors."""
 
@@ -138,18 +287,22 @@ def flash_attention_fn(causal=False, scale=None):
         kt = jnp.swapaxes(k, 1, 2)
         vt = jnp.swapaxes(v, 1, 2)
         S, D = qt.shape[2], qt.shape[3]
-        # The Mosaic kernel is opt-in: profiled on the current v5e runtime, its
-        # bwd_dkv/bwd_dq kernels are ~4x slower than XLA's fused attention at
-        # GPT-2 shapes (see BENCH notes). XLA's blockwise online-softmax keeps
-        # O(S) memory for long sequences; plain fused attention wins below 2k.
-        use_pallas = (flag_value("tpu_use_mosaic_flash") and _try_pallas()
-                      and S % 128 == 0 and D % 64 == 0
-                      and qt.dtype in (jnp.float32, jnp.bfloat16))
-        if use_pallas:
+        impl = flag_value("tpu_flash_impl")
+        tileable = (_try_pallas() and S % 128 == 0 and D % 64 == 0
+                    and S == kt.shape[2]
+                    and qt.dtype in (jnp.float32, jnp.bfloat16))
+        if impl == "auto":
+            # measured on the current v5e runtime: every Pallas variant
+            # (mosaic flash, splash) loses to the XLA flash-style custom-vjp
+            # at GPT-2 shapes; revisit per-generation
+            impl = "xla"
+        if impl == "splash" and tileable:
+            out = _splash_attention(qt, kt, vt, causal, scale)
+        elif impl == "mosaic" and tileable:
             sm = scale if scale is not None else 1.0 / math.sqrt(D)
             out = _pallas_flash(qt, kt, vt, causal, sm)
         else:
-            out = _blockwise_attention(qt, kt, vt, causal, scale)
+            out = _xla_flash(qt, kt, vt, causal, scale)
         return jnp.swapaxes(out, 1, 2)
 
     return fn

@@ -19,9 +19,11 @@ import jax.numpy as jnp
 
 
 def _pick_chunks(v: int) -> int:
-    """Largest chunk count <= 8 that divides the (padded) vocab."""
-    for nc in (8, 6, 4, 3, 2):
-        if v % nc == 0:
+    """Chunk count <= 4 that divides the (padded) vocab. Chunks are UNROLLED
+    (python loop) so the per-chunk matmuls stay independent in the graph —
+    lax.scan would serialize them behind the cheap online-logsumexp carry."""
+    for nc in (4, 3, 2):
+        if v % nc == 0 and v // nc >= 4096:
             return nc
     return 1
 
@@ -44,31 +46,36 @@ def _flce_fwd(h, w, labels):
     v = w.shape[0]
     nc = _pick_chunks(v)
     vc = v // nc
-    wb = w.reshape(nc, vc, hid)
     labels = labels.astype(jnp.int32)
 
-    def body(carry, inp):
-        m, l, picked = carry
-        w_c, base = inp
-        logits = _chunk_logits(h, w_c)                      # [N, vc] f32
+    # independent per-chunk (max, sumexp-at-own-max, picked-logit) ...
+    ms, ls, picks = [], [], []
+    for c in range(nc):
+        logits = _chunk_logits(h, w[c * vc:(c + 1) * vc])   # [N, vc] f32
         m_c = jnp.max(logits, axis=-1)
-        m_new = jnp.maximum(m, m_c)
-        l = l * jnp.exp(m - m_new) + jnp.sum(
-            jnp.exp(logits - m_new[:, None]), axis=-1)
-        idx = labels - base
+        l_c = jnp.sum(jnp.exp(logits - m_c[:, None]), axis=-1)
+        idx = labels - c * vc
         in_chunk = (idx >= 0) & (idx < vc)
-        safe = jnp.clip(idx, 0, vc - 1)
+        safe = jnp.where(in_chunk, idx, 0)
         got = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
-        picked = jnp.where(in_chunk, got, picked)
-        return (m_new, l, picked), None
-
-    m0 = jnp.full((n,), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((n,), jnp.float32)
-    p0 = jnp.zeros((n,), jnp.float32)
-    bases = jnp.arange(nc, dtype=jnp.int32) * vc
-    (m, l, picked), _ = jax.lax.scan(body, (m0, l0, p0), (wb, bases))
+        ms.append(m_c)
+        ls.append(l_c)
+        picks.append(jnp.where(in_chunk, got, -jnp.inf))
+    # ... then a cheap tree-merge into the global logsumexp
+    m = ms[0]
+    for m_c in ms[1:]:
+        m = jnp.maximum(m, m_c)
+    l = ls[0] * jnp.exp(ms[0] - m)
+    for m_c, l_c in zip(ms[1:], ls[1:]):
+        l = l + l_c * jnp.exp(m_c - m)
+    picked = picks[0]
+    for pk in picks[1:]:
+        picked = jnp.maximum(picked, pk)
     lse = m + jnp.log(l)
-    loss = lse - picked
+    # out-of-range labels (e.g. the conventional -100 padding / ignore_index)
+    # contribute zero loss and zero gradient, matching F.cross_entropy
+    valid = (labels >= 0) & (labels < v)
+    loss = jnp.where(valid, lse - picked, 0.0)
     return loss, (h, w, labels, lse)
 
 
@@ -78,15 +85,16 @@ def _flce_bwd(res, dloss):
     v = w.shape[0]
     nc = _pick_chunks(v)
     vc = v // nc
-    wb = w.reshape(nc, vc, hid)
-    bases = jnp.arange(nc, dtype=jnp.int32) * vc
-    dl = dloss.astype(jnp.float32)
+    valid = (labels >= 0) & (labels < v)
+    dl = dloss.astype(jnp.float32) * valid.astype(jnp.float32)
 
-    def body(dh, inp):
-        w_c, base = inp
+    dh = jnp.zeros((n, hid), jnp.float32)
+    dws = []
+    for c in range(nc):
+        w_c = w[c * vc:(c + 1) * vc]
         logits = _chunk_logits(h, w_c)                      # recompute [N, vc]
         p = jnp.exp(logits - lse[:, None])                  # softmax chunk
-        idx = labels - base
+        idx = labels - c * vc
         in_chunk = (idx >= 0) & (idx < vc)
         onehot = (jnp.arange(vc, dtype=jnp.int32)[None, :] ==
                   idx[:, None]) & in_chunk[:, None]
@@ -95,14 +103,10 @@ def _flce_bwd(res, dloss):
         dh = dh + jax.lax.dot_general(
             dlogits, w_c.astype(jnp.bfloat16),
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        dw_c = jax.lax.dot_general(
+        dws.append(jax.lax.dot_general(
             dlogits, h.astype(jnp.bfloat16),
-            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return dh, dw_c
-
-    dh0 = jnp.zeros((n, hid), jnp.float32)
-    dh, dwb = jax.lax.scan(body, dh0, (wb, bases))
-    dw = dwb.reshape(v, hid).astype(w.dtype)
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32))
+    dw = jnp.concatenate(dws, axis=0).astype(w.dtype)
     return dh.astype(h.dtype), dw, None
 
 

@@ -40,12 +40,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                          ensure_tensor(value))
     use_flash = attn_mask is None and dropout_p == 0.0
     if use_flash:
-        try:
-            from paddle_tpu.kernels.flash_attention import flash_attention_fn
-            fn = flash_attention_fn(causal=is_causal, scale=scale)
-            return apply(fn, query, key, value, op_name="flash_attention")
-        except Exception:
-            pass
+        # no blanket except here: a broken kernel must surface, not silently
+        # fall back to O(S^2)-materializing attention (cost a whole round once)
+        from paddle_tpu.kernels.flash_attention import flash_attention_fn
+        fn = flash_attention_fn(causal=is_causal, scale=scale)
+        return apply(fn, query, key, value, op_name="flash_attention",
+                     x64_off=True)
     ts = [query, key, value]
     has_mask = attn_mask is not None
     if has_mask:

@@ -87,15 +87,19 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         inputs.append(ensure_tensor(bias))
 
     def prim(a, *wb):
-        m = jnp.mean(a, axis=axes, keepdims=True)
-        v = jnp.var(a, axis=axes, keepdims=True)
-        out = (a - m) / jnp.sqrt(v + epsilon)
+        # stats in f32 regardless of input dtype (bf16-safe normalization, the
+        # fused-LN convention: bf16 in/out, f32 internal — ref layer_norm CUDA
+        # kernels accumulate in float)
+        a32 = a.astype(jnp.float32)
+        m = jnp.mean(a32, axis=axes, keepdims=True)
+        v = jnp.var(a32, axis=axes, keepdims=True)
+        out = (a32 - m) / jnp.sqrt(v + epsilon)
         it = iter(wb)
         if has_w:
-            out = out * next(it)
+            out = out * next(it).astype(jnp.float32)
         if has_b:
-            out = out + next(it)
-        return out
+            out = out + next(it).astype(jnp.float32)
+        return out.astype(a.dtype)
 
     return apply(prim, *inputs, op_name="layer_norm")
 

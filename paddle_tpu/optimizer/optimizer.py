@@ -46,6 +46,7 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._accumulators: dict[str, dict[int, Tensor]] = collections.defaultdict(
             dict)
+        self._fused_parts: dict = {}    # per-group flat state (see _fused_meta)
         self._global_step = 0
         self._use_master_weights = False
         self._master_weights: dict[int, Tensor] = {}
@@ -145,9 +146,23 @@ class Optimizer:
                 return g
         return None
 
+    def _lr_wd_of(self, p, lr_arr):
+        group = self._param_group_of(p)
+        lr = lr_arr
+        wd = self._weight_decay
+        if group is not None:
+            lr = lr * float(group.get("learning_rate", 1.0))
+            gwd = group.get("weight_decay", wd)
+            wd = float(gwd) if gwd is not None else wd
+        if hasattr(p, "optimize_attr"):
+            lr = lr * float(getattr(p, "optimize_attr", {}).get(
+                "learning_rate", 1.0))
+        return lr, wd
+
     @no_grad()
     def step(self):
         from paddle_tpu.core import tensor as tensor_mod
+        from paddle_tpu.framework.flags import flag_value
         params_grads = [(p, p.grad) for p in self._parameter_list
                         if not p.stop_gradient and p.grad is not None]
         if self._grad_clip is not None:
@@ -162,23 +177,167 @@ class Optimizer:
             self._step_tensor._write(self._step_tensor._read() + 1)
         lr_arr = self._lr_tensor._read()
         t_arr = self._step_tensor._read().astype(jnp.float32)
+        if self._FUSABLE and flag_value("tpu_fused_optimizer"):
+            self._fused_step(params_grads, lr_arr, t_arr)
+            return
         for p, g in params_grads:
             if g is None:
                 continue
-            group = self._param_group_of(p)
-            lr = lr_arr
-            wd = self._weight_decay
-            if group is not None:
-                lr = lr * float(group.get("learning_rate", 1.0))
-                gwd = group.get("weight_decay", wd)
-                wd = float(gwd) if gwd is not None else wd
-            if hasattr(p, "optimize_attr"):
-                lr = lr * float(getattr(p, "optimize_attr", {}).get(
-                    "learning_rate", 1.0))
+            lr, wd = self._lr_wd_of(p, lr_arr)
             self._append_optimize_op(p, g, lr, wd, t_arr)
 
     def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
         raise NotImplementedError
+
+    # ---------------------------------------------------------- fused updates
+    # Multi-tensor path: all parameters of a (src-dtype, param-dtype) group are
+    # updated in ONE fused elementwise op over concatenated flat buffers — the
+    # analog of the reference's fused adam/adamw CUDA kernels (`_C_ops.adam_`)
+    # plus its coalesce_grad_tensor_pass. Per-param updates otherwise become
+    # ~150 tiny sequential XLA fusions (~18ms/step on GPT-2-small on v5e).
+    # Optimizer state (moments etc.) lives in flat per-group buffers; state_dict
+    # slices per-param views out for checkpoint compatibility.
+
+    _FUSABLE = False                    # subclasses with _fused_update opt in
+
+    def _fused_state_names(self):
+        return []
+
+    def _fused_update(self, p32, g32, states, lr, wd, t):
+        """states: list of flat f32 arrays (same order as _fused_state_names).
+        Returns (new_p32, new_states)."""
+        raise NotImplementedError
+
+    # params at or above this size get individual updates: one big fusion per
+    # tensor is already efficient and donation-aliased in-place; concatenating
+    # them would add O(model) copy traffic. Small params (LN scales, biases)
+    # drown in per-op overhead (~150 sequential tiny fusions), so they batch.
+    _FUSE_MAX_NUMEL = 1 << 20
+
+    def _fused_partition(self, params_grads):
+        groups, singles = {}, []
+        import numpy as np
+        for p, g in params_grads:
+            if g is None:
+                continue
+            if int(np.prod(p._data.shape) or 1) >= self._FUSE_MAX_NUMEL:
+                singles.append((p, g))
+                continue
+            src = self._update_src(p)
+            key = (str(src._data.dtype), str(p._data.dtype))
+            groups.setdefault(key, []).append((p, g, src))
+        return groups, singles
+
+    def _fused_meta(self, key, pgs, lr_arr):
+        """Build (once per partition) the per-group metadata: slice offsets,
+        per-element lr-multiplier / weight-decay (scalars when uniform), and
+        flat state tensors seeded from any per-param accumulators."""
+        ids = tuple(id(p) for p, _, _ in pgs)
+        meta = self._fused_parts.get(key)
+        if meta is not None and meta["ids"] == ids:
+            return meta
+        if meta is not None:
+            # param set changed (freeze/unfreeze): spill the old flat state
+            # back to per-param accumulators so the rebuild reseeds from it
+            # instead of silently restarting moments at zero
+            self._fused_spill(key)
+        import numpy as np
+        sizes = [int(np.prod(p._data.shape)) or 1 for p, _, _ in pgs]
+        offs = np.cumsum([0] + sizes)
+        lrs, wds = [], []
+        for p, _, _ in pgs:
+            lr_m, wd = self._lr_wd_of(p, 1.0)
+            lrs.append(float(lr_m))
+            wds.append(float(wd))
+        uniform_lr = len(set(lrs)) == 1
+        uniform_wd = len(set(wds)) == 1
+        with jax.ensure_compile_time_eval():
+            if uniform_lr:
+                lr_mul = jnp.asarray(lrs[0], jnp.float32)
+            else:
+                lr_mul = jnp.concatenate([
+                    jnp.full((n,), s, jnp.float32)
+                    for n, s in zip(sizes, lrs)])
+            if uniform_wd:
+                wd_vec = jnp.asarray(wds[0], jnp.float32)
+            else:
+                wd_vec = jnp.concatenate([
+                    jnp.full((n,), s, jnp.float32)
+                    for n, s in zip(sizes, wds)])
+            states = []
+            for name in self._fused_state_names():
+                store = self._accumulators[name]
+                chunks = []
+                for (p, _, _), n in zip(pgs, sizes):
+                    acc = store.pop(id(p), None)
+                    chunks.append(acc._data.reshape(-1).astype(jnp.float32)
+                                  if acc is not None else jnp.zeros((n,),
+                                                                    jnp.float32))
+                t = Tensor(jnp.concatenate(chunks), _internal=True)
+                t.persistable = True
+                states.append(t)
+        meta = {"ids": ids, "sizes": sizes, "offs": offs, "lr_mul": lr_mul,
+                "wd": wd_vec, "states": states}
+        self._fused_parts[key] = meta
+        return meta
+
+    def _fused_step(self, params_grads, lr_arr, t_arr):
+        groups, singles = self._fused_partition(params_grads)
+        for p, g in singles:
+            lr, wd = self._lr_wd_of(p, lr_arr)
+            self._append_optimize_op(p, g, lr, wd, t_arr)
+        for key, pgs in groups.items():
+            meta = self._fused_meta(key, pgs, lr_arr)
+            flat_g = jnp.concatenate(
+                [g._read().reshape(-1).astype(jnp.float32) for _, g, _ in pgs])
+            flat_p = jnp.concatenate(
+                [s._read().reshape(-1) for _, _, s in pgs]).astype(jnp.float32)
+            new_p, new_states = self._fused_update(
+                flat_p, flat_g, [s._read() for s in meta["states"]],
+                lr_arr * meta["lr_mul"], meta["wd"], t_arr)
+            for st, arr in zip(meta["states"], new_states):
+                st._write(arr)
+            offs = meta["offs"]
+            for i, (p, _, src) in enumerate(pgs):
+                sl = jax.lax.dynamic_slice_in_dim(
+                    new_p, int(offs[i]), meta["sizes"][i]).reshape(
+                        p._data.shape).astype(src._data.dtype)
+                self._commit(p, src, sl)
+
+    def _fused_spill(self, key):
+        """Write per-param slices of a group's flat state back into
+        self._accumulators and drop the flat buffers."""
+        meta = self._fused_parts.pop(key, None)
+        if meta is None:
+            return
+        by_id = {id(p): p for p in self._parameter_list}
+        for name, flat in zip(self._fused_state_names(), meta["states"]):
+            store = self._accumulators[name]
+            for i, pid in enumerate(meta["ids"]):
+                p = by_id.get(pid)
+                if p is None:
+                    continue
+                arr = flat._data[meta["offs"][i]:
+                                 meta["offs"][i] + meta["sizes"][i]]
+                t = Tensor(arr.reshape(p._data.shape), _internal=True)
+                t.persistable = True
+                store[pid] = t
+
+    def _fused_acc_slice(self, name, p):
+        """Per-param view of a flat fused state (for state_dict)."""
+        sn = self._fused_state_names()
+        if name not in sn:
+            return None
+        idx = sn.index(name)
+        for meta in self._fused_parts.values():
+            if id(p) in meta["ids"]:
+                i = meta["ids"].index(id(p))
+                arr = meta["states"][idx]._data[
+                    meta["offs"][i]: meta["offs"][i] + meta["sizes"][i]]
+                t = Tensor(arr.reshape(p._data.shape), _internal=True)
+                t.persistable = True
+                return t
+        return None
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
         """Static-graph-style convenience: backward already run via loss.backward()
@@ -216,6 +375,13 @@ class Optimizer:
             for pk, p in zip(pkeys, self._parameter_list):
                 if id(p) in store:
                     sd[f"{pk}_{name}_0"] = store[id(p)]
+        # fused flat states: emit per-param slices (checkpoint format parity)
+        if self._fused_parts:
+            for name in self._fused_state_names():
+                for pk, p in zip(pkeys, self._parameter_list):
+                    t = self._fused_acc_slice(name, p)
+                    if t is not None:
+                        sd[f"{pk}_{name}_0"] = t
         for pk, p in zip(pkeys, self._parameter_list):
             if id(p) in self._master_weights:
                 sd[f"{pk}_master_0"] = self._master_weights[id(p)]
@@ -232,6 +398,7 @@ class Optimizer:
     def set_state_dict(self, state_dict):
         # accumulator names are parsed out of the checkpoint keys, so loading
         # into a freshly built optimizer (no accumulators yet) works
+        self._fused_parts.clear()   # truth moves back to per-param accumulators
         pkeys = self._param_keys()
 
         def as_tensor(v):

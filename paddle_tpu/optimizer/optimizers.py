@@ -20,6 +20,8 @@ def _sgd_update(p, g, lr, wd):
 
 
 class SGD(Optimizer):
+    _FUSABLE = True
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
@@ -29,6 +31,9 @@ class SGD(Optimizer):
         self._commit(p, src, _sgd_update(
             src._read(), grad._read().astype(src.dtype),
             jnp.asarray(lr, src.dtype), jnp.asarray(weight_decay, src.dtype)))
+
+    def _fused_update(self, p32, g32, states, lr, wd, t):
+        return p32 - lr * (g32 + wd * p32), []
 
 
 @partial(jax.jit, static_argnames=("use_nesterov",))
@@ -43,6 +48,20 @@ def _momentum_update(p, g, velocity, lr, mu, wd, use_nesterov):
 
 
 class Momentum(Optimizer):
+    _FUSABLE = True
+
+    def _fused_state_names(self):
+        return ["velocity"]
+
+    def _fused_update(self, p32, g32, states, lr, wd, t):
+        g = g32 + wd * p32
+        v = self._momentum * states[0] + g
+        if self._use_nesterov:
+            new_p = p32 - (g + self._momentum * v) * lr
+        else:
+            new_p = p32 - lr * v
+        return new_p, [v]
+
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
                  multi_precision=False, rescale_grad=1.0, name=None):
@@ -86,6 +105,21 @@ def _adam_update(p, g, m, v, vhat, lr, beta1, beta2, eps, t, wd, decouple=False,
 
 class Adam(Optimizer):
     _decoupled = False
+    _FUSABLE = True
+
+    def _fused_state_names(self):
+        return (["moment1", "moment2", "moment2_max"] if self._amsgrad
+                else ["moment1", "moment2"])
+
+    def _fused_update(self, p32, g32, states, lr, wd, t):
+        new_p, m, v, vhat = _adam_update(
+            p32, g32, states[0], states[1],
+            states[2] if self._amsgrad else jnp.zeros((), jnp.float32),
+            lr, jnp.asarray(self._beta1, jnp.float32),
+            jnp.asarray(self._beta2, jnp.float32),
+            jnp.asarray(self._epsilon, jnp.float32), t,
+            wd, decouple=self._decoupled, amsgrad=self._amsgrad)
+        return new_p, ([m, v, vhat] if self._amsgrad else [m, v])
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
@@ -139,13 +173,16 @@ class AdamW(Adam):
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
 
-    def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
+    def _lr_wd_of(self, p, lr_arr):
+        # per-param decay-mask / lr-ratio feed both the per-param path (step()
+        # resolves lr/wd through here) and the fused per-element multipliers
+        lr, wd = super()._lr_wd_of(p, lr_arr)
         if self._apply_decay_param_fun is not None and \
                 not self._apply_decay_param_fun(p.name):
-            weight_decay = 0.0
+            wd = 0.0
         if self._lr_ratio is not None:
             lr = lr * self._lr_ratio(p)
-        super()._append_optimize_op(p, grad, lr, weight_decay, t)
+        return lr, wd
 
 
 @jax.jit
@@ -330,6 +367,9 @@ class Lamb(Optimizer):
 
 
 class LarsMomentum(Momentum):
+    # LARS needs a per-param trust ratio (norm(p)/norm(g)); the flat fused
+    # update would silently degrade it to plain Momentum
+    _FUSABLE = False
     """LARS (ref `meta_optimizers/lars_optimizer.py`, op `lars_momentum_op`)."""
 
     def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,

@@ -205,9 +205,12 @@ class TestOptimizerStateDictKeys:
         fresh = paddle.optimizer.Adam(learning_rate=1e-3,
                                       parameters=m.parameters())
         fresh.set_state_dict(sd)
+        wkey = next(k for k in sd if k.endswith("_moment1_0")
+                    and m.weight.name in k)
         np.testing.assert_allclose(
             np.array(fresh._accumulators["moment1"][id(m.weight)]._data),
-            np.array(opt._accumulators["moment1"][id(m.weight)]._data))
+            np.array(sd[wkey]._data))
+        assert np.abs(np.array(sd[wkey]._data)).sum() > 0  # real state, not zeros
 
     def test_legacy_positional_load(self):
         m = nn.Linear(8, 8)

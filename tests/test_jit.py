@@ -61,11 +61,12 @@ def test_adam_state_threads_through_capture():
     x = paddle.ones([2, 4])
     losses = [float(step(x)) for _ in range(8)]
     assert losses[-1] < losses[0] * 0.5
-    # adam moments were created during capture and persisted as state
-    m_store = opt._accumulators["moment1"]
-    assert len(m_store) == 2  # weight + bias
-    assert all(float(np.abs(np.asarray(t._data)).sum()) > 0
-               for t in m_store.values())
+    # adam moments were created during capture and persisted as state (fused
+    # path: flat per-group buffers, inspected through the checkpoint view)
+    sd = opt.state_dict()
+    moments = [v for k, v in sd.items() if k.endswith("_moment1_0")]
+    assert len(moments) == 2  # weight + bias
+    assert all(float(np.abs(np.asarray(t._data)).sum()) > 0 for t in moments)
 
 
 def test_rng_threads_through_capture():
