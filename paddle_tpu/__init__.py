@@ -51,6 +51,7 @@ from paddle_tpu import distribution  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401
 from paddle_tpu.hapi.model import Model  # noqa: F401
+from paddle_tpu.distributed.parallel_wrappers import DataParallel  # noqa: F401
 from paddle_tpu.hapi import summary  # noqa: F401
 from paddle_tpu import sparse  # noqa: F401
 

@@ -141,22 +141,54 @@ def _proc_allgather(arr):
 # ------------------------------------------------------------------ collectives
 
 
+def _rebind(tensor, res):
+    """Write a collective's functional result into the user-facing tensor,
+    carrying the tape node along (otherwise gradients silently flow through
+    the tensor's STALE pre-collective node, or not at all)."""
+    tensor._write(res._data)
+    if res._grad_node is not None:
+        tensor._grad_node = res._grad_node
+        tensor._out_slot = res._out_slot
+        tensor.stop_gradient = False
+    return tensor
+
+
+def _inplace_apply(tensor, t, fn, op_name):
+    """In-place collective on a tape-recorded tensor: the new node's INPUT must
+    be a detached proxy carrying the tensor's previous grad node — wiring the
+    node onto the same python Tensor object would self-loop the tape and orphan
+    everything upstream."""
+    from paddle_tpu.core.autograd import apply
+    proxy = Tensor(t._data, stop_gradient=t.stop_gradient, _internal=True)
+    proxy._grad_node = t._grad_node
+    proxy._out_slot = t._out_slot
+    if t._grad_node is None and not t.stop_gradient:
+        # leaf input: backward would otherwise deposit .grad on the throwaway
+        # proxy — redirect the accumulation onto the user-facing tensor
+        def _redirect(g):
+            if tensor._grad is None:
+                tensor._grad = g
+            else:
+                tensor._grad = Tensor(tensor._grad._data + g._data,
+                                      stop_gradient=True, _internal=True)
+            return None
+        proxy.register_hook(_redirect)
+    res = apply(fn, proxy, op_name=op_name)
+    return _rebind(tensor, res)
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-graph: lax.psum over the group's mesh axis. Eager multi-process:
     process allgather + local reduce. Single process: identity (1 rank)."""
     t = ensure_tensor(tensor)
     axis = _axis(group)
     if _in_trace(t) and axis is not None:
-        from paddle_tpu.core.autograd import apply
         red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
-               ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}[op]
-        res = apply(lambda a: red(a, axis), t, op_name="all_reduce")
-        tensor._write(res._data)
-        if res._grad_node is not None:
-            tensor._grad_node = res._grad_node
-            tensor._out_slot = res._out_slot
-            tensor.stop_gradient = False
-        return tensor
+               ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean,
+               # no pprod primitive: gather + local product
+               ReduceOp.PROD: lambda a, ax: jnp.prod(
+                   jax.lax.all_gather(a, ax), axis=0)}[op]
+        return _inplace_apply(tensor, t, lambda a: red(a, axis), "all_reduce")
     if _multiprocess():
         stacked = _proc_allgather(t._data)
         fn = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max, ReduceOp.MIN: jnp.min,
@@ -211,11 +243,9 @@ def broadcast(tensor, src, group=None, sync_op=True):
     ax = _axis(group)
     if _in_trace(t) and ax is not None:
         # in-SPMD broadcast from src: select src's shard via all_gather + index
-        from paddle_tpu.core.autograd import apply
-        res = apply(lambda a: jax.lax.all_gather(a, ax)[src], t,
-                    op_name="broadcast")
-        tensor._write(res._data)
-        return tensor
+        return _inplace_apply(tensor, t,
+                              lambda a: jax.lax.all_gather(a, ax)[src],
+                              "broadcast")
     if _multiprocess():
         stacked = _proc_allgather(t._data)
         tensor._write(jnp.asarray(stacked[src]))
@@ -251,8 +281,7 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
         res = apply(lambda *arrs: jax.lax.psum_scatter(
             jnp.concatenate(arrs, axis=0), ax, tiled=True), *stacked,
             op_name="reduce_scatter")
-        tensor._write(res._data)
-        return tensor
+        return _rebind(tensor, res)
     if _multiprocess():
         from paddle_tpu.distributed.parallel import get_rank
         local = jnp.stack([ensure_tensor(x)._data for x in tensor_list])
@@ -266,12 +295,24 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     if out_tensor_list is None:
         out_tensor_list = []
+    ts = [ensure_tensor(x) for x in in_tensor_list]
+    ax = _axis(group)
+    if ts and _in_trace(ts[0]) and ax is not None:
+        # in-graph: rank r's output[j] = rank j's input[r] (lax.all_to_all on
+        # the stacked chunk axis — the global_scatter/gather building block)
+        from paddle_tpu.core.autograd import apply
+        res = apply(lambda *a: jax.lax.all_to_all(
+            jnp.stack(a), ax, split_axis=0, concat_axis=0, tiled=False),
+            *ts, op_name="alltoall")
+        for i in range(len(ts)):
+            out_tensor_list.append(res[i])
+        return out_tensor_list
     if not _multiprocess():
-        for t in in_tensor_list:
-            out_tensor_list.append(ensure_tensor(t))
+        for t in ts:
+            out_tensor_list.append(t)
         return out_tensor_list
     from paddle_tpu.distributed.parallel import get_rank
-    local = jnp.stack([ensure_tensor(x)._data for x in in_tensor_list])
+    local = jnp.stack([t._data for t in ts])
     gathered = _proc_allgather(local)  # [P, P, ...]
     rank = get_rank()
     for p in range(gathered.shape[0]):
@@ -291,8 +332,7 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
             a.reshape((n, -1) + a.shape[1:]), ax, split_axis=0, concat_axis=0,
             tiled=False).reshape(a.shape), t, op_name="alltoall_single")
         if out_tensor is not None:
-            out_tensor._write(res._data)
-            return out_tensor
+            return _rebind(out_tensor, res)
         return res
     if out_tensor is not None and not _multiprocess():
         out_tensor._write(t._data)

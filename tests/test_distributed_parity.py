@@ -1,0 +1,213 @@
+"""Loss-parity tests for every parallelism strategy vs the serial baseline.
+
+The reference's most important distributed test asset (`test_dist_base.py:901`
+TestDistBase and the `collective/fleet` hybrid suites) asserts per-step loss
+parity of each strategy against the single-process run. Same methodology here,
+on the 8-virtual-device CPU mesh from conftest.
+"""
+import numpy as np
+import jax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.mesh import auto_mesh, get_mesh, set_mesh
+
+STEPS = 3
+RTOL = 1e-3
+
+
+@pytest.fixture(autouse=True)
+def _restore_mesh():
+    prev = get_mesh()
+    yield
+    set_mesh(prev)
+
+
+def _mlp():
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 8))
+
+
+def _train_mlp(model, opt, batches, sharding=None):
+    loss_fn = nn.CrossEntropyLoss()
+
+    @paddle.jit.to_static
+    def step(x, y):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = []
+    for xb, yb in batches:
+        if sharding is not None:
+            xb = jax.device_put(xb, sharding)
+            yb = jax.device_put(yb, sharding)
+        losses.append(float(step(paddle.Tensor(xb, _internal=True),
+                                 paddle.Tensor(yb, _internal=True))))
+    return losses
+
+
+def _mlp_batches(n=STEPS, batch=16):
+    rng = np.random.RandomState(0)
+    return [(rng.randn(batch, 16).astype(np.float32),
+             rng.randint(0, 8, batch).astype(np.int64)) for _ in range(n)]
+
+
+def _serial_mlp_losses():
+    set_mesh(None)
+    model = _mlp()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    return _train_mlp(model, opt, _mlp_batches())
+
+
+class TestDataParallel:
+    def test_dp8_matches_serial(self):
+        serial = _serial_mlp_losses()
+        mesh = auto_mesh(dp=8)
+        model = paddle.DataParallel(_mlp())
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        dist = _train_mlp(model, opt, _mlp_batches(),
+                          sharding=NamedSharding(mesh, P("dp")))
+        np.testing.assert_allclose(serial, dist, rtol=RTOL)
+
+
+class TestShardingStages:
+    """ZeRO stage-1/2 (optimizer state sharded) and stage-3 (params sharded)
+    must be pure layout changes: bitwise-compatible losses vs DP."""
+
+    @pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
+    def test_group_sharded_matches_serial(self, level):
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        serial = _serial_mlp_losses()
+        mesh = auto_mesh(dp=8)
+        model = _mlp()
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        model, opt, _ = group_sharded_parallel(model, opt, level)
+        dist = _train_mlp(model, opt, _mlp_batches(),
+                          sharding=NamedSharding(mesh, P("dp")))
+        np.testing.assert_allclose(serial, dist, rtol=RTOL)
+
+
+def _gpt_cfg(**kw):
+    from paddle_tpu.models.gpt import GPTConfig
+    base = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                intermediate_size=128, max_position_embeddings=64,
+                hidden_dropout=0.0, attention_dropout=0.0)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def _train_gpt(cfg, batches, sharding=None):
+    from paddle_tpu.models.gpt import GPTForCausalLM
+    paddle.seed(11)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-3, parameters=model.parameters(),
+        grad_clip=nn.ClipGradByGlobalNorm(1.0))
+
+    @paddle.jit.to_static
+    def step(x, y):
+        _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = []
+    for ids in batches:
+        x, y = ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int64)
+        if sharding is not None:
+            x = jax.device_put(x, sharding)
+            y = jax.device_put(y, sharding)
+        losses.append(float(step(paddle.Tensor(x, _internal=True),
+                                 paddle.Tensor(y, _internal=True))))
+    return losses
+
+
+def _gpt_batches(n=STEPS, batch=4, seq=16):
+    rng = np.random.RandomState(1)
+    return [rng.randint(0, 256, (batch, seq + 1)) for _ in range(n)]
+
+
+class TestTensorParallel:
+    def test_mp2_matches_mp1(self):
+        set_mesh(None)
+        serial = _train_gpt(_gpt_cfg(), _gpt_batches())
+        mesh = auto_mesh(dp=2, mp=4)
+        dist = _train_gpt(_gpt_cfg(), _gpt_batches(),
+                          sharding=NamedSharding(mesh, P("dp", None)))
+        np.testing.assert_allclose(serial, dist, rtol=RTOL)
+
+
+class TestHybrid:
+    def test_dp_mp_sp_matches_serial(self):
+        set_mesh(None)
+        serial = _train_gpt(_gpt_cfg(), _gpt_batches())
+        mesh = auto_mesh(dp=2, mp=2, sp=2)
+        dist = _train_gpt(_gpt_cfg(seq_parallel=True), _gpt_batches(),
+                          sharding=NamedSharding(mesh, P("dp", None)))
+        np.testing.assert_allclose(serial, dist, rtol=RTOL)
+
+
+class TestGSPMDEmitsCollectives:
+    """The mpu layers promise GSPMD inserts the collectives the reference
+    hand-codes (`mp_ops.py` _mp_allreduce etc.) — inspect compiled HLO."""
+
+    def test_row_parallel_matmul_emits_all_reduce(self):
+        import jax.numpy as jnp
+        mesh = auto_mesh(mp=8)
+        xs = NamedSharding(mesh, P(None, "mp"))      # activations split on K
+        ws = NamedSharding(mesh, P("mp", None))      # weight rows split on K
+
+        @jax.jit
+        def f(x, w):
+            return x @ w                              # contraction over 'mp'
+
+        x = jax.device_put(np.ones((8, 64), np.float32), xs)
+        w = jax.device_put(np.ones((64, 16), np.float32), ws)
+        hlo = f.lower(x, w).compile().as_text()
+        assert "all-reduce" in hlo or "reduce-scatter" in hlo, hlo[:2000]
+
+    def test_dp_grad_sync_emits_all_reduce(self):
+        """DP training step: GSPMD must insert grad all-reduce (the EagerReducer
+        analog) when batch-sharded activations meet replicated params."""
+        set_mesh(None)
+        mesh = auto_mesh(dp=8)
+        model = paddle.DataParallel(_mlp())
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+
+        @paddle.jit.to_static
+        def step(x, y):
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        xb, yb = _mlp_batches(1)[0]
+        sh = NamedSharding(mesh, P("dp"))
+        x = paddle.Tensor(jax.device_put(xb, sh), _internal=True)
+        y = paddle.Tensor(jax.device_put(yb, sh), _internal=True)
+        float(step(x, y))  # capture + compile
+        compiled = step.concrete_program(x, y)
+        # reach into the jitted executable's HLO
+        hlo_texts = [m.as_text() for m in
+                     getattr(compiled.jitted, "_cache_hlo", [])] or None
+        if hlo_texts is None:
+            # recompile explicitly for inspection
+            state_in = [t._data for t in compiled.state_tensors]
+            grad_in = [t._grad._data for t, m in
+                       zip(compiled.state_tensors, compiled.grad_mask) if m]
+            lowered = compiled.jitted.lower(state_in, grad_in,
+                                            [x._data, y._data])
+            hlo_texts = [lowered.compile().as_text()]
+        assert any("all-reduce" in h for h in hlo_texts)
