@@ -353,15 +353,25 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
+    """In-graph p2p: inside shard_map, a matched send/recv pair is one
+    lax.ppermute — which is exactly how the SPMD pipeline engine moves
+    activations between stages (`fleet/pipeline.py` spmd_pipeline, the
+    counterpart of the reference's `p2p_communication.py:74`). The asymmetric
+    eager send()/recv() API cannot be expressed in a single SPMD program, so
+    these raise; use the pipeline engine or alltoall/broadcast instead."""
     raise NotImplementedError(
-        "point-to-point send/recv between processes is expressed with "
-        "jax.lax.ppermute inside shard_map on TPU (see distributed.fleet "
-        "pipeline runtime); eager cross-process p2p is not supported")
+        "asymmetric eager p2p is not expressible in one SPMD program; matched "
+        "send/recv pairs compile to lax.ppermute — see "
+        "paddle_tpu.distributed.fleet.pipeline.spmd_pipeline (the pipeline "
+        "runtime that replaces the reference's p2p layer)")
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     raise NotImplementedError(
-        "use ppermute-based pipeline runtime (distributed.fleet.meta_parallel)")
+        "asymmetric eager p2p is not expressible in one SPMD program; matched "
+        "send/recv pairs compile to lax.ppermute — see "
+        "paddle_tpu.distributed.fleet.pipeline.spmd_pipeline (the pipeline "
+        "runtime that replaces the reference's p2p layer)")
 
 
 def isend(tensor, dst, group=None):

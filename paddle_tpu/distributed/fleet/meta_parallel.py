@@ -279,12 +279,21 @@ class SegmentLayers:
 
 class PipelineLayer(Layer):
     """ref `pp_layers.py:209`. Holds the full layer list; segments map to pp
-    stages. Single-program SPMD execution runs all stages (stage placement is a
-    sharding/placement concern, not a control-flow one on TPU)."""
+    stages.
+
+    When the current mesh has a 'pp' axis of size > 1 and the layer list
+    contains a homogeneous run covering the stage segments (e.g. N identical
+    transformer blocks), that run executes on the SPMD pipeline engine
+    (`fleet/pipeline.py`): per-stage weights live stacked on a leading [pp]
+    axis sharded over 'pp', and micro-batches circulate between stages via
+    lax.ppermute inside shard_map — a real pipeline with p2p, not grad
+    accumulation. Heterogeneous prefix/suffix layers (embedding, final norm,
+    head) run outside the pipelined region. Without a pp axis, falls back to
+    sequential execution (the reference's single-stage behavior)."""
 
     def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
                  seg_method="uniform", recompute_interval=0, num_virtual_pipeline_stages=None,
-                 **kwargs):
+                 micro_batches=None, **kwargs):
         super().__init__()
         self._loss_fn = loss_fn
         self._num_stages = num_stages or (
@@ -304,19 +313,156 @@ class PipelineLayer(Layer):
             else:
                 built.append((desc, None))
         self.run_funcs = built
-        from paddle_tpu.nn.layers.container import LayerList
-        self._layers_list = LayerList([l for l, _ in built])
         self._segments = SegmentLayers(
             [l for l, _ in built], self._num_stages, seg_method).do_segment()
         self._recompute_interval = recompute_interval
+        self._pp_micro = micro_batches
+        self._pp_mode = False
+        if self._num_stages > 1 and _mesh_axis_size("pp") == self._num_stages:
+            self._init_spmd_pipeline(built)
+        if not self._pp_mode:
+            from paddle_tpu.nn.layers.container import LayerList
+            self._layers_list = LayerList([l for l, _ in built])
+
+    # ---------------------------------------------------------- SPMD pp setup
+
+    @staticmethod
+    def _layer_sig(layer):
+        """Homogeneity signature: class + param/buffer shapes + scalar config.
+        Scalar attributes (num_heads, dropout p, eps, ...) are part of the
+        signature — stages run on the stage-0 template, so layers that differ
+        in anything but weight VALUES must not be treated as interchangeable."""
+        def cfg(l):
+            scalars = tuple(sorted(
+                (k, v) for k, v in vars(l).items()
+                if isinstance(v, (int, float, bool, str, type(None)))
+                and not k.startswith("__")))
+            subs = tuple(cfg(s) for s in getattr(
+                l, "_sub_layers", {}).values())
+            return (type(l).__name__, scalars, subs)
+
+        return (cfg(layer),
+                tuple((tuple(p.shape), str(p.dtype))
+                      for p in layer.parameters()),
+                tuple((tuple(b.shape), str(b.dtype))
+                      for b in getattr(layer, "buffers", lambda: [])()))
+
+    def _init_spmd_pipeline(self, built):
+        from paddle_tpu.nn.layers.container import LayerList
+        from paddle_tpu.distributed.fleet.pipeline import stack_stage_params
+        n = len(built)
+        sigs = [self._layer_sig(l) if f is None else None for l, f in built]
+        # longest run of identical signatures
+        best = (0, 0)
+        i = 0
+        while i < n:
+            j = i
+            while j < n and sigs[j] is not None and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = max(j, i + 1)
+        start, end = best
+        run_len = end - start
+        per = run_len // self._num_stages
+        if per == 0 or run_len % self._num_stages:
+            return                                  # fall back to sequential
+        start = start + (run_len - per * self._num_stages)
+        end = start + per * self._num_stages
+        mesh = get_mesh()
+        trees = []
+        for s in range(self._num_stages):
+            seg = built[start + s * per: start + (s + 1) * per]
+            trees.append([p._data for l, _ in seg for p in l.parameters()])
+        stacked = stack_stage_params(trees, mesh)
+        self._pp_run = (start, end)
+        self._pp_per_stage = per
+        # template = stage-0 segment; its params get rebound per stage inside
+        # the pipeline body. Template layers / pipelined originals stay
+        # UNREGISTERED (the stacked params replace them).
+        self._pp_template = [built[start + i] for i in range(per)]
+        self._pp_template_params = [
+            p for l, _ in self._pp_template for p in l.parameters()]
+        self._pp_stacked = []
+        for i, arr in enumerate(stacked):
+            prm = Parameter(arr)
+            prm.name = f"pp_stage_param_{i}"
+            self.add_parameter(f"pp_stage_param_{i}", prm)
+            self._pp_stacked.append(prm)
+        prefix = [l for l, _ in built[:start]]
+        suffix = [l for l, _ in built[end:]]
+        self._layers_list = LayerList(prefix + suffix)
+        self._pp_mode = True
 
     def get_stage_layers(self, stage_id):
         lo, hi = self._segments[stage_id], self._segments[stage_id + 1]
         return self.run_funcs[lo:hi]
 
+    def _run_spmd_pipeline(self, x):
+        from paddle_tpu.core.autograd import apply
+        from paddle_tpu.distributed.fleet.pipeline import spmd_pipeline
+        mesh = get_mesh()
+        tpl_params = self._pp_template_params
+        tpl = self._pp_template
+        n_micro = self._pp_micro or 1
+        n_stages = self._num_stages
+        cache_key = (tuple(mesh.axis_names), tuple(mesh.shape.items()),
+                     tuple(d.id for d in mesh.devices.flat),
+                     n_micro, self.training)
+        cache = getattr(self, "_pp_prim_cache", None)
+        if cache is None:
+            cache = self._pp_prim_cache = {}
+        jitted = cache.get(cache_key)
+        if jitted is not None:
+            return apply(jitted, *self._pp_stacked, ensure_tensor(x),
+                         op_name="spmd_pipeline")
+
+        def prim(*arrays):
+            *stacked, xa = arrays
+
+            def stage_fn(local, xm):
+                saved = [(t._data, t._grad_node, t._out_slot)
+                         for t in tpl_params]
+                for t, a in zip(tpl_params, local):
+                    t._data = a
+                    t._grad_node = None
+                try:
+                    out = Tensor(xm, _internal=True)
+                    for layer, ffunc in tpl:
+                        out = ffunc(layer, out) if ffunc is not None \
+                            else layer(out)
+                    return out._data
+                finally:
+                    for t, (d, nd, sl) in zip(tpl_params, saved):
+                        t._data = d
+                        t._grad_node = nd
+                        t._out_slot = sl
+
+            return spmd_pipeline(stage_fn, n_stages, n_micro, list(stacked),
+                                 xa, mesh)
+
+        # jit so the partial-manual shard_map sees a compiled context even
+        # when the surrounding step runs eagerly (sharding inference for the
+        # non-manual axes needs it); cached per (mesh, n_micro, mode)
+        jitted = jax.jit(prim)
+        cache[cache_key] = jitted
+        return apply(jitted, *self._pp_stacked, ensure_tensor(x),
+                     op_name="spmd_pipeline")
+
     def forward(self, x):
         from paddle_tpu.distributed.fleet.recompute import recompute
-        for i, (layer, ffunc) in enumerate(self.run_funcs):
+        if self._pp_mode:
+            start, end = self._pp_run
+            runs = (self.run_funcs[:start]
+                    + [None]                        # pipelined region marker
+                    + self.run_funcs[end:])
+        else:
+            runs = self.run_funcs
+        for i, entry in enumerate(runs):
+            if entry is None:
+                x = self._run_spmd_pipeline(x)
+                continue
+            layer, ffunc = entry
             fn = (lambda inp, _l=layer, _f=ffunc:
                   _f(_l, inp) if _f is not None else _l(inp))
             if self._recompute_interval and i % self._recompute_interval == 0 \
@@ -349,19 +495,31 @@ class PipelineParallel(Layer):
         from paddle_tpu.ops.manipulation import split
         x, y = data
         n_micro = self._accumulate_steps
+        pp_mode = getattr(self._layers, "_pp_mode", False)
+        saved_micro = getattr(self._layers, "_pp_micro", None)
+        if pp_mode:
+            # real SPMD pipeline: micro-batching happens INSIDE the engine
+            # (ppermute schedule); one outer fwd/bwd over the full batch.
+            # Restored afterwards so eval/forward see their own setting.
+            self._layers._pp_micro = n_micro
+            n_micro = 1
         losses = []
         micro_xs = split(x, n_micro, axis=0) if n_micro > 1 else [x]
         micro_ys = split(y, n_micro, axis=0) if n_micro > 1 else [y]
-        for mx, my in zip(micro_xs, micro_ys):
-            out = self._layers(mx)
-            loss_fn = getattr(self._layers, "_loss_fn", None)
-            loss = loss_fn(out, my) if loss_fn is not None else out
-            scaled = loss / n_micro
-            if scaler is not None:
-                scaler.scale(scaled).backward()
-            else:
-                scaled.backward()
-            losses.append(loss)
+        try:
+            for mx, my in zip(micro_xs, micro_ys):
+                out = self._layers(mx)
+                loss_fn = getattr(self._layers, "_loss_fn", None)
+                loss = loss_fn(out, my) if loss_fn is not None else out
+                scaled = loss / n_micro
+                if scaler is not None:
+                    scaler.scale(scaled).backward()
+                else:
+                    scaled.backward()
+                losses.append(loss)
+        finally:
+            if pp_mode:
+                self._layers._pp_micro = saved_micro
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()

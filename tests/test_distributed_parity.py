@@ -199,15 +199,9 @@ class TestGSPMDEmitsCollectives:
         y = paddle.Tensor(jax.device_put(yb, sh), _internal=True)
         float(step(x, y))  # capture + compile
         compiled = step.concrete_program(x, y)
-        # reach into the jitted executable's HLO
-        hlo_texts = [m.as_text() for m in
-                     getattr(compiled.jitted, "_cache_hlo", [])] or None
-        if hlo_texts is None:
-            # recompile explicitly for inspection
-            state_in = [t._data for t in compiled.state_tensors]
-            grad_in = [t._grad._data for t, m in
-                       zip(compiled.state_tensors, compiled.grad_mask) if m]
-            lowered = compiled.jitted.lower(state_in, grad_in,
-                                            [x._data, y._data])
-            hlo_texts = [lowered.compile().as_text()]
-        assert any("all-reduce" in h for h in hlo_texts)
+        state_in = [t._data for t in compiled.state_tensors]
+        grad_in = [t._grad._data for t, m in
+                   zip(compiled.state_tensors, compiled.grad_mask) if m]
+        hlo = compiled.jitted.lower(state_in, grad_in,
+                                    [x._data, y._data]).compile().as_text()
+        assert "all-reduce" in hlo
