@@ -341,11 +341,14 @@ class PipelineLayer(Layer):
                 l, "_sub_layers", {}).values())
             return (type(l).__name__, scalars, subs)
 
+        if list(getattr(layer, "buffers", lambda: [])()):
+            # buffered layers (BN running stats...) can't pipeline: only
+            # parameters are stacked per stage, so every stage would read
+            # stage-0's buffer values
+            return None
         return (cfg(layer),
                 tuple((tuple(p.shape), str(p.dtype))
-                      for p in layer.parameters()),
-                tuple((tuple(b.shape), str(b.dtype))
-                      for b in getattr(layer, "buffers", lambda: [])()))
+                      for p in layer.parameters()))
 
     def _init_spmd_pipeline(self, built):
         from paddle_tpu.nn.layers.container import LayerList
@@ -365,8 +368,9 @@ class PipelineLayer(Layer):
         start, end = best
         run_len = end - start
         per = run_len // self._num_stages
-        if per == 0 or run_len % self._num_stages:
+        if per == 0:
             return                                  # fall back to sequential
+        # trim a non-divisible remainder into the sequential prefix
         start = start + (run_len - per * self._num_stages)
         end = start + per * self._num_stages
         mesh = get_mesh()
@@ -417,27 +421,38 @@ class PipelineLayer(Layer):
             return apply(jitted, *self._pp_stacked, ensure_tensor(x),
                          op_name="spmd_pipeline")
 
+        # template layers are unregistered, so train()/eval() doesn't reach
+        # them — sync mode explicitly before tracing
+        for layer, _ in tpl:
+            layer.train() if self.training else layer.eval()
+        use_remat = bool(self._recompute_interval) and self.training
+
         def prim(*arrays):
             *stacked, xa = arrays
 
             def stage_fn(local, xm):
+                from paddle_tpu.distributed.fleet.pipeline import (
+                    template_rng_guard)
                 saved = [(t._data, t._grad_node, t._out_slot)
                          for t in tpl_params]
                 for t, a in zip(tpl_params, local):
                     t._data = a
                     t._grad_node = None
                 try:
-                    out = Tensor(xm, _internal=True)
-                    for layer, ffunc in tpl:
-                        out = ffunc(layer, out) if ffunc is not None \
-                            else layer(out)
-                    return out._data
+                    with template_rng_guard("the SPMD pipeline stage body"):
+                        out = Tensor(xm, _internal=True)
+                        for layer, ffunc in tpl:
+                            out = ffunc(layer, out) if ffunc is not None \
+                                else layer(out)
+                        return out._data
                 finally:
                     for t, (d, nd, sl) in zip(tpl_params, saved):
                         t._data = d
                         t._grad_node = nd
                         t._out_slot = sl
 
+            if use_remat:
+                stage_fn = jax.checkpoint(stage_fn)
             return spmd_pipeline(stage_fn, n_stages, n_micro, list(stacked),
                                  xa, mesh)
 

@@ -20,9 +20,39 @@ Loss semantics match the reference's accumulate-then-step contract (GPipe ==
 """
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class _GuardGenerator:
+    """Swapped in as the default RNG generator while template layers execute
+    inside a raw jax trace (pipeline stage_fn / MoE expert_fn): stateful RNG
+    there would write a leaked tracer into the global generator and bake a
+    constant mask. Raising turns silent corruption into a clear error."""
+
+    def __init__(self, what):
+        self._what = what
+
+    def __getattr__(self, name):
+        raise RuntimeError(
+            f"stateful RNG (e.g. Dropout) is not supported inside {self._what}"
+            " — the template body is traced outside the to_static RNG-threading"
+            " machinery. Set dropout to 0 in these blocks (or move the dropout"
+            " outside the pipelined/expert region).")
+
+
+@contextlib.contextmanager
+def template_rng_guard(what):
+    from paddle_tpu.ops import random as rnd
+    prev = rnd._default_generator
+    rnd._default_generator = _GuardGenerator(what)
+    try:
+        yield
+    finally:
+        rnd._default_generator = prev
 
 
 def spmd_pipeline(stage_fn, n_stages, n_micro, stacked_params, x, mesh):

@@ -1,6 +1,8 @@
 """paddle.incubate (ref: `python/paddle/incubate/`) — fused transformer APIs, MoE,
 autograd prims. Fused ops route to the Pallas kernels / XLA fusions."""
 from paddle_tpu.incubate import nn  # noqa: F401
+from paddle_tpu.incubate import distributed  # noqa: F401
+from paddle_tpu.incubate import moe  # noqa: F401
 
 
 def softmax_mask_fuse_upper_triangle(x):

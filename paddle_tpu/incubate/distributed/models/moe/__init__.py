@@ -1,0 +1,3 @@
+"""paddle.incubate.distributed.models.moe (ref moe_layer.py / gate/*.py)."""
+from paddle_tpu.incubate.moe import (  # noqa: F401
+    MoELayer, BaseGate, NaiveGate, GShardGate, SwitchGate)

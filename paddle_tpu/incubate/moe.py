@@ -1,0 +1,253 @@
+"""Mixture-of-Experts with expert parallelism over the 'ep' mesh axis.
+
+Counterpart of the reference MoE stack — `MoELayer`
+(`python/paddle/incubate/distributed/models/moe/moe_layer.py:260`), gates
+(`moe/gate/{naive,gshard,switch}_gate.py`) and the `global_scatter` /
+`global_gather` all-to-all dispatch ops
+(`paddle/fluid/operators/collective/global_scatter_op.cc:80`) — redesigned
+GShard-style for XLA:
+
+- routing produces STATIC-shape dispatch/combine tensors via capacity padding
+  (SURVEY §7 hard-part #5: no dynamic shapes on TPU); overflow tokens drop,
+  exactly like the reference's capacity mechanism;
+- token -> expert movement is an einsum against the dispatch mask with 'ep'
+  sharding constraints — GSPMD lowers the resharding to the all-to-all the
+  reference codes as global_scatter/global_gather;
+- expert FFNs run as ONE vmapped computation over weights stacked on a leading
+  [E] axis sharded over 'ep' (each ep rank holds E/ep experts);
+- the load-balance auxiliary loss (`gshard_gate.py`) is returned through
+  `MoELayer.l_aux` and participates in autograd.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.tensor import Tensor, Parameter
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.framework.param_attr import ParamAttr
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.distributed.mesh import get_mesh
+
+
+def _capacity(n_tokens, n_experts, top_k, factor):
+    return max(int(math.ceil(top_k * n_tokens / n_experts * factor)), 4)
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+def _top1_dispatch(probs, capacity):
+    """Switch routing (ref `switch_gate.py`): top-1 with capacity.
+    Returns (dispatch [N,E,C], combine [N,E,C], aux_loss)."""
+    n, e = probs.shape
+    idx = jnp.argmax(probs, axis=-1)                       # [N]
+    mask = _one_hot(idx, e)                                # [N, E]
+    # position of each token inside its expert's buffer
+    pos = jnp.cumsum(mask, axis=0) * mask - mask           # [N, E] 0-based
+    keep = (pos < capacity) * mask                         # overflow drops
+    pos = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)   # [N]
+    gate = jnp.sum(probs * keep, axis=-1)                  # selected prob
+    dispatch = keep[:, :, None] * _one_hot(pos, capacity)[:, None, :]
+    combine = dispatch * gate[:, None, None]
+    # switch aux loss: E * sum_e (fraction_tokens_e * mean_prob_e)
+    frac = jnp.mean(mask, axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_p)
+    return dispatch, combine, aux
+
+
+def _top2_dispatch(probs, capacity):
+    """GShard top-2 routing (ref `gshard_gate.py`)."""
+    n, e = probs.shape
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = _one_hot(idx1, e)
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    mask2 = _one_hot(idx2, e)
+
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1
+    keep1 = (pos1 < capacity) * mask1
+    # expert buffers already hold count1 tokens when the 2nd choices land
+    count1 = jnp.sum(mask1, axis=0, keepdims=True)
+    pos2 = (jnp.cumsum(mask2, axis=0) * mask2 - mask2) + count1 * mask2
+    keep2 = (pos2 < capacity) * mask2
+
+    g1 = jnp.sum(probs * keep1, axis=-1)
+    g2 = jnp.sum(probs * keep2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    p1 = jnp.sum(pos1 * keep1, axis=-1).astype(jnp.int32)
+    p2 = jnp.sum(pos2 * keep2, axis=-1).astype(jnp.int32)
+    d1 = keep1[:, :, None] * _one_hot(p1, capacity)[:, None, :]
+    d2 = keep2[:, :, None] * _one_hot(p2, capacity)[:, None, :]
+    dispatch = jnp.minimum(d1 + d2, 1.0)
+    combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
+    frac = jnp.mean(mask1, axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_p)
+    return dispatch, combine, aux
+
+
+class BaseGate(Layer):
+    top_k = 1
+
+    def __init__(self, d_model, num_experts, capacity_factor=2.0,
+                 weight_attr=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter(
+            [d_model, num_experts], attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Normal(0.0, 0.02))
+
+    def routing(self, probs, capacity):
+        raise NotImplementedError
+
+
+class SwitchGate(BaseGate):
+    """ref `moe/gate/switch_gate.py` — top-1 capacity routing."""
+    top_k = 1
+
+    def routing(self, probs, capacity):
+        return _top1_dispatch(probs, capacity)
+
+
+class GShardGate(BaseGate):
+    """ref `moe/gate/gshard_gate.py` — top-2 capacity routing."""
+    top_k = 2
+
+    def routing(self, probs, capacity):
+        return _top2_dispatch(probs, capacity)
+
+
+class NaiveGate(BaseGate):
+    """ref `moe/gate/naive_gate.py` — top-k softmax gate; implemented as top-2
+    with a generous default capacity (static shapes need a capacity bound)."""
+    top_k = 2
+
+    def __init__(self, d_model, num_experts, capacity_factor=4.0,
+                 weight_attr=None):
+        super().__init__(d_model, num_experts, capacity_factor, weight_attr)
+
+    def routing(self, probs, capacity):
+        return _top2_dispatch(probs, capacity)
+
+
+class MoELayer(Layer):
+    """ref `moe_layer.py:260`. ``experts``: list of structurally identical
+    Layers (one per expert; each maps [*, d_model] -> [*, d_model]). Their
+    params are stacked on a leading [E] axis sharded over 'ep'; the dense
+    compute runs once under vmap. Aux load-balance loss lands in ``l_aux``
+    (add it to the training loss, ref moe aux_loss convention)."""
+
+    def __init__(self, d_model=None, experts=None, gate=None,
+                 capacity_factor=None, moe_group=None, mp_group=None, **kw):
+        super().__init__()
+        if not experts:
+            raise ValueError("MoELayer needs a non-empty expert list")
+        self.num_experts = len(experts)
+        if gate is None:
+            gate = GShardGate(d_model, self.num_experts)
+        elif isinstance(gate, str):
+            cls = {"naive": NaiveGate, "gshard": GShardGate,
+                   "switch": SwitchGate}[gate]
+            gate = cls(d_model, self.num_experts)
+        self.gate = gate
+        if capacity_factor is not None:
+            self.gate.capacity_factor = capacity_factor
+        # stack expert params over [E]; experts themselves stay unregistered
+        # (template-execution pattern, same as the SPMD pipeline engine) —
+        # bypass Layer.__setattr__ so expert 0 isn't registered as a sublayer
+        object.__setattr__(self, "_template", experts[0])
+        object.__setattr__(self, "_template_params",
+                           list(experts[0].parameters()))
+        trees = [[p._data for p in ex.parameters()] for ex in experts]
+        ref0 = trees[0]
+        for i, tree in enumerate(trees[1:], 1):
+            if len(tree) != len(ref0) or any(
+                    a.shape != b.shape or a.dtype != b.dtype
+                    for a, b in zip(tree, ref0)):
+                raise ValueError(f"expert {i} differs structurally from "
+                                 "expert 0 — experts must be uniform")
+        mesh = get_mesh()
+        self._stacked = []
+        for i in range(len(ref0)):
+            arr = jnp.stack([t[i] for t in trees])
+            if mesh is not None and "ep" in mesh.axis_names \
+                    and self.num_experts % mesh.shape["ep"] == 0:
+                arr = jax.device_put(arr, NamedSharding(
+                    mesh, P("ep", *([None] * (arr.ndim - 1)))))
+            prm = Parameter(arr)
+            prm.name = f"moe_expert_param_{i}"
+            self.add_parameter(f"moe_expert_param_{i}", prm)
+            self._stacked.append(prm)
+        self.l_aux = None
+
+    def forward(self, x):
+        from paddle_tpu.core.autograd import apply
+        from paddle_tpu.ops.common import ensure_tensor
+        x = ensure_tensor(x)
+        orig_shape = tuple(x.shape)
+        d_model = orig_shape[-1]
+        n_tokens = int(np.prod(orig_shape[:-1]))
+        e = self.num_experts
+        cap = _capacity(n_tokens, e, self.gate.top_k,
+                        self.gate.capacity_factor)
+        mesh = get_mesh()
+        ep_ok = (mesh is not None and "ep" in mesh.axis_names
+                 and e % mesh.shape["ep"] == 0 and mesh.shape["ep"] > 1)
+        tpl_params = self._template_params
+        template = self._template
+        template.train() if self.training else template.eval()
+        routing = self.gate.routing
+
+        def prim(gw, xa, *stacked):
+            flat = xa.reshape(n_tokens, d_model)
+            logits = jnp.dot(flat.astype(jnp.float32),
+                             gw.astype(jnp.float32))
+            probs = jax.nn.softmax(logits, axis=-1)         # [N, E]
+            dispatch, combine, aux = routing(probs, cap)
+            # token -> expert buffers; GSPMD turns the 'ep' resharding into
+            # the global_scatter all-to-all
+            exp_in = jnp.einsum("nec,nd->ecd",
+                                dispatch.astype(flat.dtype), flat)
+            if ep_ok:
+                exp_in = jax.lax.with_sharding_constraint(
+                    exp_in, NamedSharding(mesh, P("ep", None, None)))
+
+            def expert_fn(params, inp):
+                from paddle_tpu.distributed.fleet.pipeline import (
+                    template_rng_guard)
+                saved = [(t._data, t._grad_node, t._out_slot)
+                         for t in tpl_params]
+                for t, a in zip(tpl_params, params):
+                    t._data = a
+                    t._grad_node = None
+                try:
+                    with template_rng_guard("the MoE expert body"):
+                        return template(Tensor(inp, _internal=True))._data
+                finally:
+                    for t, (d, nd, sl) in zip(tpl_params, saved):
+                        t._data = d
+                        t._grad_node = nd
+                        t._out_slot = sl
+
+            exp_out = jax.vmap(expert_fn)(list(stacked), exp_in)  # [E, C, D]
+            if ep_ok:
+                exp_out = jax.lax.with_sharding_constraint(
+                    exp_out, NamedSharding(mesh, P("ep", None, None)))
+            out = jnp.einsum("ecd,nec->nd", exp_out.astype(jnp.float32),
+                             combine).astype(xa.dtype)
+            return out.reshape(orig_shape), aux
+
+        out, aux = apply(prim, self.gate.weight, x, *self._stacked,
+                         op_name="moe_layer", n_outputs=2)
+        self.l_aux = aux
+        return out
