@@ -45,6 +45,9 @@ class GPTConfig:
     initializer_range: float = 0.02
     use_flash: bool = True
     seq_parallel: bool = False       # constrain activations over the 'sp' axis
+    sp_attention: str = "ring"       # "ring" | "ulysses" | "none" — context-
+                                     # parallel attention when sp > 1 (beyond
+                                     # the reference, SURVEY §5.7)
     recompute: bool = False          # rematerialize each block (jax.checkpoint)
     fused_ce: bool = True            # chunked lm-head+CE, no [N,V] logits in HBM
 
@@ -83,8 +86,16 @@ class GPTAttention(nn.Layer):
             v = paddle.concat([pv, v], axis=1)
             cache = (k, v)
         drop = self.attn_drop_p if self.training else 0.0
-        out = F.scaled_dot_product_attention(
-            q, k, v, dropout_p=drop, is_causal=True, training=self.training)
+        if self.cfg.seq_parallel and cache is None:
+            # one authoritative gate (raises on misconfiguration rather than
+            # silently gathering full K/V): F.sequence_parallel_attention
+            out = F.sequence_parallel_attention(
+                q, k, v, is_causal=True, impl=self.cfg.sp_attention,
+                dropout_p=drop, training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, dropout_p=drop, is_causal=True,
+                training=self.training)
         out = out.reshape([B, S, -1])
         out = self.out_proj(out)
         out = self.resid_drop(out)

@@ -12,4 +12,5 @@ from paddle_tpu.nn.functional.norm import (  # noqa: F401
 from paddle_tpu.nn.functional.loss import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.attention import (  # noqa: F401
     scaled_dot_product_attention, sequence_mask,
+    sequence_parallel_attention,
 )

@@ -33,6 +33,47 @@ def _sdpa_xla(q, k, v, mask, dropout_p, is_causal, scale):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def sequence_parallel_attention(query, key, value, is_causal=True, scale=None,
+                                impl="ring", dropout_p=0.0, training=True,
+                                name=None):
+    """Context-parallel attention over the 'sp' mesh axis — the ONE
+    authoritative gate for ring/Ulysses dispatch (beyond-reference feature,
+    SURVEY §5.7). [B, S, H, D] layout. Falls back to
+    scaled_dot_product_attention when no sp axis is active; RAISES on
+    configurations that would silently degrade (attention dropout in training,
+    non-divisible seq/heads) instead of quietly gathering full K/V."""
+    from paddle_tpu.distributed.mesh import get_mesh
+    query, key, value = (ensure_tensor(query), ensure_tensor(key),
+                         ensure_tensor(value))
+    mesh = get_mesh()
+    sp = (mesh.shape.get("sp", 1) if mesh is not None
+          and "sp" in mesh.axis_names else 1)
+    if sp <= 1 or impl in (None, "none"):
+        return scaled_dot_product_attention(
+            query, key, value, dropout_p=dropout_p, is_causal=is_causal,
+            scale=scale, training=training)
+    if dropout_p > 0.0 and training:
+        raise RuntimeError(
+            "sequence-parallel attention does not support attention dropout "
+            "(set attention_dropout=0, or sp_attention='none'); refusing to "
+            "silently fall back to full-K/V attention")
+    S, H = query.shape[1], query.shape[2]
+    if S % sp:
+        raise ValueError(f"sequence length {S} not divisible by sp={sp}")
+    if impl == "ulysses" and H % sp:
+        raise ValueError(f"ulysses needs heads ({H}) divisible by sp ({sp})")
+    from paddle_tpu.kernels.ring_attention import (
+        ring_attention, ulysses_attention)
+    kern = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
+
+    def prim(qa, ka, va):
+        qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (qa, ka, va))
+        o = kern(qt, kt, vt, is_causal, scale, mesh)
+        return jnp.swapaxes(o, 1, 2)
+
+    return apply(prim, query, key, value, op_name=f"{impl}_attention")
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, scale=None, training=True,
                                  name=None):

@@ -1,0 +1,163 @@
+"""Ring attention + Ulysses sequence parallelism (BEYOND the reference —
+SURVEY §5.7 mandate: the snapshot has no context parallelism at all)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.mesh import auto_mesh, get_mesh, set_mesh
+
+
+@pytest.fixture(autouse=True)
+def _restore_mesh():
+    prev = get_mesh()
+    yield
+    set_mesh(prev)
+
+
+def _naive(q, k, v, causal):
+    D = q.shape[-1]
+    s = 1.0 / np.sqrt(D)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", (q * s).astype(jnp.float32),
+                        k.astype(jnp.float32))
+    if causal:
+        S = q.shape[2]
+        m = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(m, logits, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits, -1),
+                      v.astype(jnp.float32))
+
+
+def _qkv(B=2, H=8, S=64, D=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+                 for _ in range(3))
+
+
+def _kernels():
+    from paddle_tpu.kernels.ring_attention import (
+        ring_attention, ulysses_attention)
+    return {"ring": ring_attention, "ulysses": ulysses_attention}
+
+
+class TestSpKernels:
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_fwd_bwd_vs_naive(self, impl, causal):
+        kern = _kernels()[impl]
+        mesh = auto_mesh(sp=8)
+        q, k, v = _qkv()
+
+        def f(q, k, v):
+            return kern(q, k, v, causal, None, mesh)
+
+        o = jax.jit(f)(q, k, v)
+        ref = _naive(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        g = jax.jit(jax.grad(lambda q, k, v: (f(q, k, v) ** 2).sum(),
+                             argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.grad(lambda q, k, v: (_naive(q, k, v, causal) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_ulysses_head_divisibility_check(self):
+        from paddle_tpu.kernels.ring_attention import ulysses_attention
+        mesh = auto_mesh(sp=8)
+        q, k, v = _qkv(H=4)   # 4 heads, sp=8 -> error
+        with pytest.raises(ValueError, match="divisible"):
+            jax.jit(lambda q, k, v: ulysses_attention(
+                q, k, v, True, None, mesh))(q, k, v)
+
+    def test_gate_raises_on_attention_dropout(self):
+        import paddle_tpu.nn.functional as F
+        auto_mesh(sp=8)
+        x = paddle.to_tensor(np.zeros((2, 64, 8, 8), np.float32))
+        with pytest.raises(RuntimeError, match="dropout"):
+            F.sequence_parallel_attention(x, x, x, dropout_p=0.1,
+                                          training=True)
+
+
+def _gpt_losses(sp_attention, use_mesh, steps=3):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    set_mesh(None)
+    if use_mesh:
+        auto_mesh(dp=2, sp=4)
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+                    intermediate_size=64, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    seq_parallel=use_mesh, sp_attention=sp_attention)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+
+    @paddle.jit.to_static
+    def step(x, y):
+        _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(1)
+    losses = []
+    for _ in range(steps):
+        ids = rng.randint(0, 128, (4, 33))
+        x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+        y = paddle.to_tensor(ids[:, 1:].astype(np.int64))
+        losses.append(float(step(x, y)))
+    return losses
+
+
+class TestGPTSequenceParallel:
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_sp4_matches_serial(self, impl):
+        serial = _gpt_losses(impl, use_mesh=False)
+        dist = _gpt_losses(impl, use_mesh=True)
+        np.testing.assert_allclose(serial, dist, rtol=1e-3)
+
+
+class TestMemoryScaling:
+    def test_ring_peak_memory_below_reference_style(self):
+        """Long-sequence memory win: ring training (fwd+bwd, custom VJP with
+        O(S/P) residuals) must compile to a fraction of the reference-style
+        attention's footprint (the reference has NO flash — fmha_ref.h
+        materializes and saves the full [S,S] probabilities)."""
+        from paddle_tpu.kernels.ring_attention import ring_attention
+        mesh = auto_mesh(sp=8)
+        B, H, S, D = 1, 8, 8192, 64
+        rng = np.random.RandomState(0)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P(None, None, "sp", None))
+        q = jax.device_put(jnp.asarray(rng.randn(B, H, S, D), jnp.float32), sh)
+
+        ring = jax.jit(jax.grad(lambda q, k, v: (ring_attention(
+            q, k, v, True, None, mesh).astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2))).lower(q, q, q).compile()
+
+        def naive(q, k, v):
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q / np.sqrt(D), k)
+            m = jnp.tril(jnp.ones((S, S), bool))
+            p = jax.nn.softmax(jnp.where(m, logits, -1e30), axis=-1)
+            return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+        dense = jax.jit(jax.grad(
+            lambda q, k, v: (jax.lax.with_sharding_constraint(
+                naive(q, k, v), sh) ** 2).sum(),
+            argnums=(0, 1, 2))).lower(q, q, q).compile()
+
+        def peak(c):
+            ma = c.memory_analysis()
+            if ma is None:
+                pytest.skip("memory_analysis unavailable on this backend")
+            return ma.temp_size_in_bytes + ma.output_size_in_bytes
+
+        # at S=8192 the [S,S] probability tensor alone is ~2 GB; observed:
+        # ring ~0.6 GB vs reference-style ~1.7 GB (XLA already remats some of
+        # the naive bwd, so the gap is the honest compiled-program one)
+        assert peak(ring) < peak(dense) / 2, (peak(ring), peak(dense))
