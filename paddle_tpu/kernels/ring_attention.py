@@ -8,7 +8,11 @@ both over the 'sp' mesh axis:
   ring with `jax.lax.ppermute` while each rank accumulates its online-softmax
   partials — attention memory per rank stays O(S/P * S/P) per step and no rank
   ever materializes the full K/V, so max sequence length scales linearly with
-  the ring size. The backward ring falls out of jax.vjp.
+  the ring size. Known inefficiency: under causal masking the contiguous
+  block-to-rank assignment leaves early ranks computing fully-masked steps
+  (~2x causal FLOPs); a zigzag/striped token permutation (balanced early+late
+  positions per rank) would fix the imbalance but requires a global reorder of
+  the sequence around the attention call — future work.
 - **Ulysses** (`ulysses_attention`): `lax.all_to_all` reshards sequence->heads,
   runs dense flash attention on full sequences of H/P heads per rank, and
   reshards back — cheaper collectives when H >= P.

@@ -16,7 +16,7 @@ from paddle_tpu.core.autograd import apply
 from paddle_tpu.ops.common import ensure_tensor
 
 
-def _sdpa_xla(q, k, v, mask, dropout_p, is_causal, scale):
+def _sdpa_xla(q, k, v, mask, dropout_p, is_causal, scale, rng_key=None):
     # q,k,v: [B, S, H, D] (paddle convention)
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
@@ -30,6 +30,10 @@ def _sdpa_xla(q, k, v, mask, dropout_p, is_causal, scale):
         else:
             logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and rng_key is not None:
+        keep = jax.random.bernoulli(rng_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros_like(probs))
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -62,6 +66,10 @@ def sequence_parallel_attention(query, key, value, is_causal=True, scale=None,
         raise ValueError(f"sequence length {S} not divisible by sp={sp}")
     if impl == "ulysses" and H % sp:
         raise ValueError(f"ulysses needs heads ({H}) divisible by sp ({sp})")
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(
+            f"unknown sequence-parallel attention impl {impl!r}; "
+            "choose 'ring', 'ulysses', or 'none'")
     from paddle_tpu.kernels.ring_attention import (
         ring_attention, ulysses_attention)
     kern = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
@@ -91,9 +99,20 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     has_mask = attn_mask is not None
     if has_mask:
         ts.append(ensure_tensor(attn_mask))
+    use_drop = dropout_p > 0.0 and training
+    if use_drop:
+        # rng key split OUTSIDE the prim (the stateful generator advance must
+        # happen at the framework level so capture threads it as state)
+        from paddle_tpu.ops.random import default_generator
+        from paddle_tpu.core.tensor import Tensor
+        ts.append(Tensor(default_generator().next_key(), _internal=True))
 
-    def prim(q, k, v, *m):
-        return _sdpa_xla(q, k, v, m[0] if m else None, dropout_p, is_causal, scale)
+    def prim(q, k, v, *rest):
+        rest = list(rest)
+        rkey = rest.pop() if use_drop else None
+        m = rest[0] if rest else None
+        return _sdpa_xla(q, k, v, m, dropout_p if use_drop else 0.0,
+                         is_causal, scale, rng_key=rkey)
 
     return apply(prim, *ts, op_name="scaled_dot_product_attention")
 

@@ -1,0 +1,182 @@
+"""OpTest harness — the rebuild of the reference's core test asset
+(`python/paddle/fluid/tests/unittests/op_test.py:327`).
+
+A declarative entry = (paddle op, numpy reference, input arrays, kwargs).
+`check()` verifies, for each op:
+  1. eager forward vs the numpy reference (f32 tolerances);
+  2. the same call under `paddle.jit.to_static` (capture/compile parity —
+     the reference's cross-executor check);
+  3. analytic gradients (autograd tape) vs central-difference numeric
+     gradients of the eager op (the reference's check_grad);
+  4. optional bf16 forward with loose tolerances.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+F32_RTOL, F32_ATOL = 1e-5, 1e-6
+GRAD_RTOL, GRAD_ATOL = 5e-3, 5e-4
+BF16_RTOL, BF16_ATOL = 2e-2, 2e-2
+
+
+def _to_np(t):
+    if isinstance(t, paddle.Tensor):
+        return np.asarray(t._data)
+    return np.asarray(t)
+
+
+def _outputs(res):
+    if isinstance(res, (list, tuple)):
+        return [r for r in res if isinstance(r, paddle.Tensor)]
+    return [res]
+
+
+class OpTestCase:
+    def __init__(self, name, op, ref, inputs, kwargs=None, grad_inputs=None,
+                 rtol=F32_RTOL, atol=F32_ATOL, grad_rtol=GRAD_RTOL,
+                 grad_atol=GRAD_ATOL, check_static=True, check_bf16=False,
+                 out_index=None):
+        self.name = name
+        self.op = op
+        self.ref = ref
+        self.inputs = inputs                 # dict name -> np array
+        self.kwargs = kwargs or {}
+        # which inputs get gradient-checked (float inputs only); None = all
+        self.grad_inputs = grad_inputs
+        self.rtol, self.atol = rtol, atol
+        self.grad_rtol, self.grad_atol = grad_rtol, grad_atol
+        self.check_static = check_static
+        self.check_bf16 = check_bf16
+        self.out_index = out_index           # multi-output ops: compare [i]
+
+    # ---------------------------------------------------------------- helpers
+
+    def _tensors(self, dtype_map=None):
+        ts = {}
+        for k, v in self.inputs.items():
+            arr = v
+            if dtype_map and np.issubdtype(np.asarray(v).dtype, np.floating):
+                arr = np.asarray(v).astype(dtype_map)
+            ts[k] = paddle.to_tensor(arr)
+        return ts
+
+    def _run(self, ts):
+        res = self.op(*ts.values(), **self.kwargs)
+        outs = _outputs(res)
+        if self.out_index is not None:
+            outs = [outs[self.out_index]]
+        return outs
+
+    def _ref_out(self):
+        out = self.ref(*self.inputs.values(), **self.kwargs)
+        return out if isinstance(out, (list, tuple)) else [out]
+
+    # ----------------------------------------------------------------- checks
+
+    def check_forward(self):
+        outs = self._run(self._tensors())
+        refs = self._ref_out()
+        assert len(outs) == len(refs), \
+            f"{self.name}: {len(outs)} outputs vs {len(refs)} reference"
+        for o, r in zip(outs, refs):
+            np.testing.assert_allclose(
+                _to_np(o), r, rtol=self.rtol, atol=self.atol,
+                err_msg=f"{self.name}: eager forward mismatch")
+
+    def check_static_fn(self):
+        names = list(self.inputs)
+
+        @paddle.jit.to_static
+        def fn(*args):
+            res = self.op(*args, **self.kwargs)
+            outs = _outputs(res)
+            if self.out_index is not None:
+                outs = [outs[self.out_index]]
+            return tuple(outs) if len(outs) > 1 else outs[0]
+
+        ts = self._tensors()
+        res = fn(*[ts[n] for n in names])
+        outs = list(res) if isinstance(res, (list, tuple)) else [res]
+        for o, r in zip(outs, self._ref_out()):
+            np.testing.assert_allclose(
+                _to_np(o), r, rtol=self.rtol, atol=self.atol,
+                err_msg=f"{self.name}: to_static forward mismatch")
+
+    def _grad_names(self):
+        if self.grad_inputs is not None:
+            return self.grad_inputs
+        return [k for k, v in self.inputs.items()
+                if np.issubdtype(np.asarray(v).dtype, np.floating)]
+
+    def check_grad(self, eps=1e-3):
+        gnames = self._grad_names()
+        if not gnames:
+            return
+        ts = self._tensors(np.float64)       # x64 is on: f64 numeric diff
+        for n in gnames:
+            ts[n].stop_gradient = False
+        # deterministic cotangent
+        outs = self._run(ts)
+        cots = [np.asarray(np.random.RandomState(7 + i).randn(*o.shape))
+                for i, o in enumerate(outs)]
+        loss = None
+        for o, c in zip(outs, cots):
+            term = (o * paddle.to_tensor(c.astype(np.float64))).sum()
+            loss = term if loss is None else loss + term
+        loss.backward()
+        analytic = {n: _to_np(ts[n].grad) for n in gnames
+                    if ts[n].grad is not None}
+
+        def scalar_loss(arrs):
+            ts2 = self._tensors(np.float64)
+            for k, a in arrs.items():
+                ts2[k] = paddle.to_tensor(a)
+            outs2 = self._run(ts2)
+            total = 0.0
+            for o, c in zip(outs2, cots):
+                total += float((_to_np(o) * c).sum())
+            return total
+
+        for n in gnames:
+            if n not in analytic:
+                continue
+            base = np.asarray(self.inputs[n], np.float64)
+            num = np.zeros_like(base)
+            flat = base.reshape(-1)
+            nf = num.reshape(-1)
+            idxs = range(flat.size) if flat.size <= 64 else \
+                np.random.RandomState(0).choice(flat.size, 64, replace=False)
+            for i in idxs:
+                up, dn = flat.copy(), flat.copy()
+                up[i] += eps
+                dn[i] -= eps
+                arrs_u = {n: up.reshape(base.shape)}
+                arrs_d = {n: dn.reshape(base.shape)}
+                nf[i] = (scalar_loss(arrs_u) - scalar_loss(arrs_d)) / (2 * eps)
+            sel = np.zeros(flat.size, bool)
+            sel[list(idxs)] = True
+            a = analytic[n].reshape(-1)[sel]
+            b = nf[sel]
+            np.testing.assert_allclose(
+                a, b, rtol=self.grad_rtol, atol=self.grad_atol,
+                err_msg=f"{self.name}: analytic vs numeric grad for '{n}'")
+
+    def check_bf16_forward(self):
+        import jax.numpy as jnp
+        ts = self._tensors("bfloat16")
+        outs = self._run(ts)
+        for o, r in zip(outs, self._ref_out()):
+            np.testing.assert_allclose(
+                _to_np(o).astype(np.float32), r,
+                rtol=BF16_RTOL, atol=BF16_ATOL,
+                err_msg=f"{self.name}: bf16 forward mismatch")
+
+    def check(self):
+        self.check_forward()
+        if self.check_static:
+            self.check_static_fn()
+        self.check_grad()
+        if self.check_bf16:
+            self.check_bf16_forward()

@@ -138,3 +138,22 @@ class TestFusedOptimizerStateRetention:
     def test_lars_not_fused(self):
         from paddle_tpu.optimizer.optimizers import LarsMomentum
         assert LarsMomentum._FUSABLE is False
+
+
+class TestSdpaDropout:
+    def test_attention_dropout_actually_applied(self):
+        """_sdpa_xla must apply dropout (regression: dropout_p was ignored)."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(2, 16, 4, 8).astype(np.float32))
+        out_nodrop = F.scaled_dot_product_attention(
+            q, q, q, dropout_p=0.9, is_causal=True, training=False)
+        out_drop = F.scaled_dot_product_attention(
+            q, q, q, dropout_p=0.9, is_causal=True, training=True)
+        a = np.asarray(out_nodrop._data)
+        b = np.asarray(out_drop._data)
+        assert not np.allclose(a, b), "dropout_p had no effect in training"
+        # and two training calls differ (rng advances)
+        c = np.asarray(F.scaled_dot_product_attention(
+            q, q, q, dropout_p=0.9, is_causal=True, training=True)._data)
+        assert not np.allclose(b, c)
