@@ -25,3 +25,5 @@ from paddle_tpu.distributed import fleet  # noqa: F401
 from paddle_tpu.distributed.parallel_wrappers import DataParallel  # noqa: F401
 from paddle_tpu.distributed import sharding  # noqa: F401
 from paddle_tpu.distributed.spawn import spawn  # noqa: F401
+from paddle_tpu.distributed.checkpoint import (  # noqa: F401
+    save_sharded, load_sharded, async_save)

@@ -1,0 +1,218 @@
+"""Distributed (sharded) checkpointing.
+
+Counterpart of the reference's distributed checkpoint stack — sharded
+save/gather for hybrid models (`incubate/distributed/utils/io/dist_save.py`,
+`dist_load.py`) and the auto-parallel cross-plan `converter.py` — built on the
+TPU-native principle (SURVEY §5.4): the checkpoint is ONE LOGICAL snapshot of
+global arrays, written shard-by-shard, loadable under ANY mesh/parallel plan.
+Resharding between plans (the reference's converter) therefore needs no
+conversion step: load assembles the logical array and places it under the
+target sharding.
+
+Format: a directory with
+  index.json               — {key: {shape, dtype, shards: [{file, slices}]}}
+  <key>.<shard>.npy        — one file per addressable shard per process
+
+Each process writes only the shards it owns (multi-host writes disjoint files;
+rank 0 writes the index). ``async_save`` returns immediately and writes from a
+background thread (the reference's auto_checkpoint/async pattern).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import jax
+
+from paddle_tpu.core.tensor import Tensor
+
+
+def _sanitize(key):
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", key)
+
+
+def _slices_to_json(idx, shape):
+    out = []
+    for sl, dim in zip(idx, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def save_sharded(state_dict, path):
+    """Save a (possibly nested) state_dict of Tensors shard-by-shard.
+
+    Every process writes its own addressable shards plus a per-process partial
+    index ``index.p<pid>.json``; loaders merge ALL partial indexes, so
+    multi-host saves need no cross-process gather or barrier. Writes publish
+    atomically (tmp + rename)."""
+    os.makedirs(path, exist_ok=True)
+    pid = jax.process_index()
+    index = {}
+    for key, value in _flatten(state_dict).items():
+        if isinstance(value, (int, float, str, bool, type(None))) or (
+                isinstance(value, (list, tuple)) and all(
+                    isinstance(v, (int, float, str, bool)) for v in value)):
+            # non-tensor metadata (global_step, key manifests...): JSON literal
+            index[key] = {"literal": value if not isinstance(value, tuple)
+                          else list(value)}
+            continue
+        arr = value._data if isinstance(value, Tensor) else value
+        if isinstance(arr, np.ndarray):
+            # pre-snapshotted host array (async_save): one full-shape shard
+            skey = _sanitize(key)
+            dtype = str(arr.dtype)
+            data = arr
+            fname = f"{skey}.p{pid}s0.npy"
+            np.save(os.path.join(path, fname), data)
+            index[key] = {"shape": list(arr.shape), "dtype": dtype,
+                          "shards": [{"file": fname, "slices": [
+                              [0, d] for d in arr.shape]}]}
+            continue
+        if not hasattr(arr, "addressable_shards"):
+            arr = jax.numpy.asarray(arr)
+        skey = _sanitize(key)
+        entries = []
+        seen = set()
+        for j, shard in enumerate(arr.addressable_shards):
+            tup = _slices_to_json(shard.index, arr.shape)
+            sig = tuple(map(tuple, tup))
+            if sig in seen:          # replicated shards: write once
+                continue
+            seen.add(sig)
+            fname = f"{skey}.p{pid}s{j}.npy"
+            data = np.asarray(shard.data)
+            if str(arr.dtype) == "bfloat16":
+                data = data.astype(np.float32)   # npy-portable; dtype in index
+            np.save(os.path.join(path, fname), data)
+            entries.append({"file": fname, "slices": tup})
+        index[key] = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                      "shards": entries}
+    idx_path = os.path.join(path, f"index.p{pid}.json")
+    tmp = idx_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(index, f)
+    os.replace(tmp, idx_path)
+    if pid == 0:
+        # back-compat alias; loaders merge every index.p*.json regardless
+        tmp = os.path.join(path, "index.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(index, f)
+        os.replace(tmp, os.path.join(path, "index.json"))
+    return path
+
+
+class _SaveThread(threading.Thread):
+    """Background writer that re-raises its exception on join()."""
+
+    def __init__(self, snapshot, path):
+        super().__init__(daemon=True)
+        self._snapshot = snapshot
+        self._path = path
+        self.exc = None
+
+    def run(self):
+        try:
+            save_sharded(self._snapshot, self._path)
+        except BaseException as e:   # noqa: BLE001 — stored, re-raised on join
+            self.exc = e
+
+    def join(self, timeout=None):
+        super().join(timeout)
+        if not self.is_alive() and self.exc is not None:
+            raise self.exc
+
+
+def async_save(state_dict, path):
+    """Copy values to HOST on the calling thread (compiled train steps donate
+    the device buffers — a reference would race the next step's in-place
+    update), then write in the background. join() re-raises write errors."""
+    snapshot = {}
+    for key, value in _flatten(state_dict).items():
+        arr = value._data if isinstance(value, Tensor) else value
+        if hasattr(arr, "addressable_shards"):
+            arr = np.asarray(arr)      # synchronous host copy
+        snapshot[key] = arr
+
+    t = _SaveThread(snapshot, path)
+    t.start()
+    return t
+
+
+def load_sharded(path, template=None, return_numpy=False):
+    """Load a sharded checkpoint into a flat {key: Tensor} dict.
+
+    ``template``: optional {key: Tensor} (e.g. a freshly built model's
+    state_dict under the CURRENT mesh) — loaded arrays adopt each template
+    tensor's sharding, which IS the cross-plan reshard (save under dp=8, load
+    under dp2 x mp2 x sp2, any layout)."""
+    import glob as _glob
+    index = {}
+    partials = sorted(_glob.glob(os.path.join(path, "index.p*.json")))
+    if not partials:
+        partials = [os.path.join(path, "index.json")]
+    for pf in partials:
+        with open(pf) as f:
+            part = json.load(f)
+        for key, meta in part.items():
+            if key in index and "shards" in meta:
+                index[key]["shards"].extend(meta["shards"])
+            else:
+                index[key] = meta
+    tpl_flat = _flatten(template) if template is not None else {}
+    out = {}
+    for key, meta in index.items():
+        if "literal" in meta:
+            out[key] = meta["literal"]
+            continue
+        full = np.empty(meta["shape"], dtype=np.dtype(
+            meta["dtype"].replace("bfloat16", "float32")))
+        cast_bf16 = meta["dtype"] == "bfloat16"
+        covered = np.zeros(meta["shape"], dtype=bool) if meta["shape"] \
+            else np.zeros((), dtype=bool)
+        for e in meta["shards"]:
+            data = np.load(os.path.join(path, e["file"]),
+                           allow_pickle=False)
+            sl = tuple(slice(a, b) for a, b in e["slices"])
+            full[sl] = data.astype(full.dtype) if cast_bf16 else data
+            covered[sl] = True
+        if not covered.all():
+            raise ValueError(
+                f"checkpoint shard files for '{key}' do not cover the full "
+                f"array {meta['shape']} — incomplete multi-host save?")
+        arr = full
+        if cast_bf16:
+            import jax.numpy as jnp
+            arr = jnp.asarray(full, jnp.bfloat16)
+        if return_numpy:
+            out[key] = arr
+            continue
+        tpl = tpl_flat.get(key)
+        if tpl is not None and isinstance(
+                getattr(tpl._data, "sharding", None),
+                jax.sharding.NamedSharding):
+            # adopt the template's mesh placement (the cross-plan reshard);
+            # non-mesh params stay UNCOMMITTED so jit may place them freely
+            arr = jax.device_put(arr, tpl._data.sharding)
+        else:
+            import jax.numpy as jnp
+            arr = jnp.asarray(arr)
+        t = Tensor(arr, _internal=True)
+        t.persistable = True
+        out[key] = t
+    return out
+
+
+def _flatten(d, prefix=""):
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, prefix=f"{key}/"))
+        else:
+            out[key] = v
+    return out

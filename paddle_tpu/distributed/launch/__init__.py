@@ -1,0 +1,3 @@
+"""paddle.distributed.launch (ref: `python/paddle/distributed/launch/main.py:18`,
+CollectiveController at `launch/controllers/collective.py:21`)."""
+from paddle_tpu.distributed.launch.main import launch  # noqa: F401

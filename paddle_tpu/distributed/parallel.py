@@ -66,7 +66,11 @@ def init_parallel_env():
     if _initialized:
         return ParallelEnv()
     env = ParallelEnv()
-    if env.world_size > 1 and jax.process_count() == 1:
+    # NOTE: jax.process_count() would initialise the XLA backend, after which
+    # jax.distributed.initialize refuses to run — consult the distributed
+    # client state instead
+    already_joined = jax.distributed.is_initialized()
+    if env.world_size > 1 and not already_joined:
         coordinator = os.environ.get("PADDLE_MASTER") or (
             env.trainer_endpoints[0] if env.trainer_endpoints else None)
         jax.distributed.initialize(

@@ -20,17 +20,37 @@ from paddle_tpu.ops.common import ensure_tensor, rebind
 
 
 class Generator:
-    """Stateful PRNG (ref: ``paddle.framework.Generator``)."""
+    """Stateful PRNG (ref: ``paddle.framework.Generator``).
+
+    Key-state creation is LAZY: importing paddle_tpu must not initialise the
+    XLA backend, or ``jax.distributed.initialize`` (init_parallel_env) can no
+    longer run in multi-process launches."""
 
     def __init__(self, seed=0):
-        self._state = Tensor(jax.random.key_data(jax.random.PRNGKey(seed)),
-                             _internal=True)
-        self._state.persistable = True
+        self._state_lazy = None
         self._seed = seed
+
+    @property
+    def _state(self):
+        if self._state_lazy is None:
+            self._state_lazy = Tensor(
+                jax.random.key_data(jax.random.PRNGKey(self._seed)),
+                _internal=True)
+            self._state_lazy.persistable = True
+        return self._state_lazy
+
+    @_state.setter
+    def _state(self, value):
+        self._state_lazy = value
 
     def manual_seed(self, seed):
         self._seed = int(seed)
-        self._state._write(jax.random.key_data(jax.random.PRNGKey(self._seed)))
+        if self._state_lazy is not None:
+            self._state._write(
+                jax.random.key_data(jax.random.PRNGKey(self._seed)))
+        # else: stay lazy — the property seeds from _seed on first use, and
+        # materializing here would initialise the XLA backend before
+        # jax.distributed.initialize gets a chance to run
         return self
 
     def initial_seed(self):

@@ -1,0 +1,71 @@
+"""Launch CLI smoke test: 2-process CPU bringup (ref methodology:
+`test_dist_base.py` launches trainer subprocesses on localhost)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRAINER = """
+import os, json, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import paddle_tpu.distributed as dist
+
+env = dist.init_parallel_env()
+assert jax.process_count() == 2, jax.process_count()
+# cross-process eager collective (the process_allgather emulation path)
+import numpy as np
+import paddle_tpu as paddle
+t = paddle.to_tensor(np.array([float(env.rank + 1)], np.float32))
+dist.all_reduce(t)
+out = {{"rank": env.rank, "world": env.world_size,
+        "allreduce": float(t._data[0]),
+        "endpoints": len(env.trainer_endpoints)}}
+with open(os.path.join({outdir!r}, f"rank{{env.rank}}.json"), "w") as f:
+    json.dump(out, f)
+print("rank", env.rank, "ok")
+"""
+
+
+def test_two_process_launch(tmp_path):
+    script = tmp_path / "trainer.py"
+    script.write_text(TRAINER.format(repo=REPO, outdir=str(tmp_path)))
+    log_dir = tmp_path / "logs"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu",
+         "--log_dir", str(log_dir), str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+        env={**os.environ, "PYTHONPATH": REPO})
+    logs = ""
+    if log_dir.exists():
+        for f in sorted(log_dir.iterdir()):
+            logs += f"--- {f.name}\n{f.read_text()[-2000:]}\n"
+    assert proc.returncode == 0, f"{proc.stderr}\n{logs}"
+    for rank in (0, 1):
+        data = json.loads((tmp_path / f"rank{rank}.json").read_text())
+        assert data["world"] == 2
+        assert data["endpoints"] == 2
+        # sum over ranks of (rank+1) = 3
+        assert data["allreduce"] == 3.0, data
+    # per-rank logs exist (the reference's per-rank workerlog contract)
+    assert (log_dir / "workerlog.0").exists()
+    assert (log_dir / "workerlog.1").exists()
+
+
+def test_failure_propagates(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(7)\n")
+    log_dir = tmp_path / "logs"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu",
+         "--log_dir", str(log_dir), str(script)],
+        cwd=REPO, capture_output=True, text=True, timeout=100,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert proc.returncode == 7
+    assert "exited with 7" in proc.stderr
