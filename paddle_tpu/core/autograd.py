@@ -157,6 +157,10 @@ def apply(prim: Callable, *inputs, op_name: str = "", n_outputs: int | None = No
 
     if not record:
         out = fn(*arrays)
+        if _DEBUG_CHECKS:
+            _debug_check_outputs(
+                op_name or getattr(prim, "__name__", "op"),
+                list(out) if isinstance(out, (tuple, list)) else [out])
         return _wrap_outputs(out, node=None, stop_gradient=True)
 
     out, raw_vjp_fn = jax.vjp(fn, *arrays)
@@ -172,8 +176,37 @@ def apply(prim: Callable, *inputs, op_name: str = "", n_outputs: int | None = No
         vjp_fn, list(inputs), [(o.shape, o.dtype) for o in outs],
         name=op_name or getattr(prim, "__name__", "op"), prim=fn, multi=multi,
     )
+    if _DEBUG_CHECKS:
+        _debug_check_outputs(node.name, outs)
     result = _wrap_outputs(out, node=node, stop_gradient=False)
     return result
+
+
+_DEBUG_CHECKS = False     # flipped by flags.set_flags (check_nan_inf/benchmark)
+
+
+def _debug_check_outputs(op_name, outs):
+    """FLAGS_check_nan_inf / FLAGS_benchmark hooks at the dispatch point (ref
+    per-op nan/inf detection `eager/nan_inf_utils.cc`, gated the same way).
+    Eager-only: inside a trace, jax_debug_nans (also wired to the flag) covers
+    the compiled path."""
+    from paddle_tpu.framework.flags import flag_value
+    check = flag_value("check_nan_inf")
+    bench = flag_value("benchmark")
+    if not (check or bench):
+        return
+    for o in outs:
+        if isinstance(o, jax.core.Tracer):
+            return
+        if bench:
+            jax.block_until_ready(o)
+        if check and jnp.issubdtype(o.dtype, jnp.inexact):
+            bad = ~jnp.isfinite(o)
+            if bool(jnp.any(bad)):
+                raise FloatingPointError(
+                    f"FLAGS_check_nan_inf: op '{op_name}' produced "
+                    f"{int(jnp.sum(bad))} non-finite value(s) in an output of "
+                    f"shape {tuple(o.shape)}")
 
 
 def _wrap_outputs(out, node, stop_gradient):

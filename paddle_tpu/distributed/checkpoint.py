@@ -31,7 +31,12 @@ from paddle_tpu.core.tensor import Tensor
 
 
 def _sanitize(key):
-    return re.sub(r"[^A-Za-z0-9_.-]", "_", key)
+    """Filesystem-safe, collision-proof file stem for a state_dict key: the
+    readable sanitized name plus a short hash of the RAW key (two distinct
+    keys like 'a/b' and 'a_b' must never share shard files)."""
+    import hashlib
+    h = hashlib.sha1(key.encode()).hexdigest()[:8]
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", key) + "-" + h
 
 
 def _slices_to_json(idx, shape):
@@ -172,18 +177,14 @@ def load_sharded(path, template=None, return_numpy=False):
         full = np.empty(meta["shape"], dtype=np.dtype(
             meta["dtype"].replace("bfloat16", "float32")))
         cast_bf16 = meta["dtype"] == "bfloat16"
-        covered = np.zeros(meta["shape"], dtype=bool) if meta["shape"] \
-            else np.zeros((), dtype=bool)
+        boxes = []
         for e in meta["shards"]:
             data = np.load(os.path.join(path, e["file"]),
                            allow_pickle=False)
             sl = tuple(slice(a, b) for a, b in e["slices"])
             full[sl] = data.astype(full.dtype) if cast_bf16 else data
-            covered[sl] = True
-        if not covered.all():
-            raise ValueError(
-                f"checkpoint shard files for '{key}' do not cover the full "
-                f"array {meta['shape']} — incomplete multi-host save?")
+            boxes.append([tuple(p) for p in e["slices"]])
+        _check_coverage(key, meta["shape"], boxes)
         arr = full
         if cast_bf16:
             import jax.numpy as jnp
@@ -205,6 +206,30 @@ def load_sharded(path, template=None, return_numpy=False):
         t.persistable = True
         out[key] = t
     return out
+
+
+def _check_coverage(key, shape, boxes):
+    """O(#shards^2) arithmetic coverage check: total volume of (deduped,
+    non-overlapping) shard boxes must equal the array volume — no O(#elements)
+    bool mask (a 1B-param tensor would cost an extra GB just to verify)."""
+    total = int(np.prod(shape)) if shape else 1
+    boxes = list({tuple(b) for b in boxes})
+    vol = 0
+    for b in boxes:
+        v = 1
+        for lo, hi in b:
+            v *= hi - lo
+        vol += v
+    for i, a in enumerate(boxes):
+        for b in boxes[i + 1:]:
+            if all(lo1 < hi2 and lo2 < hi1
+                   for (lo1, hi1), (lo2, hi2) in zip(a, b)):
+                raise ValueError(
+                    f"checkpoint shards for '{key}' overlap: {a} vs {b}")
+    if vol != total:
+        raise ValueError(
+            f"checkpoint shard files for '{key}' cover {vol} of {total} "
+            f"elements of {shape} — incomplete multi-host save?")
 
 
 def _flatten(d, prefix=""):

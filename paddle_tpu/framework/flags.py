@@ -40,9 +40,13 @@ def define_flag(name, default, doc="", parser=None, on_change=None):
             parser = str
     info = FlagInfo(name, default, doc, parser, default, on_change)
     env = os.environ.get(f"FLAGS_{name}")
+    _REGISTRY[name] = info
     if env is not None:
         info.value = parser(env)
-    _REGISTRY[name] = info
+        if on_change:
+            # env-set flags must fire their wiring too (FLAGS_check_nan_inf=1
+            # python train.py is the canonical gflags usage)
+            on_change(info.value)
     return info
 
 
@@ -74,9 +78,23 @@ def flag_value(name):
 
 
 # ---- core flags (TPU-meaningful subset of the reference's 77) -------------------
+def _sync_debug_hooks(_value=None):
+    """check_nan_inf / benchmark wiring: a cheap module-level switch on the
+    autograd dispatch path (eager per-op checks) + jax_debug_nans for code
+    under jit (the compiled-path analog of the reference's per-op detector,
+    `eager/nan_inf_utils.cc` / `nan_inf_utils_detail.cc`)."""
+    from paddle_tpu.core import autograd
+    autograd._DEBUG_CHECKS = bool(
+        _REGISTRY["check_nan_inf"].value or _REGISTRY["benchmark"].value)
+    import jax
+    jax.config.update("jax_debug_nans", bool(_REGISTRY["check_nan_inf"].value))
+
+
 define_flag("check_nan_inf", False,
-            "check outputs of every op for nan/inf (ref FLAGS_check_nan_inf)")
-define_flag("benchmark", False, "sync after each op for timing")
+            "check outputs of every op for nan/inf (ref FLAGS_check_nan_inf)",
+            on_change=_sync_debug_hooks)
+define_flag("benchmark", False, "sync after each op for timing",
+            on_change=_sync_debug_hooks)
 define_flag("paddle_num_threads", 1, "host compute threads")
 define_flag("use_bfloat16_matmul", False,
             "run fp32 matmuls in bf16 on the MXU (TPU-specific speed knob)")

@@ -147,6 +147,10 @@ def _sig_of(args, kwargs):
 
     rec(args)
     rec(kwargs)
+    # flags that change what a trace COMPUTES must key the program cache, or
+    # toggling them after first compile is silently ignored
+    from paddle_tpu.framework.flags import flag_value
+    parts.append(("F", flag_value("use_bfloat16_matmul")))
     return tuple(parts)
 
 

@@ -14,12 +14,20 @@ from paddle_tpu.ops.common import ensure_tensor, promote_pair
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     x, y = ensure_tensor(x), ensure_tensor(y)
     x, y = promote_pair(x, y)
+    from paddle_tpu.amp.state import amp_cast_inputs
+    x, y = amp_cast_inputs("matmul", x, y)
 
     def prim(a, b):
         if transpose_x:
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
         if transpose_y:
             b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        from paddle_tpu.framework.flags import flag_value
+        if flag_value("use_bfloat16_matmul") and a.dtype == jnp.float32:
+            # FLAGS_use_bfloat16_matmul: MXU bf16 inputs, f32 accumulation
+            return jnp.matmul(a.astype(jnp.bfloat16),
+                              b.astype(jnp.bfloat16),
+                              preferred_element_type=jnp.float32)
         return jnp.matmul(a, b)
 
     return apply(prim, x, y, op_name="matmul")

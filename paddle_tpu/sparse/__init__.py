@@ -26,13 +26,26 @@ class SparseCooTensor(Tensor):
         self._dense_shape = tuple(int(s) for s in shape)
 
     def indices(self):
+        if self._indices is None:
+            self._materialize_sparse()
         return self._indices
 
+    def _materialize_sparse(self):
+        idx = jnp.stack(jnp.nonzero(self._data))
+        self._indices = Tensor(idx, _internal=True)
+        self._values = Tensor(self._data[tuple(idx)], _internal=True)
+
     def values(self):
+        if self._values is None:
+            self._materialize_sparse()
         return self._values
 
     def to_dense(self):
-        return Tensor(self._data, _internal=True)
+        t = Tensor(self._data, stop_gradient=self.stop_gradient,
+                   _internal=True)
+        t._grad_node = self._grad_node     # keep the autograd chain
+        t._out_slot = self._out_slot
+        return t
 
     def is_sparse(self):
         return True
@@ -61,3 +74,106 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
 
 def is_same_shape(x, y):
     return tuple(x.shape) == tuple(y.shape)
+
+
+# ---------------------------------------------------------------- functional
+# (ref `python/paddle/sparse/unary.py`, `binary.py`: the PHI sparse kernels
+# compute on values; here COO/CSR carry a dense backing array so the dense XLA
+# kernels serve directly, with results re-wrapped as sparse where meaningful)
+
+def _rewrap(dense_out, like):
+    """Wrap an op's dense result back as sparse WITHOUT severing the autograd
+    chain: the result shares the dense Tensor's data and grad node; indices/
+    values are recomputed lazily from the dense backing on access."""
+    if not isinstance(like, SparseCooTensor):
+        return dense_out
+    t = SparseCooTensor.__new__(SparseCooTensor)
+    Tensor.__init__(t, dense_out._data,
+                    stop_gradient=dense_out.stop_gradient, _internal=True)
+    t._grad_node = dense_out._grad_node
+    t._out_slot = dense_out._out_slot
+    t._indices = None              # lazy — see SparseCooTensor.indices()
+    t._values = None
+    t._dense_shape = tuple(dense_out.shape)
+    return t
+
+
+def add(x, y, name=None):
+    import paddle_tpu as paddle
+    return _rewrap(paddle.add(ensure_tensor(x), ensure_tensor(y)), x)
+
+
+def subtract(x, y, name=None):
+    import paddle_tpu as paddle
+    return _rewrap(paddle.subtract(ensure_tensor(x), ensure_tensor(y)), x)
+
+
+def multiply(x, y, name=None):
+    import paddle_tpu as paddle
+    return _rewrap(paddle.multiply(ensure_tensor(x), ensure_tensor(y)), x)
+
+
+def divide(x, y, name=None):
+    import paddle_tpu as paddle
+    return _rewrap(paddle.divide(ensure_tensor(x), ensure_tensor(y)), x)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (ref sparse matmul kernels)."""
+    import paddle_tpu as paddle
+    return paddle.matmul(ensure_tensor(x), ensure_tensor(y))
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense masked by a sparse pattern (ref masked_matmul)."""
+    import paddle_tpu as paddle
+    out = paddle.matmul(ensure_tensor(x), ensure_tensor(y))
+    m = (mask.to_dense() if isinstance(mask, SparseCooTensor)
+         else ensure_tensor(mask))
+    return _rewrap(paddle.multiply(
+        out, Tensor((m._data != 0).astype(out._data.dtype),
+                    _internal=True)), mask)
+
+
+def _unary(opname):
+    def fn(x, name=None):
+        import paddle_tpu as paddle
+        return _rewrap(getattr(paddle, opname)(ensure_tensor(x)), x)
+    fn.__name__ = opname
+    return fn
+
+
+sqrt = _unary("sqrt")
+sin = _unary("sin")
+tanh = _unary("tanh")
+abs = _unary("abs")
+neg = _unary("neg")
+square = _unary("square")
+
+
+def relu(x, name=None):
+    import paddle_tpu.nn.functional as F
+    return _rewrap(F.relu(ensure_tensor(x)), x)
+
+
+import types as _types
+
+nn = _types.SimpleNamespace()
+
+
+class _ReLU:
+    def __call__(self, x):
+        return relu(x)
+
+
+class _Softmax:
+    def __init__(self, axis=-1):
+        self.axis = axis
+
+    def __call__(self, x):
+        import paddle_tpu.nn.functional as F
+        return _rewrap(F.softmax(ensure_tensor(x), axis=self.axis), x)
+
+
+nn.ReLU = _ReLU
+nn.Softmax = _Softmax

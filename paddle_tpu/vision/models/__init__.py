@@ -5,3 +5,9 @@ from paddle_tpu.vision.models.resnet import (  # noqa: F401
     resnet152, resnext50_32x4d, resnext101_32x4d, wide_resnet50_2,
     wide_resnet101_2,
 )
+from paddle_tpu.vision.models.vgg import (  # noqa: F401
+    VGG, vgg11, vgg13, vgg16, vgg19)
+from paddle_tpu.vision.models.mobilenet import (  # noqa: F401
+    MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2)
+from paddle_tpu.vision.models.alexnet import (  # noqa: F401
+    AlexNet, alexnet, SqueezeNet, squeezenet1_0, squeezenet1_1)

@@ -41,7 +41,73 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=No
 
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
               box_normalized=True, axis=0):
-    raise NotImplementedError("box_coder: planned (detection tower)")
+    """Encode/decode detection boxes against priors (ref
+    `phi/kernels/impl/box_coder.h` semantics, xyxy priors <-> center-size
+    deltas)."""
+    pb = ensure_tensor(prior_box)
+    tb = ensure_tensor(target_box)
+    if prior_box_var is None:
+        pbv = None
+    elif isinstance(prior_box_var, (list, tuple)):
+        pbv = jnp.asarray(prior_box_var, jnp.float32)
+    else:
+        pbv = ensure_tensor(prior_box_var)._data
+
+    norm_off = 0.0 if box_normalized else 1.0
+
+    def _prior_cs(p):
+        pw = p[..., 2] - p[..., 0] + norm_off
+        ph = p[..., 3] - p[..., 1] + norm_off
+        px = p[..., 0] + pw * 0.5
+        py = p[..., 1] + ph * 0.5
+        return px, py, pw, ph
+
+    if code_type == "encode_center_size":
+        def prim(p, t):
+            px, py, pw, ph = _prior_cs(p)                 # [M]
+            tw = t[..., 2] - t[..., 0] + norm_off         # [N]
+            th = t[..., 3] - t[..., 1] + norm_off
+            tx = t[..., 0] + tw * 0.5
+            ty = t[..., 1] + th * 0.5
+            dx = (tx[:, None] - px[None, :]) / pw[None, :]
+            dy = (ty[:, None] - py[None, :]) / ph[None, :]
+            dw = jnp.log(tw[:, None] / pw[None, :])
+            dh = jnp.log(th[:, None] / ph[None, :])
+            out = jnp.stack([dx, dy, dw, dh], axis=-1)    # [N, M, 4]
+            if pbv is not None:
+                out = out / jnp.broadcast_to(pbv, out.shape)
+            return out
+
+        return apply(prim, pb, tb, op_name="box_coder")
+
+    if code_type == "decode_center_size":
+        def prim(p, t):
+            px, py, pw, ph = _prior_cs(p)                 # [M]
+            d = t                                         # [N, M, 4] deltas
+            if d.ndim == 2:
+                d = d[:, None, :]
+            if pbv is not None:
+                v = pbv
+                if v.ndim == 2 and axis == 1:
+                    # priors vary along dim 0 when axis=1 — align per-prior
+                    # variances with the prior broadcast orientation
+                    v = v[:, None, :]
+                d = d * jnp.broadcast_to(v, d.shape)
+            if axis == 0:
+                px_, py_, pw_, ph_ = (a[None, :] for a in (px, py, pw, ph))
+            else:
+                px_, py_, pw_, ph_ = (a[:, None] for a in (px, py, pw, ph))
+            cx = d[..., 0] * pw_ + px_
+            cy = d[..., 1] * ph_ + py_
+            w = jnp.exp(d[..., 2]) * pw_
+            h = jnp.exp(d[..., 3]) * ph_
+            return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                              cx + w * 0.5 - norm_off,
+                              cy + h * 0.5 - norm_off], axis=-1)
+
+        return apply(prim, pb, tb, op_name="box_coder")
+
+    raise ValueError(f"unknown code_type {code_type!r}")
 
 
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
@@ -86,4 +152,77 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
                   deformable_groups=1, groups=1, mask=None, name=None):
-    raise NotImplementedError("deform_conv2d: planned (detection tower)")
+    """Deformable conv v1/v2 (ref `phi/kernels/impl/deformable_conv` ideas):
+    bilinear-sample the input at offset-shifted kernel taps, then a dense
+    matmul over taps — a gather+matmul composition XLA fuses, instead of the
+    reference's custom CUDA im2col."""
+    x = ensure_tensor(x)
+    offset = ensure_tensor(offset)
+    weight = ensure_tensor(weight)
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("deform_conv2d: groups > 1 not supported")
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    ts = [x, offset, weight]
+    if mask is not None:
+        ts.append(ensure_tensor(mask))
+    if bias is not None:
+        ts.append(ensure_tensor(bias))
+
+    def prim(xa, off, w, *rest):
+        rest = list(rest)
+        b_arr = rest.pop() if bias is not None else None
+        m_arr = rest.pop() if mask is not None else None
+        B, C, H, W = xa.shape
+        Cout, Cin, KH, KW = w.shape
+        OH = (H + 2 * ph - dh * (KH - 1) - 1) // sh + 1
+        OW = (W + 2 * pw - dw * (KW - 1) - 1) // sw + 1
+        xp = jnp.pad(xa, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        Hp, Wp = H + 2 * ph, W + 2 * pw
+        # base sampling grid per output position and tap: [OH,OW,KH,KW]
+        oy = jnp.arange(OH) * sh
+        ox = jnp.arange(OW) * sw
+        ky = jnp.arange(KH) * dh
+        kx = jnp.arange(KW) * dw
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]
+        # offsets: [B, 2*KH*KW, OH, OW] -> dy/dx [B,OH,OW,KH,KW]
+        offr = off.reshape(B, KH * KW, 2, OH, OW)
+        dy = jnp.moveaxis(offr[:, :, 0], 1, -1).reshape(B, OH, OW, KH, KW)
+        dx = jnp.moveaxis(offr[:, :, 1], 1, -1).reshape(B, OH, OW, KH, KW)
+        sy = base_y[None] + dy
+        sx = base_x[None] + dx
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = sy - y0
+        wx = sx - x0
+        def tap(yy, xx):
+            # per-tap validity: out-of-bound taps contribute ZERO (reference
+            # DmcnIm2colBilinear semantics), not the clamped edge pixel
+            valid = ((yy >= 0) & (yy <= Hp - 1) &
+                     (xx >= 0) & (xx <= Wp - 1))
+            yi = jnp.clip(yy.astype(jnp.int32), 0, Hp - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, Wp - 1)
+            # gather per batch: [B,C,OH,OW,KH,KW]
+            vals = jax.vmap(lambda img, yb, xb: img[:, yb, xb])(xp, yi, xi)
+            return vals * valid[:, None]
+        v00 = tap(y0, x0)
+        v01 = tap(y0, x0 + 1)
+        v10 = tap(y0 + 1, x0)
+        v11 = tap(y0 + 1, x0 + 1)
+        wy_ = wy[:, None]
+        wx_ = wx[:, None]
+        sampled = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_ +
+                   v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+        if m_arr is not None:          # v2 modulation [B, KH*KW, OH, OW]
+            mm = jnp.moveaxis(m_arr.reshape(B, KH * KW, OH, OW), 1, -1)
+            mm = mm.reshape(B, OH, OW, KH, KW)
+            sampled = sampled * mm[:, None]
+        # contract (Cin, KH, KW) with the kernel: -> [B, Cout, OH, OW]
+        out = jnp.einsum("bchwyx,ocyx->bohw", sampled, w)
+        if b_arr is not None:
+            out = out + b_arr[None, :, None, None]
+        return out
+
+    return apply(prim, *ts, op_name="deform_conv2d")
