@@ -26,7 +26,7 @@ import numpy as np
 V5E_BF16_PEAK = 197e12
 
 
-def _timed_steps(step, args, iters=10, warmup=3):
+def _timed_steps(step, args, iters=15, warmup=4):
     loss = step(*args)
     float(loss)
     for _ in range(warmup - 1):
@@ -46,7 +46,7 @@ def bench_gpt2():
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
     paddle.seed(0)
-    batch, seq = 8, 1024
+    batch, seq = 16, 1024
     cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
                     intermediate_size=3072, max_position_embeddings=seq,
                     hidden_dropout=0.0, attention_dropout=0.0, recompute=False)
