@@ -54,6 +54,7 @@ from paddle_tpu.hapi.model import Model  # noqa: F401
 from paddle_tpu.distributed.parallel_wrappers import DataParallel  # noqa: F401
 from paddle_tpu.hapi import summary  # noqa: F401
 from paddle_tpu import sparse  # noqa: F401
+from paddle_tpu import inference  # noqa: F401
 
 from paddle_tpu.nn.functional.common import linear  # noqa: F401  (paddle exposes it)
 

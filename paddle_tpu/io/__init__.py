@@ -260,28 +260,54 @@ def default_collate_fn(batch):
     return batch
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate_fn):
+def _to_np_tree(o):
+    if isinstance(o, Tensor):
+        return o.numpy()
+    if isinstance(o, (list, tuple)):
+        return type(o)(_to_np_tree(v) for v in o)
+    if isinstance(o, dict):
+        return {k: _to_np_tree(v) for k, v in o.items()}
+    return o
+
+
+def _produce_loop(dataset, index_queue, collate_fn, put):
+    """Shared worker body; `put(seq, batch_or_None, exc_or_None)` is the
+    transport (mp.Queue or native shm ring)."""
     while True:
         item = index_queue.get()
         if item is None:
             break
         seq, indices = item
         try:
-            samples = [dataset[i] for i in indices]
-            batch = collate_fn(samples)
-
-            def to_np(o):
-                if isinstance(o, Tensor):
-                    return o.numpy()
-                if isinstance(o, (list, tuple)):
-                    return type(o)(to_np(v) for v in o)
-                if isinstance(o, dict):
-                    return {k: to_np(v) for k, v in o.items()}
-                return o
-
-            data_queue.put((seq, to_np(batch), None))
+            batch = collate_fn([dataset[i] for i in indices])
+            put(seq, _to_np_tree(batch), None)
         except Exception as e:  # propagate worker errors to the main process
-            data_queue.put((seq, None, e))
+            put(seq, None, e)
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn):
+    _produce_loop(dataset, index_queue, collate_fn,
+                  lambda seq, b, e: data_queue.put((seq, b, e)))
+
+
+def _worker_loop_shm(dataset, index_queue, shm_name, slot_bytes, collate_fn):
+    """Worker for the native shared-memory transport: batches are encoded
+    straight into the shm ring (no pickling through pipes)."""
+    import pickle as _p
+    from paddle_tpu.io.native_queue import ShmQueue, encode_batch
+    q = ShmQueue(slot_bytes=slot_bytes, name=shm_name, create=False)
+
+    def put(seq, batch, exc):
+        if exc is None:
+            q.push(encode_batch((seq, batch, None)))
+            return
+        try:
+            q.push(encode_batch((seq, None, _p.dumps(exc))))
+        except Exception:
+            q.push(encode_batch((seq, None,
+                                 _p.dumps(RuntimeError(repr(exc))))))
+
+    _produce_loop(dataset, index_queue, collate_fn, put)
 
 
 class DataLoader:
@@ -289,11 +315,14 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 shm_slot_bytes=64 << 20,
                  worker_init_fn=None, persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.shm_slot_bytes = shm_slot_bytes
         self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self.batch_size = batch_size
@@ -349,14 +378,44 @@ class DataLoader:
     def _iter_multiprocess(self):
         ctx = mp.get_context("fork")
         index_queue = ctx.Queue()
-        data_queue = ctx.Queue()
+        shmq = None
+        if self.use_shared_memory:
+            # native C++ shm ring (io/native/shm_queue.cpp); falls back to
+            # mp.Queue pickling when the toolchain/library is unavailable
+            try:
+                from paddle_tpu.io.native_queue import ShmQueue
+                shmq = ShmQueue(slots=max(self.num_workers *
+                                          self.prefetch_factor, 4),
+                                slot_bytes=self.shm_slot_bytes)
+            except Exception:
+                shmq = None
+        data_queue = ctx.Queue() if shmq is None else None
         workers = []
         for _ in range(self.num_workers):
-            w = ctx.Process(target=_worker_loop,
-                            args=(self.dataset, index_queue, data_queue,
-                                  self.collate_fn), daemon=True)
+            if shmq is not None:
+                w = ctx.Process(
+                    target=_worker_loop_shm,
+                    args=(self.dataset, index_queue, shmq.name,
+                          shmq.slot_bytes, self.collate_fn), daemon=True)
+            else:
+                w = ctx.Process(target=_worker_loop,
+                                args=(self.dataset, index_queue, data_queue,
+                                      self.collate_fn), daemon=True)
             w.start()
             workers.append(w)
+
+        def get_result():
+            if shmq is None:
+                return data_queue.get(
+                    timeout=self.timeout if self.timeout else None)
+            from paddle_tpu.io.native_queue import decode_batch
+            seq, data, err = decode_batch(shmq.pop(
+                timeout=self.timeout if self.timeout else None))
+            if err is not None:
+                import pickle as _p
+                err = _p.loads(err)
+            return seq, data, err
+
         try:
             batches = list(self.batch_sampler)
             n = len(batches)
@@ -375,8 +434,7 @@ class DataLoader:
                     next_yield += 1
                 if next_yield >= n:
                     break
-                seq, data, err = data_queue.get(
-                    timeout=self.timeout if self.timeout else None)
+                seq, data, err = get_result()
                 if err is not None:
                     raise err
                 inflight -= 1
@@ -400,10 +458,17 @@ class DataLoader:
         finally:
             for _ in workers:
                 index_queue.put(None)
+            if shmq is not None:
+                # close FIRST so pushers blocked on a full ring wake up and
+                # exit — SIGKILLing a worker mid-push would leave the
+                # process-shared mutex locked forever
+                shmq.close()
             for w in workers:
                 w.join(timeout=1)
                 if w.is_alive():
                     w.terminate()
+            if shmq is not None:
+                shmq.release()
 
 
 def get_worker_info():

@@ -63,9 +63,24 @@ def save(layer, path, input_spec=None, **configs):
                     for k, t in state.items():
                         t._data = saved[k]
 
-            args = [jax.ShapeDtypeStruct(
-                tuple(1 if d == -1 else d for d in s.shape),
-                np.dtype(dtype_mod.convert_dtype(s.dtype))) for s in specs]
+            # dynamic (None/-1) dims export as SYMBOLIC dimensions so the
+            # artifact serves any batch size (ref: the Program artifact keeps
+            # -1 dims too); shared scope so equal names unify across inputs
+            scope = jax_export.SymbolicScope()
+            args = []
+            for i, s in enumerate(specs):
+                if any(d == -1 for d in s.shape):
+                    # position-keyed names (d0, d1, ...) so the SAME dynamic
+                    # dim position unifies ACROSS inputs in the shared scope
+                    # (inputs x[None,8] and y[None,1] must share one batch sym)
+                    spec_str = ", ".join(
+                        f"d{j}" if d == -1 else str(d)
+                        for j, d in enumerate(s.shape))
+                    shape = jax_export.symbolic_shape(spec_str, scope=scope)
+                else:
+                    shape = s.shape
+                args.append(jax.ShapeDtypeStruct(
+                    shape, np.dtype(dtype_mod.convert_dtype(s.dtype))))
             exp = jax_export.export(jax.jit(pure_forward))(
                 {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
                  for k, v in params.items()}, *args)
@@ -112,6 +127,7 @@ class TranslatedLayer(Layer):
 def load(path, **configs):
     state = fio.load(path + ".pdiparams")
     exported = None
+    meta = {}
     model_path = path + ".pdmodel"
     if os.path.exists(model_path):
         with open(model_path, "rb") as f:
@@ -123,4 +139,6 @@ def load(path, **configs):
                     exported = jax_export.deserialize(blob)
                 except Exception:
                     exported = None
-    return TranslatedLayer(state, exported)
+    layer = TranslatedLayer(state, exported)
+    layer._meta = meta
+    return layer

@@ -1,0 +1,48 @@
+"""Inference tower tests (ref AnalysisPredictor: load artifact, zero-copy run,
+output parity with the source model)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.save_load import InputSpec
+
+
+def _save_model(tmp_path):
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    model.eval()
+    prefix = str(tmp_path / "deploy")
+    paddle.jit.save(model, prefix,
+                    input_spec=[InputSpec([None, 8], "float32")])
+    return model, prefix
+
+
+def test_predictor_matches_source(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    model, prefix = _save_model(tmp_path)
+    config = Config(prefix + ".pdmodel", prefix + ".pdiparams")
+    config.enable_memory_optim()
+    predictor = create_predictor(config)
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 8).astype(np.float32)
+    names = predictor.get_input_names()
+    predictor.get_input_handle(names[0]).copy_from_cpu(x)
+    assert predictor.run()
+    out_names = predictor.get_output_names()
+    out = predictor.get_output_handle(out_names[0]).copy_to_cpu()
+    ref = model(paddle.to_tensor(x))
+    np.testing.assert_allclose(out, np.asarray(ref._data),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_executable_cache(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    _, prefix = _save_model(tmp_path)
+    predictor = create_predictor(Config(prefix))
+    rng = np.random.RandomState(1)
+    predictor.run([rng.randn(2, 8).astype(np.float32)])
+    assert len(predictor._compiled) == 1
+    predictor.run([rng.randn(2, 8).astype(np.float32)])
+    assert len(predictor._compiled) == 1          # cache hit, no recompile
+    predictor.try_shrink_memory()
+    assert len(predictor._compiled) == 0

@@ -1,0 +1,121 @@
+"""Native shared-memory DataLoader transport (the C++ data-pipeline core,
+SURVEY §7 native component #3)."""
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io.native_queue import (
+    ShmQueue, encode_batch, decode_batch, get_lib)
+
+pytestmark = pytest.mark.skipif(get_lib() is None,
+                                reason="native toolchain unavailable")
+
+
+class TestShmQueue:
+    def test_roundtrip_same_process(self):
+        q = ShmQueue(slots=4, slot_bytes=1 << 20)
+        try:
+            q.push(b"hello")
+            q.push(b"world")
+            assert q.qsize() == 2
+            assert bytes(q.pop()) == b"hello"
+            assert bytes(q.pop()) == b"world"
+        finally:
+            q.close()
+            q.release()
+
+    def test_cross_process(self):
+        q = ShmQueue(slots=4, slot_bytes=1 << 20)
+
+        def producer(name, slot_bytes):
+            child = ShmQueue(slot_bytes=slot_bytes, name=name, create=False)
+            for i in range(10):
+                child.push(f"msg{i}".encode())
+
+        p = mp.get_context("fork").Process(
+            target=producer, args=(q.name, q.slot_bytes))
+        p.start()
+        try:
+            got = [bytes(q.pop()).decode() for _ in range(10)]
+            assert got == [f"msg{i}" for i in range(10)]
+        finally:
+            p.join(timeout=10)
+            q.close()
+            q.release()
+
+    def test_oversize_payload_raises(self):
+        q = ShmQueue(slots=2, slot_bytes=128)
+        try:
+            with pytest.raises(ValueError, match="slot size"):
+                q.push(b"x" * 1024)
+        finally:
+            q.close()
+            q.release()
+
+    def test_closed_drained_raises_eof(self):
+        q = ShmQueue(slots=2, slot_bytes=128)
+        q.push(b"a")
+        q.close()
+        assert bytes(q.pop()) == b"a"     # drain after close
+        with pytest.raises(EOFError):
+            q.pop()
+        q.release()
+
+
+class TestBatchCodec:
+    def test_nested_structures(self):
+        rng = np.random.RandomState(0)
+        batch = {
+            "x": rng.randn(4, 3).astype(np.float32),
+            "meta": [rng.randint(0, 9, 4), ("tag", 1.5)],
+            "pair": (rng.randn(2).astype(np.float64), None),
+        }
+        out = decode_batch(encode_batch(batch))
+        np.testing.assert_array_equal(out["x"], batch["x"])
+        np.testing.assert_array_equal(out["meta"][0], batch["meta"][0])
+        assert out["meta"][1] == ("tag", 1.5)
+        np.testing.assert_array_equal(out["pair"][0], batch["pair"][0])
+        assert out["pair"][1] is None
+
+
+class _SquareDs(Dataset):
+    def __init__(self, n=64):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return (np.full((3,), i, np.float32),
+                np.array([i * i], np.int64))
+
+
+class TestDataLoaderShm:
+    def test_multiworker_shm_delivers_all_batches_in_order(self):
+        ds = _SquareDs()
+        dl = DataLoader(ds, batch_size=8, num_workers=2, shuffle=False,
+                        use_shared_memory=True)
+        seen_x, seen_y = [], []
+        for xb, yb in dl:
+            seen_x.append(np.asarray(xb._data))
+            seen_y.append(np.asarray(yb._data))
+        x = np.concatenate(seen_x)[:, 0]
+        y = np.concatenate(seen_y).reshape(-1)
+        np.testing.assert_array_equal(x, np.arange(64, dtype=np.float32))
+        np.testing.assert_array_equal(y, np.arange(64) ** 2)
+
+    def test_worker_error_propagates(self):
+        class Bad(_SquareDs):
+            def __getitem__(self, i):
+                if i == 13:
+                    raise RuntimeError("boom-13")
+                return super().__getitem__(i)
+
+        dl = DataLoader(Bad(), batch_size=8, num_workers=2,
+                        use_shared_memory=True)
+        with pytest.raises(RuntimeError, match="boom-13"):
+            for _ in dl:
+                pass
