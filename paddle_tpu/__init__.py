@@ -55,6 +55,9 @@ from paddle_tpu.distributed.parallel_wrappers import DataParallel  # noqa: F401
 from paddle_tpu.hapi import summary  # noqa: F401
 from paddle_tpu import sparse  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
+from paddle_tpu import audio  # noqa: F401
+from paddle_tpu import quantization  # noqa: F401
+from paddle_tpu import utils  # noqa: F401
 
 from paddle_tpu.nn.functional.common import linear  # noqa: F401  (paddle exposes it)
 

@@ -1,0 +1,8 @@
+"""paddle.audio (ref: `python/paddle/audio` — spectrogram/MFCC features).
+
+Pure-jnp DSP: STFT via framing + rfft (XLA-compiled; the reference wraps
+pocketfft), mel filterbank, DCT-II MFCC. Layers live in
+``paddle.audio.features`` with the reference's class names.
+"""
+from paddle_tpu.audio import features  # noqa: F401
+from paddle_tpu.audio import functional  # noqa: F401

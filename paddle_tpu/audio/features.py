@@ -1,0 +1,86 @@
+"""paddle.audio.features (ref `python/paddle/audio/features/layers.py`)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.audio import functional as AF
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length
+        self.win_length = win_length
+        self.window = window
+        self.power = power
+        self.center = center
+
+    def forward(self, x):
+        return AF.stft_power(x, self.n_fft, self.hop_length, self.win_length,
+                             self.window, self.center, self.power)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, n_mels=64, f_min=50.0,
+                 f_max=None, htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spec = Spectrogram(n_fft, hop_length, win_length, window,
+                                 power, center)
+        self._fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min,
+                                              f_max, htk, norm)
+
+    def forward(self, x):
+        spec = self._spec(x)                          # [..., bins, frames]
+        fb = self._fbank
+
+        def prim(s):
+            return jnp.einsum("mf,...ft->...mt", jnp.asarray(fb), s)
+
+        return apply(prim, spec, op_name="mel_spectrogram")
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", center=True, n_mels=64, f_min=50.0,
+                 f_max=None, htk=False, norm="slaney", ref_value=1.0,
+                 amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                   2.0, center, n_mels, f_min, f_max, htk,
+                                   norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self._mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", center=True, n_mels=64,
+                 f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._logmel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, center, n_mels, f_min,
+            f_max, htk, norm, ref_value, amin, top_db)
+        self._dct = AF.create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        logmel = self._logmel(x)                      # [..., mels, frames]
+        dct = self._dct
+
+        def prim(s):
+            return jnp.einsum("mk,...mt->...kt", jnp.asarray(dct), s)
+
+        return apply(prim, logmel, op_name="mfcc")

@@ -1,0 +1,118 @@
+"""paddle.audio.functional (ref `python/paddle/audio/functional/`)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.ops.common import ensure_tensor
+
+
+def hz_to_mel(f, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+    f = np.asarray(f, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(f / min_log_hz) / logstep, mels)
+
+
+def mel_to_hz(m, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+    m = np.asarray(m, np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """[n_mels, n_fft//2 + 1] mel filterbank (ref compute_fbank_matrix)."""
+    f_max = f_max or sr / 2.0
+    fft_freqs = np.linspace(0, sr / 2.0, n_fft // 2 + 1)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fdiff = np.diff(hz_pts)
+    ramps = hz_pts[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2: n_mels + 2] - hz_pts[:n_mels])
+        fb *= enorm[:, None]
+    return fb.astype(np.float32)
+
+
+def get_window(window, win_length):
+    n = np.arange(win_length)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * n / win_length)
+    elif window in ("hamming",):
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * n / win_length)
+    elif window in ("ones", "rect", "boxcar", None):
+        w = np.ones(win_length)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return w.astype(np.float32)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """[n_mels, n_mfcc] DCT-II basis (ref create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k[None, :]) * 2.0
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(1.0 / (2.0 * n_mels))
+    return dct.astype(np.float32)
+
+
+def stft_power(x, n_fft=512, hop_length=None, win_length=None, window="hann",
+               center=True, power=2.0):
+    """[..., T] -> [..., n_fft//2+1, frames] power spectrogram."""
+    x = ensure_tensor(x)
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    w = get_window(window, wl)
+    if wl < n_fft:
+        w = np.pad(w, (0, n_fft - wl))
+
+    def prim(a):
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode="reflect")
+        T = a.shape[-1]
+        n_frames = 1 + (T - n_fft) // hop
+        idx = (jnp.arange(n_frames)[:, None] * hop +
+               jnp.arange(n_fft)[None, :])
+        frames = a[..., idx] * jnp.asarray(w)        # [..., frames, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1)         # [..., frames, bins]
+        mag = jnp.abs(spec) ** power
+        return jnp.swapaxes(mag, -1, -2)             # [..., bins, frames]
+
+    return apply(prim, x, op_name="spectrogram")
+
+
+def power_to_db(x, ref_value=1.0, amin=1e-10, top_db=80.0):
+    x = ensure_tensor(x)
+
+    def prim(a):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(a, amin))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+
+    return apply(prim, x, op_name="power_to_db")

@@ -27,3 +27,4 @@ from paddle_tpu.distributed import sharding  # noqa: F401
 from paddle_tpu.distributed.spawn import spawn  # noqa: F401
 from paddle_tpu.distributed.checkpoint import (  # noqa: F401
     save_sharded, load_sharded, async_save)
+from paddle_tpu.distributed import auto_parallel  # noqa: F401

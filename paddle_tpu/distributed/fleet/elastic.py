@@ -1,0 +1,86 @@
+"""Elastic / fault detection.
+
+Counterpart of the reference `ElasticManager`
+(`python/paddle/distributed/fleet/elastic/manager.py:126`): etcd leases +
+watches detecting dead hosts and rebuilding the job. TPU reality check
+(SURVEY §5.3/§7 hard-part #7): slices cannot add/remove single hosts freely,
+so elasticity degrades to FAULT DETECTION + whole-pod restart from the latest
+checkpoint — which is what this implements, file-heartbeat based (no etcd
+dependency; the launch controller is the restart authority).
+
+- workers: ``start_heartbeat(path)`` (init_parallel_env starts it
+  automatically when the launcher sets PADDLE_HEARTBEAT_FILE);
+- controller: ``ElasticManager.dead_workers()`` reports ranks whose heartbeat
+  went stale; the launch watch loop treats staleness like a crash and applies
+  its restart policy (--max_restarts).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+def start_heartbeat(path, interval=2.0):
+    """Touch `path` every `interval` seconds from a daemon thread."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def beat():
+        while True:
+            try:
+                with open(path, "w") as f:
+                    f.write(str(time.time()))
+            except OSError:
+                pass
+            time.sleep(interval)
+
+    t = threading.Thread(target=beat, daemon=True, name="paddle-heartbeat")
+    t.start()
+    return t
+
+
+class ElasticManager:
+    """Controller-side staleness watcher (ref `manager.py:126` liveness role;
+    np ranges / scale-up have no TPU-slice analog and are not pretended)."""
+
+    def __init__(self, heartbeat_dir, world_size, timeout=30.0,
+                 grace_period=60.0):
+        self.dir = heartbeat_dir
+        self.world_size = world_size
+        self.timeout = timeout
+        self._start = time.time()
+        self.grace = grace_period
+
+    def path_for(self, rank):
+        return os.path.join(self.dir, f"heartbeat.{rank}")
+
+    def reset(self):
+        """Called by the controller before a pod restart: old heartbeat files
+        must not instantly re-flag the fresh workers as stale, and the grace
+        window restarts (new workers need import/init time)."""
+        for rank in range(self.world_size):
+            try:
+                os.remove(self.path_for(rank))
+            except OSError:
+                pass
+        self._start = time.time()
+
+    def dead_workers(self):
+        """Ranks whose heartbeat is stale. Within the startup grace period a
+        missing file is not a death (workers may still be importing jax)."""
+        now = time.time()
+        dead = []
+        for rank in range(self.world_size):
+            p = self.path_for(rank)
+            try:
+                age = now - os.path.getmtime(p)
+            except OSError:
+                if now - self._start > self.grace:
+                    dead.append(rank)
+                continue
+            if age > self.timeout:
+                dead.append(rank)
+        return dead
+
+    def healthy(self):
+        return not self.dead_workers()

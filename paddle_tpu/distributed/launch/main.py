@@ -71,11 +71,13 @@ class Pod:
     """Per-node process group + watch loop (ref Controller at
     `launch/controllers/controller.py:161`; PodWatcher restart semantics)."""
 
-    def __init__(self, containers, max_restarts=0, poll_interval=0.5):
+    def __init__(self, containers, max_restarts=0, poll_interval=0.5,
+                 elastic=None):
         self.containers = containers
         self.max_restarts = max_restarts
         self.poll_interval = poll_interval
         self.restarts = 0
+        self.elastic = elastic
 
     def run(self):
         for c in self.containers:
@@ -87,6 +89,19 @@ class Pod:
                     return 0
                 bad = [(c, code) for c, code in zip(self.containers, codes)
                        if code not in (None, 0)]
+                if not bad and self.elastic is not None:
+                    # heartbeat staleness counts as death (hung worker) —
+                    # ref ElasticManager liveness watch
+                    dead = self.elastic.dead_workers()
+                    live_ranks = [c.rank for c, code in
+                                  zip(self.containers, codes) if code is None]
+                    dead = [r for r in dead if r in live_ranks]
+                    if dead:
+                        sys.stderr.write(
+                            f"[launch] rank(s) {dead} heartbeat stale — "
+                            "treating as failed\n")
+                        bad = [(next(c for c in self.containers
+                                     if c.rank == dead[0]), "stale")]
                 if bad:
                     c0, code = bad[0]
                     sys.stderr.write(
@@ -99,6 +114,8 @@ class Pod:
                             f"({self.restarts}/{self.max_restarts})\n")
                         for c in self.containers:
                             c.terminate()
+                        if self.elastic is not None:
+                            self.elastic.reset()
                         for c in self.containers:
                             c.start()
                         continue
@@ -148,10 +165,18 @@ def build_pod(args, extra):
         })
         if args.backend:
             env["JAX_PLATFORMS"] = args.backend
+        if args.elastic_timeout:
+            env["PADDLE_HEARTBEAT_FILE"] = os.path.join(
+                args.log_dir, f"heartbeat.{rank}")
         cmd = [sys.executable, "-u"] + extra
         log = os.path.join(args.log_dir, f"workerlog.{rank}")
         containers.append(Container(rank, cmd, env, log))
-    return Pod(containers, max_restarts=args.max_restarts)
+    elastic = None
+    if args.elastic_timeout:
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        elastic = ElasticManager(args.log_dir, world,
+                                 timeout=args.elastic_timeout)
+    return Pod(containers, max_restarts=args.max_restarts, elastic=elastic)
 
 
 def launch(argv=None):
@@ -169,6 +194,10 @@ def launch(argv=None):
     parser.add_argument("--backend", default=None,
                         help="force JAX_PLATFORMS for workers (e.g. cpu for "
                              "multi-process simulation on one host)")
+    parser.add_argument("--elastic_timeout", type=float, default=0,
+                        help="heartbeat staleness (seconds) after which a "
+                             "hung worker counts as failed (0 = off); "
+                             "restarts follow --max_restarts")
     # split at the first non-flag token (the script): everything after belongs
     # to the training script — parse_known_args would otherwise steal flags
     # like `--backend` the user meant for their script
@@ -178,7 +207,7 @@ def launch(argv=None):
                       i == 0 or argv[i - 1] not in (
                           "--nnodes", "--node_rank", "--nproc_per_node",
                           "--master", "--log_dir", "--max_restarts",
-                          "--backend"))), len(argv))
+                          "--backend", "--elastic_timeout"))), len(argv))
     args = parser.parse_args(argv[:split])
     extra = argv[split:]
     if not extra:

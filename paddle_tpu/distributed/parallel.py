@@ -78,6 +78,10 @@ def init_parallel_env():
             num_processes=env.world_size,
             process_id=env.rank,
         )
+    hb = os.environ.get("PADDLE_HEARTBEAT_FILE")
+    if hb:
+        from paddle_tpu.distributed.fleet.elastic import start_heartbeat
+        start_heartbeat(hb)
     _initialized = True
     return env
 

@@ -54,6 +54,18 @@ inline uint8_t* slot_ptr(Handle* h, uint64_t idx) {
   return h->base + idx * (sizeof(uint64_t) + h->ctrl->slot_size);
 }
 
+// robust-aware lock: if the previous owner died, mark the state consistent
+// (the ring indices are only ever updated after the payload memcpy, so the
+// worst case of recovery is one lost in-flight slot, never corruption)
+inline int robust_lock(Ctrl* c) {
+  int rc = pthread_mutex_lock(&c->mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&c->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
 }  // namespace
 
 extern "C" {
@@ -79,6 +91,9 @@ void* shmq_create(const char* name, uint64_t slots, uint64_t slot_size) {
   pthread_mutexattr_t ma;
   pthread_mutexattr_init(&ma);
   pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  // robust: a worker SIGKILLed/OOM-killed mid-push must not deadlock the
+  // trainer — the next locker gets EOWNERDEAD and recovers
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
   pthread_mutex_init(&c->mu, &ma);
   pthread_condattr_t ca;
   pthread_condattr_init(&ca);
@@ -128,7 +143,7 @@ int shmq_push(void* hv, const void* data, uint64_t len) {
   Handle* h = (Handle*)hv;
   Ctrl* c = h->ctrl;
   if (len > c->slot_size) return -2;
-  pthread_mutex_lock(&c->mu);
+  robust_lock(c);
   while (c->count == c->slots && !c->closed)
     pthread_cond_wait(&c->not_full, &c->mu);
   if (c->closed) {
@@ -152,7 +167,7 @@ int shmq_push(void* hv, const void* data, uint64_t len) {
 int64_t shmq_pop_timed(void* hv, void* out, uint64_t cap, int64_t timeout_ms) {
   Handle* h = (Handle*)hv;
   Ctrl* c = h->ctrl;
-  pthread_mutex_lock(&c->mu);
+  robust_lock(c);
   if (timeout_ms < 0) {
     while (c->count == 0 && !c->closed)
       pthread_cond_wait(&c->not_empty, &c->mu);
@@ -203,7 +218,7 @@ uint64_t shmq_slot_size(void* hv) { return ((Handle*)hv)->ctrl->slot_size; }
 
 uint64_t shmq_count(void* hv) {
   Handle* h = (Handle*)hv;
-  pthread_mutex_lock(&h->ctrl->mu);
+  robust_lock(h->ctrl);
   uint64_t n = h->ctrl->count;
   pthread_mutex_unlock(&h->ctrl->mu);
   return n;
@@ -212,7 +227,7 @@ uint64_t shmq_count(void* hv) {
 void shmq_close(void* hv) {
   Handle* h = (Handle*)hv;
   Ctrl* c = h->ctrl;
-  pthread_mutex_lock(&c->mu);
+  robust_lock(c);
   c->closed = 1;
   pthread_cond_broadcast(&c->not_empty);
   pthread_cond_broadcast(&c->not_full);

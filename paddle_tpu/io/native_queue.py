@@ -25,9 +25,13 @@ _LIB_ERR = None
 
 
 def _build():
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC, "-lpthread",
+    # build to a unique temp path then atomically publish: concurrent ranks
+    # on one host must never CDLL a half-written .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC, "-lpthread",
            "-lrt"]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, _SO)
 
 
 def get_lib():
@@ -142,6 +146,10 @@ def encode_batch(obj) -> bytes:
 
     def strip(o):
         if isinstance(o, np.ndarray):
+            if o.dtype.hasobject or o.dtype.names is not None:
+                # object/structured dtypes can't ship as raw bytes — keep
+                # them pickled inside the skeleton (mp.Queue-equivalent)
+                return o
             arrays.append(np.ascontiguousarray(o))
             a = arrays[-1]
             return (_ARRAY, len(arrays) - 1, str(a.dtype), a.shape)

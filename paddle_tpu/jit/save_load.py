@@ -70,11 +70,13 @@ def save(layer, path, input_spec=None, **configs):
             args = []
             for i, s in enumerate(specs):
                 if any(d == -1 for d in s.shape):
-                    # position-keyed names (d0, d1, ...) so the SAME dynamic
-                    # dim position unifies ACROSS inputs in the shared scope
-                    # (inputs x[None,8] and y[None,1] must share one batch sym)
+                    # only the BATCH dim (axis 0) unifies across inputs
+                    # ("d0" shared) — other dynamic axes stay independent
+                    # per input (src/tgt sequence lengths must not be forced
+                    # equal), matching Paddle's independent -1 semantics
                     spec_str = ", ".join(
-                        f"d{j}" if d == -1 else str(d)
+                        ("d0" if j == 0 else f"i{i}_d{j}") if d == -1
+                        else str(d)
                         for j, d in enumerate(s.shape))
                     shape = jax_export.symbolic_shape(spec_str, scope=scope)
                 else:

@@ -1,0 +1,213 @@
+"""paddle.quantization (ref: `python/paddle/quantization` + `nn/quant` +
+`static/quantization`).
+
+TPU-native scope: quant-aware training (QAT) with abs-max fake quantizers
+(straight-through estimator gradients — the reference's
+`FakeQuanterWithAbsMaxObserver`), post-training quantization (PTQ) observers
+collecting abs-max ranges, and int8 weight conversion. The deployment side
+(int8 matmul epilogues) belongs to XLA/Pallas; these layers produce the
+scales it needs.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer import Layer
+import paddle_tpu.nn as nn
+
+__all__ = ["FakeQuanterWithAbsMaxObserver", "AbsmaxObserver", "QuantConfig",
+           "QAT", "PTQ", "quant_dequant", "convert_to_int8"]
+
+
+@jax.custom_vjp
+def _fake_quant(x, scale, bits=8):
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.round(jnp.clip(x / s * qmax, -qmax, qmax)) / qmax * s
+
+
+def _fq_fwd(x, scale, bits=8):
+    return _fake_quant(x, scale, bits), (x, scale)
+
+
+def _fq_bwd(res, g):
+    x, scale = res
+    # straight-through estimator, gated outside the clip range (ref
+    # fake_quantize_dequantize grad kernels)
+    mask = (jnp.abs(x) <= jnp.maximum(scale, 1e-8)).astype(g.dtype)
+    return g * mask, None, None
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def quant_dequant(x, scale, bits=8):
+    """Differentiable fake quant-dequant (STE)."""
+    from paddle_tpu.ops.common import ensure_tensor
+    x = ensure_tensor(x)
+    s = float(scale._data) if isinstance(scale, Tensor) else float(scale)
+    return apply(lambda a: _fake_quant(a, jnp.asarray(s, jnp.float32), bits),
+                 x, op_name="fake_quant_dequant")
+
+
+class AbsmaxObserver:
+    """PTQ range collector (ref observers in static/quantization)."""
+
+    def __init__(self, moving_rate=0.9):
+        self.moving_rate = moving_rate
+        self.scale = 0.0
+
+    def observe(self, arr):
+        m = float(np.max(np.abs(np.asarray(arr)))) if np.asarray(arr).size \
+            else 0.0
+        if self.scale == 0.0:
+            self.scale = m
+        else:
+            r = self.moving_rate
+            self.scale = r * self.scale + (1 - r) * m
+        return self.scale
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """ref `paddle.quantization.quanters.FakeQuanterWithAbsMaxObserver`:
+    tracks a moving abs-max scale during training and fake-quantizes with
+    STE gradients."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32"):
+        super().__init__()
+        self.bits = bit_length
+        self.observer = AbsmaxObserver(moving_rate)
+
+    def forward(self, x):
+        from paddle_tpu.core import tensor as tensor_mod
+        if self.training and not tensor_mod.in_capture() and \
+                not isinstance(x._data, jax.core.Tracer):
+            self.observer.observe(x._data)
+        scale = self.observer.scale or 1.0
+        return quant_dequant(x, scale, self.bits)
+
+    def scales(self):
+        return self.observer.scale
+
+
+class QuantConfig:
+    """ref `paddle.quantization.QuantConfig`."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._types = (nn.Linear, nn.Conv2D)
+
+    def add_type_config(self, types, activation=None, weight=None):
+        self._types = tuple(types)
+        if activation is not None:
+            self.activation = activation
+        if weight is not None:
+            self.weight = weight
+
+
+class _QuantedWrapper(Layer):
+    """Linear/Conv with fake-quantized weight + activation."""
+
+    def __init__(self, inner, config):
+        super().__init__()
+        self.inner = inner
+        self.a_quant = (config.activation() if config.activation
+                        else FakeQuanterWithAbsMaxObserver())
+        self.w_quant = (config.weight() if config.weight
+                        else FakeQuanterWithAbsMaxObserver())
+
+    def forward(self, x):
+        x = self.a_quant(x)
+        w = self.inner.weight
+        saved = w._data
+        try:
+            wq = self.w_quant(Tensor(saved, _internal=True))
+            # route the quantized weight through the inner layer's math while
+            # keeping the PARAMETER as the trainable leaf (STE passes grads)
+            self.inner.weight._data = wq._data
+            self.inner.weight._grad_node = wq._grad_node
+            self.inner.weight._out_slot = wq._out_slot
+            return self.inner(x)
+        finally:
+            self.inner.weight._data = saved
+            self.inner.weight._grad_node = None
+
+
+class QAT:
+    """Quant-aware training driver (ref `paddle.quantization.QAT`)."""
+
+    def __init__(self, config=None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=False):
+        def convert(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, self.config._types):
+                    layer._sub_layers[name] = _QuantedWrapper(
+                        sub, self.config)
+                else:
+                    convert(sub)
+            return layer
+
+        return convert(model)
+
+    def convert(self, model, inplace=False):
+        """Strip wrappers back to plain layers holding QUANTIZED weights
+        (deploy form; scales retained on the wrapper for the runtime)."""
+        def strip(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, _QuantedWrapper):
+                    inner = sub.inner
+                    inner.weight._write(_fake_quant(
+                        inner.weight._data,
+                        jnp.asarray(sub.w_quant.observer.scale or 1.0,
+                                    jnp.float32)))
+                    layer._sub_layers[name] = inner
+                else:
+                    strip(sub)
+            return layer
+
+        return strip(model)
+
+
+class PTQ:
+    """Post-training quantization: run calibration batches, then convert
+    (ref `static/quantization` PTQ flow)."""
+
+    def __init__(self, config=None):
+        self.config = config or QuantConfig()
+        self._qat = QAT(self.config)
+
+    def quantize(self, model, inplace=False):
+        m = self._qat.quantize(model, inplace)
+        m.eval()
+        # observers still collect during calibration forwards
+        for sub in _walk(m):
+            if isinstance(sub, _QuantedWrapper):
+                sub.a_quant.training = True
+                sub.w_quant.training = True
+        return m
+
+    def convert(self, model, inplace=False):
+        return self._qat.convert(model, inplace)
+
+
+def _walk(layer):
+    yield layer
+    for sub in layer._sub_layers.values():
+        yield from _walk(sub)
+
+
+def convert_to_int8(weight, scale=None, bits=8):
+    """Weight -> (int8 array, scale) for the serving runtime."""
+    arr = np.asarray(weight._data if isinstance(weight, Tensor) else weight)
+    qmax = 2 ** (bits - 1) - 1
+    s = scale or float(np.max(np.abs(arr))) or 1.0
+    q = np.clip(np.round(arr / s * qmax), -qmax, qmax).astype(np.int8)
+    return q, s

@@ -1,0 +1,7 @@
+"""paddle.utils (ref: `python/paddle/utils`)."""
+from paddle_tpu.utils import cpp_extension  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    return importlib.import_module(name)

@@ -1,0 +1,97 @@
+"""paddle.utils.cpp_extension — JIT-compiled custom C++ ops.
+
+Counterpart of the reference's custom-op toolchain
+(`python/paddle/utils/cpp_extension/` + `framework/custom_operator.cc`):
+users compile C++ sources into a shared library and call the symbols as ops.
+TPU-native shape: the C ABI is bound with ctypes (no pybind11 in this image),
+and the returned module exposes (a) raw ctypes symbols and (b)
+``as_op(name, ...)`` which wraps a C kernel operating on contiguous float
+buffers as a paddle op with a numpy-roundtrip host callback — host-side custom
+kernels, the role the reference's CPU custom ops play. Device-side custom
+kernels are Pallas's job, not C++'s (SURVEY §7 native component #2).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+
+class CppExtensionModule:
+    def __init__(self, lib, name):
+        self._lib = lib
+        self._name = name
+
+    def __getattr__(self, item):
+        return getattr(self._lib, item)
+
+    def as_op(self, symbol, out_shape_fn=None, dtype=np.float32):
+        """Wrap `void symbol(const float* in, float* out, int64 n)` (or an
+        (in, out, n) variant matching `dtype`) as an eager paddle op via a
+        host callback. Gradients are not derived (same as reference custom
+        ops without a grad kernel)."""
+        fn = getattr(self._lib, symbol)
+        fn.restype = None
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+
+        def op(x):
+            from paddle_tpu.core.tensor import Tensor
+            arr = np.ascontiguousarray(
+                np.asarray(x._data if isinstance(x, Tensor) else x, dtype))
+            shape = (out_shape_fn(arr.shape) if out_shape_fn
+                     else arr.shape)
+            out = np.empty(shape, dtype)
+            fn(arr.ctypes.data_as(ctypes.c_void_p),
+               out.ctypes.data_as(ctypes.c_void_p),
+               ctypes.c_int64(arr.size))
+            return Tensor(out, _internal=True)
+
+        op.__name__ = symbol
+        return op
+
+
+def load(name, sources, extra_cxx_flags=None, build_directory=None,
+         verbose=False, **kwargs):
+    """Compile `sources` into <build_directory>/<name>.so and load it.
+    ref: `cpp_extension.load` (JIT path)."""
+    build_directory = build_directory or os.path.join(
+        os.path.dirname(os.path.abspath(sources[0])), "build")
+    os.makedirs(build_directory, exist_ok=True)
+    so = os.path.join(build_directory, f"lib{name}.so")
+    srcs_mtime = max(os.path.getmtime(s) for s in sources)
+    if not os.path.exists(so) or os.path.getmtime(so) < srcs_mtime:
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-o", so] + list(sources)
+               + (extra_cxx_flags or []))
+        if verbose:
+            print("cpp_extension:", " ".join(cmd))
+        res = subprocess.run(cmd, capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{res.stderr}")
+    return CppExtensionModule(ctypes.CDLL(so), name)
+
+
+class CppExtension:
+    """setup()-style descriptor (ref CppExtension); compiled via load()."""
+
+    def __init__(self, sources, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*a, **k):
+    raise NotImplementedError(
+        "CUDA custom kernels have no TPU analog — write device kernels in "
+        "Pallas (jax.experimental.pallas); host-side C++ ops go through "
+        "cpp_extension.load")
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Eager-build the extensions (the reference delegates to setuptools;
+    here load() compiles immediately and returns the modules)."""
+    mods = []
+    for ext in ext_modules or []:
+        mods.append(load(name or "custom_ext", ext.sources, **ext.kwargs))
+    return mods[0] if len(mods) == 1 else mods

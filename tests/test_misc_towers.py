@@ -1,0 +1,163 @@
+"""Auto-parallel Engine, elastic, cpp_extension, audio, quantization."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.mesh import get_mesh, set_mesh
+
+
+@pytest.fixture(autouse=True)
+def _restore_mesh():
+    prev = get_mesh()
+    yield
+    set_mesh(prev)
+
+
+class TestAutoParallelEngine:
+    def test_plan_mesh(self):
+        from paddle_tpu.distributed.auto_parallel import plan_mesh, Strategy
+        assert plan_mesh(8) == dict(dp=8, mp=1, sp=1)
+        s = Strategy()
+        s.mp = 2
+        assert plan_mesh(8, s) == dict(dp=4, mp=2, sp=1)
+        assert plan_mesh(8, n_params=3e9) == dict(dp=4, mp=2, sp=1)
+        with pytest.raises(ValueError):
+            s2 = Strategy()
+            s2.mp = 3
+            plan_mesh(8, s2)
+
+    def test_engine_fit_evaluate_save_load(self, tmp_path):
+        from paddle_tpu.distributed.auto_parallel import Engine
+        set_mesh(None)
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        engine = Engine(model=model, loss=nn.CrossEntropyLoss(),
+                        optimizer=opt)
+        engine.prepare()
+        assert engine._mesh is not None
+        rng = np.random.RandomState(0)
+        X = rng.randn(16, 8).astype(np.float32)
+        Y = rng.randint(0, 4, 16).astype(np.int64)
+        data = [(X, Y)] * 8
+        hist = engine.fit(data, epochs=3)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        ev = engine.evaluate([(X, Y)])
+        assert np.isfinite(ev["loss"])
+        engine.save(str(tmp_path / "engine_ckpt"))
+        w_before = np.asarray(model.state_dict()
+                              [list(model.state_dict())[0]]._data).copy()
+        engine.load(str(tmp_path / "engine_ckpt"))
+        w_after = np.asarray(model.state_dict()
+                             [list(model.state_dict())[0]]._data)
+        np.testing.assert_array_equal(w_before, w_after)
+
+
+class TestElastic:
+    def test_heartbeat_and_staleness(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticManager, start_heartbeat)
+        mgr = ElasticManager(str(tmp_path), world_size=2, timeout=0.5,
+                             grace_period=0.1)
+        start_heartbeat(mgr.path_for(0), interval=0.1)
+        time.sleep(0.3)
+        # rank 0 beats; rank 1 missing after grace -> dead
+        assert 0 not in mgr.dead_workers()
+        assert 1 in mgr.dead_workers()
+        # stale file counts as dead
+        with open(mgr.path_for(1), "w") as f:
+            f.write("x")
+        os.utime(mgr.path_for(1), (time.time() - 100, time.time() - 100))
+        assert 1 in mgr.dead_workers()
+
+
+class TestCppExtension:
+    def test_load_and_call(self, tmp_path):
+        from paddle_tpu.utils import cpp_extension
+        src = tmp_path / "myop.cpp"
+        src.write_text("""
+#include <cstdint>
+extern "C" void doubler(const float* in, float* out, int64_t n) {
+  for (int64_t i = 0; i < n; i++) out[i] = in[i] * 2.0f;
+}
+""")
+        mod = cpp_extension.load("myop", [str(src)])
+        op = mod.as_op("doubler")
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        out = op(x)
+        np.testing.assert_array_equal(
+            np.asarray(out._data),
+            np.arange(6, dtype=np.float32).reshape(2, 3) * 2)
+
+
+class TestAudio:
+    def test_spectrogram_parseval_and_shapes(self):
+        from paddle_tpu.audio.features import (
+            Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)
+        sr = 8000
+        t = np.arange(sr, dtype=np.float32) / sr
+        sig = np.sin(2 * np.pi * 440.0 * t)
+        x = paddle.to_tensor(sig[None])
+        spec = Spectrogram(n_fft=256, hop_length=128)(x)
+        assert tuple(spec.shape)[1] == 129          # n_fft//2+1 bins
+        s = np.asarray(spec._data)[0]
+        # 440 Hz -> bin 440/ (8000/256) = 14.08: peak lands at bin 14
+        assert np.argmax(s.mean(axis=1)) == 14
+        mel = MelSpectrogram(sr=sr, n_fft=256, hop_length=128, n_mels=32)(x)
+        assert tuple(mel.shape)[1] == 32
+        logmel = LogMelSpectrogram(sr=sr, n_fft=256, hop_length=128,
+                                   n_mels=32)(x)
+        assert np.isfinite(np.asarray(logmel._data)).all()
+        mfcc = MFCC(sr=sr, n_mfcc=13, n_fft=256, hop_length=128,
+                    n_mels=32)(x)
+        assert tuple(mfcc.shape)[1] == 13
+
+
+class TestQuantization:
+    def test_fake_quant_ste_grad(self):
+        from paddle_tpu.quantization import quant_dequant
+        x = paddle.to_tensor(np.array([0.1, 0.5, 2.0], np.float32),
+                             stop_gradient=False)
+        out = quant_dequant(x, scale=1.0)
+        out.sum().backward()
+        g = np.asarray(x.grad._data)
+        # inside range: STE identity; 2.0 > scale: gradient gated to 0
+        np.testing.assert_array_equal(g, [1.0, 1.0, 0.0])
+        o = np.asarray(out._data)
+        assert abs(o[1] - 0.5) < 1 / 127 + 1e-6     # quantized to 8-bit grid
+
+    def test_qat_roundtrip_trains(self):
+        from paddle_tpu.quantization import QAT, QuantConfig
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        model = QAT(QuantConfig()).quantize(model)
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        lf = nn.CrossEntropyLoss()
+        rng = np.random.RandomState(0)
+        X = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        Y = paddle.to_tensor(rng.randint(0, 4, 16).astype(np.int64))
+        losses = []
+        for _ in range(30):
+            loss = lf(model(X), Y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses[-3:]
+        deployed = QAT(QuantConfig()).convert(model)
+        out = deployed(X)
+        assert np.isfinite(np.asarray(out._data)).all()
+
+    def test_convert_to_int8(self):
+        from paddle_tpu.quantization import convert_to_int8
+        w = paddle.to_tensor(np.array([[0.5, -1.0], [0.25, 1.0]], np.float32))
+        q, s = convert_to_int8(w)
+        assert q.dtype == np.int8
+        np.testing.assert_allclose(q.astype(np.float32) / 127 * s,
+                                   np.asarray(w._data), atol=s / 100)
