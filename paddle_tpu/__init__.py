@@ -58,6 +58,10 @@ from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import audio  # noqa: F401
 from paddle_tpu import quantization  # noqa: F401
 from paddle_tpu import utils  # noqa: F401
+from paddle_tpu import fft  # noqa: F401
+from paddle_tpu import signal  # noqa: F401
+from paddle_tpu import geometric  # noqa: F401
+from paddle_tpu import text  # noqa: F401
 
 from paddle_tpu.nn.functional.common import linear  # noqa: F401  (paddle exposes it)
 

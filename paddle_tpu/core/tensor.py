@@ -458,7 +458,29 @@ def _to_array(data, dtype):
     if want is None and arr.dtype == np.float64 and not explicit_np:
         # match paddle.to_tensor: python float lists come in as f64 -> default dtype
         want = dtype_mod.get_default_dtype()
+    if np.issubdtype(arr.dtype if want is None else np.dtype(want),
+                     np.complexfloating) and not _complex_on_device():
+        # dev-tunnel backends reject complex transfers; pin to host CPU so the
+        # data survives (ops on it then run on the CPU backend)
+        return jax.device_put(arr.astype(want) if want is not None else arr,
+                              jax.devices("cpu")[0])
     return jnp.asarray(arr, want) if want is not None else jnp.asarray(arr)
+
+
+_COMPLEX_ON_DEVICE = None
+
+
+def _complex_on_device() -> bool:
+    global _COMPLEX_ON_DEVICE
+    if _COMPLEX_ON_DEVICE is None:
+        try:
+            from jax._src import xla_bridge
+            names = set(xla_bridge.backends().keys())
+        except Exception:
+            names = set()
+        _COMPLEX_ON_DEVICE = not (jax.default_backend() == "tpu"
+                                  and "axon" in names)
+    return _COMPLEX_ON_DEVICE
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):

@@ -1,0 +1,164 @@
+"""Graph learning primitives — ``paddle.geometric`` surface.
+
+Rebuild of the reference's geometric tower (``python/paddle/geometric/math.py``
+segment_sum/mean/min/max; ``message_passing/send_recv.py`` send_u_recv :26,
+send_ue_recv :143, send_uv :300; C++ kernels
+``paddle/phi/kernels/segment_pool_kernel.h``, ``graph_send_recv_kernel.h``).
+
+TPU design note: the reference's CUDA kernels do atomic scatter-reduce; here
+every reduce lowers to ``jax.ops.segment_*`` / ``.at[].add/max/min`` which XLA
+compiles to sorted-segment reductions — static output size is required, so the
+public API takes the same explicit sizes the reference threads through
+(`num_segments` / `out_size`), inferring eagerly when omitted.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.ops.common import ensure_tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "send_u_recv", "send_ue_recv", "send_uv",
+]
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    # eager inference (the reference's kernels read it off the data the same way)
+    return int(np.asarray(ids.numpy()).max()) + 1 if ids.shape[0] else 0
+
+
+def _segment(op_name, data, ids, num, combiner):
+    def fn(a, sid):
+        return combiner(a, sid, num)
+    return apply(fn, data, ids, op_name=op_name)
+
+
+def segment_sum(data, segment_ids, name=None, *, num_segments=None):
+    """Segment sum over the leading axis (paddle.geometric.segment_sum)."""
+    data, ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    num = _num_segments(ids, num_segments)
+    return _segment("segment_sum", data, ids,
+                    num, lambda a, s, n: jax.ops.segment_sum(a, s, num_segments=n))
+
+
+def segment_mean(data, segment_ids, name=None, *, num_segments=None):
+    """Segment mean (paddle.geometric.segment_mean); empty segments give 0."""
+    data, ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    num = _num_segments(ids, num_segments)
+
+    def mean(a, s, n):
+        tot = jax.ops.segment_sum(a, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((a.shape[0],), a.dtype), s, num_segments=n)
+        cnt = cnt.reshape((n,) + (1,) * (a.ndim - 1))
+        return tot / jnp.maximum(cnt, 1)
+
+    return _segment("segment_mean", data, ids, num, mean)
+
+
+def segment_min(data, segment_ids, name=None, *, num_segments=None):
+    """Segment min (paddle.geometric.segment_min); empty segments give 0."""
+    data, ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    num = _num_segments(ids, num_segments)
+
+    def smin(a, s, n):
+        out = jax.ops.segment_min(a, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((a.shape[0],), jnp.int32), s, num_segments=n)
+        mask = (cnt > 0).reshape((n,) + (1,) * (a.ndim - 1))
+        return jnp.where(mask, out, jnp.zeros_like(out))
+
+    return _segment("segment_min", data, ids, num, smin)
+
+
+def segment_max(data, segment_ids, name=None, *, num_segments=None):
+    """Segment max (paddle.geometric.segment_max); empty segments give 0."""
+    data, ids = ensure_tensor(data), ensure_tensor(segment_ids)
+    num = _num_segments(ids, num_segments)
+
+    def smax(a, s, n):
+        out = jax.ops.segment_max(a, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((a.shape[0],), jnp.int32), s, num_segments=n)
+        mask = (cnt > 0).reshape((n,) + (1,) * (a.ndim - 1))
+        return jnp.where(mask, out, jnp.zeros_like(out))
+
+    return _segment("segment_max", data, ids, num, smax)
+
+
+_REDUCERS = {
+    "sum": lambda m, d, n: jax.ops.segment_sum(m, d, num_segments=n),
+    "mean": None,  # composed below
+    "min": lambda m, d, n: jax.ops.segment_min(m, d, num_segments=n),
+    "max": lambda m, d, n: jax.ops.segment_max(m, d, num_segments=n),
+}
+
+
+def _reduce_msgs(msgs, dst, n, reduce_op):
+    if reduce_op == "mean":
+        tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype), dst,
+                                  num_segments=n)
+        return tot / jnp.maximum(cnt.reshape((n,) + (1,) * (msgs.ndim - 1)), 1)
+    out = _REDUCERS[reduce_op](msgs, dst, n)
+    if reduce_op in ("min", "max"):
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), jnp.int32), dst,
+                                  num_segments=n)
+        mask = (cnt > 0).reshape((n,) + (1,) * (msgs.ndim - 1))
+        out = jnp.where(mask, out, jnp.zeros_like(out))
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):
+    """Gather source-node features along edges and reduce at destinations
+    (paddle.geometric.send_u_recv; ref send_recv.py:26)."""
+    if reduce_op not in ("sum", "mean", "min", "max"):
+        raise ValueError(f"reduce_op should be sum/mean/min/max, got {reduce_op}")
+    x = ensure_tensor(x)
+    src, dst = ensure_tensor(src_index), ensure_tensor(dst_index)
+    n = int(out_size) if out_size is not None else x.shape[0]
+
+    def fn(a, s, d):
+        return _reduce_msgs(jnp.take(a, s, axis=0), d, n, reduce_op)
+
+    return apply(fn, x, src, dst, op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
+                 out_size=None, name=None):
+    """Combine source features with edge features, reduce at destinations
+    (paddle.geometric.send_ue_recv; ref send_recv.py:143)."""
+    if message_op not in ("add", "sub", "mul", "div"):
+        raise ValueError(f"message_op should be add/sub/mul/div, got {message_op}")
+    if reduce_op not in ("sum", "mean", "min", "max"):
+        raise ValueError(f"reduce_op should be sum/mean/min/max, got {reduce_op}")
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    src, dst = ensure_tensor(src_index), ensure_tensor(dst_index)
+    n = int(out_size) if out_size is not None else x.shape[0]
+    combine = {"add": jnp.add, "sub": jnp.subtract,
+               "mul": jnp.multiply, "div": jnp.divide}[message_op]
+
+    def fn(a, e, s, d):
+        return _reduce_msgs(combine(jnp.take(a, s, axis=0), e), d, n, reduce_op)
+
+    return apply(fn, x, y, src, dst, op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from source and destination node features
+    (paddle.geometric.send_uv; ref send_recv.py:300)."""
+    if message_op not in ("add", "sub", "mul", "div"):
+        raise ValueError(f"message_op should be add/sub/mul/div, got {message_op}")
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    src, dst = ensure_tensor(src_index), ensure_tensor(dst_index)
+    combine = {"add": jnp.add, "sub": jnp.subtract,
+               "mul": jnp.multiply, "div": jnp.divide}[message_op]
+
+    def fn(a, b, s, d):
+        return combine(jnp.take(a, s, axis=0), jnp.take(b, d, axis=0))
+
+    return apply(fn, x, y, src, dst, op_name="send_uv")

@@ -14,3 +14,6 @@ from paddle_tpu.nn.functional.attention import (  # noqa: F401
     scaled_dot_product_attention, sequence_mask,
     sequence_parallel_attention,
 )
+from paddle_tpu.nn.functional.vision import affine_grid, grid_sample  # noqa: F401
+from paddle_tpu.nn.functional.extension import gather_tree, temporal_shift  # noqa: F401
+from paddle_tpu.ops.random import gumbel_softmax  # noqa: F401

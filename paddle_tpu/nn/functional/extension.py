@@ -1,0 +1,67 @@
+"""Extension functionals (ref: `python/paddle/nn/functional/extension.py` —
+gather_tree, temporal_shift; C++ kernels `paddle/phi/kernels/gather_tree_kernel.h`,
+`temporal_shift_kernel.h`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.ops.common import ensure_tensor
+
+__all__ = ["gather_tree", "temporal_shift"]
+
+
+def gather_tree(ids, parents, name=None):
+    """Backtrace full beam-search predictions from per-step ids and parent
+    indices (paddle.nn.functional.gather_tree). ids/parents: [max_time, batch,
+    beam]. The reference kernel walks time backwards; here that walk is a
+    ``lax.scan`` in reversed time so it stays jittable."""
+    ids, parents = ensure_tensor(ids), ensure_tensor(parents)
+
+    def fn(i, p):
+        t, b, k = i.shape
+        batch_idx = jnp.arange(b)[:, None]
+
+        def step(beam, inputs):
+            idt, part = inputs          # [b, k] each, at time t
+            out = idt[batch_idx, beam]  # gather along beam
+            nxt = part[batch_idx, beam]
+            return nxt, out
+
+        init = jnp.broadcast_to(jnp.arange(k, dtype=p.dtype)[None, :], (b, k))
+        # walk from the last step to the first
+        _, outs = jax.lax.scan(step, init, (i[::-1], p[::-1]))
+        return outs[::-1]
+
+    return apply(fn, ids, parents, op_name="gather_tree")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """Temporal Shift Module (paddle.nn.functional.temporal_shift; ref
+    extension.py / `temporal_shift_op.cc`): shift a leading fraction of channels
+    one step back in time, the next fraction one step forward."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format should be NCHW or NHWC, got {data_format}")
+    x = ensure_tensor(x)
+    seg = int(seg_num)
+
+    def fn(a):
+        if data_format == "NHWC":
+            a = a.transpose(0, 3, 1, 2)
+        nt, c, h, w = a.shape
+        n = nt // seg
+        a = a.reshape(n, seg, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        pad = jnp.pad(a, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+        back = pad[:, 2:, :c1]                # out[t] = x[t+1]: shift back in time
+        fwd = pad[:, :seg, c1:c2]             # out[t] = x[t-1]: shift forward
+        keep = a[:, :, c2:]
+        out = jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = out.transpose(0, 2, 3, 1)
+        return out
+
+    return apply(fn, x, op_name="temporal_shift")

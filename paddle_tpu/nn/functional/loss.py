@@ -325,3 +325,172 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return apply(prim, log_probs, labels, input_lengths, label_lengths,
                  op_name="ctc_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """Two-class logistic loss over {-1, 1} labels
+    (paddle.nn.functional.soft_margin_loss; ref loss.py)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def prim(x, y):
+        return _reduce(jax.nn.softplus(-y.astype(x.dtype) * x), reduction)
+
+    return apply(prim, input, label, op_name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    """Multi-label one-vs-all BCE-with-logits averaged over classes
+    (paddle.nn.functional.multi_label_soft_margin_loss)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    ts = [input, label] + ([ensure_tensor(weight)] if weight is not None else [])
+
+    def prim(x, y, *w):
+        y = y.astype(x.dtype)
+        per = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            per = per * w[0]
+        return _reduce(jnp.mean(per, axis=-1), reduction)
+
+    return apply(prim, *ts, op_name="multi_label_soft_margin_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice coefficient loss for segmentation
+    (paddle.nn.functional.dice_loss; ref loss.py)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def prim(x, y):
+        num_classes = x.shape[-1]
+        oh = jax.nn.one_hot(y.squeeze(-1), num_classes, dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * oh, axis=red)
+        union = jnp.sum(x, axis=red) + jnp.sum(oh, axis=red)
+        return jnp.mean(1 - (2 * inter) / (union + epsilon))
+
+    return apply(prim, input, label, op_name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Improved triplet N-pair loss (paddle.nn.functional.npair_loss; ref
+    loss.py — cross entropy over anchor@positive.T with label-equality targets
+    plus an L2 pull on the embeddings)."""
+    anchor, positive = ensure_tensor(anchor), ensure_tensor(positive)
+    labels = ensure_tensor(labels)
+
+    def prim(a, p, y):
+        y = y.reshape(-1)
+        tgt = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        sim = a @ p.T
+        ce = jnp.mean(jnp.sum(-tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                        + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return ce + reg
+
+    return apply(prim, anchor, positive, labels, op_name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
+                  path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss (paddle.nn.functional.hsigmoid_loss; ref
+    loss.py / `phi/kernels/hsigmoid_loss_kernel.h`).
+
+    Default mode builds the same complete binary tree as the reference's
+    MatrixBitCodeFunctor (`paddle/fluid/operators/math/matrix_bit_code.h`):
+    leaf code = label + num_classes; internal node for step j is
+    ``(code >> (len-j)) - 1`` and the bit is ``(code >> (len-1-j)) & 1``.
+    Custom trees pass `path_table`/`path_code` [N, L] with -1 padding.
+    """
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    weight = ensure_tensor(weight)
+    ts = [input, label, weight]
+    if bias is not None:
+        ts.append(ensure_tensor(bias))
+    custom = path_table is not None
+    if custom:
+        ts += [ensure_tensor(path_table), ensure_tensor(path_code)]
+    import math as _math
+    max_len = int(_math.ceil(_math.log2(max(num_classes, 2)))) + 1
+
+    def prim(x, y, w, *rest):
+        b = rest[0] if bias is not None else None
+        if custom:
+            table = rest[-2].astype(jnp.int32)
+            code = rest[-1].astype(x.dtype)
+            mask = (table >= 0).astype(x.dtype)
+            nodes = jnp.maximum(table, 0)
+        else:
+            c = y.reshape(-1).astype(jnp.int32) + num_classes
+            length = (jnp.floor(jnp.log2(c.astype(jnp.float32)))).astype(jnp.int32)
+            j = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+            valid = j < length[:, None]
+            shift = jnp.maximum(length[:, None] - j, 0)
+            nodes = jnp.where(valid, (c[:, None] >> shift) - 1, 0)
+            bits = (c[:, None] >> jnp.maximum(shift - 1, 0)) & 1
+            code = bits.astype(x.dtype)
+            mask = valid.astype(x.dtype)
+        wp = jnp.take(w, nodes, axis=0)                    # [N, L, D]
+        pre = jnp.einsum("nd,nld->nl", x, wp)
+        if b is not None:
+            pre = pre + jnp.take(b.reshape(-1), nodes, axis=0)
+        # binary logistic per internal node: label bit = code
+        per = jnp.maximum(pre, 0) - pre * code + jnp.log1p(jnp.exp(-jnp.abs(pre)))
+        return jnp.sum(per * mask, axis=1, keepdims=True)
+
+    return apply(prim, *ts, op_name="hsigmoid_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """Combined-margin softmax cross entropy (ArcFace family)
+    (paddle.nn.functional.margin_cross_entropy; ref loss.py /
+    `c_margin_cross_entropy`): target logit cos(theta) becomes
+    ``cos(m1*theta + m2) - m3`` before scaling. Model-parallel classed
+    sharding rides GSPMD when logits carry an 'mp' sharding."""
+    logits, label = ensure_tensor(logits), ensure_tensor(label)
+
+    def prim(lg, y):
+        y = y.reshape(-1)
+        n, c = lg.shape
+        oh = jax.nn.one_hot(y, c, dtype=lg.dtype)
+        cos = jnp.clip(lg, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = jnp.where(oh > 0, tgt, cos) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.sum(oh * logp, axis=-1, keepdims=True)
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+
+    return apply(prim, logits, label, op_name="margin_cross_entropy")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers plus all positives, remapping labels
+    (paddle.nn.functional.class_center_sample; ref loss.py /
+    `class_center_sample_op.cu`). Eager/host op — sampling is data-dependent
+    (the reference also materializes the sampled set on host for the same
+    reason); returns (remapped_label, sampled_class_indices)."""
+    import zlib
+
+    import numpy as np
+    lab = np.asarray(ensure_tensor(label).numpy()).reshape(-1)
+    pos = np.unique(lab)
+    n_sample = max(int(num_samples), len(pos))
+    neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+    # crc32 (not hash(): salted per process) so every rank of a model-parallel
+    # group samples the same negative set from the same labels
+    rng = np.random.RandomState(zlib.crc32(lab.tobytes()) % (2**31))
+    extra = rng.choice(neg_pool, size=min(n_sample - len(pos), len(neg_pool)),
+                       replace=False) if n_sample > len(pos) else np.array([], np.int64)
+    sampled = np.concatenate([pos, np.sort(extra)]).astype(lab.dtype)
+    remap = {c: i for i, c in enumerate(sampled)}
+    remapped = np.array([remap[c] for c in lab], dtype=lab.dtype)
+    return Tensor(remapped), Tensor(sampled)

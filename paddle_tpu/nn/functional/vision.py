@@ -1,0 +1,108 @@
+"""Spatial-transform functionals (ref: `python/paddle/nn/functional/vision.py` —
+affine_grid :26, grid_sample :123; C++ kernels `paddle/phi/kernels/grid_sample_kernel.h`,
+`affine_grid_kernel.h`).
+
+TPU design: both ops are pure gather/matmul compositions, so they lower to XLA
+gathers instead of the reference's hand-written CUDA samplers.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.ops.common import ensure_tensor
+
+__all__ = ["affine_grid", "grid_sample"]
+
+
+def _base_grid(h, w, align_corners, dtype):
+    if align_corners:
+        xs = jnp.linspace(-1.0, 1.0, w, dtype=dtype)
+        ys = jnp.linspace(-1.0, 1.0, h, dtype=dtype)
+    else:
+        xs = (jnp.arange(w, dtype=dtype) * 2 + 1) / w - 1
+        ys = (jnp.arange(h, dtype=dtype) * 2 + 1) / h - 1
+    gx, gy = jnp.meshgrid(xs, ys)                      # [h, w]
+    return jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)   # [h, w, 3]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Generate a sampling grid from batched 2x3 affine matrices
+    (paddle.nn.functional.affine_grid; ref vision.py:26)."""
+    theta = ensure_tensor(theta)
+    if isinstance(out_shape, (list, tuple)):
+        n, c, h, w = [int(v) for v in out_shape]
+    else:
+        n, c, h, w = [int(v) for v in np.asarray(out_shape.numpy())]
+
+    def fn(th):
+        base = _base_grid(h, w, align_corners, th.dtype)        # [h, w, 3]
+        # [n, h, w, 2] = [h, w, 3] @ [n, 1, 3, 2]
+        return jnp.einsum("hwk,njk->nhwj", base, th)
+
+    return apply(fn, theta, op_name="affine_grid")
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1) / 2 * (size - 1)
+    return ((coord + 1) * size - 1) / 2
+
+
+def _reflect(x, lo, hi):
+    # reflect into [lo, hi] with period 2*(hi-lo)
+    rng = hi - lo
+    if rng <= 0:
+        return jnp.zeros_like(x)
+    x = jnp.abs(x - lo) % (2 * rng)
+    return lo + jnp.where(x > rng, 2 * rng - x, x)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample input at grid locations (paddle.nn.functional.grid_sample;
+    ref vision.py:123). x: [N, C, H, W]; grid: [N, Hg, Wg, 2] in [-1, 1]."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"mode should be 'bilinear' or 'nearest', got {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(
+            f"padding_mode should be 'zeros'/'border'/'reflection', got {padding_mode}")
+    x, grid = ensure_tensor(x), ensure_tensor(grid)
+
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx = _unnormalize(g[..., 0], w, align_corners)          # [n, hg, wg]
+        gy = _unnormalize(g[..., 1], h, align_corners)
+        if padding_mode == "reflection":
+            if align_corners:
+                gx, gy = _reflect(gx, 0.0, w - 1.0), _reflect(gy, 0.0, h - 1.0)
+            else:
+                gx = _reflect(gx, -0.5, w - 0.5)
+                gy = _reflect(gy, -0.5, h - 0.5)
+
+        def gather(ix, iy):
+            ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            flat = a.reshape(n, c, h * w)
+            idx = (iyc * w + ixc).reshape(n, 1, -1)             # [n, 1, hg*wg]
+            out = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (n, c, idx.shape[-1])),
+                                      axis=2)
+            out = out.reshape(n, c, *ix.shape[1:])
+            if padding_mode == "zeros":
+                inb = ((ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1))
+                out = out * inb[:, None].astype(a.dtype)
+            return out
+
+        if mode == "nearest":
+            return gather(jnp.round(gx), jnp.round(gy))
+        x0, y0 = jnp.floor(gx), jnp.floor(gy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = ((x1 - gx) * (y1 - gy))[:, None]
+        wb = ((x1 - gx) * (gy - y0))[:, None]
+        wc = ((gx - x0) * (y1 - gy))[:, None]
+        wd = ((gx - x0) * (gy - y0))[:, None]
+        return (gather(x0, y0) * wa.astype(a.dtype) + gather(x0, y1) * wb.astype(a.dtype)
+                + gather(x1, y0) * wc.astype(a.dtype) + gather(x1, y1) * wd.astype(a.dtype))
+
+    return apply(fn, x, grid, op_name="grid_sample")

@@ -22,73 +22,35 @@ from paddle_tpu.ops.einsum import einsum  # noqa: F401
 from paddle_tpu.core.tensor import Tensor
 
 # ---------------------------------------------------------------- method binding
+#
+# Driven by ops.yaml — the op-surface inventory (the rebuild keeps the
+# reference's yaml-as-source-of-truth design, `paddle/phi/api/yaml/ops.yaml` ->
+# api_gen.py). Entries flagged `tensor_method: true` are bound onto Tensor
+# here; `python -m paddle_tpu.ops.gen_inventory` refreshes the file and
+# `tests/test_op_inventory.py` enforces that it stays in sync with the code.
 
-_METHODS = [
-    # math unary
-    "abs", "acos", "asin", "atan", "acosh", "asinh", "atanh", "ceil", "cos", "cosh",
-    "exp", "expm1", "floor", "log", "log2", "log10", "log1p", "neg", "reciprocal",
-    "round", "rsqrt", "sigmoid", "sign", "sin", "sinh", "sqrt", "square", "tan",
-    "tanh", "trunc", "erf", "erfinv", "digamma", "lgamma", "angle", "conj", "real",
-    "imag", "isnan", "isinf", "isfinite", "logical_not", "bitwise_not", "frac",
-    "deg2rad", "rad2deg", "logit",
-    # inplace unary
-    "exp_", "sqrt_", "rsqrt_", "reciprocal_", "ceil_", "floor_", "round_", "abs_",
-    "sigmoid_", "tanh_", "square_",
-    # binary
-    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
-    "fmod", "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "logaddexp",
-    "heaviside", "nextafter", "gcd", "lcm", "hypot", "copysign", "ldexp",
-    "logical_and", "logical_or", "logical_xor", "bitwise_and", "bitwise_or",
-    "bitwise_xor", "equal", "not_equal", "less_than", "less_equal", "greater_than",
-    "greater_equal", "multiply_no_nan",
-    # inplace binary
-    "add_", "subtract_", "multiply_", "divide_", "remainder_", "floor_divide_",
-    "pow_",
-    # scalar-attr
-    "scale", "scale_", "clip", "clip_", "lerp", "lerp_", "stanh", "nan_to_num",
-    "increment", "isclose", "allclose", "equal_all",
-    # reductions
-    "sum", "mean", "prod", "max", "min", "amax", "amin", "nansum", "nanmean",
-    "all", "any", "logsumexp", "count_nonzero", "std", "var", "median", "nanmedian",
-    "quantile", "nanquantile",
-    # cumulative
-    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp", "diff",
-    # linalg
-    "matmul", "bmm", "mv", "norm", "dist", "cholesky", "cholesky_solve", "qr",
-    "svd", "eig", "eigvals", "eigh", "eigvalsh", "inv", "inverse", "pinv", "det",
-    "slogdet", "solve", "triangular_solve", "lstsq", "matrix_power", "matrix_rank",
-    "cond", "trace", "lu", "dot", "cross", "outer", "inner", "kron", "addmm",
-    "matrix_exp",
-    # creation-ish
-    "cast", "cast_", "zeros_like", "ones_like", "full_like", "diag", "diagonal",
-    "tril", "triu", "numel",
-    # manipulation
-    "reshape", "reshape_", "flatten", "flatten_", "squeeze", "squeeze_",
-    "unsqueeze", "unsqueeze_", "transpose", "moveaxis", "swapaxes", "concat",
-    "stack", "unstack", "split", "chunk", "tensor_split", "tile", "expand",
-    "expand_as", "broadcast_to", "flip", "rot90", "roll", "gather", "gather_nd",
-    "scatter", "scatter_", "scatter_nd_add", "index_select", "index_sample",
-    "index_add", "index_add_", "index_put", "index_put_", "take_along_axis",
-    "put_along_axis", "put_along_axis_", "take", "masked_select", "masked_fill",
-    "masked_fill_", "masked_scatter", "repeat_interleave", "unique",
-    "unique_consecutive", "unbind", "slice", "strided_slice", "bincount",
-    "histogram", "view", "view_as", "as_strided", "tolist", "atleast_1d",
-    "atleast_2d", "atleast_3d", "one_hot",
-    # search
-    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode", "nonzero",
-    "where", "where_", "index_fill", "searchsorted", "bucketize",
-    # random (methods)
-    "uniform_", "normal_", "bernoulli_", "exponential_", "multinomial",
-    # misc
-    "t", "einsum",
-]
+import os as _os
+
+import yaml as _yaml
+
+
+def load_inventory():
+    """Parsed ops.yaml (cached): list of {op, namespace, module, kind,
+    tensor_method} dicts."""
+    global _INVENTORY
+    if _INVENTORY is None:
+        path = _os.path.join(_os.path.dirname(__file__), "ops.yaml")
+        with open(path) as f:
+            _INVENTORY = _yaml.load(
+                f, Loader=getattr(_yaml, "CSafeLoader", _yaml.SafeLoader))
+    return _INVENTORY
+
+
+_INVENTORY = None
 
 _g = globals()
-for _name in _METHODS:
-    _fn = _g.get(_name)
-    if _fn is not None and not hasattr(Tensor, _name):
-        setattr(Tensor, _name, _fn)
-
-# a few methods whose names clash with builtins on the module but are fine on Tensor
-Tensor.item_ = None
-del Tensor.item_
+for _entry in load_inventory():
+    if _entry.get("tensor_method"):
+        _fn = _g.get(_entry["op"])
+        if _fn is not None and not hasattr(Tensor, _entry["op"]):
+            setattr(Tensor, _entry["op"], _fn)

@@ -1,0 +1,140 @@
+"""Regenerate ``ops.yaml`` — the op-surface inventory (source of truth).
+
+The reference drives its op surface from yaml
+(`paddle/phi/api/yaml/ops.yaml` + `legacy_ops.yaml` -> api_gen.py); this
+framework keeps the same yaml-as-source-of-truth stance: ``ops.yaml`` declares
+every public op (name, namespace, defining module, Tensor-method binding) and
+is what Tensor-method binding (`paddle_tpu/ops/__init__.py`) and the inventory
+test (`tests/test_op_inventory.py`) consume.
+
+Run ``python -m paddle_tpu.ops.gen_inventory`` after adding an op: it refreshes
+the yaml from the live package while preserving the invariant that every entry
+resolves. Hand-edits are allowed (e.g. to flag a new Tensor method) — the
+binder reads the yaml, not this script.
+"""
+from __future__ import annotations
+
+import inspect
+
+import yaml
+
+NAMESPACES = [
+    # (namespace key, import path, public-name filter)
+    ("paddle", "paddle_tpu.ops", None),
+    ("functional", "paddle_tpu.nn.functional", None),
+    ("fft", "paddle_tpu.fft", None),
+    ("signal", "paddle_tpu.signal", None),
+    ("geometric", "paddle_tpu.geometric", None),
+    ("text", "paddle_tpu.text", None),
+    ("vision_ops", "paddle_tpu.vision.ops", None),
+    ("sparse", "paddle_tpu.sparse", None),
+    ("audio_functional", "paddle_tpu.audio.functional", None),
+    ("linalg", "paddle_tpu.ops.linalg", None),
+]
+
+_SKIP = {
+    # infra / non-op callables that live in op modules
+    "ensure_tensor", "promote_pair", "unary", "binary", "make_inplace",
+    "rebind", "inplace_guard", "apply", "Tensor", "Generator",
+    "default_generator", "annotations", "load_inventory",
+}
+
+
+def collect():
+    import importlib
+
+    from paddle_tpu.core.tensor import Tensor
+
+    entries = []
+    seen = set()
+    for ns, path, _flt in NAMESPACES:
+        mod = importlib.import_module(path)
+        for name in sorted(dir(mod)):
+            if name.startswith("_") or name in _SKIP:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or inspect.ismodule(fn):
+                continue
+            if inspect.isclass(fn) and not name[0].isupper():
+                continue
+            if inspect.isclass(fn):
+                kind = "layer" if ns in ("functional", "text") else "class"
+            else:
+                kind = "op"
+            defmod = getattr(fn, "__module__", path) or path
+            if not str(defmod).startswith("paddle_tpu"):
+                continue
+            key = (ns, name)
+            if key in seen:
+                continue
+            seen.add(key)
+            # `module` = where the op is importable from (the namespace);
+            # factory-made ops (unary/binary wrappers) carry common.py as
+            # their defining module, which is not an import location.
+            resolvable = getattr(importlib.import_module(defmod), name, None) is fn
+            entries.append({
+                "op": name,
+                "namespace": ns,
+                "module": defmod if resolvable else path,
+                "kind": kind,
+                "tensor_method": bool(
+                    ns == "paddle" and getattr(Tensor, name, None) is not None
+                    and getattr(Tensor, name) is fn),
+            })
+    return entries
+
+
+_NS_PREFIX = {
+    "paddle": "paddle", "functional": "paddle.nn.functional",
+    "fft": "paddle.fft", "signal": "paddle.signal",
+    "geometric": "paddle.geometric", "text": "paddle.text",
+    "vision_ops": "paddle.vision.ops", "sparse": "paddle.sparse",
+    "audio_functional": "paddle.audio.functional", "linalg": "paddle.linalg",
+}
+
+
+def write_docs(entries, repo_root):
+    import os
+
+    os.makedirs(os.path.join(repo_root, "docs"), exist_ok=True)
+    path = os.path.join(repo_root, "docs", "OPS.md")
+    by_ns = {}
+    for e in entries:
+        by_ns.setdefault(e["namespace"], []).append(e)
+    with open(path, "w") as f:
+        f.write("# Op surface\n\nGenerated from `paddle_tpu/ops/ops.yaml` "
+                "(`python -m paddle_tpu.ops.gen_inventory`). "
+                f"{len(entries)} public entries.\n")
+        for ns in sorted(by_ns, key=lambda k: -len(by_ns[k])):
+            pre = _NS_PREFIX.get(ns, ns)
+            f.write(f"\n## {pre} ({len(by_ns[ns])})\n\n")
+            names = [e["op"] + ("*" if e.get("tensor_method") else "")
+                     for e in by_ns[ns]]
+            f.write(", ".join(f"`{n}`" for n in names) + "\n")
+        f.write("\n`*` = also bound as a Tensor method.\n")
+    return path
+
+
+def main():
+    import os
+
+    entries = collect()
+    out = os.path.join(os.path.dirname(__file__), "ops.yaml")
+    with open(out, "w") as f:
+        f.write("# Op-surface inventory — SOURCE OF TRUTH (see gen_inventory.py).\n"
+                "# The Tensor-method binder and tests/test_op_inventory.py consume\n"
+                "# this file; regenerate with python -m paddle_tpu.ops.gen_inventory.\n")
+        yaml.safe_dump(entries, f, sort_keys=False)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    docs = write_docs(entries, repo_root)
+    by_ns = {}
+    for e in entries:
+        by_ns[e["namespace"]] = by_ns.get(e["namespace"], 0) + 1
+    total = len(entries)
+    print(f"wrote {out} + {docs}: {total} entries")
+    for ns, n in sorted(by_ns.items(), key=lambda kv: -kv[1]):
+        print(f"  {ns:18s} {n}")
+
+
+if __name__ == "__main__":
+    main()

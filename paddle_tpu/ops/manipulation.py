@@ -640,3 +640,113 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         return jnp.where(in_shard, a - lo, ignore_value)
 
     return apply(prim, input, op_name="shard_index")
+
+
+# ----------------------------------------------------- fill / diagonal writes
+
+def fill_(x, value):
+    """In-place fill with a scalar (paddle.Tensor.fill_; ref `fill` op in
+    legacy_ops.yaml)."""
+    x = ensure_tensor(x)
+    return rebind(x, apply(lambda a: jnp.full_like(a, value), x, op_name="fill_"))
+
+
+fill = fill_
+
+
+def zero_(x):
+    """In-place zero fill (paddle.Tensor.zero_)."""
+    return fill_(x, 0.0)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """In-place diagonal fill (paddle.Tensor.fill_diagonal_; ref
+    `fill_diagonal` in legacy_ops.yaml). 2-D: offset supported; N-D square:
+    main diagonal."""
+    x = ensure_tensor(x)
+
+    def prim(a):
+        if a.ndim == 2:
+            h, w = a.shape
+            rows = jnp.arange(h)
+            cols = rows + offset
+            if wrap and offset == 0:
+                # torch/paddle wrap semantics: diagonal entries at flat indices
+                # 0, w+1, 2(w+1), ... restarting one row below each block
+                flat_idx = jnp.arange(0, h * w, w + 1)
+                mask = jnp.zeros(h * w, bool).at[flat_idx].set(True).reshape(h, w)
+                return jnp.where(mask, jnp.asarray(value, a.dtype), a)
+            valid = (cols >= 0) & (cols < w)
+            mask = jnp.zeros(a.shape, bool).at[rows[valid], cols[valid]].set(True)
+            return jnp.where(mask, jnp.asarray(value, a.dtype), a)
+        n = a.shape[0]
+        idx = (jnp.arange(n),) * a.ndim
+        return a.at[idx].set(jnp.asarray(value, a.dtype))
+
+    return rebind(x, apply(prim, x, op_name="fill_diagonal_"))
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write tensor ``y`` onto the (dim1, dim2) diagonal band of ``x``
+    (paddle.Tensor.fill_diagonal_tensor; ref `fill_diagonal_tensor` op)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def prim(a, b):
+        a2 = jnp.moveaxis(a, (dim1, dim2), (-2, -1))
+        h, w = a2.shape[-2], a2.shape[-1]
+        rows = jnp.arange(h)
+        cols = rows + offset
+        valid = (cols >= 0) & (cols < w)
+        rs, cs = rows[valid], cols[valid]
+        # b carries the diagonal as its last axis (batch dims first)
+        bm = jnp.moveaxis(b, -1, 0) if b.ndim == a.ndim - 1 else b
+        upd = jnp.broadcast_to(bm, (rs.shape[0],) + a2.shape[:-2])
+        upd = jnp.moveaxis(upd, 0, -1)
+        a2 = a2.at[..., rs, cs].set(upd.astype(a2.dtype))
+        return jnp.moveaxis(a2, (-2, -1), (dim1, dim2))
+
+    return apply(prim, x, y, op_name="fill_diagonal_tensor")
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """In-place variant of :func:`fill_diagonal_tensor`."""
+    x = ensure_tensor(x)
+    return rebind(x, fill_diagonal_tensor(x, y, offset=offset, dim1=dim1, dim2=dim2))
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (paddle.multiplex; ref
+    `multiplex` op, `phi/kernels/multiplex_kernel.h`): output row i is
+    ``inputs[index[i]][i]``."""
+    ts = [ensure_tensor(t) for t in inputs]
+    idx = ensure_tensor(index)
+
+    def prim(i, *cands):
+        stacked = jnp.stack(cands, axis=0)          # [K, N, ...]
+        sel = i.reshape(-1).astype(jnp.int32)       # [N]
+        n = stacked.shape[1]
+        return stacked[sel, jnp.arange(n)]
+
+    return apply(prim, idx, *ts, op_name="multiplex")
+
+
+def reverse(x, axis, name=None):
+    """Reverse along axes (paddle.reverse — legacy alias of flip)."""
+    return flip(x, axis)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp each slice along ``axis`` to p-norm <= max_norm (paddle.renorm;
+    ref `renorm` op)."""
+    x = ensure_tensor(x)
+
+    def prim(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7),
+                          jnp.ones_like(norms))
+        flat = flat * scale[:, None].astype(a.dtype)
+        return jnp.moveaxis(flat.reshape(moved.shape), 0, axis)
+
+    return apply(prim, x, op_name="renorm")

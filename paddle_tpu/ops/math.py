@@ -544,3 +544,28 @@ def broadcast_shape(x_shape, y_shape):
 
 def rsub(x, y, alpha=1):
     return subtract(y, multiply(x, alpha) if alpha != 1 else x)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Scale ``x`` so its Frobenius norm is at most ``max_norm``
+    (paddle.nn.clip_by_norm analog; ref `clip_by_norm` op,
+    `phi/kernels/clip_by_norm_kernel.h`)."""
+    x = ensure_tensor(x)
+
+    def prim(a):
+        norm = jnp.sqrt(jnp.sum(a * a))
+        scale = jnp.where(norm > max_norm, max_norm / norm, jnp.ones_like(norm))
+        return a * scale.astype(a.dtype)
+
+    return apply(prim, x, op_name="clip_by_norm")
+
+
+def frobenius_norm(x, axis=None, keepdim=False, name=None):
+    """Frobenius norm over the given axes (ref `frobenius_norm` op)."""
+    x = ensure_tensor(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def prim(a):
+        return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+
+    return apply(prim, x, op_name="frobenius_norm")

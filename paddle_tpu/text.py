@@ -1,0 +1,84 @@
+"""Text utilities — ``paddle.text`` surface (ref:
+`python/paddle/text/viterbi_decode.py`, kernel
+`paddle/phi/kernels/viterbi_decode_kernel.h`).
+
+The decode recursion runs as a ``lax.scan`` (max-product forward pass +
+backtrace), so it jit-compiles; the reference's CUDA kernel loops on host.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.ops.common import ensure_tensor
+from paddle_tpu.nn.layer import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Viterbi decode over emission potentials [B, T, N] with transition matrix
+    [N, N] and per-sequence lengths [B]. Returns (scores [B], paths [B, T]).
+
+    With ``include_bos_eos_tag`` the last two tags are treated as BOS/EOS like
+    the reference (:`python/paddle/text/viterbi_decode.py:64`).
+    """
+    potentials = ensure_tensor(potentials)
+    transition_params = ensure_tensor(transition_params)
+    lengths = ensure_tensor(lengths)
+
+    def prim(emis, trans, lens):
+        b, t, n = emis.shape
+        NEG = jnp.asarray(-1e30, emis.dtype)
+        if include_bos_eos_tag:
+            bos, eos = n - 2, n - 1
+            start = emis[:, 0] + trans[bos][None, :]
+        else:
+            start = emis[:, 0]
+
+        def step(carry, xt):
+            alpha, tstep = carry
+            # score[b, j] = max_i alpha[b, i] + trans[i, j] + emis[b, t, j]
+            scores = alpha[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)             # [B, N]
+            new_alpha = jnp.max(scores, axis=1) + xt
+            # freeze past each sequence's length
+            live = (tstep < lens)[:, None]
+            new_alpha = jnp.where(live, new_alpha, alpha)
+            bp = jnp.where(live, best_prev,
+                           jnp.broadcast_to(jnp.arange(n)[None, :], (b, n)))
+            return (new_alpha, tstep + 1), bp
+
+        (alpha, _), bps = jax.lax.scan(step, (start, jnp.ones((), jnp.int32)),
+                                       jnp.swapaxes(emis[:, 1:], 0, 1))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, eos][None, :]
+        scores = jnp.max(alpha, axis=1)
+        last = jnp.argmax(alpha, axis=1)                       # [B]
+
+        def back(tag, bp):
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        y0, path_rev = jax.lax.scan(back, last, bps[::-1])
+        # scan emits [y_{T-1}, ..., y_1] and carries out y_0
+        path = jnp.concatenate([y0[None, :], path_rev[::-1]], axis=0)
+        return scores, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+
+    return apply(prim, potentials, transition_params, lengths,
+                 op_name="viterbi_decode", n_outputs=2)
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper over :func:`viterbi_decode` (ref viterbi_decode.py:16)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = ensure_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -226,3 +226,373 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
         return out
 
     return apply(prim, *ts, op_name="deform_conv2d")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoI max pooling (paddle.vision.ops.roi_pool; ref `roi_pool` kernel
+    `phi/kernels/roi_pool_kernel.h`). x: [N, C, H, W]; boxes: [R, 4] xyxy."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x, boxes = ensure_tensor(x), ensure_tensor(boxes)
+    bn = np.asarray(ensure_tensor(boxes_num).numpy())
+    img_of_box = np.repeat(np.arange(len(bn)), bn)
+
+    def prim(feat, bxs):
+        H, W = feat.shape[2], feat.shape[3]
+
+        def one_box(b, img_i):
+            x1 = jnp.round(b[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(b[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(b[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(b[3] * spatial_scale).astype(jnp.int32)
+            rh = jnp.maximum(y2 - y1 + 1, 1) / ph
+            rw = jnp.maximum(x2 - x1 + 1, 1) / pw
+            # per output cell max over its bin; vectorize via mask reduction
+            ys = jnp.arange(H)[None, :]
+            xs = jnp.arange(W)[None, :]
+            hstart = jnp.floor(jnp.arange(ph)[:, None] * rh).astype(jnp.int32) + y1
+            hend = jnp.ceil((jnp.arange(ph)[:, None] + 1) * rh).astype(jnp.int32) + y1
+            wstart = jnp.floor(jnp.arange(pw)[:, None] * rw).astype(jnp.int32) + x1
+            wend = jnp.ceil((jnp.arange(pw)[:, None] + 1) * rw).astype(jnp.int32) + x1
+            hmask = (ys >= jnp.clip(hstart, 0, H)) & (ys < jnp.clip(hend, 0, H))
+            wmask = (xs >= jnp.clip(wstart, 0, W)) & (xs < jnp.clip(wend, 0, W))
+            m = hmask[:, None, :, None] & wmask[None, :, None, :]   # [ph,pw,H,W]
+            f = feat[img_i]                                         # [C, H, W]
+            NEG = jnp.asarray(-3.4e38, f.dtype)
+            masked = jnp.where(m[None], f[:, None, None], NEG)
+            out = jnp.max(masked, axis=(-2, -1))                    # [C, ph, pw]
+            return jnp.where(jnp.any(m, axis=(-2, -1))[None], out,
+                             jnp.zeros_like(out))
+
+        return jax.vmap(one_box)(bxs, jnp.asarray(img_of_box))
+
+    return apply(prim, x, boxes, op_name="roi_pool")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI average pooling (paddle.vision.ops.psroi_pool;
+    ref `phi/kernels/psroi_pool_kernel.h`). Input channels = C_out * ph * pw."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x, boxes = ensure_tensor(x), ensure_tensor(boxes)
+    bn = np.asarray(ensure_tensor(boxes_num).numpy())
+    img_of_box = np.repeat(np.arange(len(bn)), bn)
+    c_in = x.shape[1]
+    if c_in % (ph * pw) != 0:
+        raise ValueError("input channel must be divisible by output_size^2")
+    c_out = c_in // (ph * pw)
+
+    def prim(feat, bxs):
+        H, W = feat.shape[2], feat.shape[3]
+
+        def one_box(b, img_i):
+            x1 = b[0] * spatial_scale
+            y1 = b[1] * spatial_scale
+            x2 = b[2] * spatial_scale
+            y2 = b[3] * spatial_scale
+            rh = jnp.maximum(y2 - y1, 0.1) / ph
+            rw = jnp.maximum(x2 - x1, 0.1) / pw
+            ys = jnp.arange(H)[None, :]
+            xs = jnp.arange(W)[None, :]
+            hstart = jnp.floor(jnp.arange(ph)[:, None] * rh + y1).astype(jnp.int32)
+            hend = jnp.ceil((jnp.arange(ph)[:, None] + 1) * rh + y1).astype(jnp.int32)
+            wstart = jnp.floor(jnp.arange(pw)[:, None] * rw + x1).astype(jnp.int32)
+            wend = jnp.ceil((jnp.arange(pw)[:, None] + 1) * rw + x1).astype(jnp.int32)
+            hmask = (ys >= jnp.clip(hstart, 0, H)) & (ys < jnp.clip(hend, 0, H))
+            wmask = (xs >= jnp.clip(wstart, 0, W)) & (xs < jnp.clip(wend, 0, W))
+            m = (hmask[:, None, :, None] & wmask[None, :, None, :]).astype(feat.dtype)
+            # channel layout: [c_out * ph * pw] position-sensitive maps
+            f = feat[img_i].reshape(c_out, ph, pw, H, W)
+            s = jnp.einsum("cijhw,ijhw->cij", f, m)
+            cnt = jnp.maximum(jnp.sum(m, axis=(-2, -1)), 1.0)
+            return s / cnt
+
+        return jax.vmap(one_box)(bxs, jnp.asarray(img_of_box))
+
+    return apply(prim, x, boxes, op_name="psroi_pool")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes (paddle.vision.ops.prior_box; ref
+    `phi/kernels/prior_box_kernel.h`). Returns (boxes [H,W,P,4],
+    variances [H,W,P,4]); pure host computation from static shapes."""
+    feat = ensure_tensor(input)
+    img = ensure_tensor(image)
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        sizes = []
+        if min_max_aspect_ratios_order:
+            sizes.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[ms_i]
+                sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[ms_i]
+                sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        boxes.append(np.asarray(sizes))
+    sizes = np.concatenate(boxes, axis=0)                       # [P, 2] (w, h)
+    cy = (np.arange(fh) + offset) * step_h
+    cx = (np.arange(fw) + offset) * step_w
+    cxg, cyg = np.meshgrid(cx, cy)                              # [H, W]
+    out = np.zeros((fh, fw, len(sizes), 4), np.float32)
+    out[..., 0] = (cxg[:, :, None] - sizes[None, None, :, 0] / 2) / iw
+    out[..., 1] = (cyg[:, :, None] - sizes[None, None, :, 1] / 2) / ih
+    out[..., 2] = (cxg[:, :, None] + sizes[None, None, :, 0] / 2) / iw
+    out[..., 3] = (cyg[:, :, None] + sizes[None, None, :, 1] / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32), out.shape).copy()
+    return Tensor(jnp.asarray(out), _internal=True), Tensor(jnp.asarray(var), _internal=True)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head predictions into boxes+scores
+    (paddle.vision.ops.yolo_box; ref `phi/kernels/yolo_box_kernel.h`).
+    x: [N, AN*(5+C), H, W] -> (boxes [N, H*W*AN, 4], scores [N, H*W*AN, C])."""
+    x, img_size = ensure_tensor(x), ensure_tensor(img_size)
+    an = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(an, 2)
+
+    def prim(a, imgs):
+        n, _, h, w = a.shape
+        a = a.reshape(n, an, 5 + class_num, h, w)
+        gx = (jnp.arange(w)[None, None, None, :])
+        gy = (jnp.arange(h)[None, None, :, None])
+        sx, sy = scale_x_y, -0.5 * (scale_x_y - 1.0)
+        bx = (jax.nn.sigmoid(a[:, :, 0]) * sx + sy + gx) / w
+        by = (jax.nn.sigmoid(a[:, :, 1]) * sx + sy + gy) / h
+        bw = jnp.exp(a[:, :, 2]) * anc[None, :, 0, None, None] / (downsample_ratio * w)
+        bh = jnp.exp(a[:, :, 3]) * anc[None, :, 1, None, None] / (downsample_ratio * h)
+        conf = jax.nn.sigmoid(a[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor)
+        prob = jax.nn.sigmoid(a[:, :, 5:]) * conf[:, :, None]
+        ih = imgs[:, 0].astype(a.dtype)[:, None, None, None]
+        iw = imgs[:, 1].astype(a.dtype)[:, None, None, None]
+        x1 = (bx - bw / 2) * iw
+        y1 = (by - bh / 2) * ih
+        x2 = (bx + bw / 2) * iw
+        y2 = (by + bh / 2) * ih
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+            x2 = jnp.clip(x2, 0, iw - 1)
+            y2 = jnp.clip(y2, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)            # [N, AN, H, W, 4]
+        mask = (conf > conf_thresh).astype(a.dtype)
+        boxes = boxes * mask[..., None]
+        scores = prob * mask[:, :, None]
+        boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(n, h * w * an, 4)
+        # same (h, w, an) row order as boxes
+        scores = scores.transpose(0, 3, 4, 1, 2).reshape(n, h * w * an, class_num)
+        return boxes, scores
+
+    return apply(prim, x, img_size, op_name="yolo_box", n_outputs=2)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (paddle.vision.ops.matrix_nms; ref
+    `phi/kernels/matrix_nms_kernel.h`): parallel soft suppression via the
+    pairwise IoU matrix. Host/eager op (dynamic output count)."""
+    b = np.asarray(ensure_tensor(bboxes).numpy())   # [N, M, 4]
+    s = np.asarray(ensure_tensor(scores).numpy())   # [N, C, M]
+    outs, idxs, nums = [], [], []
+    for i in range(b.shape[0]):
+        dets = []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[i, c]
+            keep = np.where(sc > score_threshold)[0]
+            if len(keep) == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            bx, scs = b[i][order], sc[order]
+            x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+            off = 0.0 if normalized else 1.0
+            area = (x2 - x1 + off) * (y2 - y1 + off)
+            xx1 = np.maximum(x1[:, None], x1[None, :])
+            yy1 = np.maximum(y1[:, None], y1[None, :])
+            xx2 = np.minimum(x2[:, None], x2[None, :])
+            yy2 = np.minimum(y2[:, None], y2[None, :])
+            inter = np.clip(xx2 - xx1 + off, 0, None) * np.clip(yy2 - yy1 + off, 0, None)
+            iou = inter / (area[:, None] + area[None, :] - inter + 1e-10)
+            iou = np.triu(iou, k=1)
+            iou_cmax = iou.max(axis=0)                        # max IoU with higher-scored
+            # decay_j = min_i f(iou_ij) / f(iou_cmax_i): denominator indexed by
+            # the suppressor row i
+            if use_gaussian:
+                decay = np.exp((iou_cmax[:, None] ** 2 - iou ** 2) / gaussian_sigma)
+            else:
+                decay = (1 - iou) / (1 - iou_cmax[:, None] + 1e-10)
+            decay = decay.min(axis=0)
+            newsc = scs * decay
+            sel = np.where(newsc > post_threshold)[0]
+            for j in sel:
+                dets.append((c, newsc[j], *bx[j], order[j]))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        outs.append(np.asarray([[d[0], d[1], d[2], d[3], d[4], d[5]] for d in dets],
+                               np.float32).reshape(-1, 6))
+        idxs.append(np.asarray([d[6] + i * b.shape[1] for d in dets], np.int64))
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(outs, 0) if outs else
+                             np.zeros((0, 6), np.float32)), _internal=True)
+    rois_num = Tensor(jnp.asarray(np.asarray(nums, np.int32)), _internal=True)
+    index = Tensor(jnp.asarray(np.concatenate(idxs, 0) if idxs else
+                               np.zeros((0,), np.int64)), _internal=True)
+    res = [out]
+    if return_index:
+        res.append(index)
+    if return_rois_num:
+        res.append(rois_num)
+    return tuple(res) if len(res) > 1 else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route RoIs to FPN levels by scale (paddle.vision.ops.
+    distribute_fpn_proposals; ref `phi/kernels/distribute_fpn_proposals_kernel.h`).
+    Host/eager op; returns (multi_rois list, restore_ind, rois_num_per_level)."""
+    rois = np.asarray(ensure_tensor(fpn_rois).numpy())
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.clip(w * h, 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi, order, nums = [], [], []
+    for L in range(min_level, max_level + 1):
+        sel = np.where(lvl == L)[0]
+        multi.append(Tensor(jnp.asarray(rois[sel]), _internal=True))
+        nums.append(Tensor(jnp.asarray(np.asarray([len(sel)], np.int32)), _internal=True))
+        order.append(sel)
+    order = np.concatenate(order) if order else np.zeros((0,), np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    return multi, Tensor(jnp.asarray(restore.astype(np.int32)), _internal=True), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, pixel_offset=False,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation (paddle.vision.ops.generate_proposals; ref
+    `phi/kernels/generate_proposals_kernel.h`). Host/eager op."""
+    sc = np.asarray(ensure_tensor(scores).numpy())          # [N, A, H, W]
+    deltas = np.asarray(ensure_tensor(bbox_deltas).numpy()) # [N, 4A, H, W]
+    imgs = np.asarray(ensure_tensor(img_size).numpy())      # [N, 2] (h, w)
+    anc = np.asarray(ensure_tensor(anchors).numpy()).reshape(-1, 4)
+    var = np.asarray(ensure_tensor(variances).numpy()).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, all_nums = [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)            # [H*W*A]
+        d = deltas[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, an, vr = s[order], d[order], anc[order], var[order]
+        aw = an[:, 2] - an[:, 0] + off
+        ah = an[:, 3] - an[:, 1] + off
+        acx = an[:, 0] + aw / 2
+        acy = an[:, 1] + ah / 2
+        cx = vr[:, 0] * d[:, 0] * aw + acx
+        cy = vr[:, 1] * d[:, 1] * ah + acy
+        bw = aw * np.exp(np.minimum(vr[:, 2] * d[:, 2], np.log(1000 / 16)))
+        bh = ah * np.exp(np.minimum(vr[:, 3] * d[:, 3], np.log(1000 / 16)))
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], axis=1)
+        ih, iw = imgs[i, 0], imgs[i, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keepmask = ((boxes[:, 2] - boxes[:, 0] + off >= min_size) &
+                    (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keepmask], s[keepmask]
+        # plain NMS
+        x1, y1, x2, y2 = boxes.T
+        area = (x2 - x1 + off) * (y2 - y1 + off)
+        keep = []
+        idx = np.argsort(-s)
+        supp = np.zeros(len(boxes), bool)
+        for j in idx:
+            if supp[j]:
+                continue
+            keep.append(j)
+            if len(keep) >= post_nms_top_n:
+                break
+            xx1 = np.maximum(x1[j], x1)
+            yy1 = np.maximum(y1[j], y1)
+            xx2 = np.minimum(x2[j], x2)
+            yy2 = np.minimum(y2[j], y2)
+            inter = np.clip(xx2 - xx1 + off, 0, None) * np.clip(yy2 - yy1 + off, 0, None)
+            iou = inter / (area[j] + area - inter + 1e-10)
+            supp |= iou > nms_thresh
+            supp[j] = True
+        keep = np.asarray(keep, np.int64)
+        all_rois.append(np.concatenate([boxes[keep], s[keep, None]], axis=1))
+        all_nums.append(len(keep))
+    rois = np.concatenate([r[:, :4] for r in all_rois], 0) if all_rois else \
+        np.zeros((0, 4), np.float32)
+    roi_scores = np.concatenate([r[:, 4] for r in all_rois], 0) if all_rois else \
+        np.zeros((0,), np.float32)
+    out = (Tensor(jnp.asarray(rois.astype(np.float32)), _internal=True),
+           Tensor(jnp.asarray(roi_scores.astype(np.float32)), _internal=True))
+    if return_rois_num:
+        return out + (Tensor(jnp.asarray(np.asarray(all_nums, np.int32)),
+                             _internal=True),)
+    return out
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (paddle.vision.ops.decode_jpeg;
+    the reference wraps nvjpeg — here PIL supplies the host decode, matching
+    the reference's CPU fallback)."""
+    import io
+    from PIL import Image
+    data = np.asarray(ensure_tensor(x).numpy(), np.uint8).tobytes()
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr), _internal=True)
+
+
+def read_file(filename, name=None):
+    """Read a file into a uint8 tensor (paddle.vision.ops.read_file)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data), _internal=True)

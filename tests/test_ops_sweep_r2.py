@@ -1,0 +1,370 @@
+"""OpTest sweep for the round-2 op additions: fft / signal / geometric /
+vision functionals / extension ops / new losses (methodology: op_test.py:327
+of the reference — fwd vs numpy, analytic-vs-numeric grads, eager/static
+parity)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import OpTestCase
+
+R = np.random.RandomState(7)
+
+
+# ------------------------------------------------------------------- fft
+
+def np_rfft_mag(x):
+    return np.abs(np.fft.rfft(x)).astype(np.float32)
+
+
+class TestFFT:
+    def test_fft_roundtrip_c2c(self):
+        x = (R.randn(3, 16) + 1j * R.randn(3, 16)).astype(np.complex64)
+        X = paddle.fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(X.numpy(), np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        back = paddle.fft.ifft(X)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-4, atol=1e-4)
+
+    def test_rfft_matches_numpy(self):
+        x = R.randn(4, 32).astype(np.float32)
+        X = paddle.fft.rfft(paddle.to_tensor(x))
+        np.testing.assert_allclose(X.numpy(), np.fft.rfft(x).astype(np.complex64),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_norms(self):
+        x = R.randn(16).astype(np.float32)
+        for norm in ("backward", "ortho", "forward"):
+            X = paddle.fft.fft(paddle.to_tensor(x), norm=norm)
+            np.testing.assert_allclose(X.numpy(), np.fft.fft(x, norm=norm),
+                                       rtol=1e-4, atol=1e-4)
+        with pytest.raises(ValueError):
+            paddle.fft.fft(paddle.to_tensor(x), norm="bogus")
+
+    def test_fft2_fftn(self):
+        x = R.randn(2, 8, 8).astype(np.float32)
+        np.testing.assert_allclose(paddle.fft.fft2(paddle.to_tensor(x)).numpy(),
+                                   np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(paddle.fft.fftn(paddle.to_tensor(x)).numpy(),
+                                   np.fft.fftn(x), rtol=1e-4, atol=1e-3)
+
+    def test_hfft_ihfft(self):
+        x = (R.randn(9) + 1j * R.randn(9)).astype(np.complex64)
+        np.testing.assert_allclose(paddle.fft.hfft(paddle.to_tensor(x)).numpy(),
+                                   np.fft.hfft(x), rtol=1e-4, atol=1e-4)
+        r = R.randn(16).astype(np.float32)
+        np.testing.assert_allclose(paddle.fft.ihfft(paddle.to_tensor(r)).numpy(),
+                                   np.fft.ihfft(r).astype(np.complex64),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_shift_freq(self):
+        x = R.randn(8).astype(np.float32)
+        np.testing.assert_allclose(paddle.fft.fftshift(paddle.to_tensor(x)).numpy(),
+                                   np.fft.fftshift(x))
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5).astype(np.float32))
+        np.testing.assert_allclose(paddle.fft.rfftfreq(8).numpy(),
+                                   np.fft.rfftfreq(8).astype(np.float32))
+
+    def test_grad_through_rfft(self):
+        x = paddle.to_tensor(R.randn(16).astype(np.float32), stop_gradient=False)
+        X = paddle.fft.rfft(x)
+        ((X.real() ** 2 + X.imag() ** 2).sum()).backward()
+        # Parseval: d/dx sum |X|^2 = 2*N*x for rfft of real signal (interior bins
+        # counted once) — just check it's finite and nonzero
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+# ------------------------------------------------------------------- signal
+
+class TestSignal:
+    def test_frame_matches_manual(self):
+        x = np.arange(10, dtype=np.float32)
+        fr = paddle.signal.frame(paddle.to_tensor(x), 4, 2).numpy()
+        # frames: [0..3], [2..5], [4..7], [6..9] -> shape [4, 4] (fl, nf)
+        assert fr.shape == (4, 4)
+        np.testing.assert_allclose(fr[:, 0], x[0:4])
+        np.testing.assert_allclose(fr[:, 3], x[6:10])
+
+    def test_overlap_add_inverts_frame_hop_eq_len(self):
+        x = R.randn(2, 32).astype(np.float32)
+        fr = paddle.signal.frame(paddle.to_tensor(x), 8, 8)
+        back = paddle.signal.overlap_add(fr, 8)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-6, atol=1e-6)
+
+    def test_stft_istft_roundtrip(self):
+        x = R.randn(3, 128).astype(np.float32)
+        S = paddle.signal.stft(paddle.to_tensor(x), n_fft=32, hop_length=8)
+        assert S.shape == [3, 17, 17]
+        back = paddle.signal.istft(S, n_fft=32, hop_length=8, length=128)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-4)
+
+    def test_stft_window(self):
+        x = R.randn(64).astype(np.float32)
+        w = np.hanning(16).astype(np.float32)
+        S = paddle.signal.stft(paddle.to_tensor(x), n_fft=16, hop_length=4,
+                               window=paddle.to_tensor(w))
+        back = paddle.signal.istft(S, n_fft=16, hop_length=4,
+                                   window=paddle.to_tensor(w), length=64)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-3)
+
+
+# ------------------------------------------------------------------- geometric
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1, 2, 2]))
+        np.testing.assert_allclose(paddle.geometric.segment_sum(data, ids).numpy(),
+                                   [[2, 4], [10, 12], [18, 20]])
+        np.testing.assert_allclose(paddle.geometric.segment_mean(data, ids).numpy(),
+                                   [[1, 2], [5, 6], [9, 10]])
+        np.testing.assert_allclose(paddle.geometric.segment_max(data, ids).numpy(),
+                                   [[2, 3], [6, 7], [10, 11]])
+        np.testing.assert_allclose(paddle.geometric.segment_min(data, ids).numpy(),
+                                   [[0, 1], [4, 5], [8, 9]])
+
+    def test_segment_sum_grad(self):
+        x = paddle.to_tensor(R.randn(5, 3).astype(np.float32), stop_gradient=False)
+        ids = paddle.to_tensor(np.array([0, 1, 0, 2, 1]))
+        paddle.geometric.segment_sum(x, ids).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones((5, 3), np.float32))
+
+    def test_send_u_recv(self):
+        x = paddle.to_tensor(np.eye(4, dtype=np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        dst = paddle.to_tensor(np.array([1, 1, 0, 0]))
+        out = paddle.geometric.send_u_recv(x, src, dst, "sum")
+        np.testing.assert_allclose(out.numpy(),
+                                   [[0, 0, 1, 1], [1, 1, 0, 0],
+                                    [0, 0, 0, 0], [0, 0, 0, 0]])
+        out = paddle.geometric.send_u_recv(x, src, dst, "mean")
+        np.testing.assert_allclose(out.numpy()[0], [0, 0, .5, .5])
+
+    def test_send_ue_recv_and_uv(self):
+        x = paddle.to_tensor(np.ones((3, 2), np.float32))
+        e = paddle.to_tensor(np.full((3, 2), 2.0, np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2]))
+        dst = paddle.to_tensor(np.array([1, 2, 0]))
+        out = paddle.geometric.send_ue_recv(x, e, src, dst, "mul", "sum")
+        np.testing.assert_allclose(out.numpy(), np.full((3, 2), 2.0))
+        uv = paddle.geometric.send_uv(x, x, src, dst, "add")
+        np.testing.assert_allclose(uv.numpy(), np.full((3, 2), 2.0))
+
+
+# ------------------------------------------------------------ vision functional
+
+class TestGridSample:
+    def test_identity_grid(self):
+        x = R.randn(1, 2, 5, 5).astype(np.float32)
+        theta = np.array([[[1, 0, 0], [0, 1, 0]]], np.float32)
+        grid = F.affine_grid(paddle.to_tensor(theta), [1, 2, 5, 5],
+                             align_corners=True)
+        out = F.grid_sample(paddle.to_tensor(x), grid, align_corners=True)
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-5, atol=1e-5)
+
+    def test_translation(self):
+        x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+        # shift right by one pixel (align_corners grid): sample from x-1
+        theta = np.array([[[1, 0, -1.0], [0, 1, 0]]], np.float32)
+        grid = F.affine_grid(paddle.to_tensor(theta), [1, 1, 3, 3],
+                             align_corners=True)
+        out = F.grid_sample(paddle.to_tensor(x), grid, align_corners=True,
+                            padding_mode="border")
+        np.testing.assert_allclose(out.numpy()[0, 0, :, 1:], x[0, 0, :, :2])
+
+    def test_modes(self):
+        x = paddle.to_tensor(R.randn(2, 3, 6, 6).astype(np.float32))
+        grid = paddle.to_tensor(
+            (R.rand(2, 4, 4, 2).astype(np.float32) * 2 - 1))
+        for mode in ("bilinear", "nearest"):
+            for pm in ("zeros", "border", "reflection"):
+                out = F.grid_sample(x, grid, mode=mode, padding_mode=pm,
+                                    align_corners=False)
+                assert out.shape == [2, 3, 4, 4]
+                assert np.isfinite(out.numpy()).all()
+
+    def test_grad(self):
+        x = paddle.to_tensor(R.randn(1, 1, 4, 4).astype(np.float32),
+                             stop_gradient=False)
+        grid = paddle.to_tensor((R.rand(1, 2, 2, 2) * 1.6 - 0.8).astype(np.float32),
+                                stop_gradient=False)
+        F.grid_sample(x, grid).sum().backward()
+        assert x.grad is not None and grid.grad is not None
+
+
+class TestExtension:
+    def test_gather_tree(self):
+        # the reference's doc example (gather_tree op)
+        ids = paddle.to_tensor(np.array(
+            [[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]], np.int64))
+        parents = paddle.to_tensor(np.array(
+            [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]], np.int64))
+        out = F.gather_tree(ids, parents)
+        np.testing.assert_array_equal(
+            out.numpy(),
+            [[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]])
+
+    def test_temporal_shift(self):
+        x = paddle.to_tensor(R.randn(4, 4, 2, 2).astype(np.float32))
+        out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+        assert out.shape == [4, 4, 2, 2]
+        xn = x.numpy().reshape(2, 2, 4, 2, 2)
+        on = out.numpy().reshape(2, 2, 4, 2, 2)
+        # first quarter shifted backward: out[:, t, 0] = x[:, t+1, 0]
+        np.testing.assert_allclose(on[:, 0, 0], xn[:, 1, 0])
+        np.testing.assert_allclose(on[:, 1, 0], 0)
+        # second quarter shifted forward: out[:, t, 1] = x[:, t-1, 1]
+        np.testing.assert_allclose(on[:, 1, 1], xn[:, 0, 1])
+        np.testing.assert_allclose(on[:, 0, 1], 0)
+        # rest unshifted
+        np.testing.assert_allclose(on[:, :, 2:], xn[:, :, 2:])
+
+
+class TestViterbi:
+    def test_matches_brute_force(self):
+        import itertools
+
+        import paddle_tpu.text as text
+        B, T, N = 2, 4, 3
+        emis = R.randn(B, T, N).astype(np.float32)
+        trans = R.randn(N, N).astype(np.float32)
+        lens = np.array([T, T], np.int64)
+        scores, path = text.viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+        for b in range(B):
+            best, best_path = -1e30, None
+            for seq in itertools.product(range(N), repeat=T):
+                s = emis[b, 0, seq[0]]
+                for t in range(1, T):
+                    s += trans[seq[t - 1], seq[t]] + emis[b, t, seq[t]]
+                if s > best:
+                    best, best_path = s, seq
+            np.testing.assert_allclose(scores.numpy()[b], best, rtol=1e-5)
+            np.testing.assert_array_equal(path.numpy()[b], best_path)
+
+
+# ------------------------------------------------------------------- losses
+
+class TestNewLosses:
+    def test_soft_margin(self):
+        x = R.randn(4, 3).astype(np.float32)
+        y = np.sign(R.randn(4, 3)).astype(np.float32)
+        out = F.soft_margin_loss(paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(),
+                                   np.mean(np.log1p(np.exp(-y * x))), rtol=1e-5)
+
+    def test_multi_label_soft_margin(self):
+        x = R.randn(4, 5).astype(np.float32)
+        y = (R.rand(4, 5) > 0.5).astype(np.float32)
+        out = F.multi_label_soft_margin_loss(paddle.to_tensor(x), paddle.to_tensor(y))
+        sig = 1 / (1 + np.exp(-x))
+        ref = -(y * np.log(sig) + (1 - y) * np.log(1 - sig)).mean(axis=-1).mean()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+    def test_dice(self):
+        x = np.abs(R.rand(4, 3).astype(np.float32))
+        x = x / x.sum(-1, keepdims=True)
+        y = R.randint(0, 3, (4, 1)).astype(np.int64)
+        out = F.dice_loss(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert 0 <= float(out.numpy()) <= 1
+
+    def test_npair(self):
+        a = R.randn(4, 8).astype(np.float32)
+        p_ = R.randn(4, 8).astype(np.float32)
+        y = np.array([0, 1, 0, 2], np.int64)
+        out = F.npair_loss(paddle.to_tensor(a), paddle.to_tensor(p_),
+                           paddle.to_tensor(y))
+        assert np.isfinite(out.numpy())
+
+    def test_hsigmoid_default_tree(self):
+        x = paddle.to_tensor(R.randn(3, 6).astype(np.float32), stop_gradient=False)
+        y = paddle.to_tensor(np.array([0, 3, 4], np.int64))
+        w = paddle.to_tensor(R.randn(7, 6).astype(np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.zeros(7, np.float32))
+        out = F.hsigmoid_loss(x, y, 8, w, b)
+        assert out.shape == [3, 1]
+        out.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        assert np.abs(w.grad.numpy()).sum() > 0
+
+    def test_margin_cross_entropy(self):
+        # with no margins and scale 1 it reduces to plain softmax CE on cos
+        logits = np.clip(R.randn(4, 6).astype(np.float32), -1, 1)
+        y = R.randint(0, 6, (4,)).astype(np.int64)
+        out = F.margin_cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(y),
+                                     margin1=1.0, margin2=0.0, margin3=0.0,
+                                     scale=1.0)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        ref = -np.log(sm[np.arange(4), y]).mean()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4)
+
+    def test_class_center_sample(self):
+        y = paddle.to_tensor(np.array([1, 5, 1, 9], np.int64))
+        remapped, sampled = F.class_center_sample(y, 20, 6)
+        s = sampled.numpy()
+        assert set([1, 5, 9]) <= set(s.tolist())
+        assert len(s) == 6
+        r = remapped.numpy()
+        np.testing.assert_array_equal(s[r], [1, 5, 1, 9])
+
+
+# ------------------------------------------------------------ manipulation adds
+
+CASES = [
+    OpTestCase("clip_by_norm", paddle.clip_by_norm,
+               lambda x, max_norm: x * min(1.0, max_norm / np.sqrt((x ** 2).sum())),
+               {"x": R.randn(3, 4).astype(np.float32)}, kwargs={"max_norm": 1.0}),
+    OpTestCase("frobenius_norm", paddle.frobenius_norm,
+               lambda x: np.sqrt((x ** 2).sum()),
+               {"x": R.randn(3, 4).astype(np.float32)}),
+    OpTestCase("renorm", paddle.renorm,
+               lambda x, p, axis, max_norm: np.stack(
+                   [r * min(1.0, max_norm / (np.abs(r) ** p).sum() ** (1 / p))
+                    for r in x], 0),
+               {"x": R.randn(3, 4).astype(np.float32)},
+               kwargs={"p": 2.0, "axis": 0, "max_norm": 1.0},
+               rtol=1e-4, atol=1e-5),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c.name)
+def test_op_sweep_r2(case):
+    case.check()
+
+
+class TestFillOps:
+    def test_fill_diagonal_(self):
+        x = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        x.fill_diagonal_(5.0) if hasattr(x, "fill_diagonal_") else \
+            paddle.fill_diagonal_(x, 5.0)
+        ref = np.zeros((3, 4), np.float32)
+        np.fill_diagonal(ref, 5.0)
+        np.testing.assert_allclose(x.numpy(), ref)
+
+    def test_fill_diagonal_tensor(self):
+        x = paddle.to_tensor(np.zeros((3, 3), np.float32))
+        d = paddle.to_tensor(np.array([1, 2, 3], np.float32))
+        out = paddle.fill_diagonal_tensor(x, d)
+        np.testing.assert_allclose(out.numpy(), np.diag([1, 2, 3]).astype(np.float32))
+
+    def test_fill_(self):
+        x = paddle.to_tensor(np.zeros((2, 2), np.float32))
+        paddle.fill_(x, 7.0)
+        np.testing.assert_allclose(x.numpy(), np.full((2, 2), 7.0))
+
+    def test_multiplex(self):
+        a = np.array([[1, 2], [3, 4]], np.float32)
+        b = np.array([[5, 6], [7, 8]], np.float32)
+        idx = np.array([[1], [0]], np.int32)
+        out = paddle.multiplex([paddle.to_tensor(a), paddle.to_tensor(b)],
+                               paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), [[5, 6], [3, 4]])
+
+    def test_reverse(self):
+        x = paddle.to_tensor(np.arange(6).astype(np.float32).reshape(2, 3))
+        np.testing.assert_allclose(paddle.reverse(x, axis=[1]).numpy(),
+                                   x.numpy()[:, ::-1])
