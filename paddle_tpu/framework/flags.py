@@ -110,5 +110,7 @@ define_flag("tpu_fused_optimizer", True,
             "flat param/state buffers per dtype group (ref fused adam kernels)")
 define_flag("tpu_flash_impl", "auto",
             "flash-attention backend: auto | splash (Pallas splash kernel) | "
-            "mosaic (legacy Pallas flash) | xla (pure-XLA flash-style custom "
-            "vjp, also the fallback for non-tileable shapes)")
+            "mosaic (jax-bundled Pallas flash) | authored (in-repo Pallas "
+            "kernel, kernels/pallas/flash_attention.py) | xla (pure-XLA "
+            "flash-style custom vjp, also the fallback for non-tileable "
+            "shapes)")

@@ -301,6 +301,10 @@ def flash_attention_fn(causal=False, scale=None):
         elif impl == "mosaic" and tileable:
             sm = scale if scale is not None else 1.0 / math.sqrt(D)
             out = _pallas_flash(qt, kt, vt, causal, sm)
+        elif impl == "authored":
+            # the in-repo Pallas kernel (kernels/pallas/flash_attention.py)
+            from paddle_tpu.kernels.pallas import flash_attention as _authored
+            out = _authored(qt, kt, vt, causal=causal, sm_scale=scale)
         else:
             out = _xla_flash(qt, kt, vt, causal, scale)
         return jnp.swapaxes(out, 1, 2)

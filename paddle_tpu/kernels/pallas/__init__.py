@@ -1,0 +1,22 @@
+"""In-repo authored Pallas TPU kernels.
+
+The counterpart of the reference's hand-written fused CUDA kernels
+(`paddle/phi/kernels/fusion/`, `paddle/fluid/operators/fused/`): where the
+reference writes .cu files per op, this framework authors Mosaic-compiled
+Pallas kernels for the ops XLA does not already fuse optimally.
+
+Kernels:
+- :mod:`flash_attention` — online-softmax attention forward
+  (≈ `fused_attention_op.cu` but flash; the reference has NO flash kernel,
+  SURVEY §5.7).
+- :mod:`fused_layernorm` — single-pass layernorm fwd + analytic bwd
+  (≈ `fused_layernorm` kernels in `phi/kernels/fusion/`).
+- :mod:`rotary` — fused rotary position embedding
+  (≈ `fused_rope` in newer reference branches).
+
+All kernels run under ``interpret=True`` on CPU for tests; on TPU they compile
+through Mosaic.
+"""
+from paddle_tpu.kernels.pallas.flash_attention import flash_attention  # noqa: F401
+from paddle_tpu.kernels.pallas.fused_layernorm import fused_layer_norm  # noqa: F401
+from paddle_tpu.kernels.pallas.rotary import apply_rotary_emb  # noqa: F401

@@ -1,0 +1,149 @@
+"""Authored Pallas TPU flash-attention forward kernel.
+
+Online-softmax blockwise attention (Dao et al.) written directly against the
+Pallas TPU API — the in-repo counterpart of the reference's fused attention
+CUDA op (`paddle/fluid/operators/fused/fused_attention_op.cu`, which is
+non-flash: it materialises the full [S, S] score matrix via `fmha_ref.h`).
+Here scores never leave VMEM: the kernel streams K/V blocks through the MXU
+and keeps a running (max, denom, accumulator) triple per query block, so HBM
+traffic is O(S·D) instead of O(S²).
+
+Backward runs as the standard recompute VJP traced by XLA (`jax.custom_vjp`
+over the reference math): on TPU the bwd matmul chain is already fused well by
+XLA, and the fwd kernel is where the O(S²) memory win lives.
+
+Layout: [B, H, S, D] (callers with paddle's [B, S, H, D] transpose first —
+see `paddle_tpu/kernels/flash_attention.py`).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
+                block_k, seq_k):
+    # q_ref: [1, block_q, D]; k_ref/v_ref: [1, seq_k, D]; o_ref: [1, block_q, D]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+
+    num_kb = pl.cdiv(seq_k, block_k)
+    if causal:
+        # blocks strictly above the diagonal contribute nothing
+        last = ((qi + 1) * block_q + block_k - 1) // block_k
+        num_kb = jnp.minimum(num_kb, last)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kpos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = kpos < seq_k            # ragged tail: block padding is garbage
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask &= kpos <= qpos
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(p, v,
+                                        preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    a0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, a0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    # dynamic slices clamp at the array edge, so a ragged K tail must be
+    # zero-padded up front (the kpos mask discards the padding)
+    pad_k = (-sk) % block_k
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0)))
+    sk_pad = sk + pad_k
+    grid = (bh, pl.cdiv(sq, block_q))
+    kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                             block_q=block_q, block_k=block_k, seq_k=sk)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference(q, k, v, sm_scale, causal):
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    return _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    # recompute VJP of the reference math (XLA fuses this chain on TPU)
+    _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, sm_scale, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal=False, sm_scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Blockwise flash attention. q/k/v: [B, H, S, D] jax arrays.
+
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU so tests run
+    on the CPU mesh; on TPU the kernel compiles through Mosaic.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    out = _flash(qf, kf, vf, float(sm_scale), bool(causal), int(block_q),
+                 int(block_k), bool(interpret))
+    return out.reshape(b, h, sq, d)

@@ -1,0 +1,144 @@
+"""Authored Pallas kernels — correctness vs reference math (interpret mode on
+the CPU mesh; on TPU the same kernels compile through Mosaic)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.kernels.pallas import (
+    flash_attention, fused_layer_norm, apply_rotary_emb,
+)
+from paddle_tpu.kernels.pallas.flash_attention import _reference
+
+R = np.random.RandomState(3)
+
+
+def _qkv(b=2, h=2, s=64, d=32):
+    return (jnp.asarray(R.randn(b, h, s, d).astype(np.float32)),
+            jnp.asarray(R.randn(b, h, s, d).astype(np.float32)),
+            jnp.asarray(R.randn(b, h, s, d).astype(np.float32)))
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_reference(self, causal):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        b, h, s, d = q.shape
+        ref = _reference(q.reshape(b * h, s, d), k.reshape(b * h, s, d),
+                         v.reshape(b * h, s, d), 1 / np.sqrt(d), causal)
+        np.testing.assert_allclose(np.asarray(out).reshape(b * h, s, d),
+                                   np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_ragged_blocks(self):
+        # seq not divisible by block: 48 with block 32
+        q, k, v = _qkv(s=48)
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        b, h, s, d = q.shape
+        ref = _reference(q.reshape(b * h, s, d), k.reshape(b * h, s, d),
+                         v.reshape(b * h, s, d), 1 / np.sqrt(d), True)
+        np.testing.assert_allclose(np.asarray(out).reshape(b * h, s, d),
+                                   np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_reference(self):
+        q, k, v = _qkv(b=1, h=2, s=32, d=16)
+        b, h, s, d = q.shape
+
+        def f(q, k, v):
+            return (flash_attention(q, k, v, causal=True, block_q=16,
+                                    block_k=16) ** 2).sum()
+
+        def fr(q, k, v):
+            return (_reference(q.reshape(b * h, s, d), k.reshape(b * h, s, d),
+                               v.reshape(b * h, s, d), 1 / np.sqrt(d), True)
+                    ** 2).sum()
+
+        ga = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a).ravel(),
+                                       np.asarray(b_).ravel(),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_bf16(self):
+        q, k, v = _qkv(s=32, d=32)
+        q, k, v = q.astype(jnp.bfloat16), k.astype(jnp.bfloat16), v.astype(jnp.bfloat16)
+        out = flash_attention(q, k, v, causal=False, block_q=32, block_k=32)
+        assert out.dtype == jnp.bfloat16
+        b, h, s, d = q.shape
+        ref = _reference(q.reshape(b * h, s, d), k.reshape(b * h, s, d),
+                         v.reshape(b * h, s, d), 1 / np.sqrt(d), False)
+        np.testing.assert_allclose(
+            np.asarray(out.astype(jnp.float32)).reshape(b * h, s, d),
+            np.asarray(ref.astype(jnp.float32)), rtol=5e-2, atol=5e-2)
+
+
+class TestFusedLayerNorm:
+    def _ref(self, x, g, b, eps=1e-5):
+        mu = x.mean(-1, keepdims=True)
+        rs = jax.lax.rsqrt(x.var(-1, keepdims=True) + eps)
+        return (x - mu) * rs * g + b
+
+    def test_forward(self):
+        x = jnp.asarray(R.randn(100, 64).astype(np.float32))
+        g = jnp.asarray(R.randn(64).astype(np.float32))
+        b = jnp.asarray(R.randn(64).astype(np.float32))
+        y = fused_layer_norm(x, g, b, block_rows=32)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(self._ref(x, g, b)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_3d_input(self):
+        x = jnp.asarray(R.randn(4, 7, 32).astype(np.float32))
+        g = jnp.ones(32, jnp.float32)
+        b = jnp.zeros(32, jnp.float32)
+        y = fused_layer_norm(x, g, b)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(self._ref(x, g, b)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads(self):
+        x = jnp.asarray(R.randn(100, 64).astype(np.float32))
+        g = jnp.asarray(R.randn(64).astype(np.float32))
+        b = jnp.asarray(R.randn(64).astype(np.float32))
+
+        def loss(x, g, b):
+            return (fused_layer_norm(x, g, b, block_rows=32) ** 2).sum()
+
+        def rloss(x, g, b):
+            return (self._ref(x, g, b) ** 2).sum()
+
+        ga = jax.grad(loss, argnums=(0, 1, 2))(x, g, b)
+        gb = jax.grad(rloss, argnums=(0, 1, 2))(x, g, b)
+        for a, b_, name in zip(ga, gb, ["dx", "dgamma", "dbeta"]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-3, atol=1e-3, err_msg=name)
+
+
+class TestRotary:
+    def test_matches_reference(self):
+        S, D = 64, 32
+        q, k, _ = _qkv(s=S, d=D)
+        inv = 1.0 / (10000 ** (np.arange(0, D // 2) / (D // 2)))
+        ang = np.outer(np.arange(S), inv).astype(np.float32)
+        cos, sin = jnp.asarray(np.cos(ang)), jnp.asarray(np.sin(ang))
+        qr, kr = apply_rotary_emb(q, k, cos, sin, block_s=32)
+
+        def ref(x):
+            x1, x2 = x[..., :D // 2], x[..., D // 2:]
+            return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+
+        np.testing.assert_allclose(np.asarray(qr), np.asarray(ref(q)),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(kr), np.asarray(ref(k)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_norm_preserved(self):
+        # rotation preserves the per-pair norm
+        S, D = 32, 16
+        q, k, _ = _qkv(s=S, d=D)
+        inv = 1.0 / (10000 ** (np.arange(0, D // 2) / (D // 2)))
+        ang = np.outer(np.arange(S), inv).astype(np.float32)
+        qr, _ = apply_rotary_emb(q, k, jnp.asarray(np.cos(ang)),
+                                 jnp.asarray(np.sin(ang)))
+        n0 = np.linalg.norm(np.asarray(q), axis=-1)
+        n1 = np.linalg.norm(np.asarray(qr), axis=-1)
+        np.testing.assert_allclose(n0, n1, rtol=1e-4)
