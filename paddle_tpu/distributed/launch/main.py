@@ -100,8 +100,10 @@ class Pod:
                         sys.stderr.write(
                             f"[launch] rank(s) {dead} heartbeat stale — "
                             "treating as failed\n")
+                        # 124: conventional timeout exit code (a numeric code
+                        # must flow to sys.exit / supervisor scripting)
                         bad = [(next(c for c in self.containers
-                                     if c.rank == dead[0]), "stale")]
+                                     if c.rank == dead[0]), 124)]
                 if bad:
                     c0, code = bad[0]
                     sys.stderr.write(

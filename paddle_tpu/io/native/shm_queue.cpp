@@ -66,6 +66,29 @@ inline int robust_lock(Ctrl* c) {
   return rc;
 }
 
+// cond waits re-acquire the mutex internally, so EOWNERDEAD can surface from
+// them too (the common case: peer dies while we sleep on the condvar); the
+// mutex must be marked consistent there as well or it becomes permanently
+// ENOTRECOVERABLE
+inline int robust_cond_wait(pthread_cond_t* cv, Ctrl* c) {
+  int rc = pthread_cond_wait(cv, &c->mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&c->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+inline int robust_cond_timedwait(pthread_cond_t* cv, Ctrl* c,
+                                 const struct timespec* ts) {
+  int rc = pthread_cond_timedwait(cv, &c->mu, ts);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&c->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
 }  // namespace
 
 extern "C" {
@@ -145,7 +168,7 @@ int shmq_push(void* hv, const void* data, uint64_t len) {
   if (len > c->slot_size) return -2;
   robust_lock(c);
   while (c->count == c->slots && !c->closed)
-    pthread_cond_wait(&c->not_full, &c->mu);
+    robust_cond_wait(&c->not_full, c);
   if (c->closed) {
     pthread_mutex_unlock(&c->mu);
     return -1;
@@ -170,7 +193,7 @@ int64_t shmq_pop_timed(void* hv, void* out, uint64_t cap, int64_t timeout_ms) {
   robust_lock(c);
   if (timeout_ms < 0) {
     while (c->count == 0 && !c->closed)
-      pthread_cond_wait(&c->not_empty, &c->mu);
+      robust_cond_wait(&c->not_empty, c);
   } else {
     struct timespec ts;
     clock_gettime(CLOCK_REALTIME, &ts);
@@ -181,7 +204,7 @@ int64_t shmq_pop_timed(void* hv, void* out, uint64_t cap, int64_t timeout_ms) {
       ts.tv_nsec -= 1000000000L;
     }
     while (c->count == 0 && !c->closed) {
-      if (pthread_cond_timedwait(&c->not_empty, &c->mu, &ts) == ETIMEDOUT) {
+      if (robust_cond_timedwait(&c->not_empty, c, &ts) == ETIMEDOUT) {
         if (c->count == 0) {
           int closed = c->closed;
           pthread_mutex_unlock(&c->mu);

@@ -62,7 +62,11 @@ def load(name, sources, extra_cxx_flags=None, build_directory=None,
     so = os.path.join(build_directory, f"lib{name}.so")
     srcs_mtime = max(os.path.getmtime(s) for s in sources)
     if not os.path.exists(so) or os.path.getmtime(so) < srcs_mtime:
-        cmd = (["g++", "-O2", "-shared", "-fPIC", "-o", so] + list(sources)
+        # compile to a per-process temp then publish atomically, so concurrent
+        # ranks never dlopen a half-written .so (same pattern as
+        # io/native_queue.py:_build)
+        tmp = f"{so}.{os.getpid()}.tmp"
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-o", tmp] + list(sources)
                + (extra_cxx_flags or []))
         if verbose:
             print("cpp_extension:", " ".join(cmd))
@@ -70,6 +74,7 @@ def load(name, sources, extra_cxx_flags=None, build_directory=None,
         if res.returncode != 0:
             raise RuntimeError(
                 f"cpp_extension build failed:\n{res.stderr}")
+        os.replace(tmp, so)
     return CppExtensionModule(ctypes.CDLL(so), name)
 
 
