@@ -11,3 +11,12 @@ from paddle_tpu.vision.models.mobilenet import (  # noqa: F401
     MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2)
 from paddle_tpu.vision.models.alexnet import (  # noqa: F401
     AlexNet, alexnet, SqueezeNet, squeezenet1_0, squeezenet1_1)
+from paddle_tpu.vision.models.densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264)
+from paddle_tpu.vision.models.googlenet import (  # noqa: F401
+    GoogLeNet, googlenet, InceptionV3, inception_v3)
+from paddle_tpu.vision.models.shufflenetv2 import (  # noqa: F401
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0)
+from paddle_tpu.vision.models.mobilenetv3 import (  # noqa: F401
+    MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small, mobilenet_v3_large)

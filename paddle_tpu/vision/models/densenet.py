@@ -1,0 +1,136 @@
+"""DenseNet family (ref: `python/paddle/vision/models/densenet.py`).
+NCHW; dense blocks concatenate features so XLA fuses the BN+ReLU+conv chains."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, num_input_features, growth_rate, bn_size, drop_rate):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(num_input_features)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(num_input_features, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                               bias_attr=False)
+        self.drop_rate = drop_rate
+        self.dropout = nn.Dropout(drop_rate) if drop_rate > 0 else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return paddle.concat([x, out], axis=1)
+
+
+class _DenseBlock(nn.Layer):
+    def __init__(self, num_layers, num_input_features, bn_size, growth_rate,
+                 drop_rate):
+        super().__init__()
+        self.layers = nn.LayerList([
+            _DenseLayer(num_input_features + i * growth_rate, growth_rate,
+                        bn_size, drop_rate)
+            for i in range(num_layers)
+        ])
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class _Transition(nn.Layer):
+    def __init__(self, num_input_features, num_output_features):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(num_input_features)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(num_input_features, num_output_features, 1,
+                              bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+_CFG = {
+    121: (6, 12, 24, 16),
+    161: (6, 12, 36, 24),
+    169: (6, 12, 32, 32),
+    201: (6, 12, 48, 32),
+    264: (6, 12, 64, 48),
+}
+
+
+class DenseNet(nn.Layer):
+    """DenseNet (ref densenet.py:DenseNet)."""
+
+    def __init__(self, layers=121, growth_rate=32, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        if layers == 161:
+            growth_rate = 48
+            num_init_features = 96
+        else:
+            num_init_features = 64
+        block_config = _CFG[layers]
+        self.conv0 = nn.Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                               bias_attr=False)
+        self.norm0 = nn.BatchNorm2D(num_init_features)
+        self.relu = nn.ReLU()
+        self.pool0 = nn.MaxPool2D(3, stride=2, padding=1)
+
+        blocks, feats = [], num_init_features
+        for i, n in enumerate(block_config):
+            blocks.append(_DenseBlock(n, feats, bn_size, growth_rate, dropout))
+            feats += n * growth_rate
+            if i != len(block_config) - 1:
+                blocks.append(_Transition(feats, feats // 2))
+                feats //= 2
+        self.blocks = nn.LayerList(blocks)
+        self.norm5 = nn.BatchNorm2D(feats)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(feats, num_classes)
+
+    def forward(self, x):
+        x = self.pool0(self.relu(self.norm0(self.conv0(x))))
+        for b in self.blocks:
+            x = b(x)
+        x = self.relu(self.norm5(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = paddle.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def _densenet(layers, **kwargs):
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, **kwargs)

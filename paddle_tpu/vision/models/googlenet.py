@@ -1,0 +1,243 @@
+"""GoogLeNet / Inception v1 and Inception v3
+(ref: `python/paddle/vision/models/googlenet.py`, `inceptionv3.py`)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class _ConvBN(nn.Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, c1, 1)
+        self.b2_1 = _ConvBN(in_c, c3r, 1)
+        self.b2_2 = _ConvBN(c3r, c3, 3, padding=1)
+        self.b3_1 = _ConvBN(in_c, c5r, 1)
+        self.b3_2 = _ConvBN(c5r, c5, 5, padding=2)
+        self.pool = nn.MaxPool2D(3, stride=1, padding=1)
+        self.b4 = _ConvBN(in_c, proj, 1)
+
+    def forward(self, x):
+        return paddle.concat([
+            self.b1(x),
+            self.b2_2(self.b2_1(x)),
+            self.b3_2(self.b3_1(x)),
+            self.b4(self.pool(x)),
+        ], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """Inception v1 (ref googlenet.py:GoogLeNet). Returns (main, aux1, aux2)
+    like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _ConvBN(64, 64, 1),
+            _ConvBN(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+            # aux classifiers (training heads, ref :aux_logits)
+            self.aux1 = nn.Sequential(
+                nn.AdaptiveAvgPool2D(4), nn.Flatten(),
+                nn.Linear(512 * 16, 1024), nn.ReLU(), nn.Dropout(0.7),
+                nn.Linear(1024, num_classes))
+            self.aux2 = nn.Sequential(
+                nn.AdaptiveAvgPool2D(4), nn.Flatten(),
+                nn.Linear(528 * 16, 1024), nn.ReLU(), nn.Dropout(0.7),
+                nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        aux1 = self.aux1(x) if self.num_classes > 0 else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2 = self.aux2(x) if self.num_classes > 0 else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(paddle.flatten(x, 1)))
+        return x, aux1, aux2
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)
+
+
+# ------------------------------------------------------------- Inception v3
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 64, 1)
+        self.b5_1 = _ConvBN(in_c, 48, 1)
+        self.b5_2 = _ConvBN(48, 64, 5, padding=2)
+        self.b3_1 = _ConvBN(in_c, 64, 1)
+        self.b3_2 = _ConvBN(64, 96, 3, padding=1)
+        self.b3_3 = _ConvBN(96, 96, 3, padding=1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(in_c, pool_features, 1)
+
+    def forward(self, x):
+        return paddle.concat([
+            self.b1(x), self.b5_2(self.b5_1(x)),
+            self.b3_3(self.b3_2(self.b3_1(x))), self.bp(self.pool(x))], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _ConvBN(in_c, 384, 3, stride=2)
+        self.bd_1 = _ConvBN(in_c, 64, 1)
+        self.bd_2 = _ConvBN(64, 96, 3, padding=1)
+        self.bd_3 = _ConvBN(96, 96, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([
+            self.b3(x), self.bd_3(self.bd_2(self.bd_1(x))), self.pool(x)],
+            axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 192, 1)
+        self.b7_1 = _ConvBN(in_c, c7, 1)
+        self.b7_2 = _ConvBN(c7, c7, (1, 7), padding=(0, 3))
+        self.b7_3 = _ConvBN(c7, 192, (7, 1), padding=(3, 0))
+        self.b77_1 = _ConvBN(in_c, c7, 1)
+        self.b77_2 = _ConvBN(c7, c7, (7, 1), padding=(3, 0))
+        self.b77_3 = _ConvBN(c7, c7, (1, 7), padding=(0, 3))
+        self.b77_4 = _ConvBN(c7, c7, (7, 1), padding=(3, 0))
+        self.b77_5 = _ConvBN(c7, 192, (1, 7), padding=(0, 3))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(in_c, 192, 1)
+
+    def forward(self, x):
+        return paddle.concat([
+            self.b1(x),
+            self.b7_3(self.b7_2(self.b7_1(x))),
+            self.b77_5(self.b77_4(self.b77_3(self.b77_2(self.b77_1(x))))),
+            self.bp(self.pool(x))], axis=1)
+
+
+class _InceptionD(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3_1 = _ConvBN(in_c, 192, 1)
+        self.b3_2 = _ConvBN(192, 320, 3, stride=2)
+        self.b7_1 = _ConvBN(in_c, 192, 1)
+        self.b7_2 = _ConvBN(192, 192, (1, 7), padding=(0, 3))
+        self.b7_3 = _ConvBN(192, 192, (7, 1), padding=(3, 0))
+        self.b7_4 = _ConvBN(192, 192, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return paddle.concat([
+            self.b3_2(self.b3_1(x)),
+            self.b7_4(self.b7_3(self.b7_2(self.b7_1(x)))),
+            self.pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 320, 1)
+        self.b3_1 = _ConvBN(in_c, 384, 1)
+        self.b3_2a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b33_1 = _ConvBN(in_c, 448, 1)
+        self.b33_2 = _ConvBN(448, 384, 3, padding=1)
+        self.b33_3a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b33_3b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBN(in_c, 192, 1)
+
+    def forward(self, x):
+        b3 = self.b3_1(x)
+        b33 = self.b33_2(self.b33_1(x))
+        return paddle.concat([
+            self.b1(x),
+            paddle.concat([self.b3_2a(b3), self.b3_2b(b3)], axis=1),
+            paddle.concat([self.b33_3a(b33), self.b33_3b(b33)], axis=1),
+            self.bp(self.pool(x))], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Inception v3 (ref inceptionv3.py:InceptionV3)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBN(3, 32, 3, stride=2),
+            _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _ConvBN(64, 80, 1),
+            _ConvBN(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160), _InceptionC(768, 160),
+            _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(paddle.flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

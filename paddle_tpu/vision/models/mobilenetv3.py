@@ -1,0 +1,151 @@
+"""MobileNet v3 small/large (ref: `python/paddle/vision/models/mobilenetv3.py`)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _SE(nn.Layer):
+    def __init__(self, c, r=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, _make_divisible(c // r), 1)
+        self.fc2 = nn.Conv2D(_make_divisible(c // r), c, 1)
+
+    def forward(self, x):
+        s = self.pool(x)
+        s = F.relu(self.fc1(s))
+        s = F.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _ConvBNAct(nn.Sequential):
+    def __init__(self, in_c, out_c, k, stride=1, groups=1, act="hardswish"):
+        layers = [
+            nn.Conv2D(in_c, out_c, k, stride=stride, padding=k // 2,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+        ]
+        if act == "relu":
+            layers.append(nn.ReLU())
+        elif act == "hardswish":
+            layers.append(nn.Hardswish())
+        super().__init__(*layers)
+
+
+class _Bneck(nn.Layer):
+    def __init__(self, in_c, exp, out_c, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp != in_c:
+            layers.append(_ConvBNAct(in_c, exp, 1, act=act))
+        layers.append(_ConvBNAct(exp, exp, k, stride=stride, groups=exp, act=act))
+        if use_se:
+            layers.append(_SE(exp))
+        layers.append(_ConvBNAct(exp, out_c, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_LARGE = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+_SMALL = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        self.conv0 = _ConvBNAct(3, in_c, 3, stride=2, act="hardswish")
+        blocks = []
+        for k, exp, out_c, se, act, stride in config:
+            exp_c = _make_divisible(exp * scale)
+            out_sc = _make_divisible(out_c * scale)
+            blocks.append(_Bneck(in_c, exp_c, out_sc, k, stride, se, act))
+            in_c = out_sc
+        self.blocks = nn.Sequential(*blocks)
+        last_conv = _make_divisible(6 * in_c)
+        self.conv_last = _ConvBNAct(in_c, last_conv, 1, act="hardswish")
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.conv_last(self.blocks(self.conv0(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(paddle.flatten(x, 1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    """MobileNetV3-Large (ref mobilenetv3.py:MobileNetV3Large)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 1280, scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    """MobileNetV3-Small (ref mobilenetv3.py:MobileNetV3Small)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 1024, scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)

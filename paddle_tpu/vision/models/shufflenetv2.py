@@ -1,0 +1,133 @@
+"""ShuffleNet v2 (ref: `python/paddle/vision/models/shufflenetv2.py`)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _channel_shuffle(x, groups):
+    return F.channel_shuffle(x, groups)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(in_c // 2, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU(),
+                nn.Conv2D(branch_c, branch_c, 3, stride=1, padding=1,
+                          groups=branch_c, bias_attr=False),
+                nn.BatchNorm2D(branch_c),
+                nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU(),
+            )
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1,
+                          groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU(),
+            )
+            self.branch2 = nn.Sequential(
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU(),
+                nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                          groups=branch_c, bias_attr=False),
+                nn.BatchNorm2D(branch_c),
+                nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU(),
+            )
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    """ShuffleNet v2 (ref shufflenetv2.py:ShuffleNetV2)."""
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = (4, 8, 4)
+        out_cs = _STAGE_OUT[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, out_cs[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(out_cs[0]), nn.ReLU(),
+        )
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = out_cs[0]
+        for i, reps in enumerate(stage_repeats):
+            out_c = out_cs[i + 1]
+            seq = [_InvertedResidual(in_c, out_c, 2)]
+            for _ in range(reps - 1):
+                seq.append(_InvertedResidual(out_c, out_c, 1))
+            stages.append(nn.Sequential(*seq))
+            in_c = out_c
+        self.stages = nn.LayerList(stages)
+        self.conv5 = nn.Sequential(
+            nn.Conv2D(in_c, out_cs[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(out_cs[-1]), nn.ReLU(),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(out_cs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        for s in self.stages:
+            x = s(x)
+        x = self.conv5(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(paddle.flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)

@@ -225,3 +225,237 @@ def pad(img, padding, fill=0, padding_mode="constant"):
         padding = (padding, padding, padding, padding)
     l, t, r, b = padding
     return np.pad(arr, ((t, b), (l, r), (0, 0)), constant_values=fill)
+
+
+def _rgb_to_hsv(rgb):
+    """Vectorized RGB[0,1] -> HSV[0,1] (matches colorsys semantics)."""
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    maxc = np.max(rgb, axis=-1)
+    minc = np.min(rgb, axis=-1)
+    v = maxc
+    rng = maxc - minc
+    s_ = np.where(maxc > 0, rng / np.maximum(maxc, 1e-12), 0.0)
+    rngs = np.maximum(rng, 1e-12)
+    rc = (maxc - r) / rngs
+    gc = (maxc - g) / rngs
+    bc = (maxc - b) / rngs
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(rng > 0, (h / 6.0) % 1.0, 0.0)
+    return np.stack([h, s_, v], axis=-1)
+
+
+def _hsv_to_rgb(hsv):
+    """Vectorized HSV[0,1] -> RGB[0,1]."""
+    h, s_, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s_)
+    q = v * (1.0 - s_ * f)
+    t = v * (1.0 - s_ * (1.0 - f))
+    i = i.astype(np.int64) % 6
+    choices = np.stack([
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1)], 0)
+    return np.take_along_axis(choices, i[None, ..., None], axis=0)[0]
+
+
+def adjust_brightness(img, brightness_factor):
+    """Scale pixel intensities (ref functional.adjust_brightness)."""
+    arr = _as_hwc(img).astype(np.float32)
+    return np.clip(arr * brightness_factor, 0, 255).astype(np.uint8)
+
+
+def adjust_contrast(img, contrast_factor):
+    """Blend with the mean intensity (ref functional.adjust_contrast)."""
+    arr = _as_hwc(img).astype(np.float32)
+    mean = arr.mean()
+    return np.clip(mean + contrast_factor * (arr - mean), 0, 255).astype(np.uint8)
+
+
+def adjust_saturation(img, saturation_factor):
+    """Blend with the grayscale image (ref functional.adjust_saturation)."""
+    arr = _as_hwc(img).astype(np.float32)
+    gray = arr @ np.asarray([0.299, 0.587, 0.114], np.float32) \
+        if arr.shape[-1] == 3 else arr[..., 0]
+    gray = gray[..., None]
+    return np.clip(gray + saturation_factor * (arr - gray), 0, 255).astype(np.uint8)
+
+
+def adjust_hue(img, hue_factor):
+    """Rotate hue (ref functional.adjust_hue); hue_factor in [-0.5, 0.5].
+    Vectorized numpy HSV round-trip (the data-loading hot path)."""
+    arr = _as_hwc(img)
+    if arr.shape[-1] != 3:
+        return arr
+    hsv = _rgb_to_hsv(arr.astype(np.float32) / 255.0)
+    hsv[..., 0] = (hsv[..., 0] + hue_factor) % 1.0
+    rgb = _hsv_to_rgb(hsv)
+    return np.clip(np.round(rgb * 255.0), 0, 255).astype(np.uint8)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _as_hwc(img).astype(np.float32)
+    gray = arr @ np.asarray([0.299, 0.587, 0.114], np.float32) \
+        if arr.shape[-1] == 3 else arr[..., 0]
+    out = gray[..., None]
+    if num_output_channels == 3:
+        out = np.repeat(out, 3, axis=-1)
+    return np.clip(out, 0, 255).astype(np.uint8)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase a rectangle (ref functional.erase)."""
+    arr = _as_hwc(img)
+    if not inplace:
+        arr = arr.copy()
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate an HWC image by `angle` degrees counter-clockwise
+    (ref functional.rotate); nearest-neighbor sampling. With ``expand`` the
+    output grows to hold the whole rotated image."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else \
+        (center[1], center[0])
+    rad = np.deg2rad(angle)
+    cos_a, sin_a = np.cos(rad), np.sin(rad)
+    if expand:
+        oh = int(np.ceil(abs(h * cos_a) + abs(w * sin_a)))
+        ow = int(np.ceil(abs(w * cos_a) + abs(h * sin_a)))
+        ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
+    else:
+        oh, ow, ocy, ocx = h, w, cy, cx
+    ys, xs = np.mgrid[0:oh, 0:ow]
+    # inverse map: output pixel -> source pixel
+    sx = cos_a * (xs - ocx) + sin_a * (ys - ocy) + cx
+    sy = -sin_a * (xs - ocx) + cos_a * (ys - ocy) + cy
+    sxi = np.round(sx).astype(np.int64)
+    syi = np.round(sy).astype(np.int64)
+    valid = (sxi >= 0) & (sxi < w) & (syi >= 0) & (syi < h)
+    out = np.full((oh, ow) + arr.shape[2:], fill, dtype=arr.dtype)
+    out[valid] = arr[syi[valid], sxi[valid]]
+    return out
+
+
+class ContrastTransform(BaseTransform):
+    """Random contrast jitter (ref transforms.py:ContrastTransform)."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        return adjust_contrast(img, 1 + random.uniform(-self.value, self.value))
+
+
+class SaturationTransform(BaseTransform):
+    """Random saturation jitter (ref transforms.py:SaturationTransform)."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        return adjust_saturation(img,
+                                 1 + random.uniform(-self.value, self.value))
+
+
+class HueTransform(BaseTransform):
+    """Random hue rotation (ref transforms.py:HueTransform); value in [0, 0.5]."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue (ref transforms.py:ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = list(self.ts)
+        random.shuffle(order)
+        for t in order:
+            img = t(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    """RGB -> grayscale with 1 or 3 output channels (ref transforms.py:Grayscale)."""
+
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    """Random rotation by an angle in degrees (ref transforms.py:RandomRotation).
+    Nearest-neighbor resampling on the numpy grid (no PIL dependency in the
+    hot path)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, interpolation=self.interpolation,
+                      expand=self.expand, center=self.center, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Random cutout rectangle (ref transforms.py:RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3), value=0,
+                 inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio, self.value = prob, scale, ratio, value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        arr = _as_hwc(img)
+        if random.random() > self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            eh = int(round((target * ar) ** 0.5))
+            ew = int(round((target / ar) ** 0.5))
+            if eh < h and ew < w:
+                top = random.randint(0, h - eh)
+                left = random.randint(0, w - ew)
+                return erase(arr, top, left, eh, ew, self.value,
+                             inplace=self.inplace)
+        return arr

@@ -1,0 +1,95 @@
+"""Vision model zoo + transforms breadth (ref `python/paddle/vision/models/`,
+`vision/transforms/`): forward shape + trainability per family."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.vision import models as M
+import paddle_tpu.vision.transforms as T
+
+R = np.random.RandomState(11)
+
+
+def _train_step(model, size=64):
+    x = paddle.to_tensor(R.randn(2, 3, size, size).astype(np.float32))
+    y = paddle.to_tensor(np.array([1, 3]))
+    model.train()
+    out = model(x)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    loss = nn.CrossEntropyLoss()(out, y)
+    loss.backward()
+    grads = [p.grad for p in model.parameters() if p.grad is not None]
+    assert grads, "no grads flowed"
+    gn = sum(float((g.numpy() ** 2).sum()) for g in grads)
+    assert np.isfinite(gn) and gn > 0
+    return out
+
+
+@pytest.mark.parametrize("ctor", [
+    M.densenet121, M.shufflenet_v2_x0_5, M.mobilenet_v3_small,
+], ids=["densenet121", "shufflenet_v2", "mobilenet_v3"])
+def test_zoo_forward_backward(ctor):
+    model = ctor(num_classes=10)
+    out = _train_step(model)
+    assert out.shape == [2, 10]
+
+
+def test_googlenet_aux_heads():
+    model = M.googlenet(num_classes=10)
+    model.eval()
+    x = paddle.to_tensor(R.randn(1, 3, 96, 96).astype(np.float32))
+    out, aux1, aux2 = model(x)
+    assert out.shape == [1, 10] and aux1.shape == [1, 10] and aux2.shape == [1, 10]
+
+
+def test_inception_v3_forward():
+    model = M.inception_v3(num_classes=7)
+    model.eval()
+    x = paddle.to_tensor(R.randn(1, 3, 299, 299).astype(np.float32))
+    assert model(x).shape == [1, 7]
+
+
+def test_zoo_inventory_complete():
+    # the reference ships these families (SURVEY.md §2.9 vision row)
+    for name in ["LeNet", "AlexNet", "VGG", "ResNet", "MobileNetV1",
+                 "MobileNetV2", "MobileNetV3Small", "MobileNetV3Large",
+                 "DenseNet", "GoogLeNet", "InceptionV3", "ShuffleNetV2",
+                 "SqueezeNet"]:
+        assert hasattr(M, name), name
+
+
+class TestTransforms:
+    def setup_method(self):
+        self.img = (R.rand(24, 24, 3) * 255).astype(np.uint8)
+
+    def test_color_jitter(self):
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.1)(self.img)
+        assert out.shape == (24, 24, 3) and out.dtype == np.uint8
+
+    def test_grayscale(self):
+        assert T.Grayscale(1)(self.img).shape == (24, 24, 1)
+        out3 = T.Grayscale(3)(self.img)
+        assert out3.shape == (24, 24, 3)
+        np.testing.assert_array_equal(out3[..., 0], out3[..., 1])
+
+    def test_rotate_identity(self):
+        np.testing.assert_array_equal(T.rotate(self.img, 0), self.img)
+
+    def test_rotate_90_roundtrip(self):
+        out = T.rotate(self.img, 90)
+        back = T.rotate(out, -90)
+        # interior pixels survive the double nearest-neighbor rotation
+        np.testing.assert_array_equal(back[8:16, 8:16], self.img[8:16, 8:16])
+
+    def test_random_erasing(self):
+        out = T.RandomErasing(prob=1.0, value=0)(self.img + 1)
+        assert (out == 0).any()
+
+    def test_adjusts(self):
+        assert T.adjust_brightness(self.img, 1.5).shape == (24, 24, 3)
+        assert T.adjust_contrast(self.img, 0.5).shape == (24, 24, 3)
+        assert T.adjust_hue(self.img, 0.25).shape == (24, 24, 3)
+        mid = T.adjust_brightness(self.img, 1.0)
+        np.testing.assert_array_equal(mid, self.img)
