@@ -332,6 +332,11 @@ def _accumulate_leaf(t, g_arr):
             new_g = hook(T.Tensor(g_arr, stop_gradient=True, _internal=True))
             if new_g is not None:
                 g_arr = new_g._data if isinstance(new_g, T.Tensor) else jnp.asarray(new_g)
+    if t._grad is not None and not isinstance(t._grad, T.Tensor):
+        # existing grad is a SelectedRows (sparse embedding + tied dense use):
+        # densify so both contributions survive
+        t._grad = T.Tensor(t._grad.to_dense().astype(t.dtype),
+                           stop_gradient=True, _internal=True)
     if t._grad is None:
         t._grad = T.Tensor(g_arr, stop_gradient=True, _internal=True)
     else:

@@ -1,0 +1,72 @@
+"""SelectedRows — row-sparse gradient container.
+
+Rebuild of the reference's `phi::SelectedRows`
+(`paddle/phi/core/selected_rows.h`): a (rows, values, height) triple used for
+embedding-table gradients so an update touches only the looked-up rows. The
+reference threads it through sparse kernels (`phi/kernels/selected_rows/`);
+here the optimizers dispatch on the grad type and apply row-wise scatter
+updates (`w.at[rows]`), which XLA lowers to an efficient scatter on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    """rows: int array [N]; values: array [N, ...]; height: size of dim 0 of
+    the dense tensor this sparsely represents."""
+
+    def __init__(self, rows, values, height):
+        self.rows = jnp.asarray(rows).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.height = int(height)
+        if self.values.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"values rows {self.values.shape[0]} != rows {self.rows.shape[0]}")
+
+    @property
+    def shape(self):
+        return [self.height] + list(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def merge(self) -> "SelectedRows":
+        """Sum duplicate rows (ref `merge_selected_rows` op,
+        `phi/kernels/selected_rows/merge_selected_rows_kernel.h`). Eager-only
+        (unique is data-dependent)."""
+        rows_np = np.asarray(self.rows)
+        uniq, inv = np.unique(rows_np, return_inverse=True)
+        import jax
+        summed = jax.ops.segment_sum(self.values, jnp.asarray(inv),
+                                     num_segments=len(uniq))
+        return SelectedRows(jnp.asarray(uniq), summed, self.height)
+
+    def to_dense(self):
+        out = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                        self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+    def accumulate(self, other: "SelectedRows") -> "SelectedRows":
+        """Concatenate contributions (grad accumulation across micro-steps)."""
+        if other.height != self.height:
+            raise ValueError("height mismatch in SelectedRows accumulation")
+        return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                            jnp.concatenate([self.values, other.values]),
+                            self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, nnz_rows="
+                f"{self.rows.shape[0]}, value_shape={tuple(self.values.shape)})")
+
+
+def merge_selected_rows(x):
+    """Functional form of SelectedRows.merge (ref `merge_selected_rows` op)."""
+    if not isinstance(x, SelectedRows):
+        raise TypeError("merge_selected_rows expects a SelectedRows")
+    return x.merge()

@@ -272,9 +272,11 @@ class Tensor:
         return RemovableHandle(self, hid)
 
     def clear_grad(self, set_to_zero=False):
-        if set_to_zero and self._grad is not None:
+        if (set_to_zero and self._grad is not None
+                and isinstance(self._grad, Tensor)):
             self._grad = Tensor(jnp.zeros_like(self._grad._data), _internal=True)
         else:
+            # None, or a SelectedRows grad (no dense buffer to zero)
             self._grad = None
 
     clear_gradient = clear_grad

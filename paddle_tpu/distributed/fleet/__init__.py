@@ -19,3 +19,7 @@ from paddle_tpu.distributed.fleet.meta_parallel import (  # noqa: F401
     TensorParallel, PipelineParallel, get_rng_state_tracker,
 )
 from paddle_tpu.distributed.fleet.recompute import recompute, recompute_sequential  # noqa: F401
+from paddle_tpu.distributed.fleet.meta_optimizers import (  # noqa: F401
+    GradientMergeOptimizer, LocalSGDOptimizer, DGCOptimizer,
+    FP16AllreduceOptimizer, apply_meta_optimizers,
+)

@@ -60,10 +60,13 @@ class Fleet:
         return DataParallel(model)
 
     def distributed_optimizer(self, optimizer, strategy=None):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            apply_meta_optimizers)
         from paddle_tpu.distributed.fleet.meta_parallel import (
             HybridParallelOptimizer)
-        return HybridParallelOptimizer(optimizer, self._hcg,
-                                       strategy or self._strategy)
+        strategy = strategy or self._strategy
+        optimizer = apply_meta_optimizers(optimizer, strategy, self._hcg)
+        return HybridParallelOptimizer(optimizer, self._hcg, strategy)
 
     def distributed_scaler(self, scaler):
         return scaler

@@ -84,8 +84,21 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Lookup rows of ``weight`` (ref `phi/kernels/embedding_kernel.h`; the
-    vocab-parallel variant lives in distributed.fleet)."""
+    vocab-parallel variant lives in distributed.fleet).
+
+    ``sparse=True`` reproduces the reference's SelectedRows gradient path
+    (`embedding_sparse_grad_kernel.h`): ``weight.grad`` becomes a
+    :class:`~paddle_tpu.core.selected_rows.SelectedRows` holding only the
+    looked-up rows, and the optimizers apply a row-wise scatter update.
+    Eager-mode feature (the captured/jit path keeps dense grads, where XLA's
+    scatter fusion already gives the same effect)."""
     x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if padding_idx is not None and padding_idx < 0:
+        # paddle normalizes negative padding_idx against the vocab size
+        padding_idx = weight.shape[0] + padding_idx
+    from paddle_tpu.core import tensor as tensor_mod
+    if sparse and not tensor_mod.in_capture():
+        return _sparse_embedding(x, weight, padding_idx)
 
     def prim(ids, w):
         out = jnp.take(w, ids, axis=0)
@@ -95,6 +108,46 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
         return out
 
     return apply(prim, x, weight, op_name="embedding")
+
+
+def _sparse_embedding(x, weight, padding_idx):
+    from paddle_tpu.autograd import PyLayer
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    class _SparseEmbedding(PyLayer):
+        @staticmethod
+        def forward(ctx, ids, w):
+            ctx.ids = ids._data
+            ctx.w = w
+            out = jnp.take(w._data, ids._data, axis=0)
+            if padding_idx is not None and padding_idx >= 0:
+                mask = (ids._data == padding_idx)[..., None]
+                out = jnp.where(mask, 0.0, out).astype(w.dtype)
+            return Tensor(out, _internal=True)
+
+        @staticmethod
+        def backward(ctx, d_out):
+            ids = ctx.ids.reshape(-1)
+            vals = d_out._data.reshape(-1, d_out.shape[-1])
+            if padding_idx is not None and padding_idx >= 0:
+                vals = jnp.where((ids == padding_idx)[:, None], 0.0,
+                                 vals).astype(vals.dtype)
+            sr = SelectedRows(ids, vals, ctx.w.shape[0])
+            prev = ctx.w._grad
+            if isinstance(prev, SelectedRows):
+                ctx.w._grad = prev.accumulate(sr)
+            elif prev is not None:
+                # a dense grad already landed (e.g. tied lm-head weights):
+                # densify so neither contribution is lost
+                ctx.w._grad = Tensor(
+                    prev._data + sr.to_dense().astype(prev.dtype),
+                    _internal=True)
+            else:
+                ctx.w._grad = sr
+            # weight grad delivered out-of-band as SelectedRows; ids carry none
+            return None, None
+
+    return _SparseEmbedding.apply(x, weight)
 
 
 def one_hot(x, num_classes, name=None):

@@ -162,9 +162,17 @@ class Optimizer:
     @no_grad()
     def step(self):
         from paddle_tpu.core import tensor as tensor_mod
+        from paddle_tpu.core.selected_rows import SelectedRows
         from paddle_tpu.framework.flags import flag_value
         params_grads = [(p, p.grad) for p in self._parameter_list
                         if not p.stop_gradient and p.grad is not None]
+        # SelectedRows grads (sparse embedding) take the row-wise update path;
+        # they bypass grad_clip like the reference's sparse grads do under
+        # ClipGradByNorm (merge+clip would densify, defeating the point)
+        sparse_pg = [(p, g) for p, g in params_grads
+                     if isinstance(g, SelectedRows)]
+        params_grads = [(p, g) for p, g in params_grads
+                        if not isinstance(g, SelectedRows)]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         self._global_step += 1
@@ -177,6 +185,9 @@ class Optimizer:
             self._step_tensor._write(self._step_tensor._read() + 1)
         lr_arr = self._lr_tensor._read()
         t_arr = self._step_tensor._read().astype(jnp.float32)
+        for p, g in sparse_pg:
+            lr, wd = self._lr_wd_of(p, lr_arr)
+            self._append_sparse_op(p, g.merge(), lr, wd, t_arr)
         if self._FUSABLE and flag_value("tpu_fused_optimizer"):
             self._fused_step(params_grads, lr_arr, t_arr)
             return
@@ -188,6 +199,14 @@ class Optimizer:
 
     def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
         raise NotImplementedError
+
+    def _append_sparse_op(self, p, grad, lr, weight_decay, t=None):
+        """Row-wise update for a merged SelectedRows grad. Default: densify
+        (correct, loses the sparsity win); SGD/Adam override with true
+        row-scatter updates (ref `phi/kernels/selected_rows/` sgd/adam)."""
+        from paddle_tpu.core.tensor import Tensor
+        self._append_optimize_op(
+            p, Tensor(grad.to_dense(), _internal=True), lr, weight_decay, t)
 
     # ---------------------------------------------------------- fused updates
     # Multi-tensor path: all parameters of a (src-dtype, param-dtype) group are

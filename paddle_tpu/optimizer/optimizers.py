@@ -35,6 +35,16 @@ class SGD(Optimizer):
     def _fused_update(self, p32, g32, states, lr, wd, t):
         return p32 - lr * (g32 + wd * p32), []
 
+    def _append_sparse_op(self, p, grad, lr, weight_decay, t=None):
+        # row-scatter SGD (ref phi/kernels/selected_rows/sgd_kernel)
+        src = self._update_src(p)
+        w = src._read()
+        rows = grad.rows
+        vals = grad.values.astype(w.dtype)
+        upd = vals + weight_decay * w[rows] if weight_decay else vals
+        self._commit(p, src, w.at[rows].add(
+            (-jnp.asarray(lr, w.dtype)) * upd))
+
 
 @partial(jax.jit, static_argnames=("use_nesterov",))
 def _momentum_update(p, g, velocity, lr, mu, wd, use_nesterov):
@@ -130,6 +140,40 @@ class Adam(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
         self._amsgrad = amsgrad
+
+    def _append_sparse_op(self, p, grad, lr, weight_decay, t=None):
+        # lazy-mode row-wise Adam (ref `phi/kernels/selected_rows/adam_kernel`,
+        # `python/paddle/optimizer/adam.py` lazy_mode): moments and weights of
+        # untouched rows are left alone
+        m = self._accumulator("moment1", p, dtype=jnp.float32)
+        v = self._accumulator("moment2", p, dtype=jnp.float32)
+        src = self._update_src(p)
+        w = src._read()
+        rows = grad.rows
+        g = grad.values.astype(jnp.float32)
+        t_arr = (t if t is not None
+                 else jnp.asarray(self._global_step, jnp.float32))
+        b1 = jnp.asarray(self._beta1, jnp.float32)
+        b2 = jnp.asarray(self._beta2, jnp.float32)
+        w_rows = w[rows].astype(jnp.float32)
+        if weight_decay and not self._decoupled:
+            g = g + weight_decay * w_rows
+        m_new = b1 * m._read()[rows] + (1 - b1) * g
+        v_new = b2 * v._read()[rows] + (1 - b2) * g * g
+        if self._amsgrad:
+            vhat_acc = self._accumulator("moment2_max", p, dtype=jnp.float32)
+            v_eff = jnp.maximum(vhat_acc._read()[rows], v_new)
+            vhat_acc._write(vhat_acc._read().at[rows].set(v_eff))
+        else:
+            v_eff = v_new
+        mhat = m_new / (1 - b1 ** t_arr)
+        vhat = v_eff / (1 - b2 ** t_arr)
+        new_rows = w_rows - lr * (mhat / (jnp.sqrt(vhat) + self._epsilon))
+        if weight_decay and self._decoupled:
+            new_rows = new_rows - lr * weight_decay * w_rows
+        m._write(m._read().at[rows].set(m_new))
+        v._write(v._read().at[rows].set(v_new))
+        self._commit(p, src, w.at[rows].set(new_rows.astype(w.dtype)))
 
     def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
         m = self._accumulator("moment1", p, dtype=jnp.float32)

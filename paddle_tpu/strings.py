@@ -1,0 +1,102 @@
+"""String tensors — ``paddle.strings`` surface.
+
+Rebuild of the reference's `phi::StringTensor` tower
+(`paddle/phi/core/string_tensor.h`, kernels `paddle/phi/kernels/strings/`
+registered from `paddle/phi/api/yaml/strings_ops.yaml`: strings_empty,
+strings_empty_like, strings_lower, strings_upper).
+
+Strings are host data — there is no TPU representation — so the container
+wraps a numpy object array (the reference likewise keeps pstrings on CPU
+unless a special allocator is used). UTF-8 handling matches the reference's
+``use_utf8_encoding`` flag: python str handles unicode natively.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor", "empty", "empty_like", "lower",
+           "upper"]
+
+
+class StringTensor:
+    """A tensor of variable-length strings (ref `string_tensor.h:29`)."""
+
+    def __init__(self, data, name=""):
+        if isinstance(data, StringTensor):
+            arr = data._data.copy()
+        else:
+            arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return "pstring"
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        other = other._data if isinstance(other, StringTensor) else other
+        return np.asarray(self._data == other)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"
+
+
+def to_string_tensor(data, name=""):
+    """Create a StringTensor from python/numpy strings."""
+    return StringTensor(data, name=name)
+
+
+def empty(shape, name=None):
+    """Uninitialized (empty-string) StringTensor (ref `strings_empty`)."""
+    return StringTensor(np.full(tuple(shape), "", dtype=object))
+
+
+def empty_like(x, name=None):
+    """Empty StringTensor with x's shape (ref `strings_empty_like`)."""
+    return empty(x.shape)
+
+
+def _map(fn, x):
+    flat = np.asarray([fn(s) for s in x._data.reshape(-1)], dtype=object)
+    return StringTensor(flat.reshape(x._data.shape))
+
+
+def lower(x, use_utf8_encoding=False, name=None):
+    """Elementwise lowercase (ref `strings_lower`,
+    `phi/kernels/strings/case_convert_kernel.h`)."""
+    if not isinstance(x, StringTensor):
+        x = StringTensor(x)
+    if use_utf8_encoding:
+        return _map(lambda s: s.lower(), x)
+    # ascii mode mirrors the reference's default (non-utf8) kernel
+    return _map(
+        lambda s: "".join(c.lower() if ord(c) < 128 else c for c in s), x)
+
+
+def upper(x, use_utf8_encoding=False, name=None):
+    """Elementwise uppercase (ref `strings_upper`)."""
+    if not isinstance(x, StringTensor):
+        x = StringTensor(x)
+    if use_utf8_encoding:
+        return _map(lambda s: s.upper(), x)
+    return _map(
+        lambda s: "".join(c.upper() if ord(c) < 128 else c for c in s), x)

@@ -1,0 +1,140 @@
+"""Fleet meta-optimizers: gradient merge / LocalSGD / DGC / fp16-allreduce
+(ref meta_optimizers/{gradient_merge,localsgd,dgc,fp16_allreduce}_optimizer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    GradientMergeOptimizer, LocalSGDOptimizer, DGCOptimizer,
+    FP16AllreduceOptimizer, apply_meta_optimizers,
+)
+
+R = np.random.RandomState(5)
+
+
+def _model_and_data():
+    paddle.seed(0)
+    m = nn.Linear(4, 3)
+    x = paddle.to_tensor(R.randn(8, 4).astype(np.float32))
+    y = paddle.to_tensor(R.randn(8, 3).astype(np.float32))
+    return m, x, y
+
+
+def _loss(m, x, y):
+    return ((m(x) - y) ** 2).mean()
+
+
+class TestGradientMerge:
+    def test_applies_every_k_and_matches_mean_grad(self):
+        m, x, y = _model_and_data()
+        w0 = m.weight.numpy().copy()
+        opt = GradientMergeOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters()),
+            k_steps=2, avg=True)
+        halves = [x[:4], x[4:]], [y[:4], y[4:]]
+        grads = []
+        for i in range(2):
+            loss = _loss(m, halves[0][i], halves[1][i])
+            loss.backward()
+            grads.append(m.weight.grad.numpy().copy())
+            opt.step()
+            if i == 0:
+                # first micro-step must not move params
+                np.testing.assert_allclose(m.weight.numpy(), w0)
+            opt.clear_grad()
+        expect = w0 - 0.1 * (grads[0] + grads[1]) / 2
+        np.testing.assert_allclose(m.weight.numpy(), expect, rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestLocalSGD:
+    def test_single_process_is_plain_sgd(self):
+        m, x, y = _model_and_data()
+        w0 = m.weight.numpy().copy()
+        opt = LocalSGDOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters()),
+            k_steps=2)
+        loss = _loss(m, x, y)
+        loss.backward()
+        g = m.weight.grad.numpy().copy()
+        opt.step()
+        np.testing.assert_allclose(m.weight.numpy(), w0 - 0.1 * g, rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestDGC:
+    def test_sparsifies_and_keeps_error_feedback(self):
+        m, x, y = _model_and_data()
+        opt = DGCOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters()),
+            rampup_begin_step=0, sparsity=0.75)
+        loss = _loss(m, x, y)
+        loss.backward()
+        opt.step()
+        # grad was replaced by the sparsified version: 25% of 12 entries = 3
+        sent = m.weight.grad.numpy()
+        assert np.count_nonzero(sent) == 3
+        # residue lives in the error-feedback buffers
+        v = np.asarray(opt._v[0])
+        assert np.count_nonzero(v) == 9
+
+    def test_error_feedback_preserves_total_signal(self):
+        # with momentum=0, sent + residue must equal the accumulated grads
+        m, x, y = _model_and_data()
+        opt = DGCOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.0, parameters=m.parameters()),
+            rampup_begin_step=0, momentum=0.0, sparsity=0.5)
+        total_sent = np.zeros((4, 3), np.float32)
+        gsum = np.zeros((4, 3), np.float32)
+        for _ in range(3):
+            loss = _loss(m, x, y)
+            loss.backward()
+            gsum += m.weight.grad.numpy()
+            opt.step()
+            total_sent += m.weight.grad.numpy()
+            opt.clear_grad()
+        residue = np.asarray(opt._v[0])
+        np.testing.assert_allclose(total_sent + residue, gsum, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_rampup_passthrough(self):
+        m, x, y = _model_and_data()
+        opt = DGCOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters()),
+            rampup_begin_step=10, sparsity=0.75)
+        loss = _loss(m, x, y)
+        loss.backward()
+        dense = m.weight.grad.numpy().copy()
+        opt.step()
+        np.testing.assert_allclose(m.weight.grad.numpy(), dense)
+
+
+class TestFP16Allreduce:
+    def test_single_process_skips_cast(self):
+        # the bf16 cast only pays off on the wire: world==1 leaves grads exact
+        m, x, y = _model_and_data()
+        w0 = m.weight.numpy().copy()
+        opt = FP16AllreduceOptimizer(
+            paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters()))
+        loss = _loss(m, x, y)
+        loss.backward()
+        dense = m.weight.grad.numpy().copy()
+        opt.step()
+        np.testing.assert_allclose(m.weight.grad.numpy(), dense)
+        np.testing.assert_allclose(m.weight.numpy(), w0 - 0.1 * dense,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_strategy_composition():
+    from paddle_tpu.distributed.fleet.base import DistributedStrategy
+    m, _, _ = _model_and_data()
+    s = DistributedStrategy()
+    s.dgc = True
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 4, "avg": True}
+    opt = apply_meta_optimizers(
+        paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters()), s)
+    assert isinstance(opt, GradientMergeOptimizer)
+    assert isinstance(opt.inner_opt, DGCOptimizer)
+    assert opt.k_steps == 4

@@ -1,0 +1,140 @@
+"""SelectedRows sparse gradients (ref `phi/core/selected_rows.h`,
+`embedding_sparse_grad_kernel.h`, selected_rows sgd/adam kernels)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.selected_rows import SelectedRows, merge_selected_rows
+
+R = np.random.RandomState(9)
+
+
+class TestSelectedRows:
+    def test_to_dense_and_merge(self):
+        sr = SelectedRows([1, 3, 1], np.ones((3, 2), np.float32), height=5)
+        dense = np.asarray(sr.to_dense())
+        assert dense.shape == (5, 2)
+        np.testing.assert_allclose(dense[1], [2, 2])
+        np.testing.assert_allclose(dense[3], [1, 1])
+        merged = merge_selected_rows(sr)
+        assert sorted(np.asarray(merged.rows).tolist()) == [1, 3]
+        np.testing.assert_allclose(np.asarray(merged.to_dense()), dense)
+
+    def test_accumulate(self):
+        a = SelectedRows([0], np.ones((1, 2), np.float32), 4)
+        b = SelectedRows([2], np.full((1, 2), 3.0, np.float32), 4)
+        c = a.accumulate(b)
+        np.testing.assert_allclose(np.asarray(c.to_dense()),
+                                   [[1, 1], [0, 0], [3, 3], [0, 0]])
+
+
+class TestSparseEmbedding:
+    def test_grad_is_selected_rows(self):
+        w = paddle.to_tensor(R.randn(10, 4).astype(np.float32),
+                             stop_gradient=False)
+        ids = paddle.to_tensor(np.array([1, 3, 1]))
+        out = F.embedding(ids, w, sparse=True)
+        out.sum().backward()
+        assert isinstance(w.grad, SelectedRows)
+        assert w.grad.height == 10
+        dense = np.asarray(w.grad.to_dense())
+        # row 1 hit twice, row 3 once
+        np.testing.assert_allclose(dense[1], [2, 2, 2, 2])
+        np.testing.assert_allclose(dense[3], [1, 1, 1, 1])
+        assert np.all(dense[[0, 2, 4, 5, 6, 7, 8, 9]] == 0)
+
+    def test_matches_dense_embedding_grad(self):
+        wv = R.randn(8, 3).astype(np.float32)
+        ids = np.array([[0, 2], [5, 2]])
+        wd_ = paddle.to_tensor(wv.copy(), stop_gradient=False)
+        F.embedding(paddle.to_tensor(ids), wd_, sparse=False).sum().backward()
+        ws = paddle.to_tensor(wv.copy(), stop_gradient=False)
+        F.embedding(paddle.to_tensor(ids), ws, sparse=True).sum().backward()
+        np.testing.assert_allclose(np.asarray(ws.grad.to_dense()),
+                                   wd_.grad.numpy(), rtol=1e-6)
+
+    def test_padding_idx(self):
+        w = paddle.to_tensor(R.randn(6, 2).astype(np.float32),
+                             stop_gradient=False)
+        ids = paddle.to_tensor(np.array([1, 0, 1]))
+        out = F.embedding(ids, w, padding_idx=0, sparse=True)
+        np.testing.assert_allclose(out.numpy()[1], [0, 0])
+        out.sum().backward()
+        dense = np.asarray(w.grad.to_dense())
+        np.testing.assert_allclose(dense[0], [0, 0])
+
+
+class TestSparseOptimizerUpdates:
+    def test_sgd_updates_only_touched_rows(self):
+        wv = R.randn(10, 4).astype(np.float32)
+        w = paddle.to_tensor(wv.copy(), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
+        ids = paddle.to_tensor(np.array([2, 7, 2]))
+        F.embedding(ids, w, sparse=True).sum().backward()
+        opt.step()
+        out = w.numpy()
+        np.testing.assert_allclose(out[2], wv[2] - 0.5 * 2, rtol=1e-5)
+        np.testing.assert_allclose(out[7], wv[7] - 0.5 * 1, rtol=1e-5)
+        untouched = [i for i in range(10) if i not in (2, 7)]
+        np.testing.assert_allclose(out[untouched], wv[untouched])
+
+    def test_sgd_sparse_matches_dense(self):
+        wv = R.randn(6, 3).astype(np.float32)
+        ids = np.array([1, 4])
+        stepped = {}
+        for sparse in (False, True):
+            paddle.seed(0)
+            w = paddle.to_tensor(wv.copy(), stop_gradient=False)
+            opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+            (F.embedding(paddle.to_tensor(ids), w, sparse=sparse) ** 2).sum().backward()
+            opt.step()
+            stepped[sparse] = w.numpy()
+        np.testing.assert_allclose(stepped[True], stepped[False], rtol=1e-5)
+
+    def test_lazy_adam_sparse(self):
+        wv = R.randn(10, 4).astype(np.float32)
+        w = paddle.to_tensor(wv.copy(), stop_gradient=False)
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w],
+                                    lazy_mode=True)
+        ids = paddle.to_tensor(np.array([3, 3, 8]))
+        F.embedding(ids, w, sparse=True).sum().backward()
+        opt.step()
+        out = w.numpy()
+        untouched = [i for i in range(10) if i not in (3, 8)]
+        np.testing.assert_allclose(out[untouched], wv[untouched])
+        assert not np.allclose(out[3], wv[3])
+        assert not np.allclose(out[8], wv[8])
+        # moments only touched on updated rows
+        m = np.asarray(opt._accumulators["moment1"][id(w)]._data)
+        assert np.all(m[untouched] == 0) and np.any(m[3] != 0)
+
+    def test_grad_accumulation_two_backwards(self):
+        w = paddle.to_tensor(R.randn(5, 2).astype(np.float32),
+                             stop_gradient=False)
+        for _ in range(2):
+            F.embedding(paddle.to_tensor(np.array([1])), w,
+                        sparse=True).sum().backward()
+        dense = np.asarray(w.grad.to_dense())
+        np.testing.assert_allclose(dense[1], [2, 2])
+
+
+class TestStringTensor:
+    def test_basic(self):
+        import paddle_tpu.strings as S
+        st = S.to_string_tensor([["Hello", "World"], ["FOO", "bar"]])
+        assert st.shape == [2, 2] and st.dtype == "pstring"
+        low = S.lower(st)
+        assert low.tolist() == [["hello", "world"], ["foo", "bar"]]
+        up = S.upper(st, use_utf8_encoding=True)
+        assert up.tolist() == [["HELLO", "WORLD"], ["FOO", "BAR"]]
+        e = S.empty_like(st)
+        assert e.tolist() == [["", ""], ["", ""]]
+
+    def test_ascii_mode_leaves_unicode(self):
+        import paddle_tpu.strings as S
+        st = S.to_string_tensor(["Ä-Abc"])
+        # default (non-utf8) kernel only folds ascii
+        assert S.lower(st).tolist() == ["Ä-abc"]
+        assert S.lower(st, use_utf8_encoding=True).tolist() == ["ä-abc"]
