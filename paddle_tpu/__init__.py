@@ -63,6 +63,7 @@ from paddle_tpu import signal  # noqa: F401
 from paddle_tpu import geometric  # noqa: F401
 from paddle_tpu import text  # noqa: F401
 from paddle_tpu import strings  # noqa: F401
+from paddle_tpu import onnx  # noqa: F401
 
 from paddle_tpu.nn.functional.common import linear  # noqa: F401  (paddle exposes it)
 

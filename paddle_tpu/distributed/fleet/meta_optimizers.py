@@ -74,14 +74,21 @@ class GradientMergeOptimizer(_MetaOptimizerBase):
         self.k_steps = int(k_steps)
         self.avg = avg
         self._acc = {}
+        self._sparse_acc = {}
         self._count = 0
 
     def step(self):
         self._count += 1
         params = self._inner_opt._parameter_list
         for i, p in enumerate(params):
-            if p.grad is None or isinstance(p.grad, SelectedRows):
-                continue   # sparse grads pass straight to the inner optimizer
+            if p.grad is None:
+                continue
+            if isinstance(p.grad, SelectedRows):
+                # buffer sparse grads too (clear_grad would drop them)
+                prev = self._sparse_acc.get(i)
+                self._sparse_acc[i] = p.grad if prev is None else \
+                    prev.accumulate(p.grad)
+                continue
             g = p.grad._data.astype(jnp.float32)
             self._acc[i] = g if i not in self._acc else self._acc[i] + g
         if self._count < self.k_steps:
@@ -93,8 +100,12 @@ class GradientMergeOptimizer(_MetaOptimizerBase):
             if i in self._acc:
                 p._grad = Tensor((self._acc[i] * scale).astype(p.dtype),
                                  _internal=True)
+            elif i in self._sparse_acc:
+                sr = self._sparse_acc[i]
+                p._grad = SelectedRows(sr.rows, sr.values * scale, sr.height)
         self._inner_opt.step()
         self._acc = {}
+        self._sparse_acc = {}
         self._count = 0
 
 

@@ -97,7 +97,11 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
         # paddle normalizes negative padding_idx against the vocab size
         padding_idx = weight.shape[0] + padding_idx
     from paddle_tpu.core import tensor as tensor_mod
-    if sparse and not tensor_mod.in_capture():
+    if (sparse and not tensor_mod.in_capture()
+            and weight._grad_node is None):
+        # leaf weights only: for a computed weight (weight-norm/LoRA style)
+        # the SelectedRows would land on the intermediate and the real
+        # parameters would get nothing — use the dense path there
         return _sparse_embedding(x, weight, padding_idx)
 
     def prim(ids, w):
@@ -110,44 +114,57 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     return apply(prim, x, weight, op_name="embedding")
 
 
+class _SparseEmbedding:
+    """Module-level PyLayer (built lazily to avoid an import cycle) whose
+    backward delivers the weight grad out-of-band as SelectedRows."""
+    _cls = None
+
+    @classmethod
+    def get(cls):
+        if cls._cls is None:
+            from paddle_tpu.autograd import PyLayer
+            from paddle_tpu.core.selected_rows import SelectedRows
+
+            class Impl(PyLayer):
+                @staticmethod
+                def forward(ctx, ids, w, padding_idx=None):
+                    ctx.ids = ids._data
+                    ctx.w = w
+                    ctx.padding_idx = padding_idx
+                    out = jnp.take(w._data, ids._data, axis=0)
+                    if padding_idx is not None:
+                        mask = (ids._data == padding_idx)[..., None]
+                        out = jnp.where(mask, 0.0, out).astype(w.dtype)
+                    return Tensor(out, _internal=True)
+
+                @staticmethod
+                def backward(ctx, d_out):
+                    ids = ctx.ids.reshape(-1)
+                    vals = d_out._data.reshape(-1, d_out.shape[-1])
+                    if ctx.padding_idx is not None:
+                        vals = jnp.where((ids == ctx.padding_idx)[:, None],
+                                         0.0, vals).astype(vals.dtype)
+                    sr = SelectedRows(ids, vals, ctx.w.shape[0])
+                    prev = ctx.w._grad
+                    if isinstance(prev, SelectedRows):
+                        ctx.w._grad = prev.accumulate(sr)
+                    elif prev is not None:
+                        # a dense grad already landed (e.g. tied lm-head
+                        # weights): densify so neither contribution is lost
+                        ctx.w._grad = Tensor(
+                            prev._data + sr.to_dense().astype(prev.dtype),
+                            _internal=True)
+                    else:
+                        ctx.w._grad = sr
+                    # weight grad delivered out-of-band; ids carry none
+                    return None, None
+
+            cls._cls = Impl
+        return cls._cls
+
+
 def _sparse_embedding(x, weight, padding_idx):
-    from paddle_tpu.autograd import PyLayer
-    from paddle_tpu.core.selected_rows import SelectedRows
-
-    class _SparseEmbedding(PyLayer):
-        @staticmethod
-        def forward(ctx, ids, w):
-            ctx.ids = ids._data
-            ctx.w = w
-            out = jnp.take(w._data, ids._data, axis=0)
-            if padding_idx is not None and padding_idx >= 0:
-                mask = (ids._data == padding_idx)[..., None]
-                out = jnp.where(mask, 0.0, out).astype(w.dtype)
-            return Tensor(out, _internal=True)
-
-        @staticmethod
-        def backward(ctx, d_out):
-            ids = ctx.ids.reshape(-1)
-            vals = d_out._data.reshape(-1, d_out.shape[-1])
-            if padding_idx is not None and padding_idx >= 0:
-                vals = jnp.where((ids == padding_idx)[:, None], 0.0,
-                                 vals).astype(vals.dtype)
-            sr = SelectedRows(ids, vals, ctx.w.shape[0])
-            prev = ctx.w._grad
-            if isinstance(prev, SelectedRows):
-                ctx.w._grad = prev.accumulate(sr)
-            elif prev is not None:
-                # a dense grad already landed (e.g. tied lm-head weights):
-                # densify so neither contribution is lost
-                ctx.w._grad = Tensor(
-                    prev._data + sr.to_dense().astype(prev.dtype),
-                    _internal=True)
-            else:
-                ctx.w._grad = sr
-            # weight grad delivered out-of-band as SelectedRows; ids carry none
-            return None, None
-
-    return _SparseEmbedding.apply(x, weight)
+    return _SparseEmbedding.get().apply(x, weight, padding_idx=padding_idx)
 
 
 def one_hot(x, num_classes, name=None):

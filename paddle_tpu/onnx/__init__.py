@@ -1,0 +1,5 @@
+"""``paddle.onnx`` — export Layers to ONNX (ref `python/paddle/onnx/export.py`,
+which delegates to paddle2onnx; here the jaxpr->ONNX emitter is in-tree, see
+`export.py`; `runtime.py` is a numpy evaluator used for artifact validation)."""
+from paddle_tpu.onnx.export import export  # noqa: F401
+from paddle_tpu.onnx import runtime  # noqa: F401

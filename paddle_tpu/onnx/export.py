@@ -1,0 +1,433 @@
+"""ONNX export — jaxpr → ONNX graph conversion.
+
+The reference delegates `paddle.onnx.export` to the external paddle2onnx
+package (`python/paddle/onnx/export.py:28`, which walks the static Program).
+The TPU-native equivalent walks the *jaxpr* of the layer's traced forward:
+parameters become initializers, each lax primitive maps to ONNX node(s), and
+the ModelProto is serialized through the in-tree schema (`onnx.proto`,
+official field numbers, so standard runtimes can load the artifact).
+
+Covered primitive set: the elementwise/matmul/conv/pool/reduce/shape ops that
+eval-mode vision and transformer blocks trace to. `dot_general` always lowers
+to Einsum (exact for every contraction), convs to Conv, `reduce_window` max /
+add to MaxPool / AveragePool.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.extend.core import Literal as _Literal
+except ImportError:  # older/newer jax layouts
+    from jax._src.core import Literal as _Literal
+
+from paddle_tpu.onnx import onnx_pb2 as pb
+
+_DTYPE = {
+    np.dtype(np.float32): pb.TensorProto.FLOAT,
+    np.dtype(np.float64): pb.TensorProto.DOUBLE,
+    np.dtype(np.int32): pb.TensorProto.INT32,
+    np.dtype(np.int64): pb.TensorProto.INT64,
+    np.dtype(np.bool_): pb.TensorProto.BOOL,
+    np.dtype(np.uint8): pb.TensorProto.UINT8,
+    np.dtype(np.int8): pb.TensorProto.INT8,
+    np.dtype(np.float16): pb.TensorProto.FLOAT16,
+}
+
+
+def _np_dtype(d):
+    d = np.dtype(d) if not str(d).startswith("bfloat") else np.dtype(np.float32)
+    return d
+
+
+def _tensor_proto(name, arr):
+    arr = np.asarray(arr)
+    if arr.dtype == jnp.bfloat16:
+        arr = arr.astype(np.float32)
+    t = pb.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    t.data_type = _DTYPE[np.dtype(arr.dtype)]
+    t.raw_data = np.ascontiguousarray(arr).tobytes()
+    return t
+
+
+def _value_info(name, shape, dtype):
+    vi = pb.ValueInfoProto()
+    vi.name = name
+    vi.type.tensor_type.elem_type = _DTYPE[_np_dtype(dtype)]
+    for d in shape:
+        vi.type.tensor_type.shape.dim.add().dim_value = int(d)
+    return vi
+
+
+class _Emitter:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = {}
+        self._n = 0
+
+    def fresh(self, hint="t"):
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def const(self, arr, hint="const"):
+        name = self.fresh(hint)
+        self.initializers[name] = _tensor_proto(name, arr)
+        return name
+
+    def node(self, op, inputs, n_out=1, name=None, **attrs):
+        nd = pb.NodeProto()
+        nd.op_type = op
+        nd.name = name or self.fresh(op.lower())
+        nd.input.extend(inputs)
+        outs = [self.fresh(op.lower()) for _ in range(n_out)]
+        nd.output.extend(outs)
+        for k, v in attrs.items():
+            a = nd.attribute.add()
+            a.name = k
+            if isinstance(v, float):
+                a.f = v
+                a.type = pb.AttributeProto.FLOAT
+            elif isinstance(v, bool) or isinstance(v, (int, np.integer)):
+                a.i = int(v)
+                a.type = pb.AttributeProto.INT
+            elif isinstance(v, str):
+                a.s = v.encode()
+                a.type = pb.AttributeProto.STRING
+            elif isinstance(v, (list, tuple)) and all(
+                    isinstance(x, (int, np.integer)) for x in v):
+                a.ints.extend(int(x) for x in v)
+                a.type = pb.AttributeProto.INTS
+            else:
+                raise TypeError(f"attr {k}={v!r}")
+        self.nodes.append(nd)
+        return outs[0] if n_out == 1 else outs
+
+
+_UNARY = {
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+    "sqrt": "Sqrt", "abs": "Abs", "neg": "Neg", "sign": "Sign",
+    "floor": "Floor", "ceil": "Ceil", "round_nearest_even": "Round",
+    "erf": "Erf", "sin": "Sin", "cos": "Cos", "not": "Not",
+}
+_BINARY = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div", "max": "Max",
+    "min": "Min", "pow": "Pow",
+    "gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
+    "le": "LessOrEqual", "eq": "Equal", "and": "And", "or": "Or",
+    "xor": "Xor",
+}
+_INLINE = {"jit", "pjit", "closed_call", "core_call", "custom_jvp_call",
+           "custom_vjp_call", "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr",
+           "remat", "checkpoint", "remat2", "custom_lin"}
+
+
+def _inner_closed_jaxpr(eqn):
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        if key in eqn.params:
+            cj = eqn.params[key]
+            return cj
+    raise NotImplementedError(
+        f"cannot inline {eqn.primitive.name}: params {list(eqn.params)}")
+
+
+def _einsum_eq(dn, lhs_ndim, rhs_ndim):
+    (lc, rc), (lb, rb) = dn
+    letters = iter("abcdefghijklmnopqrstuvwxyz")
+    lhs = [None] * lhs_ndim
+    rhs = [None] * rhs_ndim
+    out = []
+    for i, j in zip(lb, rb):
+        c = next(letters)
+        lhs[i] = rhs[j] = c
+        out.append(c)
+    for i, j in zip(lc, rc):
+        c = next(letters)
+        lhs[i] = rhs[j] = c
+    for i in range(lhs_ndim):
+        if lhs[i] is None:
+            lhs[i] = next(letters)
+            out.append(lhs[i])
+    for j in range(rhs_ndim):
+        if rhs[j] is None:
+            rhs[j] = next(letters)
+            out.append(rhs[j])
+    return f"{''.join(lhs)},{''.join(rhs)}->{''.join(out)}"
+
+
+def _convert_eqn(eqn, env, em):
+    prim = eqn.primitive.name
+    ins = []
+    for v in eqn.invars:
+        if isinstance(v, _Literal):
+            ins.append(em.const(np.asarray(v.val), "lit"))
+        else:
+            ins.append(env[v])
+
+    def out(name_or_names):
+        names = name_or_names if isinstance(name_or_names, list) \
+            else [name_or_names]
+        for var, nm in zip(eqn.outvars, names):
+            env[var] = nm
+
+    if prim in _INLINE:
+        cj = _inner_closed_jaxpr(eqn)
+        jx = cj.jaxpr if hasattr(cj, "jaxpr") else cj
+        consts = list(getattr(cj, "consts", []))
+        inner_env = {}
+        cvars = list(jx.constvars)
+        for cv, cval in zip(cvars, consts):
+            inner_env[cv] = em.const(np.asarray(cval), "cv")
+        for iv, nm in zip(jx.invars, ins[len(ins) - len(jx.invars):]):
+            inner_env[iv] = nm
+        for inner_eqn in jx.eqns:
+            _convert_eqn(inner_eqn, inner_env, em)
+        names = []
+        for ov in jx.outvars:
+            if isinstance(ov, _Literal):
+                names.append(em.const(np.asarray(ov.val), "lit"))
+            else:
+                names.append(inner_env[ov])
+        out(names)
+        return
+
+    if prim in _UNARY:
+        out(em.node(_UNARY[prim], [ins[0]]))
+    elif prim == "is_finite":
+        # finite = not (isnan or isinf)
+        bad = em.node("Or", [em.node("IsNaN", [ins[0]]),
+                             em.node("IsInf", [ins[0]])])
+        out(em.node("Not", [bad]))
+    elif prim == "rem":
+        # lax.rem is truncated (C-style) remainder -> Mod with fmod=1
+        out(em.node("Mod", ins, fmod=1))
+    elif prim == "ne":
+        out(em.node("Not", [em.node("Equal", ins)]))
+    elif prim == "rsqrt":
+        out(em.node("Reciprocal", [em.node("Sqrt", [ins[0]])]))
+    elif prim == "square":
+        out(em.node("Mul", [ins[0], ins[0]]))
+    elif prim == "integer_pow":
+        e = em.const(np.asarray(float(eqn.params["y"]), np.float32))
+        out(em.node("Pow", [ins[0], e]))
+    elif prim in _BINARY:
+        out(em.node(_BINARY[prim], ins))
+    elif prim == "select_n":
+        if len(ins) != 3:
+            raise NotImplementedError("select_n with >2 cases")
+        # select_n(pred, on_false, on_true) -> Where(pred, on_true, on_false)
+        out(em.node("Where", [ins[0], ins[2], ins[1]]))
+    elif prim == "stop_gradient" or prim == "copy":
+        out(em.node("Identity", [ins[0]]))
+    elif prim == "convert_element_type":
+        to = _DTYPE[_np_dtype(eqn.params["new_dtype"])]
+        out(em.node("Cast", [ins[0]], to=int(to)))
+    elif prim == "reshape":
+        shape = em.const(np.asarray(eqn.params["new_sizes"], np.int64))
+        out(em.node("Reshape", [ins[0], shape]))
+    elif prim == "transpose":
+        out(em.node("Transpose", [ins[0]], perm=list(eqn.params["permutation"])))
+    elif prim == "broadcast_in_dim":
+        shape = eqn.params["shape"]
+        bdims = eqn.params["broadcast_dimensions"]
+        # reshape input into rank-len(shape) with 1s, then Expand
+        in_shape = eqn.invars[0].aval.shape
+        inter = [1] * len(shape)
+        for src, dst in enumerate(bdims):
+            inter[dst] = in_shape[src]
+        r = em.node("Reshape",
+                    [ins[0], em.const(np.asarray(inter, np.int64))])
+        out(em.node("Expand", [r, em.const(np.asarray(shape, np.int64))]))
+    elif prim in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+                  "argmax", "argmin"):
+        axes = list(eqn.params["axes"]) if "axes" in eqn.params else \
+            [eqn.params["axis"]]
+        if prim == "reduce_sum":
+            out(em.node("ReduceSum",
+                        [ins[0], em.const(np.asarray(axes, np.int64))],
+                        keepdims=0))
+        elif prim in ("reduce_max", "reduce_min", "reduce_prod"):
+            op = {"reduce_max": "ReduceMax", "reduce_min": "ReduceMin",
+                  "reduce_prod": "ReduceProd"}[prim]
+            out(em.node(op, [ins[0]], axes=axes, keepdims=0))
+        else:
+            op = "ArgMax" if prim == "argmax" else "ArgMin"
+            out(em.node(op, [ins[0]], axis=axes[0], keepdims=0))
+    elif prim == "concatenate":
+        out(em.node("Concat", ins, axis=int(eqn.params["dimension"])))
+    elif prim == "pad":
+        lo_hi = eqn.params["padding_config"]
+        if any(p[2] != 0 for p in lo_hi):
+            raise NotImplementedError("interior pad")
+        pads = [p[0] for p in lo_hi] + [p[1] for p in lo_hi]
+        out(em.node("Pad", [ins[0],
+                            em.const(np.asarray(pads, np.int64)), ins[1]]))
+    elif prim == "slice":
+        starts = list(eqn.params["start_indices"])
+        ends = list(eqn.params["limit_indices"])
+        steps = list(eqn.params["strides"] or [1] * len(starts))
+        axes = list(range(len(starts)))
+        out(em.node("Slice", [
+            ins[0], em.const(np.asarray(starts, np.int64)),
+            em.const(np.asarray(ends, np.int64)),
+            em.const(np.asarray(axes, np.int64)),
+            em.const(np.asarray(steps, np.int64))]))
+    elif prim == "rev":
+        # Reverse via Slice with negative steps
+        dims = list(eqn.params["dimensions"])
+        shape = eqn.invars[0].aval.shape
+        starts = [shape[d] - 1 for d in dims]
+        ends = [-(shape[d] + 1) for d in dims]
+        steps = [-1] * len(dims)
+        out(em.node("Slice", [
+            ins[0], em.const(np.asarray(starts, np.int64)),
+            em.const(np.asarray(ends, np.int64)),
+            em.const(np.asarray(dims, np.int64)),
+            em.const(np.asarray(steps, np.int64))]))
+    elif prim == "dot_general":
+        eq = _einsum_eq(eqn.params["dimension_numbers"],
+                        len(eqn.invars[0].aval.shape),
+                        len(eqn.invars[1].aval.shape))
+        out(em.node("Einsum", ins, equation=eq))
+    elif prim == "conv_general_dilated":
+        dn = eqn.params["dimension_numbers"]
+        if (dn.lhs_spec != tuple(range(len(dn.lhs_spec)))
+                or dn.rhs_spec != tuple(range(len(dn.rhs_spec)))
+                or dn.out_spec != tuple(range(len(dn.out_spec)))):
+            raise NotImplementedError(f"conv layout {dn}")
+        if any(d != 1 for d in eqn.params["lhs_dilation"]):
+            raise NotImplementedError("transposed conv export")
+        pads_lohi = eqn.params["padding"]
+        pads = [p[0] for p in pads_lohi] + [p[1] for p in pads_lohi]
+        out(em.node("Conv", ins,
+                    strides=list(eqn.params["window_strides"]),
+                    pads=pads,
+                    dilations=list(eqn.params["rhs_dilation"]),
+                    group=int(eqn.params["feature_group_count"])))
+    elif prim in ("reduce_window_max", "reduce_window_sum"):
+        wd = list(eqn.params["window_dimensions"])
+        ws = list(eqn.params["window_strides"])
+        pad_cfg = eqn.params["padding"]
+        if wd[0] != 1 or wd[1] != 1:
+            raise NotImplementedError(f"pool window {wd}")
+        pads = ([p[0] for p in pad_cfg[2:]] + [p[1] for p in pad_cfg[2:]])
+        kernel = wd[2:]
+        strides = ws[2:]
+        if prim == "reduce_window_max":
+            out(em.node("MaxPool", [ins[0]], kernel_shape=kernel,
+                        strides=strides, pads=pads))
+        else:
+            avg = em.node("AveragePool", [ins[0]], kernel_shape=kernel,
+                          strides=strides, pads=pads, count_include_pad=1)
+            scale = em.const(np.asarray(float(np.prod(kernel)), np.float32))
+            out(em.node("Mul", [avg, scale]))
+    elif prim == "iota":
+        shape = eqn.params["shape"]
+        dim = eqn.params["dimension"]
+        dt = _np_dtype(eqn.params["dtype"])
+        rng = np.arange(shape[dim], dtype=dt)
+        reps = [1] * len(shape)
+        view = [1] * len(shape)
+        view[dim] = shape[dim]
+        arr = np.broadcast_to(rng.reshape(view), shape)
+        out(em.const(np.ascontiguousarray(arr), "iota"))
+    elif prim == "gather":
+        # only embedding-style gathers: one collapsed dim, indices over axis 0
+        gd = eqn.params["dimension_numbers"]
+        if (gd.collapsed_slice_dims == (0,) and gd.start_index_map == (0,)):
+            idx = ins[1]
+            sq = em.node("Squeeze",
+                         [idx, em.const(np.asarray([-1], np.int64))])
+            out(em.node("Gather", [ins[0], sq], axis=0))
+        else:
+            raise NotImplementedError(f"gather {gd}")
+    else:
+        raise NotImplementedError(
+            f"ONNX export: unsupported primitive '{prim}' "
+            f"(params {list(eqn.params)})")
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export an eval-mode Layer to an ONNX file (ref paddle.onnx.export).
+
+    input_spec: list of paddle.static.InputSpec-likes, Tensors, or shape
+    tuples. Returns the path written.
+    """
+    from paddle_tpu.core.autograd import no_grad
+    from paddle_tpu.core.tensor import Tensor
+
+    if input_spec is None:
+        raise ValueError("input_spec is required")
+    if not str(path).endswith(".onnx"):
+        path = str(path) + ".onnx"
+
+    specs = []
+    for s in input_spec:
+        if isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s._data.dtype))
+        elif hasattr(s, "shape"):
+            specs.append(jax.ShapeDtypeStruct(
+                tuple(int(d) for d in s.shape),
+                np.dtype(getattr(s, "dtype", "float32") or "float32")))
+        else:
+            specs.append(jax.ShapeDtypeStruct(tuple(s), np.float32))
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+
+        def pure(*arrs):
+            with no_grad():
+                outs = layer(*[Tensor(a, _internal=True) for a in arrs])
+            if isinstance(outs, (tuple, list)):
+                return tuple(o._data for o in outs if isinstance(o, Tensor))
+            return (outs._data,)
+
+        closed = jax.make_jaxpr(pure)(*specs)
+        jx = closed.jaxpr
+
+        em = _Emitter()
+        env = {}
+        input_names = []
+        for i, (iv, spec) in enumerate(zip(jx.invars, specs)):
+            nm = f"input_{i}"
+            env[iv] = nm
+            input_names.append(nm)
+        for cv, cval in zip(jx.constvars, closed.consts):
+            env[cv] = em.const(np.asarray(cval), "param")
+        for eqn in jx.eqns:
+            _convert_eqn(eqn, env, em)
+
+        graph = pb.GraphProto()
+        graph.name = type(layer).__name__
+        graph.node.extend(em.nodes)
+        graph.initializer.extend(em.initializers.values())
+        for nm, spec in zip(input_names, specs):
+            graph.input.append(_value_info(nm, spec.shape, spec.dtype))
+        for i, ov in enumerate(jx.outvars):
+            nm = env[ov] if not isinstance(ov, _Literal) else \
+                em.const(np.asarray(ov.val), "out")
+            # ONNX requires distinct graph output entries
+            vi = _value_info(f"output_{i}", ov.aval.shape, ov.aval.dtype)
+            graph.node.append(pb.NodeProto(op_type="Identity", input=[nm],
+                                           output=[f"output_{i}"],
+                                           name=em.fresh("out")))
+            graph.output.append(vi)
+
+        model = pb.ModelProto()
+        model.ir_version = 7
+        model.producer_name = "paddle_tpu"
+        model.graph.CopyFrom(graph)
+        ops = model.opset_import.add()
+        ops.domain = ""
+        ops.version = opset_version
+        with open(path, "wb") as f:
+            f.write(model.SerializeToString())
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+    return path

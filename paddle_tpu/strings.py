@@ -53,8 +53,13 @@ class StringTensor:
         return len(self._data)
 
     def __eq__(self, other):
+        """Elementwise comparison returning a bool ndarray (tensor semantics,
+        not python equality)."""
         other = other._data if isinstance(other, StringTensor) else other
         return np.asarray(self._data == other)
+
+    # __eq__ returns an array; keep identity hashing like Tensor
+    __hash__ = object.__hash__
 
     def __repr__(self):
         return f"StringTensor(shape={self.shape}, data={self._data!r})"

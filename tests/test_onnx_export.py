@@ -1,0 +1,89 @@
+"""ONNX export: jaxpr->ONNX emitter + numpy runtime parity
+(ref `python/paddle/onnx/export.py`; here in-tree, see paddle_tpu/onnx/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.onnx import export, runtime
+
+R = np.random.RandomState(21)
+
+
+def _roundtrip(layer, shapes, tmp_path, atol=1e-5, inputs=None):
+    layer.eval()
+    path = export(layer, str(tmp_path / "m.onnx"), input_spec=shapes)
+    model = runtime.load(path)
+    if inputs is None:
+        inputs = [R.randn(*s).astype(np.float32) for s in shapes]
+    got = runtime.run(model, inputs)[0]
+    want = layer(*[paddle.to_tensor(x) for x in inputs])
+    if isinstance(want, (tuple, list)):
+        want = want[0]
+    np.testing.assert_allclose(got, want.numpy(), atol=atol, rtol=1e-4)
+    return model
+
+
+def test_mlp_with_layernorm_softmax(tmp_path):
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.LayerNorm(16),
+                      nn.Linear(16, 4), nn.Softmax())
+    model = _roundtrip(m, [(3, 8)], tmp_path)
+    ops = {n.op_type for n in model.graph.node}
+    assert "Einsum" in ops
+
+
+def test_lenet_conv_pool(tmp_path):
+    from paddle_tpu.vision.models import LeNet
+    model = _roundtrip(LeNet(num_classes=10), [(2, 1, 28, 28)], tmp_path,
+                       atol=1e-4)
+    ops = {n.op_type for n in model.graph.node}
+    assert "Conv" in ops and "MaxPool" in ops
+
+
+def test_batchnorm_eval_and_avgpool(tmp_path):
+    m = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1), nn.BatchNorm2D(4),
+                      nn.Sigmoid(), nn.AvgPool2D(2))
+    _roundtrip(m, [(1, 3, 8, 8)], tmp_path, atol=1e-4)
+
+
+def test_embedding_gather(tmp_path):
+    m = nn.Embedding(12, 5)
+    m.eval()
+    ids = np.array([[1, 3, 7]], np.int64)
+    path = export(m, str(tmp_path / "e.onnx"),
+                  input_spec=[paddle.to_tensor(ids)])
+    model = runtime.load(path)
+    got = runtime.run(model, [ids])[0]
+    np.testing.assert_allclose(got, m(paddle.to_tensor(ids)).numpy(),
+                               atol=1e-6)
+
+
+def test_artifact_structure(tmp_path):
+    m = nn.Linear(4, 2)
+    m.eval()
+    path = export(m, str(tmp_path / "lin.onnx"), input_spec=[(1, 4)])
+    model = runtime.load(path)
+    assert model.ir_version == 7
+    assert model.producer_name == "paddle_tpu"
+    assert model.opset_import[0].version == 13
+    assert len(model.graph.input) == 1
+    assert len(model.graph.output) == 1
+    assert model.graph.output[0].name == "output_0"
+    # weights travel as raw_data initializers
+    assert any(t.raw_data for t in model.graph.initializer)
+
+
+def test_appends_onnx_suffix(tmp_path):
+    m = nn.Linear(2, 2)
+    m.eval()
+    path = export(m, str(tmp_path / "noext"), input_spec=[(1, 2)])
+    assert path.endswith(".onnx")
+
+
+def test_unsupported_primitive_raises(tmp_path):
+    class Weird(nn.Layer):
+        def forward(self, x):
+            return paddle.cumsum(x, axis=0)
+
+    with pytest.raises(NotImplementedError):
+        export(Weird(), str(tmp_path / "w.onnx"), input_spec=[(3, 3)])
