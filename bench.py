@@ -138,11 +138,25 @@ def bench_bert():
     return batch / dt, dt, loss
 
 
+def _retry(fn, attempts=3):
+    """The dev-tunnel backend occasionally drops a remote_compile connection
+    (HTTP 500 / closed body) — transient, so each rung retries."""
+    last = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — rung isolation by design
+            last = e
+            if i < attempts - 1:
+                time.sleep(5)
+    raise last
+
+
 def main():
     import jax
     platform = jax.default_backend()
 
-    tps, mfu, dt, loss, n_params = bench_gpt2()
+    tps, mfu, dt, loss, n_params = _retry(bench_gpt2)
     target_mfu = 0.8 * 0.45
     print(json.dumps({
         "metric": "gpt2s_train_tokens_per_sec_per_chip",
@@ -154,14 +168,14 @@ def main():
           f"step={dt*1e3:.1f}ms mfu={mfu:.3f} platform={platform}",
           file=sys.stderr)
     try:
-        ips, dt_r, loss_r = bench_resnet50()
+        ips, dt_r, loss_r = _retry(bench_resnet50)
         print(f"# resnet50 imgs/sec/chip={ips:.1f} step={dt_r*1e3:.1f}ms "
               f"loss={loss_r:.3f}", file=sys.stderr)
     except Exception as e:  # secondary rung must not kill the primary metric
         print(f"# resnet50 rung failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     try:
-        sps, dt_b, loss_b = bench_bert()
+        sps, dt_b, loss_b = _retry(bench_bert)
         print(f"# bert_base seqs/sec/chip={sps:.1f} step={dt_b*1e3:.1f}ms "
               f"loss={loss_b:.3f}", file=sys.stderr)
     except Exception as e:

@@ -64,6 +64,11 @@ from paddle_tpu import geometric  # noqa: F401
 from paddle_tpu import text  # noqa: F401
 from paddle_tpu import strings  # noqa: F401
 from paddle_tpu import onnx  # noqa: F401
+from paddle_tpu import regularizer  # noqa: F401
+from paddle_tpu import hub  # noqa: F401
+from paddle_tpu import static  # noqa: F401
+from paddle_tpu.hapi import callbacks  # noqa: F401
+from paddle_tpu import version  # noqa: F401
 
 from paddle_tpu.nn.functional.common import linear  # noqa: F401  (paddle exposes it)
 

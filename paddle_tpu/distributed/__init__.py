@@ -28,3 +28,4 @@ from paddle_tpu.distributed.spawn import spawn  # noqa: F401
 from paddle_tpu.distributed.checkpoint import (  # noqa: F401
     save_sharded, load_sharded, async_save)
 from paddle_tpu.distributed import auto_parallel  # noqa: F401
+from paddle_tpu.distributed import rpc  # noqa: F401

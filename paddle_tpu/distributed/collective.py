@@ -352,31 +352,98 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
     return t
 
 
+# p2p sequence counters, keyed (src, dst) — both ends advance in lockstep.
+# The counter only advances AFTER a successful transfer, so a timed-out recv
+# retries the same sequence number instead of silently skipping a message.
+_p2p_seq: dict = {}
+
+
+def _p2p_peek_key(src, dst):
+    n = _p2p_seq.get((src, dst), 0)
+    return n, f"ptpu_p2p/{src}to{dst}/{n}"
+
+
+def _p2p_advance(src, dst, n):
+    _p2p_seq[(src, dst)] = n + 1
+
+
+def _kv_client():
+    from jax._src.distributed import global_state
+    if global_state.client is None:
+        raise RuntimeError("p2p needs init_parallel_env (jax.distributed)")
+    return global_state.client
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    """In-graph p2p: inside shard_map, a matched send/recv pair is one
-    lax.ppermute — which is exactly how the SPMD pipeline engine moves
-    activations between stages (`fleet/pipeline.py` spmd_pipeline, the
-    counterpart of the reference's `p2p_communication.py:74`). The asymmetric
-    eager send()/recv() API cannot be expressed in a single SPMD program, so
-    these raise; use the pipeline engine or alltoall/broadcast instead."""
-    raise NotImplementedError(
-        "asymmetric eager p2p is not expressible in one SPMD program; matched "
-        "send/recv pairs compile to lax.ppermute — see "
-        "paddle_tpu.distributed.fleet.pipeline.spmd_pipeline (the pipeline "
-        "runtime that replaces the reference's p2p layer)")
+    """Point-to-point send (ref `send_v2` op / ProcessGroup::Send).
+
+    In-graph p2p: inside shard_map a matched send/recv pair is one
+    lax.ppermute — that is how the SPMD pipeline engine moves activations
+    between stages (`fleet/pipeline.py` spmd_pipeline, counterpart of the
+    reference's `p2p_communication.py:74`); calling this inside a trace
+    raises with that pointer.
+
+    Eager multi-process: the payload travels through the coordination
+    service's KV store (the TCPStore analog) — a correctness path for
+    control-plane-sized tensors, like the reference's Gloo fallback."""
+    t = ensure_tensor(tensor)
+    if _in_trace(t):
+        raise NotImplementedError(
+            "asymmetric eager p2p is not expressible in one SPMD program; "
+            "matched send/recv pairs compile to lax.ppermute — see "
+            "paddle_tpu.distributed.fleet.pipeline.spmd_pipeline")
+    if not _multiprocess():
+        raise RuntimeError("send() with world_size 1 has no peer")
+    from paddle_tpu.distributed.parallel import get_rank
+    arr = np.ascontiguousarray(np.asarray(t._data))
+    n, key = _p2p_peek_key(get_rank(), dst)
+    _kv_client().key_value_set_bytes(key, arr.tobytes())
+    _p2p_advance(get_rank(), dst, n)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "asymmetric eager p2p is not expressible in one SPMD program; matched "
-        "send/recv pairs compile to lax.ppermute — see "
-        "paddle_tpu.distributed.fleet.pipeline.spmd_pipeline (the pipeline "
-        "runtime that replaces the reference's p2p layer)")
+    """Point-to-point receive into ``tensor`` (shape/dtype taken from it;
+    ref `recv_v2` op / ProcessGroup::Recv). See send() for the transport."""
+    t = ensure_tensor(tensor)
+    if _in_trace(t):
+        raise NotImplementedError(
+            "asymmetric eager p2p is not expressible in one SPMD program; "
+            "matched send/recv pairs compile to lax.ppermute — see "
+            "paddle_tpu.distributed.fleet.pipeline.spmd_pipeline")
+    if not _multiprocess():
+        raise RuntimeError("recv() with world_size 1 has no peer")
+    from paddle_tpu.distributed.parallel import get_rank
+    n, key = _p2p_peek_key(src, get_rank())
+    client = _kv_client()
+    raw = client.blocking_key_value_get_bytes(key, 120_000)
+    _p2p_advance(src, get_rank(), n)
+    # free the coordinator's copy — otherwise every payload ever sent
+    # accumulates in the coordination service
+    try:
+        client.key_value_delete(key)
+    except Exception:
+        pass
+    arr = np.frombuffer(raw, dtype=np.dtype(str(t._data.dtype))).reshape(
+        t.shape)
+    t._write(jnp.asarray(arr))
+    return t
 
 
 def isend(tensor, dst, group=None):
-    return send(tensor, dst, group)
+    send(tensor, dst, group)
+    return _DoneTask()
 
 
 def irecv(tensor, src=None, group=None):
-    return recv(tensor, src, group)
+    recv(tensor, src if src is not None else 0, group)
+    return _DoneTask()
+
+
+class _DoneTask:
+    """Completed-task handle (the eager KV transport is synchronous)."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True

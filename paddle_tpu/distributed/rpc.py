@@ -1,0 +1,316 @@
+"""``paddle.distributed.rpc`` — worker-to-worker remote procedure calls.
+
+Rebuild of the reference's brpc-based RPC tower
+(`paddle/fluid/distributed/rpc/rpc_agent.cc`, python surface
+`python/paddle/distributed/rpc/rpc.py`: init_rpc, rpc_sync :114,
+rpc_async :157, shutdown, get_worker_info). brpc collapses to a plain TCP
+JSON-length-prefixed pickle protocol: every worker runs a daemon server
+thread; calls pickle (fn, args, kwargs), the callee executes and ships the
+result back. The master (worker 0 or an external store) performs name →
+(host, port) rendezvous exactly like the reference's KVStore handshake.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state = None
+
+
+def _advertise_ip(master_ip):
+    """The address peers should dial: loopback for single-host jobs, else the
+    interface that routes to the master (multi-host)."""
+    if master_ip in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        probe.connect((master_ip, 9))  # no traffic sent for UDP connect
+        return probe.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        probe.close()
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class _Server(threading.Thread):
+    def __init__(self, sock):
+        super().__init__(daemon=True)
+        self._sock = sock
+        self._stop = threading.Event()
+
+    def run(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            with conn:
+                while True:
+                    msg = _recv_msg(conn)
+                    kind = msg[0]
+                    if kind == "call":
+                        _, fn, args, kwargs = msg
+                        try:
+                            res = ("ok", fn(*args, **kwargs))
+                        except Exception as e:  # ship the exception back
+                            res = ("err", e)
+                        _send_msg(conn, res)
+                    elif kind == "bye":
+                        _send_msg(conn, ("ok", None))
+                        return
+        except (ConnectionError, EOFError, OSError):
+            return
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _RpcState:
+    def __init__(self, name, rank, world_size, server_sock, master_addr):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.server = _Server(server_sock)
+        self.server.start()
+        self.master_addr = master_addr
+        self.workers: dict[str, WorkerInfo] = {}
+        self.pool = ThreadPoolExecutor(max_workers=8)
+        self._conns: dict[str, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._peer_locks: dict[str, threading.Lock] = {}
+
+    def connect(self, to: str):
+        """Returns (socket, per-peer lock): calls to different peers run
+        concurrently; calls to one peer serialize on its connection."""
+        with self._conn_lock:
+            if to not in self._conns:
+                wi = self.workers[to]
+                s = socket.create_connection((wi.ip, wi.port), timeout=60)
+                self._conns[to] = s
+                self._peer_locks[to] = threading.Lock()
+            return self._conns[to], self._peer_locks[to]
+
+
+def _master_rendezvous(state, ip, port, master_ip, master_port):
+    """Worker 0 hosts a registry socket; everyone registers then receives the
+    full table (ref KVStore barrier in `rpc.py:init_rpc`)."""
+    me = WorkerInfo(state.name, state.rank, ip, port)
+    if state.rank == 0:
+        reg = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        reg.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        reg.bind((master_ip, master_port))
+        reg.listen(state.world_size)
+        infos = {me.name: me}
+        conns = []
+        while len(infos) < state.world_size:
+            conn, _ = reg.accept()
+            wi = _recv_msg(conn)
+            infos[wi.name] = wi
+            conns.append(conn)
+        for conn in conns:
+            _send_msg(conn, infos)
+            conn.close()
+        reg.close()
+        state.workers = infos
+    else:
+        for _ in range(100):
+            try:
+                s = socket.create_connection((master_ip, master_port),
+                                             timeout=5)
+                break
+            except OSError:
+                time.sleep(0.1)
+        else:
+            raise ConnectionError("cannot reach rpc master")
+        with s:
+            _send_msg(s, me)
+            state.workers = _recv_msg(s)
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC agent and rendezvous with the others
+    (ref `python/paddle/distributed/rpc/rpc.py:init_rpc`)."""
+    global _state, _barrier_count
+    import os
+    # fresh barrier per agent lifetime (repeated init/shutdown cycles)
+    with _barrier_lock:
+        _barrier_count = 0
+        _barrier_event.clear()
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    if world_size is None:
+        world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    if master_endpoint is None:
+        master_endpoint = os.environ.get("PADDLE_MASTER_ENDPOINT",
+                                         "127.0.0.1:29531")
+    master_ip, master_port = master_endpoint.rsplit(":", 1)
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", 0))
+    srv.listen(64)
+    port = srv.getsockname()[1]
+    ip = _advertise_ip(master_ip)
+    _state = _RpcState(name, rank, world_size, srv, master_endpoint)
+    _master_rendezvous(_state, ip, port, master_ip, int(master_port) + 1)
+    return get_current_worker_info()
+
+
+def _require_state():
+    if _state is None:
+        raise RuntimeError("rpc is not initialized; call init_rpc first")
+    return _state
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
+    """Blocking remote call (ref rpc.py:114)."""
+    return rpc_async(to, fn, args=args, kwargs=kwargs,
+                     timeout=timeout).result(timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=None) -> Future:
+    """Non-blocking remote call returning a Future (ref rpc.py:157).
+
+    The returned future's ``wait()`` alias matches the reference API.
+    """
+    st = _require_state()
+
+    def do_call():
+        conn, lock = st.connect(to)
+        with lock:
+            _send_msg(conn, ("call", fn, tuple(args or ()), dict(kwargs or {})))
+            status, payload = _recv_msg(conn)
+        if status == "err":
+            raise payload
+        return payload
+
+    fut = st.pool.submit(do_call)
+    if not hasattr(fut, "wait"):
+        fut.wait = fut.result
+    return fut
+
+
+def get_worker_info(name):
+    """ref rpc.py:get_worker_info."""
+    return _require_state().workers[name]
+
+
+def get_all_worker_infos():
+    st = _require_state()
+    return sorted(st.workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info():
+    st = _require_state()
+    return st.workers[st.name]
+
+
+_barrier_lock = threading.Lock()
+_barrier_count = 0
+_barrier_event = threading.Event()
+
+
+def _barrier_enter(world_size):
+    """Runs on worker 0 (in a per-connection server thread, so blocking here
+    is safe): releases once every worker has checked in."""
+    global _barrier_count
+    with _barrier_lock:
+        _barrier_count += 1
+        if _barrier_count >= world_size:
+            _barrier_event.set()
+    _barrier_event.wait(timeout=60)
+    return True
+
+
+def shutdown():
+    """Graceful stop: barrier across workers (so nobody tears the server down
+    under a peer's in-flight call — ref rpc.py:shutdown's KVStore barrier),
+    then drain connections and stop the agent."""
+    global _state
+    if _state is None:
+        return
+    if _state.world_size > 1 and _state.workers:
+        root = next(w.name for w in _state.workers.values() if w.rank == 0)
+        try:
+            if _state.rank == 0:
+                _barrier_enter(_state.world_size)
+            else:
+                rpc_sync(root, _barrier_enter, args=(_state.world_size,),
+                         timeout=60)
+        except (ConnectionError, OSError):
+            pass
+    for name, conn in list(_state._conns.items()):
+        try:
+            _send_msg(conn, ("bye",))
+            _recv_msg(conn)
+            conn.close()
+        except OSError:
+            pass
+    _state.server.stop()
+    _state.pool.shutdown(wait=False)
+    _state = None
+
+
+def _advertise_ip(master_ip):
+    """The address peers should dial: loopback for single-host jobs, else the
+    interface that routes to the master (multi-host)."""
+    if master_ip in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        probe.connect((master_ip, 9))  # no traffic sent for UDP connect
+        return probe.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        probe.close()

@@ -1,0 +1,63 @@
+"""``paddle.hub`` — load models from local hubconf repositories
+(ref: `python/paddle/hapi/hub.py` — list :103, help :139, load :174).
+
+The github/gitee download path is gated on network availability; the local
+directory source (`source='local'`) is fully supported: a repo directory
+containing ``hubconf.py`` whose public callables are the hub entrypoints.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve(repo_dir, source):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"Unknown source: {source!r}. Valid: 'github' | 'gitee' | 'local'")
+    if source == "local":
+        return repo_dir
+    raise RuntimeError(
+        "remote hub sources need network access; clone the repo and use "
+        "source='local'")
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """Entrypoint names exposed by the repo's hubconf (ref hub.py:103)."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return [n for n, f in vars(mod).items()
+            if callable(f) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    """Docstring of one entrypoint (ref hub.py:139)."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no entrypoint {model!r} in hubconf")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Instantiate one entrypoint (ref hub.py:174)."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no entrypoint {model!r} in hubconf")
+    return fn(**kwargs)

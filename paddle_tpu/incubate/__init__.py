@@ -19,3 +19,4 @@ def softmax_mask_fuse_upper_triangle(x):
         return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
 
     return apply(prim, x, op_name="softmax_mask_fuse_upper_triangle")
+from paddle_tpu.incubate import asp  # noqa: F401

@@ -1,0 +1,193 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Rebuild of the reference's `python/paddle/incubate/asp/` (over
+`fluid/contrib/sparsity/`: `calculate_density`, `prune_model` :~, `decorate`,
+utils `create_mask`/`check_sparsity` with mask_1d / mask_2d algorithms).
+On TPU there is no sparse tensor-core constraint, but the n:m pattern is still
+the pruning contract users train against, and XLA benefits from the induced
+zeros at int8 time; masks are applied as element multiplies and re-applied
+after every optimizer step by the decorated optimizer (the reference's
+OptimizerWithSparsityGuarantee).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["calculate_density", "create_mask", "check_sparsity", "prune_model",
+           "decorate", "reset_excluded_layers", "set_excluded_layers"]
+
+# mask lives on the parameter itself (p._asp_mask); this registry only lists
+# pruned params for introspection and is weakref-safe against id() reuse
+import weakref
+
+_masks: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+_excluded: set[str] = set()
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros (ref asp.py:calculate_density)."""
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _mask_1d(mat, n, m):
+    """Keep the n largest-|w| entries of every contiguous group of m along the
+    last axis (ref sparsity/utils.py:get_mask_1d)."""
+    shape = mat.shape
+    flat = mat.reshape(-1, m)
+    order = np.argsort(-np.abs(flat), axis=1)
+    mask = np.zeros_like(flat, dtype=bool)
+    rows = np.arange(flat.shape[0])[:, None]
+    mask[rows, order[:, :n]] = True
+    return mask.reshape(shape)
+
+
+def _mask_2d_greedy(mat, n, m):
+    """Greedy m x m block selection keeping n entries per row AND column
+    (ref sparsity/utils.py:get_mask_2d_greedy)."""
+    shape = mat.shape
+    mat2 = mat.reshape(-1, shape[-1])
+    rows, cols = mat2.shape
+    mask = np.zeros_like(mat2, dtype=bool)
+    for r0 in range(0, rows, m):
+        for c0 in range(0, cols, m):
+            blk = np.abs(mat2[r0:r0 + m, c0:c0 + m])
+            bm = np.zeros_like(blk, dtype=bool)
+            row_cnt = np.zeros(blk.shape[0], np.int64)
+            col_cnt = np.zeros(blk.shape[1], np.int64)
+            for idx in np.argsort(-blk, axis=None):
+                i, j = divmod(int(idx), blk.shape[1])
+                if row_cnt[i] < n and col_cnt[j] < n:
+                    bm[i, j] = True
+                    row_cnt[i] += 1
+                    col_cnt[j] += 1
+            # greedy can strand deficits (a row and column both short but
+            # their crossing already blocked) — complete to exactly n per
+            # row/col by best remaining candidates
+            while (row_cnt < n).any():
+                i = int(np.argmin(row_cnt))
+                avail = np.where((~bm[i]) & (col_cnt < n))[0]
+                if len(avail):
+                    j = avail[np.argmax(blk[i, avail])]
+                    bm[i, j] = True
+                    row_cnt[i] += 1
+                    col_cnt[j] += 1
+                    continue
+                # stranded: row i's remaining slots all sit on full columns.
+                # Augment: move a selected cell (r, j2) to (r, j_deficit),
+                # freeing column j2 for row i.
+                j_def = int(np.argmin(col_cnt))
+                moved = False
+                for j2 in np.where(~bm[i])[0]:
+                    rs = np.where(bm[:, j2] & ~bm[:, j_def])[0]
+                    if len(rs):
+                        r = int(rs[0])
+                        bm[r, j2] = False
+                        bm[r, j_def] = True
+                        col_cnt[j2] -= 1
+                        col_cnt[j_def] += 1
+                        bm[i, j2] = True
+                        row_cnt[i] += 1
+                        col_cnt[j2] += 1
+                        moved = True
+                        break
+                if not moved:
+                    break
+            mask[r0:r0 + m, c0:c0 + m] = bm
+    return mask.reshape(shape)
+
+
+_ALGOS = {"mask_1d": _mask_1d, "mask_2d_greedy": _mask_2d_greedy,
+          "mask_2d_best": _mask_2d_greedy}
+
+
+def create_mask(mat, func_name="mask_1d", n=2, m=4):
+    """Boolean n:m mask for a 2-D (or trailing-dim-divisible) weight."""
+    arr = np.asarray(mat.numpy() if hasattr(mat, "numpy") else mat)
+    if arr.shape[-1] % m != 0:
+        raise ValueError(f"last dim {arr.shape[-1]} not divisible by m={m}")
+    return _ALGOS[func_name](arr, n, m)
+
+
+def check_sparsity(mat, n=2, m=4, func_name="mask_1d"):
+    """True iff the matrix already satisfies the n:m pattern
+    (ref sparsity/utils.py:check_sparsity)."""
+    arr = np.asarray(mat.numpy() if hasattr(mat, "numpy") else mat)
+    if arr.shape[-1] % m != 0:
+        return False
+    nz = (arr.reshape(-1, m) != 0).sum(axis=1)
+    return bool(np.all(nz <= n))
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Skip these parameter names during pruning (ref asp.py)."""
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _prunable(layer, name, p):
+    import paddle_tpu.nn as nn
+    if name in _excluded or p.name in _excluded:
+        return False
+    if p.ndim < 2:
+        return False
+    # the reference prunes FC and conv weights
+    return isinstance(layer, (nn.Linear, nn.Conv2D, nn.Conv1D, nn.Conv3D))
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m pruning to every supported layer's weight and remember the
+    masks so `decorate`d optimizers re-impose them after each step
+    (ref asp.py:prune_model)."""
+    from paddle_tpu.core.tensor import Tensor
+    masks = {}
+    for lname, layer in model.named_sublayers():
+        w = getattr(layer, "weight", None)
+        if w is None or not _prunable(layer, lname, w):
+            continue
+        arr = np.asarray(w.numpy())
+        flat2d = arr.reshape(arr.shape[0], -1) if arr.ndim > 2 else arr
+        if flat2d.shape[-1] % m != 0:
+            continue
+        mask = _ALGOS[mask_algo](flat2d, n, m).reshape(arr.shape)
+        w._write(jnp.asarray(arr * mask))
+        if with_mask:
+            w._asp_mask = jnp.asarray(mask, arr.dtype)
+            masks[lname] = w._asp_mask
+            _masks[lname] = w
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies the pruning masks after every step
+    (ref asp.py:OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer):
+        self._inner_opt = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+        for p in self._inner_opt._parameter_list:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._write(p._data * mask.astype(p._data.dtype))
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._inner_opt._parameter_list]
+
+
+def decorate(optimizer):
+    """Wrap an optimizer with the sparsity guarantee (ref asp.py:decorate)."""
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -255,7 +255,12 @@ def _convert_eqn(eqn, env, em):
             out(em.node(op, [ins[0]], axes=axes, keepdims=0))
         else:
             op = "ArgMax" if prim == "argmax" else "ArgMin"
-            out(em.node(op, [ins[0]], axis=axes[0], keepdims=0))
+            res = em.node(op, [ins[0]], axis=axes[0], keepdims=0)
+            # ONNX Arg* always emits INT64; the jaxpr aval may be int32
+            want = _DTYPE[_np_dtype(eqn.outvars[0].aval.dtype)]
+            if want != pb.TensorProto.INT64:
+                res = em.node("Cast", [res], to=int(want))
+            out(res)
     elif prim == "concatenate":
         out(em.node("Concat", ins, axis=int(eqn.params["dimension"])))
     elif prim == "pad":

@@ -40,10 +40,17 @@ class Optimizer:
         elif weight_decay is None:
             self._weight_decay = 0.0
         else:
-            # L2Decay-like object with a coeff attribute
+            # regularizer object (paddle.regularizer.L1Decay/L2Decay) with a
+            # coeff attribute; L1 is applied as sign(p)*coeff on the grad in
+            # step(), L2 rides the fused update's weight_decay term
             self._weight_decay = float(getattr(weight_decay, "_coeff",
                                                getattr(weight_decay, "coeff", 0.0)))
+            if getattr(weight_decay, "_kind", "l2") == "l1":
+                self._l1_decay = self._weight_decay
+                self._weight_decay = 0.0
         self._grad_clip = grad_clip
+        if not hasattr(self, "_l1_decay"):
+            self._l1_decay = 0.0
         self._accumulators: dict[str, dict[int, Tensor]] = collections.defaultdict(
             dict)
         self._fused_parts: dict = {}    # per-group flat state (see _fused_meta)
@@ -169,6 +176,19 @@ class Optimizer:
         # SelectedRows grads (sparse embedding) take the row-wise update path;
         # they bypass grad_clip like the reference's sparse grads do under
         # ClipGradByNorm (merge+clip would densify, defeating the point)
+        if self._l1_decay:
+            c = self._l1_decay
+
+            def _l1(p, g):
+                if isinstance(g, SelectedRows):
+                    rows_sign = jnp.sign(p._data[g.rows]).astype(g.values.dtype)
+                    return SelectedRows(g.rows, g.values + c * rows_sign,
+                                        g.height)
+                return tensor_mod.Tensor(
+                    g._data + c * jnp.sign(p._data).astype(g._data.dtype),
+                    _internal=True)
+
+            params_grads = [(p, _l1(p, g)) for p, g in params_grads]
         sparse_pg = [(p, g) for p, g in params_grads
                      if isinstance(g, SelectedRows)]
         params_grads = [(p, g) for p, g in params_grads

@@ -1,0 +1,30 @@
+"""``paddle.regularizer`` (ref: `python/paddle/regularizer.py` — L1Decay :27,
+L2Decay :90). Optimizers consume `.coeff`; L2 folds into the fused update
+(the `weight_decay` fast path), L1 contributes sign(p)*coeff to the grad."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    """L2 weight decay: grad += coeff * param (ref regularizer.py:90)."""
+
+    _kind = "l2"
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"
+
+
+class L1Decay:
+    """L1 weight decay: grad += coeff * sign(param) (ref regularizer.py:27)."""
+
+    _kind = "l1"
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"

@@ -1,0 +1,59 @@
+"""``paddle.static`` compatibility surface.
+
+The reference's static-graph tower (Program/Executor/CompiledProgram,
+`python/paddle/static/`) is deliberately collapsed in this design: `@to_static`
+whole-step capture + XLA replaces Program construction + executors (SURVEY §7
+architecture stance). What remains here is the API users actually carry across
+codebases:
+
+- :class:`InputSpec` — shape/dtype declarations for jit.save / onnx.export
+- :func:`data` — builds an InputSpec (static-graph `paddle.static.data` analog)
+- amp/save/load passthroughs re-exported from their dygraph homes
+
+Program-building entry points raise with a pointer to the jit equivalent
+instead of silently half-working.
+"""
+from __future__ import annotations
+
+from paddle_tpu.jit.save_load import InputSpec  # noqa: F401
+from paddle_tpu.framework.io import save, load  # noqa: F401
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a graph input (ref `paddle.static.data`); returns an InputSpec
+    usable with jit.to_static/jit.save/onnx.export."""
+    spec = InputSpec(shape=shape, dtype=dtype)
+    spec.name = name
+    return spec
+
+
+def _no_static(api):
+    def fail(*a, **k):
+        raise RuntimeError(
+            f"paddle.static.{api} builds static Programs, which this "
+            "TPU-native framework replaces with @paddle.jit.to_static "
+            "whole-step capture (compiled by XLA). Decorate your train step "
+            "instead (see paddle_tpu/jit/static_function.py).")
+    fail.__name__ = api
+    return fail
+
+
+Program = _no_static("Program")
+program_guard = _no_static("program_guard")
+default_main_program = _no_static("default_main_program")
+default_startup_program = _no_static("default_startup_program")
+Executor = _no_static("Executor")
+CompiledProgram = _no_static("CompiledProgram")
+
+
+def name_scope(prefix=None):
+    """Names are cosmetic under XLA; kept as a no-op context (ref
+    paddle.static.name_scope)."""
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def accuracy(input, label, k=1):
+    """ref `paddle.static.accuracy` — same math as paddle.metric.accuracy."""
+    from paddle_tpu.metric import accuracy as _acc
+    return _acc(input, label, k=k)

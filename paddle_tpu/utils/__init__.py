@@ -5,3 +5,5 @@ from paddle_tpu.utils import cpp_extension  # noqa: F401
 def try_import(name):
     import importlib
     return importlib.import_module(name)
+
+from paddle_tpu.utils import unique_name  # noqa: F401

@@ -1,0 +1,42 @@
+"""``paddle.utils.unique_name`` (ref: `python/paddle/utils/unique_name.py` —
+generate/guard/switch over a prefix-counter registry)."""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _Generator:
+    def __init__(self):
+        self.ids = {}
+
+    def __call__(self, key):
+        self.ids[key] = self.ids.get(key, 0) + 1
+        return f"{key}_{self.ids[key] - 1}"
+
+
+_generator = _Generator()
+
+
+def generate(key):
+    """'fc' -> 'fc_0', 'fc_1', ... (ref unique_name.generate)."""
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Swap the registry; returns the old one (ref unique_name.switch)."""
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope a fresh registry (ref unique_name.guard)."""
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,24 @@
+"""``paddle.version`` (ref: generated `python/paddle/version.py`)."""
+full_version = "2.4.0+tpu"
+major = "2"
+minor = "4"
+patch = "0"
+rc = "0"
+cuda_version = "False"
+cudnn_version = "False"
+istaged = True
+commit = "tpu-native"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}\nminor: {minor}\npatch: {patch}\nrc: {rc}")
+    print(f"commit: {commit}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

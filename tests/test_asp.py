@@ -1,0 +1,66 @@
+"""ASP n:m sparsity (ref `python/paddle/incubate/asp/`)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import asp
+
+R = np.random.RandomState(13)
+
+
+def test_mask_1d_keeps_top2_of_4():
+    w = np.array([[0.1, -0.9, 0.5, 0.05, 3.0, -2.0, 0.2, 0.1]], np.float32)
+    mask = asp.create_mask(w, "mask_1d", n=2, m=4)
+    np.testing.assert_array_equal(
+        mask, [[False, True, True, False, True, True, False, False]])
+
+
+def test_check_sparsity_and_density():
+    w = R.randn(8, 16).astype(np.float32)
+    assert not asp.check_sparsity(w)
+    mask = asp.create_mask(w)
+    pruned = w * mask
+    assert asp.check_sparsity(pruned)
+    assert abs(asp.calculate_density(pruned) - 0.5) < 1e-6
+
+
+def test_mask_2d_greedy_row_and_col():
+    w = R.randn(8, 8).astype(np.float32)
+    mask = asp.create_mask(w, "mask_2d_greedy", n=2, m=4)
+    m2 = mask.reshape(2, 4, 2, 4)
+    # every row and column of each 4x4 block keeps exactly 2
+    for bi in range(2):
+        for bj in range(2):
+            blk = mask[bi * 4:(bi + 1) * 4, bj * 4:(bj + 1) * 4]
+            assert (blk.sum(0) == 2).all() and (blk.sum(1) == 2).all()
+
+
+def test_prune_model_and_decorate():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    masks = asp.prune_model(model, n=2, m=4)
+    assert len(masks) == 2
+    for lyr in (model[0], model[2]):
+        assert asp.check_sparsity(lyr.weight.numpy())
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=model.parameters()))
+    x = paddle.to_tensor(R.randn(4, 16).astype(np.float32))
+    y = paddle.to_tensor(R.randn(4, 8).astype(np.float32))
+    for _ in range(3):
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity survived training
+    for lyr in (model[0], model[2]):
+        assert asp.check_sparsity(lyr.weight.numpy())
+
+
+def test_excluded_layers():
+    asp.reset_excluded_layers()
+    model = nn.Sequential(nn.Linear(8, 8))
+    asp.set_excluded_layers(["0"])
+    masks = asp.prune_model(model)
+    assert len(masks) == 0
+    asp.reset_excluded_layers()

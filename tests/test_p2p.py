@@ -1,0 +1,58 @@
+"""Eager point-to-point send/recv over the coordination-service KV transport
+(ref `send_v2`/`recv_v2` ops, ProcessGroup::Send/Recv; methodology:
+`test_dist_base.py` localhost subprocesses)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRAINER = """
+import os, json, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+env = dist.init_parallel_env()
+rank = env.rank
+if rank == 0:
+    payload = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    dist.send(payload, dst=1)
+    # second message to exercise the sequence counters
+    dist.send(paddle.to_tensor(np.array([42.0], np.float32)), dst=1)
+    back = paddle.to_tensor(np.zeros(2, np.float32))
+    dist.recv(back, src=1)
+    got = back.numpy().tolist()
+else:
+    buf = paddle.to_tensor(np.zeros((3, 4), np.float32))
+    dist.recv(buf, src=0)
+    assert np.allclose(buf.numpy(), np.arange(12).reshape(3, 4)), buf.numpy()
+    buf2 = paddle.to_tensor(np.zeros(1, np.float32))
+    dist.recv(buf2, src=0)
+    assert buf2.numpy()[0] == 42.0
+    task = dist.isend(paddle.to_tensor(np.array([7.0, 8.0], np.float32)), dst=0)
+    assert task.wait() and task.is_completed()
+    got = None
+with open(os.path.join({outdir!r}, f"rank{{rank}}.json"), "w") as f:
+    json.dump({{"rank": rank, "got": got}}, f)
+print("rank", rank, "p2p ok")
+"""
+
+
+def test_p2p_two_process(tmp_path):
+    script = tmp_path / "trainer.py"
+    script.write_text(TRAINER.format(repo=REPO, outdir=str(tmp_path)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu",
+         "--log_dir", str(tmp_path / "logs"), str(script)],
+        capture_output=True, text=True, timeout=180, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    r0 = json.load(open(tmp_path / "rank0.json"))
+    assert r0["got"] == [7.0, 8.0]
+    assert os.path.exists(tmp_path / "rank1.json")
