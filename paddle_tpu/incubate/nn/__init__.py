@@ -83,3 +83,46 @@ class FusedTransformerEncoderLayer(Layer):
     def forward(self, src, src_mask=None, cache=None):
         out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
         return self.ffn(out)
+
+
+class FusedLayerNorm(Layer):
+    """LayerNorm over the authored Pallas kernel
+    (`paddle_tpu/kernels/pallas/fused_layernorm.py` — the counterpart of the
+    reference's fused_layernorm CUDA kernels). Single pass per row for the
+    forward; analytic one-pass backward with in-kernel dgamma/dbeta partials."""
+
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        import numpy as _np
+        from paddle_tpu.core.tensor import Parameter
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        if len(normalized_shape) != 1:
+            raise ValueError("FusedLayerNorm fuses over the last axis only")
+        d = int(normalized_shape[0])
+        self.epsilon = epsilon
+        self.weight = Parameter(_np.ones(d, _np.float32))
+        self.bias = Parameter(_np.zeros(d, _np.float32))
+
+    def forward(self, x):
+        from paddle_tpu.core.autograd import apply
+        from paddle_tpu.kernels.pallas import fused_layer_norm
+        from paddle_tpu.ops.common import ensure_tensor
+        x = ensure_tensor(x)
+        return apply(
+            lambda a, g, b: fused_layer_norm(a, g, b, eps=self.epsilon),
+            x, self.weight, self.bias, op_name="fused_layer_norm")
+
+
+def fused_rotary_position_embedding(q, k, cos, sin, name=None):
+    """Fused rope over the authored Pallas kernel
+    (`paddle_tpu/kernels/pallas/rotary.py`; ref newer-branch `fused_rope`).
+    q/k: [B, H, S, D] tensors; cos/sin: [S, D/2]."""
+    from paddle_tpu.core.autograd import apply
+    from paddle_tpu.kernels.pallas import apply_rotary_emb
+    from paddle_tpu.ops.common import ensure_tensor
+    q, k = ensure_tensor(q), ensure_tensor(k)
+    cos, sin = ensure_tensor(cos), ensure_tensor(sin)
+    return apply(lambda a, b, c, s: apply_rotary_emb(a, b, c, s),
+                 q, k, cos, sin, op_name="fused_rope", n_outputs=2)
