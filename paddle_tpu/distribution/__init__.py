@@ -286,3 +286,234 @@ def _kl_categorical(p, q):
         jax.nn.softmax(lp, -1) * (jax.nn.log_softmax(lp, -1) -
                                   jax.nn.log_softmax(lq, -1)), -1),
         p.logits, q.logits, op_name="categorical_kl")
+
+
+class Laplace(Distribution):
+    """ref `python/paddle/distribution/laplace.py`."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc, dtype="float32")
+        self.scale = ensure_tensor(scale, dtype="float32")
+        super().__init__(tuple(np.broadcast_shapes(tuple(self.loc.shape),
+                                                   tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply(lambda s: 2 * s * s, self.scale, op_name="laplace_var")
+
+    @property
+    def stddev(self):
+        return apply(lambda s: math.sqrt(2.0) * s, self.scale,
+                     op_name="laplace_std")
+
+    def sample(self, shape=()):
+        key = default_generator().next_key()
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(key, shp, jnp.float32, -0.5 + 1e-7, 0.5)
+        return apply(lambda l, s: l - s * jnp.sign(u) * jnp.log1p(
+            -2 * jnp.abs(u)), self.loc, self.scale, op_name="laplace_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+                     value, self.loc, self.scale, op_name="laplace_log_prob")
+
+    def entropy(self):
+        return apply(lambda s: 1 + jnp.log(2 * s), self.scale,
+                     op_name="laplace_entropy")
+
+    def cdf(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            lambda v, l, s: 0.5 - 0.5 * jnp.sign(v - l) * jnp.expm1(
+                -jnp.abs(v - l) / s),
+            value, self.loc, self.scale, op_name="laplace_cdf")
+
+    def icdf(self, q):
+        q = ensure_tensor(q)
+        return apply(
+            lambda p, l, s: l - s * jnp.sign(p - 0.5) * jnp.log1p(
+                -2 * jnp.abs(p - 0.5)),
+            q, self.loc, self.scale, op_name="laplace_icdf")
+
+    def kl_divergence(self, other):
+        return apply(
+            lambda l1, s1, l2, s2: jnp.log(s2 / s1) + jnp.abs(l1 - l2) / s2 +
+            (s1 / s2) * jnp.exp(-jnp.abs(l1 - l2) / s1) - 1,
+            self.loc, self.scale, other.loc, other.scale,
+            op_name="laplace_kl")
+
+
+class Gumbel(Distribution):
+    """ref `python/paddle/distribution/gumbel.py` (location-scale Gumbel)."""
+
+    _EULER = 0.5772156649015329
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc, dtype="float32")
+        self.scale = ensure_tensor(scale, dtype="float32")
+        super().__init__(tuple(np.broadcast_shapes(tuple(self.loc.shape),
+                                                   tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return apply(lambda l, s: l + self._EULER * s, self.loc, self.scale,
+                     op_name="gumbel_mean")
+
+    @property
+    def variance(self):
+        return apply(lambda s: (math.pi ** 2 / 6) * s * s, self.scale,
+                     op_name="gumbel_var")
+
+    @property
+    def stddev(self):
+        return apply(lambda s: (math.pi / math.sqrt(6)) * s, self.scale,
+                     op_name="gumbel_std")
+
+    def sample(self, shape=()):
+        key = default_generator().next_key()
+        shp = tuple(shape) + self._batch_shape
+        g = jax.random.gumbel(key, shp, jnp.float32)
+        return apply(lambda l, s: l + s * g, self.loc, self.scale,
+                     op_name="gumbel_sample")
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            lambda v, l, s: -(v - l) / s - jnp.exp(-(v - l) / s) - jnp.log(s),
+            value, self.loc, self.scale, op_name="gumbel_log_prob")
+
+    def entropy(self):
+        return apply(lambda s: jnp.log(s) + 1 + self._EULER, self.scale,
+                     op_name="gumbel_entropy")
+
+    def cdf(self, value):
+        value = ensure_tensor(value)
+        return apply(lambda v, l, s: jnp.exp(-jnp.exp(-(v - l) / s)),
+                     value, self.loc, self.scale, op_name="gumbel_cdf")
+
+
+class ExponentialFamily(Distribution):
+    """Base for natural-parameter families (ref exponential_family.py):
+    entropy via the Bregman identity when `_log_normalizer` is given."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims of a base distribution as event dims
+    (ref independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = tuple(base.batch_shape)
+        super().__init__(bshape[:len(bshape) - self.rank],
+                         bshape[len(bshape) - self.rank:]
+                         + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return apply(lambda a: jnp.sum(a, axis=tuple(range(-self.rank, 0))),
+                     lp, op_name="independent_log_prob")
+
+    def entropy(self):
+        ent = self.base.entropy()
+        return apply(lambda a: jnp.sum(a, axis=tuple(range(-self.rank, 0))),
+                     ent, op_name="independent_entropy")
+
+
+class TransformedDistribution(Distribution):
+    """Change of variables through a chain of transforms
+    (ref transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        from paddle_tpu.distribution.transform import ChainTransform, Transform
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms)
+        super().__init__(tuple(base.batch_shape), tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self._chain.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self._chain.forward(x)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        x = self._chain.inverse(value)
+        base_lp = self.base.log_prob(x)
+        ldj = self._chain.forward_log_det_jacobian(x)
+        return apply(lambda a, b: a - b, base_lp, ldj,
+                     op_name="transformed_log_prob")
+
+
+class LogNormal(TransformedDistribution):
+    """exp(Normal(loc, scale)) (ref lognormal.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        from paddle_tpu.distribution.transform import ExpTransform
+        base = Normal(loc, scale)
+        super().__init__(base, [ExpTransform()])
+        self.loc = base.loc
+        self.scale = base.scale
+
+    @property
+    def mean(self):
+        return apply(lambda l, s: jnp.exp(l + s * s / 2), self.loc, self.scale,
+                     op_name="lognormal_mean")
+
+    @property
+    def variance(self):
+        return apply(
+            lambda l, s: (jnp.exp(s * s) - 1) * jnp.exp(2 * l + s * s),
+            self.loc, self.scale, op_name="lognormal_var")
+
+    def entropy(self):
+        return apply(lambda l, s: l + 0.5 + 0.5 * math.log(2 * math.pi) +
+                     jnp.log(s), self.loc, self.scale,
+                     op_name="lognormal_entropy")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    return p.kl_divergence(q)
+
+
+from paddle_tpu.distribution import transform  # noqa: E402,F401
+from paddle_tpu.distribution.transform import (  # noqa: E402,F401
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+)

@@ -1,0 +1,341 @@
+"""Probability transforms (ref: `python/paddle/distribution/transform.py` —
+Transform :59, AbsTransform :342, AffineTransform :414, ChainTransform :496,
+ExpTransform :621, IndependentTransform :670, PowerTransform :765,
+ReshapeTransform :829, SigmoidTransform :953, SoftmaxTransform :996,
+StackTransform :1052, StickBreakingTransform :1172, TanhTransform :1238).
+
+Each transform supplies forward/inverse and the log|det J| used by
+TransformedDistribution's change-of-variables.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core.autograd import apply
+from paddle_tpu.ops.common import ensure_tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+def _apply1(fn, x, name):
+    return apply(fn, ensure_tensor(x), op_name=name)
+
+
+class Transform:
+    """Base class (ref transform.py:59). ``_is_injective`` mirrors the
+    reference's Type enum (BIJECTION unless stated)."""
+
+    _is_injective = True
+
+    # event dims consumed/produced (ref _domain/_codomain event_rank)
+    _domain_event_rank = 0
+    _codomain_event_rank = 0
+
+    def forward(self, x):
+        return self._forward(ensure_tensor(x))
+
+    def inverse(self, y):
+        return self._inverse(ensure_tensor(y))
+
+    def forward_log_det_jacobian(self, x):
+        return self._forward_log_det_jacobian(ensure_tensor(x))
+
+    def inverse_log_det_jacobian(self, y):
+        from paddle_tpu.ops.math import neg
+        return neg(self._forward_log_det_jacobian(self.inverse(y)))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # subclass hooks
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    """y = |x| (ref :342) — not injective; inverse returns the positive
+    branch like the reference's right-inverse."""
+
+    _is_injective = False
+
+    def _forward(self, x):
+        return _apply1(jnp.abs, x, "abs_t")
+
+    def _inverse(self, y):
+        return _apply1(lambda a: a, y, "abs_t_inv")
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("AbsTransform is not injective")
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (ref :414)."""
+
+    def __init__(self, loc, scale):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+
+    def _forward(self, x):
+        return apply(lambda a, l, s: l + s * a, x, self.loc, self.scale,
+                     op_name="affine_t")
+
+    def _inverse(self, y):
+        return apply(lambda a, l, s: (a - l) / s, y, self.loc, self.scale,
+                     op_name="affine_t_inv")
+
+    def _forward_log_det_jacobian(self, x):
+        return apply(lambda a, s: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                   a.shape),
+                     x, self.scale, op_name="affine_t_ldj")
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ ... ∘ t_1 (ref :496)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ldj = t.forward_log_det_jacobian(x)
+            total = ldj if total is None else total + ldj
+            x = t.forward(x)
+        return total
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (ref :621)."""
+
+    def _forward(self, x):
+        return _apply1(jnp.exp, x, "exp_t")
+
+    def _inverse(self, y):
+        return _apply1(jnp.log, y, "exp_t_inv")
+
+    def _forward_log_det_jacobian(self, x):
+        return _apply1(lambda a: a, x, "exp_t_ldj")
+
+
+class IndependentTransform(Transform):
+    """Reinterpret trailing batch dims as event dims (ref :670): sums the
+    base's log-det over the reinterpreted dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base.forward(x)
+
+    def _inverse(self, y):
+        return self.base.inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self.base.forward_log_det_jacobian(x)
+        return apply(lambda a: jnp.sum(a, axis=tuple(range(-self.rank, 0))),
+                     ldj, op_name="independent_t_ldj")
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive reals (ref :765)."""
+
+    def __init__(self, power):
+        self.power = ensure_tensor(power)
+
+    def _forward(self, x):
+        return apply(lambda a, p: a ** p, x, self.power, op_name="pow_t")
+
+    def _inverse(self, y):
+        return apply(lambda a, p: a ** (1.0 / p), y, self.power,
+                     op_name="pow_t_inv")
+
+    def _forward_log_det_jacobian(self, x):
+        return apply(lambda a, p: jnp.log(jnp.abs(p * a ** (p - 1))),
+                     x, self.power, op_name="pow_t_ldj")
+
+
+class ReshapeTransform(Transform):
+    """Reshape event shape (ref :829)."""
+
+    _is_injective = True
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if int(np.prod(self.in_event_shape)) != int(np.prod(self.out_event_shape)):
+            raise ValueError("event sizes must match")
+
+    def _forward(self, x):
+        n = len(self.in_event_shape)
+        return apply(lambda a: a.reshape(a.shape[:a.ndim - n]
+                                         + self.out_event_shape),
+                     x, op_name="reshape_t")
+
+    def _inverse(self, y):
+        n = len(self.out_event_shape)
+        return apply(lambda a: a.reshape(a.shape[:a.ndim - n]
+                                         + self.in_event_shape),
+                     y, op_name="reshape_t_inv")
+
+    def _forward_log_det_jacobian(self, x):
+        n = len(self.in_event_shape)
+        return apply(lambda a: jnp.zeros(a.shape[:a.ndim - n], a.dtype), x,
+                     op_name="reshape_t_ldj")
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (ref :953)."""
+
+    def _forward(self, x):
+        return _apply1(jax.nn.sigmoid, x, "sigmoid_t")
+
+    def _inverse(self, y):
+        return _apply1(lambda a: jnp.log(a) - jnp.log1p(-a), y,
+                       "sigmoid_t_inv")
+
+    def _forward_log_det_jacobian(self, x):
+        return _apply1(
+            lambda a: -jax.nn.softplus(-a) - jax.nn.softplus(a), x,
+            "sigmoid_t_ldj")
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (ref :996) — not a bijection; the
+    inverse is log(y) (a right-inverse up to additive constant, matching the
+    reference)."""
+
+    _is_injective = False
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        return _apply1(lambda a: jax.nn.softmax(a, axis=-1), x, "softmax_t")
+
+    def _inverse(self, y):
+        return _apply1(jnp.log, y, "softmax_t_inv")
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("SoftmaxTransform is not injective")
+
+
+class StackTransform(Transform):
+    """Apply a different transform to each slice along ``axis`` (ref :1052)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _slice(self, x, i):
+        from paddle_tpu.ops.manipulation import squeeze
+        idx = [slice(None)] * len(x.shape)
+        idx[self.axis] = slice(i, i + 1)
+        return squeeze(x[tuple(idx)], axis=self.axis)
+
+    def _forward(self, x):
+        from paddle_tpu.ops.manipulation import stack
+        return stack([t.forward(self._slice(x, i))
+                      for i, t in enumerate(self.transforms)], axis=self.axis)
+
+    def _inverse(self, y):
+        from paddle_tpu.ops.manipulation import stack
+        return stack([t.inverse(self._slice(y, i))
+                      for i, t in enumerate(self.transforms)], axis=self.axis)
+
+    def _forward_log_det_jacobian(self, x):
+        from paddle_tpu.ops.manipulation import stack
+        return stack([t.forward_log_det_jacobian(self._slice(x, i))
+                      for i, t in enumerate(self.transforms)], axis=self.axis)
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^{K-1} -> simplex interior Δ^{K-1} (ref :1172)."""
+
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        def fn(a):
+            k = a.shape[-1]
+            offset = jnp.log(jnp.asarray([k - i for i in range(k)],
+                                         a.dtype))
+            z = jax.nn.sigmoid(a - offset)
+            zc = jnp.cumprod(1 - z, axis=-1)
+            # prod_{j<i}(1-z_j) for each stick, then the leftover mass
+            lead = jnp.concatenate(
+                [jnp.ones(a.shape[:-1] + (1,), a.dtype), zc[..., :-1]],
+                axis=-1)
+            return jnp.concatenate([z * lead, zc[..., -1:]], axis=-1)
+
+        return _apply1(fn, x, "stickbreaking_t")
+
+    def _inverse(self, y):
+        def fn(b):
+            k = b.shape[-1] - 1
+            cum = jnp.cumsum(b[..., :-1], axis=-1)
+            rest = 1 - jnp.concatenate(
+                [jnp.zeros(b.shape[:-1] + (1,), b.dtype), cum[..., :-1]],
+                axis=-1)
+            z = b[..., :-1] / rest
+            offset = jnp.log(jnp.asarray([k - i for i in range(k)], b.dtype))
+            return jnp.log(z) - jnp.log1p(-z) + offset
+
+        return _apply1(fn, y, "stickbreaking_t_inv")
+
+    def _forward_log_det_jacobian(self, x):
+        def fn(a):
+            k = a.shape[-1]
+            offset = jnp.log(jnp.asarray([k - i for i in range(k)], a.dtype))
+            t = a - offset
+            z = jax.nn.sigmoid(t)
+            zc = jnp.cumprod(1 - z, axis=-1)
+            lead = jnp.concatenate(
+                [jnp.ones(a.shape[:-1] + (1,), a.dtype), zc[..., :-1]],
+                axis=-1)
+            # d probs_i / d x_i = z_i * (1 - z_i) * prod_{j<i} (1 - z_j)
+            return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(lead),
+                           axis=-1)
+
+        return _apply1(fn, x, "stickbreaking_t_ldj")
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (ref :1238)."""
+
+    def _forward(self, x):
+        return _apply1(jnp.tanh, x, "tanh_t")
+
+    def _inverse(self, y):
+        return _apply1(jnp.arctanh, y, "tanh_t_inv")
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2 (log2 - x - softplus(-2x))
+        return _apply1(
+            lambda a: 2.0 * (math.log(2.0) - a - jax.nn.softplus(-2.0 * a)),
+            x, "tanh_t_ldj")

@@ -1,0 +1,158 @@
+"""Round-2 distribution tower additions: Laplace/Gumbel/LogNormal/Independent/
+TransformedDistribution + transforms (ref `python/paddle/distribution/`)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distribution as D
+
+R = np.random.RandomState(17)
+
+
+class TestLaplace:
+    def test_log_prob_matches_closed_form(self):
+        d = D.Laplace(0.0, 2.0)
+        v = paddle.to_tensor(np.array([0.0, 1.0, -3.0], np.float32))
+        got = d.log_prob(v).numpy()
+        want = -np.abs([0, 1, -3]) / 2.0 - np.log(4.0)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cdf_icdf_roundtrip(self):
+        d = D.Laplace(1.0, 0.5)
+        q = paddle.to_tensor(np.array([0.1, 0.5, 0.9], np.float32))
+        np.testing.assert_allclose(d.cdf(d.icdf(q)).numpy(), q.numpy(),
+                                   rtol=1e-5)
+
+    def test_sample_moments(self):
+        d = D.Laplace(2.0, 1.0)
+        s = d.sample((20000,)).numpy()
+        assert abs(s.mean() - 2.0) < 0.05
+        assert abs(s.var() - 2.0) < 0.15
+
+    def test_kl_self_zero(self):
+        d = D.Laplace(0.5, 1.5)
+        np.testing.assert_allclose(
+            D.kl_divergence(d, D.Laplace(0.5, 1.5)).numpy(), 0.0, atol=1e-6)
+
+
+class TestGumbel:
+    def test_log_prob(self):
+        d = D.Gumbel(0.0, 1.0)
+        v = paddle.to_tensor(np.array([0.0], np.float32))
+        np.testing.assert_allclose(d.log_prob(v).numpy(), [-1.0], rtol=1e-6)
+
+    def test_mean_entropy(self):
+        d = D.Gumbel(1.0, 2.0)
+        np.testing.assert_allclose(d.mean.numpy(), 1 + 0.5772156649 * 2,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(d.entropy().numpy(),
+                                   math.log(2.0) + 1 + 0.5772156649, rtol=1e-5)
+
+    def test_sample_mean(self):
+        s = D.Gumbel(0.0, 1.0).sample((20000,)).numpy()
+        assert abs(s.mean() - 0.5772) < 0.05
+
+
+class TestLogNormal:
+    def test_log_prob_matches_scipy_form(self):
+        d = D.LogNormal(0.0, 1.0)
+        v = np.array([0.5, 1.0, 2.0], np.float32)
+        got = d.log_prob(paddle.to_tensor(v)).numpy()
+        want = -np.log(v) - 0.5 * np.log(2 * np.pi) - (np.log(v) ** 2) / 2
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_sample_positive_and_mean(self):
+        d = D.LogNormal(0.0, 0.5)
+        s = d.sample((20000,)).numpy()
+        assert (s > 0).all()
+        np.testing.assert_allclose(s.mean(), np.exp(0.125), rtol=0.05)
+
+
+class TestIndependent:
+    def test_log_prob_sums_event_dims(self):
+        base = D.Normal(paddle.to_tensor(np.zeros((3, 4), np.float32)),
+                        paddle.to_tensor(np.ones((3, 4), np.float32)))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+        v = paddle.to_tensor(R.randn(3, 4).astype(np.float32))
+        np.testing.assert_allclose(ind.log_prob(v).numpy(),
+                                   base.log_prob(v).numpy().sum(-1),
+                                   rtol=1e-5)
+
+
+class TestTransforms:
+    def test_affine_roundtrip_and_ldj(self):
+        t = D.AffineTransform(paddle.to_tensor(1.0), paddle.to_tensor(3.0))
+        x = paddle.to_tensor(np.array([0.5, -2.0], np.float32))
+        y = t.forward(x)
+        np.testing.assert_allclose(y.numpy(), [2.5, -5.0])
+        np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(t.forward_log_det_jacobian(x).numpy(),
+                                   np.log(3.0) * np.ones(2), rtol=1e-6)
+
+    @pytest.mark.parametrize("t,dom", [
+        (D.ExpTransform(), (-2, 2)),
+        (D.SigmoidTransform(), (-3, 3)),
+        (D.TanhTransform(), (-2, 2)),
+        (D.PowerTransform(2.0), (0.1, 3)),
+    ], ids=["exp", "sigmoid", "tanh", "power"])
+    def test_roundtrip_and_numeric_ldj(self, t, dom):
+        x = paddle.to_tensor(
+            np.linspace(dom[0], dom[1], 7).astype(np.float32))
+        y = t.forward(x)
+        np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        # numeric check of log|dy/dx|
+        eps = 1e-3
+        xp = paddle.to_tensor(x.numpy() + eps)
+        num = np.log(np.abs((t.forward(xp).numpy() - y.numpy()) / eps))
+        np.testing.assert_allclose(t.forward_log_det_jacobian(x).numpy(), num,
+                                   atol=2e-2)
+
+    def test_chain(self):
+        chain = D.ChainTransform([D.AffineTransform(paddle.to_tensor(0.0),
+                                                    paddle.to_tensor(2.0)),
+                                  D.ExpTransform()])
+        x = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+        np.testing.assert_allclose(chain.forward(x).numpy(),
+                                   np.exp([0.0, 2.0]), rtol=1e-5)
+        np.testing.assert_allclose(chain.inverse(chain.forward(x)).numpy(),
+                                   x.numpy(), rtol=1e-5)
+        # ldj adds: log(2) + (2x)
+        np.testing.assert_allclose(
+            chain.forward_log_det_jacobian(x).numpy(),
+            np.log(2) + np.array([0.0, 2.0]), rtol=1e-5)
+
+    def test_stickbreaking_simplex(self):
+        t = D.StickBreakingTransform()
+        x = paddle.to_tensor(R.randn(5, 3).astype(np.float32))
+        y = t.forward(x).numpy()
+        assert y.shape == (5, 4)
+        np.testing.assert_allclose(y.sum(-1), np.ones(5), rtol=1e-5)
+        assert (y > 0).all()
+        back = t.inverse(paddle.to_tensor(y)).numpy()
+        np.testing.assert_allclose(back, x.numpy(), rtol=1e-3, atol=1e-4)
+
+    def test_reshape(self):
+        t = D.ReshapeTransform((4,), (2, 2))
+        x = paddle.to_tensor(R.randn(3, 4).astype(np.float32))
+        y = t.forward(x)
+        assert y.shape == [3, 2, 2]
+        np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy())
+        assert t.forward_log_det_jacobian(x).shape == [3]
+
+
+class TestTransformedDistribution:
+    def test_lognormal_equals_transformed_normal(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0), [D.ExpTransform()])
+        ln = D.LogNormal(0.0, 1.0)
+        v = paddle.to_tensor(np.array([0.5, 1.5], np.float32))
+        np.testing.assert_allclose(td.log_prob(v).numpy(),
+                                   ln.log_prob(v).numpy(), rtol=1e-5)
+
+    def test_sample_through_tanh(self):
+        td = D.TransformedDistribution(D.Normal(0.0, 1.0), [D.TanhTransform()])
+        s = td.sample((1000,)).numpy()
+        assert (np.abs(s) < 1).all()
