@@ -152,3 +152,61 @@ def current_stream(device=None):
 def stream_guard(stream):
     import contextlib
     return contextlib.nullcontext()
+
+
+# ------------------------------------------------------- memory introspection
+# (ref `paddle.device.cuda.max_memory_allocated` etc., `memory/stats.cc`;
+# on TPU the numbers come from the PJRT device's memory_stats)
+
+
+def _mem_stats(device=None):
+    import jax
+    d = jax.local_devices()[0] if device is None else device
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def max_memory_allocated(device=None):
+    """Peak bytes in use on the device (ref device/cuda:max_memory_allocated)."""
+    return int(_mem_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_allocated(device=None):
+    """Current bytes in use (ref device/cuda:memory_allocated)."""
+    return int(_mem_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None):
+    """Peak bytes reserved by the allocator pool (ref max_memory_reserved)."""
+    s = _mem_stats(device)
+    return int(s.get("peak_pool_bytes", s.get("peak_bytes_in_use", 0)))
+
+
+def memory_reserved(device=None):
+    s = _mem_stats(device)
+    return int(s.get("pool_bytes", s.get("bytes_in_use", 0)))
+
+
+class cuda:
+    """Namespace shim: `paddle.device.cuda.*` memory queries report the
+    accelerator (TPU) allocator stats so profiling code ports unchanged."""
+
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    memory_reserved = staticmethod(memory_reserved)
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        return synchronize(device)
+
+    @staticmethod
+    def empty_cache():
+        import gc
+        gc.collect()

@@ -1,0 +1,147 @@
+"""incubate.autograd — functional AD primitives (ref
+`python/paddle/incubate/autograd/primapi.py` jvp/vjp and the Jacobian/Hessian
+classes from `autograd/functional.py`).
+
+The reference built a whole primitive-op AD system (orig2prim/prim2orig
+transforms over a prim op set) because its static graph could not differentiate
+twice; on a jax substrate these are direct calls into `jax.jvp`/`jax.vjp` —
+the transform machinery *is* the substrate.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "forward_grad", "grad"]
+
+
+def _unwrap(xs):
+    single = isinstance(xs, Tensor)
+    lst = [xs] if single else list(xs)
+    return single, [t._data for t in lst]
+
+
+def _wrap(arrs, single):
+    ts = [Tensor(a, stop_gradient=True, _internal=True) for a in arrs]
+    return ts[0] if single else ts
+
+
+def _purify(func, single):
+    def pure(*arrs):
+        ts = [Tensor(a, stop_gradient=False, _internal=True) for a in arrs]
+        from paddle_tpu.core.autograd import no_grad
+        with no_grad():
+            out = func(ts[0]) if single and len(ts) == 1 else func(*ts)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+    return pure
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: returns (outputs, JVP) (ref primapi.jvp)."""
+    single, arrs = _unwrap(xs)
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrs]
+    else:
+        _, tangents = _unwrap(v)
+    pure = _purify(func, single)
+    out, tangent_out = jax.jvp(pure, tuple(arrs), tuple(tangents))
+    multi_out = isinstance(out, tuple)
+    wrap_out = _wrap(list(out) if multi_out else [out], not multi_out)
+    wrap_tan = _wrap(list(tangent_out) if multi_out else [tangent_out],
+                     not multi_out)
+    return wrap_out, wrap_tan
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: returns (outputs, VJP) (ref primapi.vjp)."""
+    single, arrs = _unwrap(xs)
+    pure = _purify(func, single)
+    out, vjp_fn = jax.vjp(pure, *arrs)
+    if v is None:
+        cot = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(
+            jnp.ones_like(o) for o in out)
+    else:
+        vs, varrs = _unwrap(v)
+        cot = varrs[0] if vs else tuple(varrs)
+    grads = vjp_fn(cot)
+    multi_out = isinstance(out, tuple)
+    wrap_out = _wrap(list(out) if multi_out else [out], not multi_out)
+    return wrap_out, _wrap(list(grads), single)
+
+
+def forward_grad(func, xs, v=None):
+    """Alias of jvp returning only the tangent (ref primapi.forward_grad)."""
+    return jvp(func, xs, v)[1]
+
+
+def grad(func, xs, v=None):
+    """Alias of vjp returning only the gradients (ref primapi.grad)."""
+    return vjp(func, xs, v)[1]
+
+
+class Jacobian:
+    """Lazy Jacobian matrix (ref autograd/functional.py:Jacobian): index to
+    materialize rows; `[:]` gives the full matrix."""
+
+    def __init__(self, func, xs, is_batched=False):
+        single, arrs = _unwrap(xs)
+        pure = _purify(func, single)
+        if is_batched:
+            jac = jax.vmap(jax.jacrev(pure))(arrs[0])
+        else:
+            jac = jax.jacrev(pure)(*arrs) if len(arrs) == 1 else \
+                jax.jacrev(pure, argnums=tuple(range(len(arrs))))(*arrs)
+        self._jac = jnp.asarray(jac if not isinstance(jac, (tuple, list))
+                                else jac[0])
+        # flatten to 2-D (out_size, in_size) like the reference matrix view
+        if not is_batched and self._jac.ndim > 2:
+            half = self._jac.ndim // 2
+            osz = int(np.prod(self._jac.shape[:half]))
+            self._jac = self._jac.reshape(osz, -1)
+
+    @property
+    def shape(self):
+        return list(self._jac.shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._jac[idx], _internal=True)
+
+    def numpy(self):
+        return np.asarray(self._jac)
+
+
+class Hessian:
+    """Lazy Hessian (ref autograd/functional.py:Hessian) for scalar-output
+    functions."""
+
+    def __init__(self, func, xs, is_batched=False):
+        single, arrs = _unwrap(xs)
+        pure = _purify(func, single)
+
+        def scalar(*a):
+            out = pure(*a)
+            return out.reshape(())
+
+        if is_batched:
+            hes = jax.vmap(jax.hessian(scalar))(arrs[0])
+        else:
+            hes = jax.hessian(scalar)(*arrs)
+        self._hes = jnp.asarray(hes)
+        if not is_batched and self._hes.ndim > 2:
+            n = int(np.sqrt(np.prod(self._hes.shape)))
+            self._hes = self._hes.reshape(n, n)
+
+    @property
+    def shape(self):
+        return list(self._hes.shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._hes[idx], _internal=True)
+
+    def numpy(self):
+        return np.asarray(self._hes)

@@ -161,3 +161,28 @@ class TestQuantization:
         assert q.dtype == np.int8
         np.testing.assert_allclose(q.astype(np.float32) / 127 * s,
                                    np.asarray(w._data), atol=s / 100)
+
+
+class TestIncubateAutograd:
+    def test_jvp_vjp_jacobian_hessian(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate import autograd as A
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out, tan = A.jvp(lambda t: (t ** 2).sum(), x)
+        assert abs(float(tan.numpy()) - 6.0) < 1e-6
+        out, g = A.vjp(lambda t: (t ** 3).sum(), x)
+        np.testing.assert_allclose(g.numpy(), [3.0, 12.0], rtol=1e-6)
+        J = A.Jacobian(lambda t: t ** 2, x)
+        np.testing.assert_allclose(J[:].numpy(), [[2, 0], [0, 4]], rtol=1e-6)
+        H = A.Hessian(lambda t: (t ** 3).sum(), x)
+        np.testing.assert_allclose(H[:].numpy(), [[6, 0], [0, 12]], rtol=1e-6)
+
+
+class TestDeviceMemoryStats:
+    def test_memory_queries(self):
+        import paddle_tpu as paddle
+        assert paddle.device.memory_allocated() >= 0
+        assert paddle.device.max_memory_allocated() >= 0
+        assert paddle.device.cuda.device_count() >= 1
+        paddle.device.cuda.empty_cache()
