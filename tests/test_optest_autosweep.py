@@ -1,0 +1,172 @@
+"""Yaml-driven OpTest auto-sweep.
+
+The reference's OpTest harness covers ~800 ops because every op has a
+registered spec; here the op inventory (ops.yaml) drives an automatic sweep:
+every single-tensor op is probed with a generic input and checked for
+(1) eager execution, (2) eager vs to_static parity (the reference's
+cross-executor check), (3) finite analytic gradients for float outputs.
+Ops needing richer signatures are covered by the curated sweeps
+(test_ops_sweep*.py); this file guarantees the long tail doesn't rot and
+records the coverage floor.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import load_inventory
+
+# ops that mutate RNG state / are nondeterministic / interact with global state
+_SKIP = {
+    "bernoulli", "bernoulli_", "exponential_", "multinomial", "normal",
+    "normal_", "poisson", "rand", "randint", "randint_like", "randn",
+    "randperm", "shuffle", "standard_normal", "uniform", "uniform_",
+    "gumbel_softmax", "seed", "get_rng_state", "set_rng_state", "dropout",
+    "dropout2d", "dropout3d", "alpha_dropout", "rrelu", "to_tensor",
+    "tolist", "item", "save", "load", "fill_", "fill", "zero_",
+    # host/eager-only detection + io ops (dynamic shapes by design)
+    "nms", "matrix_nms", "generate_proposals", "distribute_fpn_proposals",
+    "decode_jpeg", "read_file", "class_center_sample", "nonzero",
+    "masked_select", "unique", "unique_consecutive",
+    # dynamic output shape with one arg / in-place / int-typed contract
+    "where", "increment", "sequence_mask",
+}
+
+_NAMESPACES = {"paddle": paddle, "linalg": paddle.linalg, "fft": paddle.fft,
+               "signal": None, "functional": None}
+
+
+def _candidates():
+    import paddle_tpu.nn.functional as F
+    _NAMESPACES["functional"] = F
+    import paddle_tpu.signal as S
+    _NAMESPACES["signal"] = S
+    out = []
+    for e in load_inventory():
+        ns = e["namespace"]
+        if ns not in _NAMESPACES or e["kind"] != "op":
+            continue
+        name = e["op"]
+        if name in _SKIP or name.endswith("_"):
+            continue
+        mod = _NAMESPACES[ns]
+        fn = getattr(mod, name, None)
+        if fn is not None and callable(fn):
+            out.append((f"{ns}.{name}", fn))
+    return out
+
+
+class _SkipStatic(Exception):
+    pass
+
+
+def _probe_input():
+    # strictly inside (0.1, 0.9): in-domain for log/asin/probability ops
+    arr = (np.random.RandomState(0).rand(4, 4) * 0.8 + 0.1).astype(np.float32)
+    return arr
+
+
+def _try_eager(fn, arr):
+    t = paddle.to_tensor(arr.copy())
+    try:
+        out = fn(t)
+    except Exception:
+        return None
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    outs = [o for o in outs if isinstance(o, paddle.Tensor)]
+    if not outs:
+        return None
+    return outs
+
+
+# domain adjustments / known eager-only ops
+_SHIFT = {"paddle.acosh": 1.5}          # domain x > 1
+_NEEDS_SPEC = {"paddle.cholesky", "linalg.cholesky"}       # needs an SPD matrix
+_EAGER_ONLY = {"paddle.eig", "paddle.eigvals",
+               "linalg.eig", "linalg.eigvals",
+               "paddle.histogram", "paddle.histogramdd"}  # bins depend on data values            # LAPACK path is host-side (like the
+                                        # reference's CPU-only eig kernel)
+
+RESULTS = {"auto": [], "needs_spec": []}
+
+
+def test_autosweep_eager_static_grad():
+    cands = _candidates()
+    assert len(cands) > 250, len(cands)
+    arr = _probe_input()
+    auto, needs_spec, failures = [], [], []
+    for name, fn in cands:
+        if name in _NEEDS_SPEC:
+            needs_spec.append(name)
+            continue
+        op_arr = arr + _SHIFT.get(name, 0.0)
+        outs = _try_eager(fn, op_arr)
+        if outs is None:
+            needs_spec.append(name)
+            continue
+        eager_vals = [np.asarray(o._data) for o in outs]
+        # static parity
+        try:
+            if name in _EAGER_ONLY:
+                raise _SkipStatic()
+            compiled = paddle.jit.to_static(lambda t: fn(t))
+            souts = compiled(paddle.to_tensor(op_arr.copy()))
+            souts = souts if isinstance(souts, (tuple, list)) else [souts]
+            souts = [o for o in souts if isinstance(o, paddle.Tensor)]
+            for ev, so in zip(eager_vals, souts):
+                sv = np.asarray(so._data)
+                if ev.dtype.kind == "f":
+                    ok = np.allclose(ev, sv, rtol=1e-5, atol=1e-6,
+                                     equal_nan=True)
+                else:
+                    ok = np.array_equal(ev, sv)
+                if not ok:
+                    failures.append(f"{name}: eager/static mismatch")
+                    break
+        except _SkipStatic:
+            pass
+        except Exception as e:
+            failures.append(f"{name}: static raised {type(e).__name__}: {e}")
+            continue
+        # gradient finiteness for float outputs
+        if eager_vals[0].dtype.kind == "f":
+            try:
+                x = paddle.to_tensor(op_arr.copy(), stop_gradient=False)
+                out = fn(x)
+                out0 = out[0] if isinstance(out, (tuple, list)) else out
+                if isinstance(out0, paddle.Tensor) and \
+                        np.asarray(out0._data).dtype.kind == "f":
+                    out0.sum().backward()
+                    if x.grad is not None and \
+                            not np.isfinite(x.grad.numpy()).all():
+                        failures.append(f"{name}: non-finite grad")
+            except Exception as e:
+                failures.append(f"{name}: backward raised "
+                                f"{type(e).__name__}: {e}")
+                continue
+        auto.append(name)
+    RESULTS["auto"] = auto
+    RESULTS["needs_spec"] = needs_spec
+    assert not failures, failures
+    # the single-tensor long tail must stay broadly green
+    assert len(auto) >= 150, (len(auto), needs_spec[:20])
+
+
+def test_write_coverage_report(tmp_path):
+    # runs after the sweep (pytest ordering within a module is sequential)
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "docs", "OPTEST_COVERAGE.md")
+    if not RESULTS["auto"]:
+        pytest.skip("sweep did not run")
+    with open(path, "w") as f:
+        f.write("# OpTest auto-sweep coverage\n\nGenerated by "
+                "`tests/test_optest_autosweep.py`.\n\n"
+                f"- auto-verified single-tensor ops: {len(RESULTS['auto'])}\n"
+                f"- ops needing a curated spec (multi-arg/creation): "
+                f"{len(RESULTS['needs_spec'])} — covered by "
+                "tests/test_ops_sweep*.py where numerically meaningful\n\n"
+                "## Auto-verified\n\n"
+                + ", ".join(f"`{n}`" for n in RESULTS["auto"])
+                + "\n\n## Needs curated spec\n\n"
+                + ", ".join(f"`{n}`" for n in RESULTS["needs_spec"]) + "\n")
+    assert os.path.exists(path)
