@@ -118,3 +118,74 @@ def stack_stage_params(per_stage_param_trees, mesh):
         spec = P("pp", *([None] * (arr.ndim - 1)))
         stacked.append(jax.device_put(arr, NamedSharding(mesh, spec)))
     return stacked
+
+
+def spmd_pipeline_interleaved(stage_fn, n_stages, n_chunks, n_micro,
+                              stacked_params, x, mesh):
+    """Interleaved (virtual-stage) GPipe over the 'pp' axis — the SPMD analog
+    of the reference's `PipelineParallelWithInterleave`
+    (`meta_parallel/pipeline_parallel.py:463`): each rank owns ``n_chunks``
+    non-adjacent model chunks, so the pipeline bubble shrinks by ~1/n_chunks.
+
+    stage_fn(chunk_param_arrays, x_micro) -> y_micro  (shape-preserving)
+    stacked_params: arrays with leading axis n_stages * n_chunks in RANK-MAJOR
+    order — index r * n_chunks + c holds the params of LOGICAL stage
+    c * n_stages + r (shard_map splits the leading axis contiguously per rank,
+    so each rank's local block is its n_chunks chunks in order). Build it as
+    ``stacked_logical[[c * n_stages + r for r in range(S) for c in range(V)]]``.
+    Returns the final chunk's outputs [B, ...], replicated over 'pp'.
+    """
+    B = x.shape[0]
+    assert B % n_micro == 0, f"batch {B} not divisible into {n_micro} micro"
+    mb = B // n_micro
+    s_total = n_stages * n_chunks
+    xm = x.reshape((n_micro, mb) + x.shape[1:])
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    for p in stacked_params:
+        assert p.shape[0] == s_total, (
+            f"stacked param leading axis {p.shape[0]} != "
+            f"n_stages*n_chunks={s_total}")
+
+    def per_rank(params, xs):
+        # shard_map's contiguous P('pp') split gives each rank its local
+        # [n_chunks, ...] block (rank-major layout, see docstring)
+        local = list(params)
+        r = jax.lax.axis_index("pp")
+        is_first = (r == 0)
+        is_last = (r == n_stages - 1)
+        carry = jnp.zeros((n_chunks, mb) + xs.shape[2:], xs.dtype)
+        outs = jnp.zeros_like(xs)
+        total_ticks = n_micro + s_total - 1
+        for t in range(total_ticks):
+            feed = xs[min(t, n_micro - 1)]
+            x0 = jnp.where(is_first, feed, carry[0]) \
+                if t < n_micro else carry[0]
+            x_in = carry.at[0].set(x0)
+            # all chunks advance one tick in parallel (independent microbatches)
+            y = _vmap_chunks(stage_fn, local, x_in)
+            # microbatch m leaves the last chunk of the last rank at
+            # t = m + s_total - 1
+            m = t - (s_total - 1)
+            if 0 <= m < n_micro:
+                outs = outs.at[m].set(jnp.where(is_last, y[-1], outs[m]))
+            if t < total_ticks - 1:
+                moved = jax.lax.ppermute(y, "pp", perm)
+                # the wrap-around from the last rank enters the NEXT chunk on
+                # rank 0; other ranks keep chunk alignment
+                rolled = jnp.roll(moved, 1, axis=0)
+                carry = jnp.where(is_first, rolled, moved)
+        return jax.lax.psum(
+            jnp.where(is_last, outs, jnp.zeros_like(outs)), "pp")
+
+    def _vmap_chunks(fn, local, x_in):
+        # vmap over the chunk axis of the local params and carries
+        return jax.vmap(lambda *args: fn(list(args[:-1]), args[-1]))(
+            *local, x_in)
+
+    f = jax.shard_map(
+        per_rank, mesh=mesh,
+        in_specs=(tuple(P("pp") for _ in stacked_params), P()),
+        out_specs=P(), axis_names={"pp"}, check_vma=False)
+    outs = f(tuple(stacked_params), xm)
+    return outs.reshape((B,) + outs.shape[2:])

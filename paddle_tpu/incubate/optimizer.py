@@ -1,0 +1,119 @@
+"""incubate optimizers: LookAhead, ModelAverage
+(ref `python/paddle/incubate/optimizer/lookahead.py` :30,
+`modelaverage.py` :31).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k-step lookahead: slow weights pulled toward the fast optimizer's
+    weights every k steps (Zhang et al.; ref lookahead.py:30)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = {}
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def step(self):
+        params = self.inner_optimizer._parameter_list
+        if self._step_num == 0:
+            for i, p in enumerate(params):
+                self._slow[i] = p._data
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for i, p in enumerate(params):
+                slow = self._slow[i] + self.alpha * (p._data - self._slow[i])
+                self._slow[i] = slow
+                p._write(slow.astype(p._data.dtype))
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad)
+                      for p in self.inner_optimizer._parameter_list]
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@LookAhead.slow"] = {i: np.asarray(v)
+                                 for i, v in self._slow.items()}
+        sd["@LookAhead.step"] = self._step_num
+        return sd
+
+    def set_state_dict(self, state):
+        state = dict(state)   # don't mutate the caller's dict
+        slow = state.pop("@LookAhead.slow", None)
+        self._step_num = state.pop("@LookAhead.step", 0)
+        if slow is not None:
+            self._slow = {i: jnp.asarray(v) for i, v in slow.items()}
+        self.inner_optimizer.set_state_dict(state)
+
+
+class ModelAverage:
+    """Maintains a running average of parameters for evaluation
+    (ref modelaverage.py:31): `apply()` swaps averaged weights in,
+    `restore()` swaps training weights back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("parameters is required")
+        self._params = list(parameters)
+        self.rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._sum = [jnp.zeros_like(p._data) for p in self._params]
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current weights (call after each optimizer.step)."""
+        if self._count >= self.max_window:
+            # restart the window like the reference's sum rotation
+            shrink = max(self.min_window, int(self.rate * self._count))
+            scale = shrink / max(self._count, 1)
+            self._sum = [s * scale for s in self._sum]
+            self._count = shrink
+        self._sum = [s + p._data for s, p in zip(self._sum, self._params)]
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager friendly)."""
+        if self._count == 0:
+            return self
+        self._backup = [p._data for p in self._params]
+        for p, s in zip(self._params, self._sum):
+            p._write((s / self._count).astype(p._data.dtype))
+        if not need_restore:
+            self._backup = None
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p._write(b)
+        self._backup = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.restore()
