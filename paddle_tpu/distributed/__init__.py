@@ -29,3 +29,5 @@ from paddle_tpu.distributed.checkpoint import (  # noqa: F401
     save_sharded, load_sharded, async_save)
 from paddle_tpu.distributed import auto_parallel  # noqa: F401
 from paddle_tpu.distributed import rpc  # noqa: F401
+from paddle_tpu.distributed import utils  # noqa: F401
+from paddle_tpu.distributed.utils import global_scatter, global_gather  # noqa: F401

@@ -56,45 +56,16 @@ def template_rng_guard(what):
 
 
 def spmd_pipeline(stage_fn, n_stages, n_micro, stacked_params, x, mesh):
-    """Pure-jax GPipe over the 'pp' axis.
+    """Pure-jax GPipe over the 'pp' axis — the single-chunk case of
+    :func:`spmd_pipeline_interleaved`.
 
     stage_fn(local_param_arrays, x_micro) -> y_micro  (shape-preserving)
     stacked_params: list of arrays [n_stages, ...] (leading axis = stage id)
     x: [B, ...] full batch; B must divide into n_micro micro-batches.
     Returns [B, ...] outputs of the LAST stage, replicated over 'pp'.
     """
-    B = x.shape[0]
-    assert B % n_micro == 0, f"batch {B} not divisible into {n_micro} micro"
-    mb = B // n_micro
-    xm = x.reshape((n_micro, mb) + x.shape[1:])
-    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-
-    def per_rank(params, xs):
-        local = [p[0] for p in params]          # [1, ...] slice -> this stage
-        r = jax.lax.axis_index("pp")
-        is_first = (r == 0)
-        is_last = (r == n_stages - 1)
-        carry = jnp.zeros(xs.shape[1:], xs.dtype)
-        outs = jnp.zeros_like(xs)
-        for t in range(n_micro + n_stages - 1):
-            feed = xs[min(t, n_micro - 1)]
-            x_in = jnp.where(is_first, feed, carry) if t < n_micro else carry
-            y = stage_fn(local, x_in)
-            m = t - (n_stages - 1)
-            if 0 <= m < n_micro:
-                outs = outs.at[m].set(jnp.where(is_last, y, outs[m]))
-            if t < n_micro + n_stages - 2:
-                carry = jax.lax.ppermute(y, "pp", perm)
-        # replicate the last stage's results onto every pp rank
-        return jax.lax.psum(
-            jnp.where(is_last, outs, jnp.zeros_like(outs)), "pp")
-
-    f = jax.shard_map(
-        per_rank, mesh=mesh,
-        in_specs=(tuple(P("pp") for _ in stacked_params), P()),
-        out_specs=P(), axis_names={"pp"}, check_vma=False)
-    outs = f(tuple(stacked_params), xm)
-    return outs.reshape((B,) + outs.shape[2:])
+    return spmd_pipeline_interleaved(stage_fn, n_stages, 1, n_micro,
+                                     stacked_params, x, mesh)
 
 
 def stack_stage_params(per_stage_param_trees, mesh):

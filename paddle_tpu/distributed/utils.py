@@ -1,0 +1,145 @@
+"""``paddle.distributed.utils`` — MoE dispatch primitives.
+
+Rebuild of the reference's `python/paddle/distributed/utils/moe_utils.py`
+(`global_scatter` :25, `global_gather` :145) over
+`operators/collective/global_scatter_op.cc:80`: rows grouped by
+(expert, destination rank) are exchanged all-to-all so each rank ends up
+holding the rows destined for its local experts.
+
+Count layout (reference contract): ``local_count[e * world + r]`` = number of
+my rows headed to expert ``e`` living on rank ``r``; ``global_count`` is the
+transpose view (how many I receive). In-graph MoE should use
+`incubate.moe.MoELayer` (static-shape einsum dispatch compiled by GSPMD); these
+eager functions are the correctness/interop path, like the reference's eager
+ProcessGroup calls.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.common import ensure_tensor
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _counts(t):
+    return np.asarray(ensure_tensor(t).numpy()).astype(np.int64).reshape(-1)
+
+
+def _world(group):
+    from paddle_tpu.distributed.parallel import get_world_size
+    if group is not None and set(group.ranks) != set(range(get_world_size())):
+        # the allgather emulation is a whole-world collective; a subgroup
+        # would read other ranks' buffers and desync ranks outside the group
+        raise NotImplementedError(
+            "global_scatter/global_gather support the default (world) group "
+            "only on the eager path; in-graph MoE dispatch over a mesh axis "
+            "lives in incubate.moe.MoELayer")
+    return max(get_world_size(), 1)
+
+
+def _rank():
+    from paddle_tpu.distributed.parallel import get_rank
+    return get_rank()
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Send my rows (grouped by expert-major (e, dest-rank) segments per
+    ``local_count``) to their destination ranks; receive the rows my experts
+    serve, ordered (src-rank, expert) to match ``global_count``
+    (ref moe_utils.global_scatter :25)."""
+    x = ensure_tensor(x)
+    lc = _counts(local_count)
+    gc = _counts(global_count)
+    world = _world(group)
+    n_expert = lc.size // world
+    if world == 1:
+        # single rank: receive order (src-rank-major) == send order reshuffled
+        # from expert-major; with one rank both collapse to expert order
+        return Tensor(x._data, _internal=True)
+
+    from jax.experimental import multihost_utils
+    # variable-size exchange via the allgather emulation path (correctness):
+    # everyone shares rows + counts, each rank slices out its inbox
+    all_counts = multihost_utils.process_allgather(
+        jnp.asarray(lc))                       # [world, n_expert*world]
+    n_rows = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([x.shape[0]], np.int64))).reshape(-1)
+    pad = int(n_rows.max())
+    xp = jnp.zeros((pad,) + tuple(x.shape[1:]), x._data.dtype)
+    xp = xp.at[:x.shape[0]].set(x._data)
+    all_rows = np.asarray(multihost_utils.process_allgather(xp))
+    me = _rank()
+    counts_np = np.asarray(all_counts)
+    # reference contract check: my global_count must be the transpose view of
+    # everyone's local_count (gc[e*world+src] == lc_src[e*world+me])
+    expect_gc = np.asarray([counts_np[src][e * world + me]
+                            for e in range(n_expert) for src in range(world)])
+    got_gc = gc.reshape(n_expert, world).reshape(-1)
+    if not np.array_equal(np.sort(expect_gc), np.sort(got_gc)) and \
+            not np.array_equal(
+                expect_gc.reshape(n_expert, world),
+                gc.reshape(n_expert, world)):
+        raise ValueError(
+            "global_count is not the transpose of the gathered local_counts")
+    out = []
+    # receive order: src-rank-major, expert within (matches global_count's
+    # [e * world + r] read on the receiver with r = src)
+    for src in range(world):
+        offs = np.zeros(1 + counts_np.shape[1], np.int64)
+        np.cumsum(counts_np[src], out=offs[1:])
+        for e in range(n_expert):
+            seg = e * world + me
+            a, b = int(offs[seg]), int(offs[seg + 1])
+            if b > a:
+                out.append(all_rows[src][a:b])
+    if out:
+        res = np.concatenate(out, axis=0)
+    else:
+        res = np.zeros((0,) + tuple(x.shape[1:]), np.asarray(all_rows).dtype)
+    return Tensor(jnp.asarray(res), _internal=True)
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of :func:`global_scatter`: return the rows I originally sent,
+    back in my local expert-major order (ref moe_utils.global_gather :145)."""
+    x = ensure_tensor(x)
+    lc = _counts(local_count)
+    gc = _counts(global_count)
+    world = _world(group)
+    n_expert = lc.size // world
+    if world == 1:
+        return Tensor(x._data, _internal=True)
+
+    from jax.experimental import multihost_utils
+    n_rows = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([x.shape[0]], np.int64))).reshape(-1)
+    pad = int(n_rows.max())
+    xp = jnp.zeros((pad,) + tuple(x.shape[1:]), x._data.dtype)
+    xp = xp.at[:x.shape[0]].set(x._data)
+    all_rows = np.asarray(multihost_utils.process_allgather(xp))
+    all_gc = np.asarray(multihost_utils.process_allgather(jnp.asarray(gc)))
+    me = _rank()
+    # On each holder rank, rows sit in (src-rank, expert) order; to reclaim my
+    # rows IN MY SEND ORDER (expert-major across dest ranks) walk my
+    # local_count segments and pull from the holder's buffer
+    # per-holder cumulative offsets over its (src-rank-major, expert) inbox
+    # order: seg_counts[dst][src, e] = rows dst received from src for expert e
+    seg_counts = all_gc.reshape(world, n_expert, world).transpose(0, 2, 1)
+    seg_offsets = np.zeros((world, world * n_expert + 1), np.int64)
+    np.cumsum(seg_counts.reshape(world, -1), axis=1, out=seg_offsets[:, 1:])
+    out = []
+    for e in range(n_expert):
+        for dst in range(world):
+            cnt = int(lc[e * world + dst])
+            if cnt == 0:
+                continue
+            off = int(seg_offsets[dst][me * n_expert + e])
+            out.append(all_rows[dst][off:off + cnt])
+    if out:
+        res = np.concatenate(out, axis=0)
+    else:
+        res = np.zeros((0,) + tuple(x.shape[1:]), np.asarray(all_rows).dtype)
+    return Tensor(jnp.asarray(res), _internal=True)

@@ -10,28 +10,35 @@ import jax.numpy as jnp
 __all__ = ["LookAhead", "ModelAverage"]
 
 
-class LookAhead:
+from paddle_tpu.distributed.fleet.meta_optimizers import _MetaOptimizerBase
+
+
+class LookAhead(_MetaOptimizerBase):
     """k-step lookahead: slow weights pulled toward the fast optimizer's
-    weights every k steps (Zhang et al.; ref lookahead.py:30)."""
+    weights every k steps (Zhang et al.; ref lookahead.py:30). Delegation /
+    minimize ride the shared meta-optimizer base."""
 
     def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
         if not 0.0 <= alpha <= 1.0:
             raise ValueError("alpha should be in [0, 1]")
-        self.inner_optimizer = inner_optimizer
+        if int(k) < 1:
+            raise ValueError("k should be >= 1")
+        super().__init__(inner_optimizer)
         self.alpha = float(alpha)
         self.k = int(k)
         self._step_num = 0
         self._slow = {}
 
-    def __getattr__(self, item):
-        return getattr(self.inner_optimizer, item)
+    @property
+    def inner_optimizer(self):
+        return self._inner_opt
 
     def step(self):
-        params = self.inner_optimizer._parameter_list
+        params = self._inner_opt._parameter_list
         if self._step_num == 0:
             for i, p in enumerate(params):
                 self._slow[i] = p._data
-        self.inner_optimizer.step()
+        self._inner_opt.step()
         self._step_num += 1
         if self._step_num % self.k == 0:
             for i, p in enumerate(params):
@@ -39,18 +46,8 @@ class LookAhead:
                 self._slow[i] = slow
                 p._write(slow.astype(p._data.dtype))
 
-    def clear_grad(self, *a, **k):
-        self.inner_optimizer.clear_grad(*a, **k)
-
-    def minimize(self, loss, startup_program=None, parameters=None,
-                 no_grad_set=None):
-        loss.backward()
-        self.step()
-        return None, [(p, p.grad)
-                      for p in self.inner_optimizer._parameter_list]
-
     def state_dict(self):
-        sd = self.inner_optimizer.state_dict()
+        sd = self._inner_opt.state_dict()
         sd["@LookAhead.slow"] = {i: np.asarray(v)
                                  for i, v in self._slow.items()}
         sd["@LookAhead.step"] = self._step_num
@@ -62,7 +59,7 @@ class LookAhead:
         self._step_num = state.pop("@LookAhead.step", 0)
         if slow is not None:
             self._slow = {i: jnp.asarray(v) for i, v in slow.items()}
-        self.inner_optimizer.set_state_dict(state)
+        self._inner_opt.set_state_dict(state)
 
 
 class ModelAverage:
@@ -98,7 +95,8 @@ class ModelAverage:
         """Swap averaged weights in (context-manager friendly)."""
         if self._count == 0:
             return self
-        self._backup = [p._data for p in self._params]
+        if self._backup is None:   # double-apply must not clobber the backup
+            self._backup = [p._data for p in self._params]
         for p, s in zip(self._params, self._sum):
             p._write((s / self._count).astype(p._data.dtype))
         if not need_restore:
