@@ -69,6 +69,7 @@ from paddle_tpu import hub  # noqa: F401
 from paddle_tpu import static  # noqa: F401
 from paddle_tpu.hapi import callbacks  # noqa: F401
 from paddle_tpu import version  # noqa: F401
+from paddle_tpu import sysconfig  # noqa: F401
 
 from paddle_tpu.nn.functional.common import linear  # noqa: F401  (paddle exposes it)
 

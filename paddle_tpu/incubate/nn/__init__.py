@@ -126,3 +126,38 @@ def fused_rotary_position_embedding(q, k, cos, sin, name=None):
     cos, sin = ensure_tensor(cos), ensure_tensor(sin)
     return apply(lambda a, b, c, s: apply_rotary_emb(a, b, c, s),
                  q, k, cos, sin, op_name="fused_rope", n_outputs=2)
+
+
+class FusedMultiTransformer(Layer):
+    """N pre-LN decoder layers in one traced region
+    (ref `incubate/nn/layer/fused_transformer.py` FusedMultiTransformer — the
+    reference fuses all layers into one CUDA op, `fused_multi_transformer_op.cu`;
+    here the whole stack is one jit region XLA fuses, with attention on the
+    flash kernel)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, num_layers=1,
+                 epsilon=1e-5, **kwargs):
+        super().__init__()
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer is pre-LN only (ref constraint)")
+        self.layers = []
+        for i in range(num_layers):
+            blk = FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward, dropout_rate,
+                activation=activation, normalize_before=True)
+            self.add_sublayer(f"layer_{i}", blk)
+            self.layers.append(blk)
+
+    def forward(self, src, attn_mask=None, caches=None, **kwargs):
+        out = src
+        new_caches = [] if caches is not None else None
+        for i, blk in enumerate(self.layers):
+            cache = caches[i] if caches is not None else None
+            out = blk(out, src_mask=attn_mask, cache=cache)
+            if isinstance(out, tuple):
+                out, c = out
+                if new_caches is not None:
+                    new_caches.append(c)
+        return (out, new_caches) if caches is not None else out

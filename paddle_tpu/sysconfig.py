@@ -1,0 +1,17 @@
+"""``paddle.sysconfig`` (ref: `python/paddle/sysconfig.py` — get_include :20,
+get_lib :35): paths for compiling extensions against the framework."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory with the framework's headers (the native shm-queue / any
+    cpp_extension sources live under io/native)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "io",
+                        "native")
+
+
+def get_lib():
+    """Directory containing the framework's built native libraries."""
+    return get_include()
