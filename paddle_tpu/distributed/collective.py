@@ -259,15 +259,24 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
             tensor._write(ensure_tensor(tensor_list[0])._data)
         return tensor
     rank = get_rank()
-    if rank == src and tensor_list:
-        stacked = jnp.stack([ensure_tensor(x)._data for x in tensor_list])
+    world = jax.process_count()
+    # right-sized p2p through the coordination-service KV (each rank moves
+    # O(data/P), not the O(P*data) broadcast-everything emulation)
+    if rank == src:
+        if not tensor_list:
+            raise ValueError("scatter src needs tensor_list")
+        for r in range(world):
+            chunk = ensure_tensor(tensor_list[r])
+            if r == rank:
+                tensor._write(chunk._data)
+            else:
+                n, key = _p2p_peek_key(src, r)
+                _kv_client().key_value_set_bytes(
+                    key, np.ascontiguousarray(
+                        np.asarray(chunk._data)).tobytes())
+                _p2p_advance(src, r, n)
     else:
-        shape = (len(group.ranks if group else range(jax.process_count())),) + \
-            tuple(tensor.shape)
-        stacked = jnp.zeros(shape, tensor.dtype)
-    # emulate via broadcast of the stacked buffer then local pick
-    g = _proc_allgather(stacked)
-    tensor._write(jnp.asarray(g[src][rank]))
+        recv(tensor, src=src)
     return tensor
 
 
@@ -312,12 +321,32 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
             out_tensor_list.append(t)
         return out_tensor_list
     from paddle_tpu.distributed.parallel import get_rank
-    local = jnp.stack([t._data for t in ts])
-    gathered = _proc_allgather(local)  # [P, P, ...]
     rank = get_rank()
-    for p in range(gathered.shape[0]):
-        out_tensor_list.append(Tensor(jnp.asarray(gathered[p][rank]),
-                                      _internal=True))
+    world = jax.process_count()
+    # pairwise exchange through the KV transport: O(data/P) per peer instead
+    # of the former allgather-everything emulation
+    client = _kv_client()
+    for r in range(world):
+        if r == rank:
+            continue
+        n, key = _p2p_peek_key(rank, r)
+        client.key_value_set_bytes(
+            key, np.ascontiguousarray(np.asarray(ts[r]._data)).tobytes())
+        _p2p_advance(rank, r, n)
+    for r in range(world):
+        if r == rank:
+            out_tensor_list.append(Tensor(ts[rank]._data, _internal=True))
+            continue
+        n, key = _p2p_peek_key(r, rank)
+        raw = client.blocking_key_value_get_bytes(key, 120_000)
+        _p2p_advance(r, rank, n)
+        try:
+            client.key_value_delete(key)
+        except Exception:
+            pass
+        arr = np.frombuffer(raw, dtype=np.dtype(str(ts[r]._data.dtype))
+                            ).reshape(ts[r].shape)
+        out_tensor_list.append(Tensor(jnp.asarray(arr), _internal=True))
     return out_tensor_list
 
 

@@ -56,3 +56,50 @@ def test_p2p_two_process(tmp_path):
     r0 = json.load(open(tmp_path / "rank0.json"))
     assert r0["got"] == [7.0, 8.0]
     assert os.path.exists(tmp_path / "rank1.json")
+
+
+TRAINER2 = """
+import os, json, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+env = dist.init_parallel_env()
+rank = env.rank
+# scatter: rank 0 distributes distinct chunks
+buf = paddle.to_tensor(np.zeros((2,), np.float32))
+if rank == 0:
+    chunks = [paddle.to_tensor(np.array([10.0 * r, 10.0 * r + 1], np.float32))
+              for r in range(2)]
+    dist.scatter(buf, chunks, src=0)
+else:
+    dist.scatter(buf, src=0)
+assert np.allclose(buf.numpy(), [10.0 * rank, 10.0 * rank + 1]), buf.numpy()
+# alltoall: rank r sends [r*10+j] to rank j
+ins = [paddle.to_tensor(np.array([rank * 10.0 + j], np.float32))
+       for j in range(2)]
+outs = []
+dist.alltoall(ins, outs)
+got = [float(t.numpy()[0]) for t in outs]
+assert got == [0.0 + rank, 10.0 + rank], got
+with open(os.path.join({outdir!r}, f"rank{{rank}}_c.json"), "w") as f:
+    json.dump({{"ok": True}}, f)
+print("rank", rank, "scatter/alltoall ok")
+"""
+
+
+def test_scatter_alltoall_two_process(tmp_path):
+    script = tmp_path / "trainer.py"
+    script.write_text(TRAINER2.format(repo=REPO, outdir=str(tmp_path)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu",
+         "--log_dir", str(tmp_path / "logs"), str(script)],
+        capture_output=True, text=True, timeout=180, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.load(open(tmp_path / "rank0_c.json"))["ok"]
+    assert json.load(open(tmp_path / "rank1_c.json"))["ok"]
