@@ -23,3 +23,4 @@ from paddle_tpu.distributed.fleet.meta_optimizers import (  # noqa: F401
     GradientMergeOptimizer, LocalSGDOptimizer, DGCOptimizer,
     FP16AllreduceOptimizer, apply_meta_optimizers,
 )
+from paddle_tpu.distributed.fleet import utils_mod as utils  # noqa: F401

@@ -1,0 +1,108 @@
+"""fleet.utils — rank-aware logging + filesystem + hybrid-parallel helpers
+(ref `python/paddle/distributed/fleet/utils/`: `log_util.py` logger,
+`fs.py` LocalFS, `hybrid_parallel_util.py` fused sync helpers).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import sys
+
+__all__ = ["get_logger", "logger", "LocalFS", "recompute"]
+
+
+def _rank() -> int:
+    from paddle_tpu.distributed.parallel import get_rank
+    try:
+        return get_rank()
+    except Exception:
+        return 0
+
+
+class _RankFilter(logging.Filter):
+    def filter(self, record):
+        record.rank = _rank()
+        return True
+
+
+def get_logger(level=logging.INFO, name="paddle_tpu.fleet"):
+    """Rank-prefixed logger (ref log_util.py:get_logger — the reference
+    prefixes every record with the trainer rank)."""
+    log = logging.getLogger(name)
+    if not log.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s [rank %(rank)s] %(levelname)s %(message)s"))
+        h.addFilter(_RankFilter())
+        log.addHandler(h)
+        log.propagate = False
+    log.setLevel(level)
+    return log
+
+
+logger = get_logger()
+
+
+class LocalFS:
+    """Local filesystem client with the reference's FS interface
+    (ref fs.py:LocalFS — ls_dir, mkdirs, rename, delete, upload/download as
+    copies, is_file/is_dir/is_exist, touch, mv)."""
+
+    def ls_dir(self, path):
+        if not os.path.exists(path):
+            return [], []
+        dirs, files = [], []
+        for e in os.listdir(path):
+            (dirs if os.path.isdir(os.path.join(path, e)) else files).append(e)
+        return dirs, files
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    mv = rename
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def need_upload_download(self):
+        return False
+
+    def upload(self, local, remote):
+        shutil.copy(local, remote)
+
+    def download(self, remote, local):
+        shutil.copy(remote, local)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        open(path, "a").close()
+
+    def cat(self, path):
+        with open(path) as f:
+            return f.read()
+
+    def list_dirs(self, path):
+        return self.ls_dir(path)[0]
+
+
+def recompute(function, *args, **kwargs):
+    """Re-export of the recompute API at the reference's fleet.utils path."""
+    from paddle_tpu.distributed.fleet.recompute import recompute as _rc
+    return _rc(function, *args, **kwargs)
