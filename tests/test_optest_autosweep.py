@@ -65,6 +65,17 @@ def _probe_input():
     return arr
 
 
+def _try_eager_binary(fn, a, b):
+    t, u = paddle.to_tensor(a.copy()), paddle.to_tensor(b.copy())
+    try:
+        out = fn(t, u)
+    except Exception:
+        return None
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    outs = [o for o in outs if isinstance(o, paddle.Tensor)]
+    return outs or None
+
+
 def _try_eager(fn, arr):
     t = paddle.to_tensor(arr.copy())
     try:
@@ -80,11 +91,14 @@ def _try_eager(fn, arr):
 
 # domain adjustments / known eager-only ops
 _SHIFT = {"paddle.acosh": 1.5}          # domain x > 1
-_NEEDS_SPEC = {"paddle.cholesky", "linalg.cholesky"}       # needs an SPD matrix
+_NEEDS_SPEC = {"paddle.cholesky", "linalg.cholesky",
+               "paddle.lstsq", "linalg.lstsq"}   # SPD / least-squares shapes       # needs an SPD matrix
 _EAGER_ONLY = {"paddle.eig", "paddle.eigvals",
                "linalg.eig", "linalg.eigvals",
                "paddle.histogram", "paddle.histogramdd"}  # bins depend on data values            # LAPACK path is host-side (like the
                                         # reference's CPU-only eig kernel)
+
+_NO_GRAD = {"paddle.nextafter"}        # no JVP rule (discrete float step)
 
 RESULTS = {"auto": [], "needs_spec": []}
 
@@ -94,12 +108,19 @@ def test_autosweep_eager_static_grad():
     assert len(cands) > 250, len(cands)
     arr = _probe_input()
     auto, needs_spec, failures = [], [], []
+    arr2 = (np.random.RandomState(1).rand(4, 4) * 0.8 + 0.1).astype(
+        np.float32)
     for name, fn in cands:
         if name in _NEEDS_SPEC:
             needs_spec.append(name)
             continue
         op_arr = arr + _SHIFT.get(name, 0.0)
+        binary = False
         outs = _try_eager(fn, op_arr)
+        if outs is None:
+            # second probe: same-shape two-tensor ops (add/atan2/fmax/...)
+            outs = _try_eager_binary(fn, op_arr, arr2)
+            binary = outs is not None
         if outs is None:
             needs_spec.append(name)
             continue
@@ -108,8 +129,13 @@ def test_autosweep_eager_static_grad():
         try:
             if name in _EAGER_ONLY:
                 raise _SkipStatic()
-            compiled = paddle.jit.to_static(lambda t: fn(t))
-            souts = compiled(paddle.to_tensor(op_arr.copy()))
+            if binary:
+                compiled = paddle.jit.to_static(lambda t, u: fn(t, u))
+                souts = compiled(paddle.to_tensor(op_arr.copy()),
+                                 paddle.to_tensor(arr2.copy()))
+            else:
+                compiled = paddle.jit.to_static(lambda t: fn(t))
+                souts = compiled(paddle.to_tensor(op_arr.copy()))
             souts = souts if isinstance(souts, (tuple, list)) else [souts]
             souts = [o for o in souts if isinstance(o, paddle.Tensor)]
             for ev, so in zip(eager_vals, souts):
@@ -128,10 +154,10 @@ def test_autosweep_eager_static_grad():
             failures.append(f"{name}: static raised {type(e).__name__}: {e}")
             continue
         # gradient finiteness for float outputs
-        if eager_vals[0].dtype.kind == "f":
+        if eager_vals[0].dtype.kind == "f" and name not in _NO_GRAD:
             try:
                 x = paddle.to_tensor(op_arr.copy(), stop_gradient=False)
-                out = fn(x)
+                out = fn(x, paddle.to_tensor(arr2.copy())) if binary else fn(x)
                 out0 = out[0] if isinstance(out, (tuple, list)) else out
                 if isinstance(out0, paddle.Tensor) and \
                         np.asarray(out0._data).dtype.kind == "f":
@@ -148,7 +174,7 @@ def test_autosweep_eager_static_grad():
     RESULTS["needs_spec"] = needs_spec
     assert not failures, failures
     # the single-tensor long tail must stay broadly green
-    assert len(auto) >= 150, (len(auto), needs_spec[:20])
+    assert len(auto) >= 270, (len(auto), needs_spec[:20])
 
 
 def test_write_coverage_report(tmp_path):
@@ -161,7 +187,8 @@ def test_write_coverage_report(tmp_path):
     with open(path, "w") as f:
         f.write("# OpTest auto-sweep coverage\n\nGenerated by "
                 "`tests/test_optest_autosweep.py`.\n\n"
-                f"- auto-verified single-tensor ops: {len(RESULTS['auto'])}\n"
+                f"- auto-verified ops (unary + binary probes): "
+                f"{len(RESULTS['auto'])}\n"
                 f"- ops needing a curated spec (multi-arg/creation): "
                 f"{len(RESULTS['needs_spec'])} — covered by "
                 "tests/test_ops_sweep*.py where numerically meaningful\n\n"
