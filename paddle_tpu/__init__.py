@@ -70,6 +70,7 @@ from paddle_tpu import static  # noqa: F401
 from paddle_tpu.hapi import callbacks  # noqa: F401
 from paddle_tpu import version  # noqa: F401
 from paddle_tpu import sysconfig  # noqa: F401
+from paddle_tpu import tensor  # noqa: F401
 
 from paddle_tpu.nn.functional.common import linear  # noqa: F401  (paddle exposes it)
 
@@ -91,3 +92,17 @@ def in_dynamic_mode():
 # paddle exposes creation/math at top level already via ops import; a few extras:
 def is_grad_enabled_():  # pragma: no cover - alias safety
     return is_grad_enabled()
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Batch a sample generator (ref `python/paddle/batch.py`)."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched

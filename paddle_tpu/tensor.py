@@ -1,0 +1,6 @@
+"""``paddle.tensor`` namespace alias (ref: `python/paddle/tensor/__init__.py`
+re-exports the op surface; here the ops live in `paddle_tpu.ops` and this
+module mirrors them so `from paddle.tensor import math`-style imports port)."""
+from paddle_tpu.ops import *  # noqa: F401,F403
+from paddle_tpu.ops import math, creation, manipulation, linalg, search, random  # noqa: F401
+from paddle_tpu.ops import einsum as einsum_mod  # noqa: F401

@@ -171,3 +171,86 @@ class DatasetFolder(Dataset):
 
 
 ImageFolder = DatasetFolder
+
+
+class Flowers(Dataset):
+    """Flowers-102 from a local extracted directory (ref:
+    `vision/datasets/flowers.py` — the reference downloads jpg/labels/setid
+    .mat archives; no egress here, so point `data_file` at a directory
+    containing jpg/ plus imagelabels.npy + setid .npy splits, or any folder
+    of class-subdir images via DatasetFolder semantics)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        if data_file is None or not os.path.isdir(data_file):
+            raise FileNotFoundError(
+                "Flowers needs a local data directory (no network egress): "
+                "either the extracted 102flowers layout (jpg/ + "
+                "imagelabels.npy + setid_{train,valid,test}.npy) or a "
+                "class-per-subdir image folder")
+        jpg = os.path.join(data_file, "jpg")
+        labels_npy = os.path.join(data_file, "imagelabels.npy")
+        if os.path.isdir(jpg) and os.path.exists(labels_npy):
+            self._images = sorted(
+                os.path.join(jpg, f) for f in os.listdir(jpg)
+                if f.lower().endswith((".jpg", ".jpeg", ".png")))
+            labels = np.load(labels_npy).astype(np.int64) - 1
+            split_npy = os.path.join(data_file, f"setid_{mode}.npy")
+            if os.path.exists(split_npy):
+                idx = np.load(split_npy).astype(np.int64) - 1
+            else:
+                idx = np.arange(len(self._images))
+            self._images = [self._images[i] for i in idx]
+            self._labels = labels[idx]
+        else:
+            folder = DatasetFolder(data_file, transform=None)
+            self._images = [s[0] for s in folder.samples]
+            self._labels = np.asarray([s[1] for s in folder.samples], np.int64)
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        img = np.asarray(Image.open(self._images[idx]).convert("RGB"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self._labels[idx])
+
+    def __len__(self):
+        return len(self._images)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs from a local VOCdevkit tree (ref:
+    `vision/datasets/voc2012.py`; no egress — point `data_file` at
+    .../VOC2012 containing JPEGImages/, SegmentationClass/ and
+    ImageSets/Segmentation/{train,val,trainval}.txt)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        if data_file is None or not os.path.isdir(data_file):
+            raise FileNotFoundError(
+                "VOC2012 needs a local VOCdevkit/VOC2012 directory "
+                "(no network egress)")
+        split = {"train": "train", "test": "val", "valid": "val",
+                 "trainval": "trainval"}.get(mode, "train")
+        list_file = os.path.join(data_file, "ImageSets", "Segmentation",
+                                 f"{split}.txt")
+        with open(list_file) as f:
+            names = [ln.strip() for ln in f if ln.strip()]
+        self._pairs = [
+            (os.path.join(data_file, "JPEGImages", n + ".jpg"),
+             os.path.join(data_file, "SegmentationClass", n + ".png"))
+            for n in names]
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        img_p, seg_p = self._pairs[idx]
+        img = np.asarray(Image.open(img_p).convert("RGB"))
+        seg = np.asarray(Image.open(seg_p))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, seg
+
+    def __len__(self):
+        return len(self._pairs)
