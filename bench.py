@@ -138,6 +138,52 @@ def bench_bert():
     return batch / dt, dt, loss
 
 
+def bench_dataloader():
+    """Data-pipeline rung (SURVEY §7 hard-part #4): multi-worker DataLoader
+    throughput over the native shared-memory transport vs in-process."""
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.vision.datasets import FakeData
+
+    import paddle_tpu.vision.transforms as T
+
+    # realistic per-sample CPU cost (decode-ish augmentation) so the worker
+    # pipeline has actual work to parallelize
+    aug = T.Compose([
+        # CHW float [0,1] -> HWC uint8 [0,255]: the jitter family operates on
+        # image-range uint8 like real decoded inputs
+        lambda img: (img.transpose(1, 2, 0) * 255).astype(np.uint8),
+        T.RandomResizedCrop(224),
+        T.RandomHorizontalFlip(),
+        T.ColorJitter(0.4, 0.4, 0.4),
+        lambda img: np.ascontiguousarray(
+            np.asarray(img, np.float32).transpose(2, 0, 1) / 255.0),
+    ])
+    ds = FakeData(size=512, image_shape=(3, 256, 256), transform=aug)
+
+    def host_collate(batch):
+        # measure the pipeline (workers + transport), not the device link:
+        # the tunnel's host->device path would otherwise dominate
+        import numpy as _np
+        return _np.stack([b[0] for b in batch])
+
+    def pump(num_workers, use_shared_memory):
+        dl = DataLoader(ds, batch_size=64, num_workers=num_workers,
+                        use_shared_memory=use_shared_memory, drop_last=True,
+                        collate_fn=host_collate)
+        it = iter(dl)
+        next(it)  # warm up worker spin-up
+        n, t0 = 0, time.perf_counter()
+        for batch in it:
+            n += 1
+        dt = time.perf_counter() - t0
+        return (n * 64) / dt
+
+    inproc = pump(0, False)
+    shm = pump(4, True)
+    return inproc, shm
+
+
 def _retry(fn, attempts=3):
     """The dev-tunnel backend occasionally drops a remote_compile connection
     (HTTP 500 / closed body) — transient, so each rung retries."""
@@ -180,6 +226,15 @@ def main():
               f"loss={loss_b:.3f}", file=sys.stderr)
     except Exception as e:
         print(f"# bert rung failed: {type(e).__name__}: {e}", file=sys.stderr)
+    try:
+        inproc, shm = _retry(bench_dataloader)
+        import os
+        print(f"# dataloader imgs/sec in-process={inproc:.0f} "
+              f"shm-4workers={shm:.0f} (host_cores={os.cpu_count()}; "
+              "the worker pipeline only wins with >1 core)", file=sys.stderr)
+    except Exception as e:
+        print(f"# dataloader rung failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

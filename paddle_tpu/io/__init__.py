@@ -261,8 +261,12 @@ def default_collate_fn(batch):
 
 
 def _to_np_tree(o):
+    # Tensors are tagged so the parent restores exactly the nodes that were
+    # Tensors — a custom collate returning plain ndarrays stays numpy on the
+    # other side (matching the single-process iterator, which yields the
+    # collate output untouched)
     if isinstance(o, Tensor):
-        return o.numpy()
+        return ("__pt_tensor__", o.numpy())
     if isinstance(o, (list, tuple)):
         return type(o)(_to_np_tree(v) for v in o)
     if isinstance(o, dict):
@@ -444,8 +448,10 @@ class DataLoader:
                     inflight += 1
 
                 def to_tensor(o):
-                    if isinstance(o, np.ndarray):
-                        return Tensor(o)
+                    if (isinstance(o, tuple) and len(o) == 2
+                            and isinstance(o[0], str)
+                            and o[0] == "__pt_tensor__"):
+                        return Tensor(o[1])
                     if isinstance(o, list):
                         return [to_tensor(v) for v in o]
                     if isinstance(o, tuple):

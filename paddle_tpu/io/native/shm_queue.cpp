@@ -9,13 +9,20 @@
 // zero-extra-copy views to numpy — no pickling through pipes.
 //
 // Layout of the shm segment:
-//   [Ctrl][slot_0 len|data][slot_1 len|data]...[slot_{n-1}]
-// Ctrl holds a process-shared mutex + condvars and the ring indices.
+//   [Ctrl][slot_0 hdr|data][slot_1 hdr|data]...[slot_{n-1}]
+// slot hdr = [len:u64][state:u64]. Ctrl holds a process-shared mutex +
+// condvars and the ring indices. Payload memcpys happen OUTSIDE the mutex
+// (claim/commit protocol): a producer claims the tail slot under the lock,
+// copies lock-free, then commits READY; the single consumer claims the head
+// slot, copies lock-free, then releases it EMPTY. With multi-MB batches this
+// is what keeps N workers' copies parallel instead of serialized on the ring
+// mutex. Single consumer, multiple producers.
 //
 // Built on demand with `g++ -O2 -shared -fPIC` (no pybind11 — plain C ABI via
 // ctypes, per the environment's binding guidance).
 
 #include <cerrno>
+#include <csignal>
 #include <cstdint>
 #include <cstring>
 #include <ctime>
@@ -33,14 +40,22 @@ struct Ctrl {
   pthread_cond_t not_empty;
   uint64_t head;       // next slot to pop
   uint64_t tail;       // next slot to push
-  uint64_t count;      // filled slots
+  uint64_t claimed;    // slots claimed by producers (writing or ready)
   uint64_t slots;
   uint64_t slot_size;  // payload bytes per slot
   uint32_t closed;
   uint32_t magic;
 };
 
-constexpr uint32_t kMagic = 0x53484d51;  // "SHMQ"
+enum SlotState : uint64_t { kEmpty = 0, kWriting = 1, kReady = 2 };
+
+struct SlotHdr {
+  uint64_t len;
+  uint64_t state;
+  uint64_t producer_pid;  // for dead-producer reclamation (kWriting orphan)
+};
+
+constexpr uint32_t kMagic = 0x53484d52;  // "SHMR" (v2: claim/commit slots)
 
 struct Handle {
   Ctrl* ctrl;
@@ -50,13 +65,17 @@ struct Handle {
   char name[256];
 };
 
-inline uint8_t* slot_ptr(Handle* h, uint64_t idx) {
-  return h->base + idx * (sizeof(uint64_t) + h->ctrl->slot_size);
+inline SlotHdr* slot_hdr(Handle* h, uint64_t idx) {
+  return (SlotHdr*)(h->base + idx * (sizeof(SlotHdr) + h->ctrl->slot_size));
 }
 
-// robust-aware lock: if the previous owner died, mark the state consistent
-// (the ring indices are only ever updated after the payload memcpy, so the
-// worst case of recovery is one lost in-flight slot, never corruption)
+inline uint8_t* slot_data(Handle* h, uint64_t idx) {
+  return (uint8_t*)slot_hdr(h, idx) + sizeof(SlotHdr);
+}
+
+// robust-aware lock: if the previous owner died while HOLDING the mutex,
+// mark the state consistent. Death between claim and commit (no lock held)
+// is handled separately by dead-producer reclamation in shmq_pop_timed.
 inline int robust_lock(Ctrl* c) {
   int rc = pthread_mutex_lock(&c->mu);
   if (rc == EOWNERDEAD) {
@@ -97,7 +116,7 @@ void* shmq_create(const char* name, uint64_t slots, uint64_t slot_size) {
   shm_unlink(name);  // stale segment from a crashed run
   int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) return nullptr;
-  size_t len = sizeof(Ctrl) + slots * (sizeof(uint64_t) + slot_size);
+  size_t len = sizeof(Ctrl) + slots * (sizeof(SlotHdr) + slot_size);
   if (ftruncate(fd, (off_t)len) != 0) {
     close(fd);
     shm_unlink(name);
@@ -114,8 +133,9 @@ void* shmq_create(const char* name, uint64_t slots, uint64_t slot_size) {
   pthread_mutexattr_t ma;
   pthread_mutexattr_init(&ma);
   pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
-  // robust: a worker SIGKILLed/OOM-killed mid-push must not deadlock the
-  // trainer — the next locker gets EOWNERDEAD and recovers
+  // robust: a worker SIGKILLed/OOM-killed while holding the mutex must not
+  // deadlock the trainer — the next locker gets EOWNERDEAD and recovers;
+  // death during the lock-free copy window is reclaimed via producer_pid
   pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
   pthread_mutex_init(&c->mu, &ma);
   pthread_condattr_t ca;
@@ -167,18 +187,27 @@ int shmq_push(void* hv, const void* data, uint64_t len) {
   Ctrl* c = h->ctrl;
   if (len > c->slot_size) return -2;
   robust_lock(c);
-  while (c->count == c->slots && !c->closed)
+  while (c->claimed == c->slots && !c->closed)
     robust_cond_wait(&c->not_full, c);
   if (c->closed) {
     pthread_mutex_unlock(&c->mu);
     return -1;
   }
-  uint8_t* p = slot_ptr(h, c->tail);
-  memcpy(p, &len, sizeof(uint64_t));
-  memcpy(p + sizeof(uint64_t), data, len);
+  uint64_t my = c->tail;
   c->tail = (c->tail + 1) % c->slots;
-  c->count++;
-  pthread_cond_signal(&c->not_empty);
+  c->claimed++;
+  SlotHdr* hdr = slot_hdr(h, my);
+  hdr->state = kWriting;
+  hdr->producer_pid = (uint64_t)getpid();
+  pthread_mutex_unlock(&c->mu);
+
+  // bulk copy outside the lock — concurrent producers copy in parallel
+  hdr->len = len;
+  memcpy(slot_data(h, my), data, len);
+
+  robust_lock(c);
+  hdr->state = kReady;
+  pthread_cond_broadcast(&c->not_empty);
   pthread_mutex_unlock(&c->mu);
   return 0;
 }
@@ -191,8 +220,9 @@ int64_t shmq_pop_timed(void* hv, void* out, uint64_t cap, int64_t timeout_ms) {
   Handle* h = (Handle*)hv;
   Ctrl* c = h->ctrl;
   robust_lock(c);
+  // single consumer: the head slot is ours once its producer commits READY
   if (timeout_ms < 0) {
-    while (c->count == 0 && !c->closed)
+    while (slot_hdr(h, c->head)->state != kReady && !c->closed)
       robust_cond_wait(&c->not_empty, c);
   } else {
     struct timespec ts;
@@ -203,9 +233,22 @@ int64_t shmq_pop_timed(void* hv, void* out, uint64_t cap, int64_t timeout_ms) {
       ts.tv_sec += 1;
       ts.tv_nsec -= 1000000000L;
     }
-    while (c->count == 0 && !c->closed) {
+    while (slot_hdr(h, c->head)->state != kReady && !c->closed) {
       if (robust_cond_timedwait(&c->not_empty, c, &ts) == ETIMEDOUT) {
-        if (c->count == 0) {
+        // a producer that died between claim and commit (no lock held, so
+        // EOWNERDEAD cannot fire) leaves the head slot kWriting forever:
+        // reclaim it — one lost in-flight batch, matching the pre-v2
+        // recovery semantics
+        SlotHdr* head_hdr = slot_hdr(h, c->head);
+        if (head_hdr->state == kWriting && head_hdr->producer_pid != 0 &&
+            kill((pid_t)head_hdr->producer_pid, 0) != 0 && errno == ESRCH) {
+          head_hdr->state = kEmpty;
+          c->head = (c->head + 1) % c->slots;
+          c->claimed--;
+          pthread_cond_signal(&c->not_full);
+          continue;
+        }
+        if (slot_hdr(h, c->head)->state != kReady) {
           int closed = c->closed;
           pthread_mutex_unlock(&c->mu);
           return closed ? -1 : -3;
@@ -214,20 +257,27 @@ int64_t shmq_pop_timed(void* hv, void* out, uint64_t cap, int64_t timeout_ms) {
       }
     }
   }
-  if (c->count == 0 && c->closed) {
+  if (slot_hdr(h, c->head)->state != kReady && c->closed) {
     pthread_mutex_unlock(&c->mu);
     return -1;
   }
-  uint8_t* p = slot_ptr(h, c->head);
-  uint64_t len;
-  memcpy(&len, p, sizeof(uint64_t));
+  uint64_t my = c->head;
+  SlotHdr* hdr = slot_hdr(h, my);
+  uint64_t len = hdr->len;
   if (len > cap) {
     pthread_mutex_unlock(&c->mu);
     return -2;
   }
-  memcpy(out, p + sizeof(uint64_t), len);
-  c->head = (c->head + 1) % c->slots;
-  c->count--;
+  pthread_mutex_unlock(&c->mu);
+
+  // bulk copy outside the lock; the slot cannot be reclaimed until we
+  // release it below (producers gate on `claimed`)
+  memcpy(out, slot_data(h, my), len);
+
+  robust_lock(c);
+  hdr->state = kEmpty;
+  c->head = (my + 1) % c->slots;
+  c->claimed--;
   pthread_cond_signal(&c->not_full);
   pthread_mutex_unlock(&c->mu);
   return (int64_t)len;
@@ -242,7 +292,7 @@ uint64_t shmq_slot_size(void* hv) { return ((Handle*)hv)->ctrl->slot_size; }
 uint64_t shmq_count(void* hv) {
   Handle* h = (Handle*)hv;
   robust_lock(h->ctrl);
-  uint64_t n = h->ctrl->count;
+  uint64_t n = h->ctrl->claimed;
   pthread_mutex_unlock(&h->ctrl->mu);
   return n;
 }

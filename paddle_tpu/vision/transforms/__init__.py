@@ -75,19 +75,47 @@ class Normalize(BaseTransform):
             out.astype(np.float32)
 
 
+def _resize_bilinear_np(arr, th, tw):
+    """Vectorized half-pixel bilinear resample on numpy (HWC)."""
+    h, w = arr.shape[:2]
+    a = arr.astype(np.float32)
+    ys = (np.arange(th, dtype=np.float32) + 0.5) * (h / th) - 0.5
+    xs = (np.arange(tw, dtype=np.float32) + 0.5) * (w / tw) - 0.5
+    y0 = np.clip(np.floor(ys), 0, h - 1).astype(np.int64)
+    x0 = np.clip(np.floor(xs), 0, w - 1).astype(np.int64)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
+    top = a[y0][:, x0] * (1 - wx) + a[y0][:, x1] * wx
+    bot = a[y1][:, x0] * (1 - wx) + a[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
 class Resize(BaseTransform):
     def __init__(self, size, interpolation="bilinear", keys=None):
         super().__init__(keys)
         self.size = (size, size) if isinstance(size, int) else tuple(size)
 
     def _apply_image(self, img):
+        # host-side bilinear: the input pipeline must never bounce per-sample
+        # work through the accelerator (PIL's C path for uint8, vectorized
+        # numpy otherwise)
         arr = _as_hwc(img)
-        import jax
-        import jax.numpy as jnp
-        out = jax.image.resize(jnp.asarray(arr),
-                               (self.size[0], self.size[1], arr.shape[2]),
-                               "bilinear")
-        return np.asarray(out).astype(arr.dtype)
+        th, tw = self.size
+        if arr.dtype == np.uint8:
+            try:
+                from PIL import Image
+                if arr.shape[2] in (1, 3, 4):
+                    mode_arr = arr[:, :, 0] if arr.shape[2] == 1 else arr
+                    out = np.asarray(Image.fromarray(mode_arr).resize(
+                        (tw, th), Image.BILINEAR))
+                    if out.ndim == 2:
+                        out = out[:, :, None]
+                    return out
+            except Exception:
+                pass
+        return _resize_bilinear_np(arr, th, tw).astype(arr.dtype)
 
 
 class CenterCrop(BaseTransform):
