@@ -38,25 +38,78 @@ class Strategy:
         self.recompute = type("rc", (), {"enable": False})()
 
 
-def plan_mesh(n_devices, strategy=None, n_params=None):
-    """Pick (dp, mp, sp) for the device count. Heuristic standing in for the
-    reference's cost-model Planner: fill user-pinned axes first, give the
-    remainder to dp (pure data parallelism is collective-cheapest on ICI);
-    very large models (>2B params) trade dp for mp before dp."""
+def estimate_step_cost(n_params, dp, mp, n_layers=None, hidden=None,
+                       batch_tokens=None, bytes_per_param=2,
+                       hbm_bytes=16e9, optimizer_state_mult=7.0):
+    """Per-step communication bytes + memory feasibility for a (dp, mp) split
+    — the quantitative core the reference spreads across
+    `auto_parallel/cost/{comm_op_cost,tensor_cost,estimate_cost}.py`.
+
+    - DP grad sync: ring all-reduce moves 2*(dp-1)/dp * M bytes per device.
+    - TP activation sync: Megatron inserts 2 all-reduces per layer in fwd and
+      2 in bwd, each of the full activation [batch_tokens, hidden].
+    - memory: params + grads + master/adam states, sharded over mp only
+      (dp replicates; ZeRO would divide further — the planner is conservative).
+
+    Returns (comm_bytes, fits_memory).
+    """
+    m_bytes = n_params * bytes_per_param
+    dp_comm = 2.0 * (dp - 1) / max(dp, 1) * m_bytes
+    tp_comm = 0.0
+    if mp > 1 and n_layers and hidden and batch_tokens:
+        act = batch_tokens * hidden * bytes_per_param
+        tp_comm = 4.0 * n_layers * 2.0 * (mp - 1) / mp * act
+    state_bytes = n_params * (bytes_per_param + optimizer_state_mult * 4) / mp
+    return dp_comm + tp_comm, state_bytes <= hbm_bytes
+
+
+def plan_mesh(n_devices, strategy=None, n_params=None, n_layers=None,
+              hidden=None, batch_tokens=None, hbm_bytes=16e9):
+    """Pick (dp, mp, sp) for the device count (ref Planner,
+    `auto_parallel/planner_v2.py` + cost model): honor user-pinned axes, then
+    enumerate divisor splits of the remainder and take the memory-feasible
+    split with the least estimated communication. Without model stats the
+    tie-break prefers pure dp (cheapest on ICI), trading dp for mp only when
+    the parameter+state footprint cannot fit one device's HBM."""
     s = strategy or Strategy()
-    mp = int(s.mp or 1)
+    mp_pinned = int(s.mp or 1) if s.mp and s.mp > 1 else None
     sp = int(s.sp or 1)
-    rest = n_devices // (mp * sp)
-    if rest * mp * sp != n_devices:
-        raise ValueError(
-            f"mp({mp}) x sp({sp}) does not divide device count {n_devices}")
     if s.dp is not None:
+        mp = mp_pinned or 1
         if s.dp * mp * sp != n_devices:
             raise ValueError("dp x mp x sp != device count")
         return dict(dp=s.dp, mp=mp, sp=sp)
-    if n_params and n_params > 2e9 and mp == 1 and rest % 2 == 0:
-        mp, rest = 2, rest // 2
-    return dict(dp=rest, mp=mp, sp=sp)
+    rest = n_devices // sp
+    if rest * sp != n_devices:
+        raise ValueError(f"sp({sp}) does not divide device count {n_devices}")
+    candidates = []
+    for mp in ([mp_pinned] if mp_pinned else
+               [d for d in range(1, rest + 1) if rest % d == 0]):
+        dp = rest // mp
+        if dp * mp != rest:
+            continue
+        have_stats = bool(n_params and n_layers and hidden and batch_tokens)
+        if n_params:
+            comm, fits = estimate_step_cost(
+                n_params, dp, mp, n_layers=n_layers, hidden=hidden,
+                batch_tokens=batch_tokens, hbm_bytes=hbm_bytes)
+        else:
+            comm, fits = float(mp), True
+        if not fits:
+            # nothing ideal: prefer the split closest to fitting (largest mp)
+            key = (1, -mp, comm)
+        elif have_stats:
+            key = (0, comm, mp)
+        else:
+            # without activation stats the TP comm term is unknowable —
+            # be conservative: smallest mp that fits memory wins
+            key = (0, mp, comm)
+        candidates.append((key, mp, dp))
+    if not candidates:
+        raise ValueError(
+            f"mp({mp_pinned}) x sp({sp}) does not divide {n_devices}")
+    _, mp, dp = min(candidates)
+    return dict(dp=dp, mp=mp, sp=sp)
 
 
 class Engine:

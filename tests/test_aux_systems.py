@@ -165,3 +165,40 @@ class TestEnvFlagWiring:
             capture_output=True, text=True, timeout=120)
         assert p.returncode == 0, p.stderr
         assert "env flag wired" in p.stdout
+
+
+class TestPlannerCostModel:
+    def test_small_model_prefers_pure_dp(self):
+        from paddle_tpu.distributed.auto_parallel import plan_mesh
+        assert plan_mesh(8, n_params=124e6) == dict(dp=8, mp=1, sp=1)
+
+    def test_memory_bound_model_grows_mp(self):
+        from paddle_tpu.distributed.auto_parallel import (
+            estimate_step_cost, plan_mesh)
+        # 3B params: bf16 + fp32 states ~= 90 GB, fits 16 GB HBM only at mp=8
+        comm, fits = estimate_step_cost(3e9, 8, 1)
+        assert not fits
+        plan = plan_mesh(8, n_params=3e9)
+        assert plan["mp"] > 1
+        _, fits_mp = estimate_step_cost(3e9, plan["dp"], plan["mp"])
+        assert fits_mp
+
+    def test_nothing_fits_picks_largest_mp(self):
+        from paddle_tpu.distributed.auto_parallel import plan_mesh
+        plan = plan_mesh(8, n_params=30e9)
+        assert plan["mp"] == 8, plan
+
+    def test_comm_cost_monotone_in_dp(self):
+        from paddle_tpu.distributed.auto_parallel import estimate_step_cost
+        c2, _ = estimate_step_cost(1e9, 2, 1)
+        c8, _ = estimate_step_cost(1e9, 8, 1)
+        assert c8 > c2
+
+    def test_pinned_axes_respected(self):
+        from paddle_tpu.distributed.auto_parallel import Strategy, plan_mesh
+        s = Strategy()
+        s.mp = 4
+        assert plan_mesh(8, strategy=s, n_params=1e9)["mp"] == 4
+        s2 = Strategy()
+        s2.dp, s2.mp, s2.sp = 2, 2, 2
+        assert plan_mesh(8, strategy=s2) == dict(dp=2, mp=2, sp=2)
