@@ -61,12 +61,27 @@ def export_protobuf(dir_name, worker_name=None):
     return export_chrome_tracing(dir_name)
 
 
+# host-side event aggregation feeding Profiler.summary() — the analog of the
+# reference's HostTracer ring buffers + profiler_statistic.py tables
+_host_events: dict = {}
+_collecting = False
+
+
+def _record_host_event(name, seconds):
+    if not _collecting:
+        return
+    cnt, total, mx = _host_events.get(name, (0, 0.0, 0.0))
+    _host_events[name] = (cnt + 1, total + seconds, max(mx, seconds))
+
+
 class RecordEvent:
-    """Host-side named range (≈ platform::RecordEvent -> TraceMe)."""
+    """Host-side named range (≈ platform::RecordEvent -> TraceMe); durations
+    also feed the host statistics table while a Profiler is active."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ctx = None
+        self._t0 = None
 
     def __enter__(self):
         self.begin()
@@ -76,6 +91,7 @@ class RecordEvent:
         self.end()
 
     def begin(self):
+        self._t0 = time.perf_counter()
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
 
@@ -83,6 +99,9 @@ class RecordEvent:
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
+        if self._t0 is not None:
+            _record_host_event(self.name, time.perf_counter() - self._t0)
+            self._t0 = None
 
 
 class Profiler:
@@ -113,6 +132,9 @@ class Profiler:
         self.stop()
 
     def start(self):
+        global _collecting
+        _collecting = True
+        _host_events.clear()
         self._last_step_time = time.perf_counter()
         if self._timer_only:
             return
@@ -126,6 +148,8 @@ class Profiler:
             self._running = False
 
     def stop(self):
+        global _collecting
+        _collecting = False
         if self._running:
             try:
                 jax.profiler.stop_trace()
@@ -177,3 +201,53 @@ def load_profiler_result(path):
     raise NotImplementedError(
         "TPU traces are XPlane directories; open them with TensorBoard's "
         "profile plugin")
+
+
+def _fmt_time(seconds):
+    if seconds >= 1.0:
+        return f"{seconds:.3f}s"
+    if seconds >= 1e-3:
+        return f"{seconds * 1e3:.2f}ms"
+    return f"{seconds * 1e6:.1f}us"
+
+
+class SummaryTable:
+    """Aggregated host-event statistics (ref `profiler_statistic.py`'s event
+    summary tables): one row per RecordEvent name."""
+
+    def __init__(self, events, step_times):
+        self.rows = sorted(
+            ((name, cnt, total, total / cnt, mx)
+             for name, (cnt, total, mx) in events.items()),
+            key=lambda r: -r[2])
+        self.step_times = [t for t, _ in step_times]
+
+    def __str__(self):
+        lines = []
+        if self.step_times:
+            ts = self.step_times
+            lines.append(
+                f"steps: {len(ts)}  avg {_fmt_time(sum(ts) / len(ts))}  "
+                f"min {_fmt_time(min(ts))}  max {_fmt_time(max(ts))}")
+        if self.rows:
+            name_w = max(len("event"), *(len(r[0]) for r in self.rows))
+            lines.append(f"{'event'.ljust(name_w)}  {'count':>7}  "
+                         f"{'total':>10}  {'avg':>10}  {'max':>10}")
+            for name, cnt, total, avg, mx in self.rows:
+                lines.append(
+                    f"{name.ljust(name_w)}  {cnt:>7}  "
+                    f"{_fmt_time(total):>10}  {_fmt_time(avg):>10}  "
+                    f"{_fmt_time(mx):>10}")
+        return "\n".join(lines) or "(no host events recorded)"
+
+
+def _profiler_summary(self, sorted_by=None, op_detail=False, thread_sep=False,
+                      time_unit="ms", views=None):
+    """Print + return the host-event statistics table
+    (ref `paddle.profiler.Profiler.summary`)."""
+    table = SummaryTable(dict(_host_events), self._step_times)
+    print(table)
+    return table
+
+
+Profiler.summary = _profiler_summary

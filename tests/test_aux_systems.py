@@ -202,3 +202,23 @@ class TestPlannerCostModel:
         s2 = Strategy()
         s2.dp, s2.mp, s2.sp = 2, 2, 2
         assert plan_mesh(8, strategy=s2) == dict(dp=2, mp=2, sp=2)
+
+
+class TestProfilerSummary:
+    def test_host_event_table(self):
+        import time as _time
+        import paddle_tpu.profiler as prof
+        p = prof.Profiler(timer_only=True)
+        p.start()
+        for _ in range(2):
+            with prof.RecordEvent("fwd"):
+                _time.sleep(0.005)
+            with prof.RecordEvent("bwd"):
+                _time.sleep(0.01)
+            p.step()
+        p.stop()
+        table = p.summary()
+        s = str(table)
+        assert "fwd" in s and "bwd" in s and "steps: 2" in s
+        # sorted by total time desc: bwd first
+        assert table.rows[0][0] == "bwd" and table.rows[0][1] == 2
