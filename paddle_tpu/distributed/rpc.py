@@ -8,9 +8,20 @@ JSON-length-prefixed pickle protocol: every worker runs a daemon server
 thread; calls pickle (fn, args, kwargs), the callee executes and ships the
 result back. The master (worker 0 or an external store) performs name →
 (host, port) rendezvous exactly like the reference's KVStore handshake.
+
+Security model: RPC executes pickled callables, so it is for TRUSTED cluster
+networks only (the same assumption as the reference's brpc agents). Defense
+in depth: the agent binds the advertised interface (not 0.0.0.0), and every
+connection must open with a 32-byte shared-secret digest — set
+``PADDLE_RPC_TOKEN`` to a cluster secret, else one is derived from the master
+endpoint (which only guards against accidental cross-job connections, not an
+attacker on the same network) — before any pickle is read off the wire.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac
+import os
 import pickle
 import socket
 import struct
@@ -32,6 +43,27 @@ class WorkerInfo:
 
 
 _state = None
+_AUTH_LEN = 32
+
+
+def _auth_token(master_endpoint: str) -> bytes:
+    secret = os.environ.get("PADDLE_RPC_TOKEN") or f"pt-rpc:{master_endpoint}"
+    return hashlib.sha256(secret.encode()).digest()
+
+
+def _check_auth(conn, token: bytes) -> bool:
+    """Read exactly the 32-byte preamble and compare; nothing is unpickled
+    from an unauthenticated peer."""
+    got = b""
+    try:
+        while len(got) < _AUTH_LEN:
+            chunk = conn.recv(_AUTH_LEN - len(got))
+            if not chunk:
+                return False
+            got += chunk
+    except OSError:
+        return False
+    return hmac.compare_digest(got, token)
 
 
 def _advertise_ip(master_ip):
@@ -72,9 +104,10 @@ def _recv_msg(sock):
 
 
 class _Server(threading.Thread):
-    def __init__(self, sock):
+    def __init__(self, sock, token):
         super().__init__(daemon=True)
         self._sock = sock
+        self._token = token
         self._stop = threading.Event()
 
     def run(self):
@@ -92,6 +125,10 @@ class _Server(threading.Thread):
     def _serve(self, conn):
         try:
             with conn:
+                conn.settimeout(10)
+                if not _check_auth(conn, self._token):
+                    return
+                conn.settimeout(None)
                 while True:
                     msg = _recv_msg(conn)
                     kind = msg[0]
@@ -121,7 +158,8 @@ class _RpcState:
         self.name = name
         self.rank = rank
         self.world_size = world_size
-        self.server = _Server(server_sock)
+        self.token = _auth_token(master_addr)
+        self.server = _Server(server_sock, self.token)
         self.server.start()
         self.master_addr = master_addr
         self.workers: dict[str, WorkerInfo] = {}
@@ -132,14 +170,18 @@ class _RpcState:
 
     def connect(self, to: str):
         """Returns (socket, per-peer lock): calls to different peers run
-        concurrently; calls to one peer serialize on its connection."""
+        concurrently; calls to one peer serialize on its connection. Dial +
+        handshake happen under the PEER lock only, so one unreachable peer
+        cannot stall calls to healthy ones."""
         with self._conn_lock:
+            lock = self._peer_locks.setdefault(to, threading.Lock())
+        with lock:
             if to not in self._conns:
                 wi = self.workers[to]
                 s = socket.create_connection((wi.ip, wi.port), timeout=60)
+                s.sendall(self.token)
                 self._conns[to] = s
-                self._peer_locks[to] = threading.Lock()
-            return self._conns[to], self._peer_locks[to]
+        return self._conns[to], lock
 
 
 def _master_rendezvous(state, ip, port, master_ip, master_port):
@@ -154,7 +196,19 @@ def _master_rendezvous(state, ip, port, master_ip, master_port):
         infos = {me.name: me}
         conns = []
         while len(infos) < state.world_size:
-            conn, _ = reg.accept()
+            conn, peer = reg.accept()
+            conn.settimeout(10)
+            if not _check_auth(conn, state.token):
+                # loud: a token mismatch (different PADDLE_RPC_TOKEN or a
+                # differently-spelled master endpoint) would otherwise hang
+                # rendezvous with zero diagnostics
+                import logging
+                logging.getLogger("paddle_tpu.rpc").warning(
+                    "rpc rendezvous: rejected unauthenticated peer %s "
+                    "(PADDLE_RPC_TOKEN / master endpoint mismatch?)", peer)
+                conn.close()
+                continue
+            conn.settimeout(None)
             wi = _recv_msg(conn)
             infos[wi.name] = wi
             conns.append(conn)
@@ -174,6 +228,7 @@ def _master_rendezvous(state, ip, port, master_ip, master_port):
         else:
             raise ConnectionError("cannot reach rpc master")
         with s:
+            s.sendall(state.token)
             _send_msg(s, me)
             state.workers = _recv_msg(s)
 
@@ -195,12 +250,23 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         master_endpoint = os.environ.get("PADDLE_MASTER_ENDPOINT",
                                          "127.0.0.1:29531")
     master_ip, master_port = master_endpoint.rsplit(":", 1)
+    ip = _advertise_ip(master_ip)
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("0.0.0.0", 0))
+    # bind the advertised interface only — the agent executes pickled
+    # callables and must not listen on every interface
+    try:
+        srv.bind((ip, 0))
+    except OSError:
+        # _advertise_ip's DNS fallback can return a non-local address
+        # (stale /etc/hosts, NAT); the auth preamble still gates every
+        # connection, so a wildcard bind is an acceptable last resort
+        import logging
+        logging.getLogger("paddle_tpu.rpc").warning(
+            "rpc: cannot bind advertised ip %s, falling back to 0.0.0.0", ip)
+        srv.bind(("0.0.0.0", 0))
     srv.listen(64)
     port = srv.getsockname()[1]
-    ip = _advertise_ip(master_ip)
     _state = _RpcState(name, rank, world_size, srv, master_endpoint)
     _master_rendezvous(_state, ip, port, master_ip, int(master_port) + 1)
     return get_current_worker_info()
@@ -299,18 +365,3 @@ def shutdown():
     _state.server.stop()
     _state.pool.shutdown(wait=False)
     _state = None
-
-
-def _advertise_ip(master_ip):
-    """The address peers should dial: loopback for single-host jobs, else the
-    interface that routes to the master (multi-host)."""
-    if master_ip in ("127.0.0.1", "localhost"):
-        return "127.0.0.1"
-    probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-    try:
-        probe.connect((master_ip, 9))  # no traffic sent for UDP connect
-        return probe.getsockname()[0]
-    except OSError:
-        return socket.gethostbyname(socket.gethostname())
-    finally:
-        probe.close()

@@ -108,6 +108,11 @@ define_flag("tpu_donate_buffers", True,
 define_flag("tpu_fused_optimizer", True,
             "multi-tensor optimizer path: one fused update over concatenated "
             "flat param/state buffers per dtype group (ref fused adam kernels)")
+define_flag("dataloader_mp_method", "spawn",
+            "multiprocessing start method for DataLoader workers: spawn "
+            "(default — fork is unsafe under the multithreaded JAX runtime) "
+            "| forkserver | fork (requires a single-threaded parent; kept "
+            "for unpicklable datasets at the caller's risk)")
 define_flag("tpu_flash_impl", "auto",
             "flash-attention backend: auto | splash (Pallas splash kernel) | "
             "mosaic (jax-bundled Pallas flash) | authored (in-repo Pallas "

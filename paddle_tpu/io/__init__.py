@@ -12,8 +12,10 @@ import bisect
 import itertools
 import math
 import multiprocessing as mp
+import os as _os
 import queue as queue_mod
 import threading
+import time
 
 import numpy as np
 
@@ -380,7 +382,28 @@ class DataLoader:
             yield self.collate_fn(batch)
 
     def _iter_multiprocess(self):
-        ctx = mp.get_context("fork")
+        # spawn, not fork: the parent runs a multithreaded JAX runtime and
+        # os.fork() from it can deadlock (CPython RuntimeWarning). Workers
+        # only produce numpy batches, so a fresh interpreter is safe; the
+        # cost is that dataset/collate_fn must be picklable (same contract
+        # as the reference's spawn mode, fluid/dataloader/dataloader_iter.py).
+        from paddle_tpu.framework.flags import flag_value
+        method = flag_value("dataloader_mp_method")
+        if method != "fork":
+            import sys as _sys
+            main_file = getattr(_sys.modules.get("__main__"), "__file__", None)
+            if main_file is not None and main_file.startswith("<"):
+                # spawn bootstrap re-runs the parent's __main__ by path; a
+                # pseudo-file parent ("<stdin>" heredoc) has none, so workers
+                # would die at startup — fork is the only viable context
+                # there. Real paths (including zipapp members) stay on spawn.
+                import warnings
+                warnings.warn(
+                    "DataLoader: parent __main__ is not a re-importable file"
+                    f" ({main_file!r}); falling back to fork workers",
+                    RuntimeWarning)
+                method = "fork"
+        ctx = mp.get_context(method)
         index_queue = ctx.Queue()
         shmq = None
         if self.use_shared_memory:
@@ -409,16 +432,41 @@ class DataLoader:
             workers.append(w)
 
         def get_result():
-            if shmq is None:
-                return data_queue.get(
-                    timeout=self.timeout if self.timeout else None)
-            from paddle_tpu.io.native_queue import decode_batch
-            seq, data, err = decode_batch(shmq.pop(
-                timeout=self.timeout if self.timeout else None))
-            if err is not None:
-                import pickle as _p
-                err = _p.loads(err)
-            return seq, data, err
+            # bounded waits so a crashed worker pool raises instead of
+            # hanging the consumer forever (e.g. spawn bootstrap failures)
+            deadline = (time.monotonic() + self.timeout) if self.timeout \
+                else None
+            while True:
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise TimeoutError("DataLoader timed out")
+                    wait = min(1.0, left)
+                else:
+                    wait = 1.0
+                if shmq is None:
+                    try:
+                        return data_queue.get(timeout=wait)
+                    except queue_mod.Empty:
+                        pass
+                else:
+                    from paddle_tpu.io.native_queue import decode_batch
+                    try:
+                        raw = shmq.pop(timeout=wait)
+                    except TimeoutError:
+                        raw = None
+                    if raw is not None:
+                        seq, data, err = decode_batch(raw)
+                        if err is not None:
+                            import pickle as _p
+                            err = _p.loads(err)
+                        return seq, data, err
+                if all(not w.is_alive() for w in workers):
+                    codes = [w.exitcode for w in workers]
+                    raise RuntimeError(
+                        "DataLoader workers exited unexpectedly (exitcodes "
+                        f"{codes}); if the parent has no importable __main__ "
+                        "set FLAGS_dataloader_mp_method=fork")
 
         try:
             batches = list(self.batch_sampler)

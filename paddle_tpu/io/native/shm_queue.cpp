@@ -217,14 +217,19 @@ int shmq_push(void* hv, const void* data, uint64_t len) {
 // timeout_ms < 0 waits forever. Python polls with short timeouts so
 // KeyboardInterrupt and DataLoader(timeout=...) both work.
 int64_t shmq_pop_timed(void* hv, void* out, uint64_t cap, int64_t timeout_ms) {
+  if (timeout_ms < 0) {
+    // infinite wait = loop over short timed waits so dead-producer
+    // reclamation (below) runs on this path too; -3 never escapes
+    for (;;) {
+      int64_t r = shmq_pop_timed(hv, out, cap, 200);
+      if (r != -3) return r;
+    }
+  }
   Handle* h = (Handle*)hv;
   Ctrl* c = h->ctrl;
   robust_lock(c);
   // single consumer: the head slot is ours once its producer commits READY
-  if (timeout_ms < 0) {
-    while (slot_hdr(h, c->head)->state != kReady && !c->closed)
-      robust_cond_wait(&c->not_empty, c);
-  } else {
+  {
     struct timespec ts;
     clock_gettime(CLOCK_REALTIME, &ts);
     ts.tv_sec += timeout_ms / 1000;

@@ -1,0 +1,21 @@
+"""Backend probe shared by the authored Pallas kernels."""
+from __future__ import annotations
+
+
+def default_interpret() -> bool:
+    """True when pallas_call must run in interpreter mode.
+
+    Any non-TPU backend interprets; so does the experimental 'axon' dev
+    tunnel, which reports platform "tpu" but cannot lower Mosaic (trace-time
+    RecursionError). Probe by backend NAME only — executing an op to find out
+    poisons a tunnel's stream (same rule as fft._fft_on_device).
+    """
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return True
+    try:
+        from jax._src import xla_bridge
+        return "axon" in xla_bridge.backends()
+    except Exception:
+        return False

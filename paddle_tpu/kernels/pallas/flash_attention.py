@@ -27,15 +27,18 @@ NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
-                block_k, seq_k):
+                block_k, seq_q, seq_k):
     # q_ref: [1, block_q, D]; k_ref/v_ref: [1, seq_k, D]; o_ref: [1, block_q, D]
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale
 
     num_kb = pl.cdiv(seq_k, block_k)
     if causal:
-        # blocks strictly above the diagonal contribute nothing
-        last = ((qi + 1) * block_q + block_k - 1) // block_k
+        # bottom-right-aligned diagonal (matches _reference's tril k=sk-sq):
+        # row qpos may attend kpos <= qpos + (seq_k - seq_q). Blocks fully
+        # above that line contribute nothing.
+        off = seq_k - seq_q
+        last = ((qi + 1) * block_q - 1 + off) // block_k + 1
         num_kb = jnp.minimum(num_kb, last)
 
     def body(j, carry):
@@ -50,7 +53,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            mask &= kpos <= qpos
+            mask &= kpos <= qpos + (seq_k - seq_q)
         s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -81,7 +84,8 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     sk_pad = sk + pad_k
     grid = (bh, pl.cdiv(sq, block_q))
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                             block_q=block_q, block_k=block_k, seq_k=sk)
+                             block_q=block_q, block_k=block_k, seq_q=sq,
+                             seq_k=sk)
     return pl.pallas_call(
         kern,
         grid=grid,
@@ -136,9 +140,17 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None, block_q=128,
     on the CPU mesh; on TPU the kernel compiles through Mosaic.
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from paddle_tpu.kernels.pallas._compat import default_interpret
+        interpret = default_interpret()
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    if causal and sq > sk:
+        # bottom-right alignment leaves rows with no visible keys; the
+        # reference math degenerates to a uniform softmax over -1e30 scores
+        # there, which a streaming kernel cannot reproduce blockwise
+        raise NotImplementedError(
+            "causal flash_attention requires seq_q <= seq_k "
+            f"(got {sq} > {sk})")
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
     qf = q.reshape(b * h, sq, d)

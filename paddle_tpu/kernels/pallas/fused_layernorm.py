@@ -131,7 +131,8 @@ def fused_layer_norm(x, gamma, beta, eps=1e-5, *, block_rows=256,
                      interpret=None):
     """Fused layernorm over the last axis. x: [..., D] jax array."""
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from paddle_tpu.kernels.pallas._compat import default_interpret
+        interpret = default_interpret()
     shape = x.shape
     d = shape[-1]
     out = _ln(x.reshape(-1, d), gamma, beta, float(eps), int(block_rows),

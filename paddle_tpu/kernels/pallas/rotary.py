@@ -38,7 +38,8 @@ def apply_rotary_emb(q, k, cos, sin, *, block_s=256, interpret=None):
     q/k: [B, H, S, D]; cos/sin: [S, D/2]. Returns (q_rot, k_rot).
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from paddle_tpu.kernels.pallas._compat import default_interpret
+        interpret = default_interpret()
     b, h, s, d = q.shape
     block_s = min(block_s, s)
     qf = q.reshape(b * h, s, d)

@@ -93,6 +93,14 @@ class _SquareDs(Dataset):
                 np.array([i * i], np.int64))
 
 
+class _BadDs(_SquareDs):
+    # module-level so it pickles under the spawn worker context
+    def __getitem__(self, i):
+        if i == 13:
+            raise RuntimeError("boom-13")
+        return super().__getitem__(i)
+
+
 class TestDataLoaderShm:
     def test_multiworker_shm_delivers_all_batches_in_order(self):
         ds = _SquareDs()
@@ -108,13 +116,7 @@ class TestDataLoaderShm:
         np.testing.assert_array_equal(y, np.arange(64) ** 2)
 
     def test_worker_error_propagates(self):
-        class Bad(_SquareDs):
-            def __getitem__(self, i):
-                if i == 13:
-                    raise RuntimeError("boom-13")
-                return super().__getitem__(i)
-
-        dl = DataLoader(Bad(), batch_size=8, num_workers=2,
+        dl = DataLoader(_BadDs(), batch_size=8, num_workers=2,
                         use_shared_memory=True)
         with pytest.raises(RuntimeError, match="boom-13"):
             for _ in dl:

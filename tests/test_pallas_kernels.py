@@ -40,6 +40,24 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out).reshape(b * h, s, d),
                                    np.asarray(ref), rtol=2e-5, atol=2e-5)
 
+    def test_causal_cross_lengths_bottom_right(self):
+        # sq < sk (decode-with-kv-cache shape): mask must be bottom-right
+        # aligned so the LAST query row sees the full key prefix, matching
+        # _reference's tril(k=sk-sq)
+        b, h, sq, sk, d = 1, 2, 4, 64, 16
+        q = jnp.asarray(R.randn(b, h, sq, d).astype(np.float32))
+        k = jnp.asarray(R.randn(b, h, sk, d).astype(np.float32))
+        v = jnp.asarray(R.randn(b, h, sk, d).astype(np.float32))
+        out = flash_attention(q, k, v, causal=True, block_q=4, block_k=16)
+        ref = _reference(q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+                         v.reshape(b * h, sk, d), 1 / np.sqrt(d), True)
+        np.testing.assert_allclose(np.asarray(out).reshape(b * h, sq, d),
+                                   np.asarray(ref), rtol=2e-5, atol=2e-5)
+        # forward must now agree with the function the recompute-VJP
+        # backward differentiates (the round-2 advisor divergence)
+        with pytest.raises(NotImplementedError):
+            flash_attention(k, q, v[:, :, :sq, :], causal=True)  # sq > sk
+
     def test_grads_match_reference(self):
         q, k, v = _qkv(b=1, h=2, s=32, d=16)
         b, h, s, d = q.shape

@@ -80,3 +80,32 @@ def test_rpc_two_process():
             p.terminate()
     assert results.get(0) == "ok", results.get(0)
     assert results.get(1) == "ok", results.get(1)
+
+
+@pytest.mark.timeout(60)
+def test_unauthenticated_peer_rejected():
+    """A peer without the shared token must get nothing unpickled/executed."""
+    import socket
+    import struct
+    import pickle
+    from paddle_tpu.distributed import rpc
+
+    rpc.init_rpc("solo", rank=0, world_size=1,
+                 master_endpoint="127.0.0.1:29871")
+    try:
+        me = rpc.get_current_worker_info()
+        s = socket.create_connection((me.ip, me.port), timeout=5)
+        # wrong 32-byte preamble, then a well-formed call frame
+        s.sendall(b"\x00" * 32)
+        payload = pickle.dumps(("call", _boom, (), {}))
+        try:
+            s.sendall(struct.pack("<Q", len(payload)) + payload)
+            s.settimeout(5)
+            got = s.recv(1)
+        except OSError:
+            got = b""
+        assert got == b""  # server closed without replying or executing
+        # an authenticated client still works
+        assert rpc.rpc_sync("solo", _sq, args=(6,)) == 36
+    finally:
+        rpc.shutdown()
