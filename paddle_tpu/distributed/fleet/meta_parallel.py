@@ -242,15 +242,51 @@ class SharedLayerDesc(LayerDesc):
 
 
 class SegmentLayers:
-    """ref `pp_layers.py:93` — uniform / param-count segmentation."""
+    """ref `pp_layers.py:93` — uniform / param-count / layer-class / manual
+    segmentation. ``method`` may also be an explicit bounds list
+    ``[0, ..., n]`` of length num_parts+1 (the reference's manual mode)."""
 
     def __init__(self, layers, num_parts, method="uniform"):
         self.layers = layers
         self.num_parts = num_parts
         self.method = method
 
+    def _param_counts(self):
+        counts = []
+        for l in self.layers:
+            if isinstance(l, LayerDesc):
+                counts.append(1)        # lazy descs: fall back to uniform weight
+            else:
+                ps = getattr(l, "parameters", None)
+                counts.append(sum(int(np.prod(p.shape)) for p in ps())
+                              if callable(ps) else 0)
+        return counts
+
     def do_segment(self):
         n = len(self.layers)
+        if isinstance(self.method, (list, tuple)):
+            bounds = [int(b) for b in self.method]
+            if (len(bounds) != self.num_parts + 1 or bounds[0] != 0
+                    or bounds[-1] != n
+                    or any(a > b for a, b in zip(bounds, bounds[1:]))):
+                raise ValueError(
+                    f"manual segment bounds {bounds} must be monotonic, "
+                    f"length {self.num_parts + 1}, spanning [0, {n}]")
+            return bounds
+        if self.method == "param":
+            # greedy: cut where cumulative param count crosses each 1/P mark
+            counts = self._param_counts()
+            total = max(sum(counts), 1)
+            bounds = [0]
+            acc = 0
+            for i, c in enumerate(counts):
+                acc += c
+                if (len(bounds) < self.num_parts
+                        and acc >= total * len(bounds) / self.num_parts):
+                    bounds.append(i + 1)
+            while len(bounds) <= self.num_parts:
+                bounds.append(n)
+            return bounds[: self.num_parts + 1]
         if self.method == "uniform":
             base = n // self.num_parts
             extra = n % self.num_parts
@@ -317,6 +353,7 @@ class PipelineLayer(Layer):
             [l for l, _ in built], self._num_stages, seg_method).do_segment()
         self._recompute_interval = recompute_interval
         self._pp_micro = micro_batches
+        self._pp_chunks = int(num_virtual_pipeline_stages or 1)
         self._pp_mode = False
         if self._num_stages > 1 and _mesh_axis_size("pp") == self._num_stages:
             self._init_spmd_pipeline(built)
@@ -367,17 +404,29 @@ class PipelineLayer(Layer):
             i = max(j, i + 1)
         start, end = best
         run_len = end - start
-        per = run_len // self._num_stages
+        s_total = self._num_stages * self._pp_chunks
+        per = run_len // s_total
         if per == 0:
-            return                                  # fall back to sequential
+            if self._pp_chunks > 1:                 # too few layers to chunk
+                self._pp_chunks = 1
+                s_total = self._num_stages
+                per = run_len // s_total
+            if per == 0:
+                return                              # fall back to sequential
         # trim a non-divisible remainder into the sequential prefix
-        start = start + (run_len - per * self._num_stages)
-        end = start + per * self._num_stages
+        start = start + (run_len - per * s_total)
+        end = start + per * s_total
         mesh = get_mesh()
+        # rank-major stacking: rank r's local block holds its V chunks in
+        # order, chunk c of rank r = LOGICAL stage c*S + r (see
+        # spmd_pipeline_interleaved's layout contract)
         trees = []
-        for s in range(self._num_stages):
-            seg = built[start + s * per: start + (s + 1) * per]
-            trees.append([p._data for l, _ in seg for p in l.parameters()])
+        for r in range(self._num_stages):
+            for c in range(self._pp_chunks):
+                ls = c * self._num_stages + r
+                seg = built[start + ls * per: start + (ls + 1) * per]
+                trees.append([p._data for l, _ in seg
+                              for p in l.parameters()])
         stacked = stack_stage_params(trees, mesh)
         self._pp_run = (start, end)
         self._pp_per_stage = per
@@ -404,22 +453,48 @@ class PipelineLayer(Layer):
 
     def _run_spmd_pipeline(self, x):
         from paddle_tpu.core.autograd import apply
-        from paddle_tpu.distributed.fleet.pipeline import spmd_pipeline
+        from paddle_tpu.distributed.fleet.pipeline import (
+            spmd_pipeline_interleaved)
         mesh = get_mesh()
         tpl_params = self._pp_template_params
         tpl = self._pp_template
         n_micro = self._pp_micro or 1
         n_stages = self._num_stages
+        n_chunks = self._pp_chunks
+        # dropout inside stage bodies: draw ONE base key per step from a
+        # DEDICATED pipeline generator (seeded off the global seed, the
+        # reference's named-RNG-state pattern, `mpu/random.py:34` /
+        # `model_parallel_random_seed`) and let the engine fold
+        # (logical stage, microbatch) into it — nn.Dropout then works in
+        # stages, deterministically in model position. A separate stream
+        # keeps the GLOBAL generator untouched, so dropout-free pipelined
+        # models consume exactly the same global draws as serial execution.
+        use_rng = self.training
+        if use_rng and not hasattr(self, "_pp_generator"):
+            from paddle_tpu.ops import random as rnd
+            from paddle_tpu.ops.random import Generator
+            self._pp_generator = Generator(
+                rnd._default_generator.initial_seed() + 2718)
+            with jax.ensure_compile_time_eval():
+                self._pp_generator._state  # materialize concretely, even if
+                # the first pipelined call happens inside a to_static trace
         cache_key = (tuple(mesh.axis_names), tuple(mesh.shape.items()),
                      tuple(d.id for d in mesh.devices.flat),
-                     n_micro, self.training)
+                     n_micro, n_chunks, self.training)
         cache = getattr(self, "_pp_prim_cache", None)
         if cache is None:
             cache = self._pp_prim_cache = {}
+
+        def _dispatch(jitted):
+            args = list(self._pp_stacked) + [ensure_tensor(x)]
+            if use_rng:
+                kd = jax.random.key_data(self._pp_generator.next_key())
+                args.append(Tensor(kd, _internal=True))
+            return apply(jitted, *args, op_name="spmd_pipeline")
+
         jitted = cache.get(cache_key)
         if jitted is not None:
-            return apply(jitted, *self._pp_stacked, ensure_tensor(x),
-                         op_name="spmd_pipeline")
+            return _dispatch(jitted)
 
         # template layers are unregistered, so train()/eval() doesn't reach
         # them — sync mode explicitly before tracing
@@ -428,18 +503,25 @@ class PipelineLayer(Layer):
         use_remat = bool(self._recompute_interval) and self.training
 
         def prim(*arrays):
-            *stacked, xa = arrays
+            if use_rng:
+                *stacked, xa, kd = arrays
+                base_key = jax.random.wrap_key_data(kd)
+            else:
+                *stacked, xa = arrays
+                base_key = None
 
-            def stage_fn(local, xm):
+            def stage_fn(local, xm, key=None):
                 from paddle_tpu.distributed.fleet.pipeline import (
-                    template_rng_guard)
+                    functional_rng, template_rng_guard)
                 saved = [(t._data, t._grad_node, t._out_slot)
                          for t in tpl_params]
                 for t, a in zip(tpl_params, local):
                     t._data = a
                     t._grad_node = None
+                ctx = (functional_rng(key) if key is not None else
+                       template_rng_guard("the SPMD pipeline stage body"))
                 try:
-                    with template_rng_guard("the SPMD pipeline stage body"):
+                    with ctx:
                         out = Tensor(xm, _internal=True)
                         for layer, ffunc in tpl:
                             out = ffunc(layer, out) if ffunc is not None \
@@ -453,16 +535,16 @@ class PipelineLayer(Layer):
 
             if use_remat:
                 stage_fn = jax.checkpoint(stage_fn)
-            return spmd_pipeline(stage_fn, n_stages, n_micro, list(stacked),
-                                 xa, mesh)
+            return spmd_pipeline_interleaved(
+                stage_fn, n_stages, n_chunks, n_micro, list(stacked), xa,
+                mesh, rng_key=base_key)
 
         # jit so the partial-manual shard_map sees a compiled context even
         # when the surrounding step runs eagerly (sharding inference for the
         # non-manual axes needs it); cached per (mesh, n_micro, mode)
         jitted = jax.jit(prim)
         cache[cache_key] = jitted
-        return apply(jitted, *self._pp_stacked, ensure_tensor(x),
-                     op_name="spmd_pipeline")
+        return _dispatch(jitted)
 
     def forward(self, x):
         from paddle_tpu.distributed.fleet.recompute import recompute

@@ -55,24 +55,78 @@ def template_rng_guard(what):
         rnd._default_generator = prev
 
 
-def spmd_pipeline(stage_fn, n_stages, n_micro, stacked_params, x, mesh):
+@contextlib.contextmanager
+def functional_rng(key):
+    """Install a functional generator (ops/random.FunctionalGenerator) so
+    nn.Dropout works inside pipeline stage / expert bodies: draws fold a
+    deterministic per-call counter into ``key`` instead of mutating global
+    state (the TPU answer to the reference's RNGStatesTracker,
+    `fleet/layers/mpu/random.py:34` — placement-independent by construction)."""
+    from paddle_tpu.ops import random as rnd
+    prev = rnd._default_generator
+    rnd._default_generator = rnd.FunctionalGenerator(key)
+    try:
+        yield
+    finally:
+        rnd._default_generator = prev
+
+
+def stage_rng_key(base_key, logical_stage, micro):
+    """The per-(logical stage, microbatch) dropout key. ONE derivation shared
+    by the SPMD engine and the serial oracle, so RNG is a function of model
+    position — not of how the pipeline is partitioned."""
+    import jax.random as jrandom
+    return jrandom.fold_in(jrandom.fold_in(base_key, logical_stage), micro)
+
+
+def spmd_pipeline(stage_fn, n_stages, n_micro, stacked_params, x, mesh,
+                  rng_key=None):
     """Pure-jax GPipe over the 'pp' axis — the single-chunk case of
     :func:`spmd_pipeline_interleaved`.
 
-    stage_fn(local_param_arrays, x_micro) -> y_micro  (shape-preserving)
+    stage_fn(local_param_arrays, x_micro) -> y_micro  (shape-preserving);
+    with ``rng_key`` it is called as stage_fn(params, x_micro, key).
     stacked_params: list of arrays [n_stages, ...] (leading axis = stage id)
     x: [B, ...] full batch; B must divide into n_micro micro-batches.
     Returns [B, ...] outputs of the LAST stage, replicated over 'pp'.
     """
     return spmd_pipeline_interleaved(stage_fn, n_stages, 1, n_micro,
-                                     stacked_params, x, mesh)
+                                     stacked_params, x, mesh,
+                                     rng_key=rng_key)
+
+
+def pipeline_serial_reference(stage_fn, s_total, n_micro, logical_params, x,
+                              rng_key=None):
+    """Single-device oracle computing EXACTLY the function the SPMD engine
+    computes (same microbatching, same `stage_rng_key` derivation) — the
+    parity reference for tests and the multichip dryrun.
+
+    logical_params: arrays with leading axis s_total in LOGICAL stage order
+    (the engine instead wants rank-major, see spmd_pipeline_interleaved).
+    """
+    B = x.shape[0]
+    mb = B // n_micro
+    xm = x.reshape((n_micro, mb) + x.shape[1:])
+    outs = []
+    for m in range(n_micro):
+        h = xm[m]
+        for s in range(s_total):
+            local = [p[s] for p in logical_params]
+            if rng_key is None:
+                h = stage_fn(local, h)
+            else:
+                h = stage_fn(local, h, stage_rng_key(rng_key, s, m))
+        outs.append(h)
+    return jnp.concatenate(outs, axis=0)
 
 
 def stack_stage_params(per_stage_param_trees, mesh):
     """[stage][i] -> list of stacked arrays [n_stages, ...] placed on 'pp'.
 
     per_stage_param_trees: list (one per stage) of equal-length lists of
-    jax arrays in matching order/shapes.
+    jax arrays in matching order/shapes. A source param already carrying a
+    NamedSharding (e.g. the mpu layers' 'mp' placements) keeps its spec with
+    'pp' prepended, so pipeline and tensor parallelism compose in one mesh.
     """
     n = len(per_stage_param_trees)
     ref0 = per_stage_param_trees[0]
@@ -86,19 +140,28 @@ def stack_stage_params(per_stage_param_trees, mesh):
     stacked = []
     for i in range(len(ref0)):
         arr = jnp.stack([per_stage_param_trees[s][i] for s in range(n)])
-        spec = P("pp", *([None] * (arr.ndim - 1)))
+        src_sh = getattr(ref0[i], "sharding", None)
+        if isinstance(src_sh, NamedSharding) and any(
+                ax is not None for ax in src_sh.spec):
+            spec = P("pp", *src_sh.spec)
+        else:
+            spec = P("pp", *([None] * (arr.ndim - 1)))
         stacked.append(jax.device_put(arr, NamedSharding(mesh, spec)))
     return stacked
 
 
 def spmd_pipeline_interleaved(stage_fn, n_stages, n_chunks, n_micro,
-                              stacked_params, x, mesh):
+                              stacked_params, x, mesh, rng_key=None):
     """Interleaved (virtual-stage) GPipe over the 'pp' axis — the SPMD analog
     of the reference's `PipelineParallelWithInterleave`
     (`meta_parallel/pipeline_parallel.py:463`): each rank owns ``n_chunks``
     non-adjacent model chunks, so the pipeline bubble shrinks by ~1/n_chunks.
 
-    stage_fn(chunk_param_arrays, x_micro) -> y_micro  (shape-preserving)
+    stage_fn(chunk_param_arrays, x_micro) -> y_micro  (shape-preserving);
+    with ``rng_key`` it is called as stage_fn(params, x_micro, key) where key
+    is `stage_rng_key(rng_key, logical_stage, micro)` — dropout inside stage
+    bodies is then deterministic in model position, so the serial oracle
+    (:func:`pipeline_serial_reference`) reproduces it bit-for-bit.
     stacked_params: arrays with leading axis n_stages * n_chunks in RANK-MAJOR
     order — index r * n_chunks + c holds the params of LOGICAL stage
     c * n_stages + r (shard_map splits the leading axis contiguously per rank,
@@ -118,13 +181,15 @@ def spmd_pipeline_interleaved(stage_fn, n_stages, n_chunks, n_micro,
             f"stacked param leading axis {p.shape[0]} != "
             f"n_stages*n_chunks={s_total}")
 
-    def per_rank(params, xs):
+    def per_rank(params, xs, *key_data):
         # shard_map's contiguous P('pp') split gives each rank its local
         # [n_chunks, ...] block (rank-major layout, see docstring)
         local = list(params)
         r = jax.lax.axis_index("pp")
         is_first = (r == 0)
         is_last = (r == n_stages - 1)
+        base_key = (jax.random.wrap_key_data(key_data[0])
+                    if key_data else None)
         carry = jnp.zeros((n_chunks, mb) + xs.shape[2:], xs.dtype)
         outs = jnp.zeros_like(xs)
         total_ticks = n_micro + s_total - 1
@@ -134,7 +199,17 @@ def spmd_pipeline_interleaved(stage_fn, n_stages, n_chunks, n_micro,
                 if t < n_micro else carry[0]
             x_in = carry.at[0].set(x0)
             # all chunks advance one tick in parallel (independent microbatches)
-            y = _vmap_chunks(stage_fn, local, x_in)
+            if base_key is not None:
+                # chunk ci runs LOGICAL stage s = ci*n_stages + r, which at
+                # tick t holds microbatch m = t - s (clipped: out-of-range
+                # ticks compute garbage that never reaches the output)
+                s_ids = jnp.arange(n_chunks) * n_stages + r
+                m_ids = jnp.clip(t - s_ids, 0, n_micro - 1)
+                keys = jax.vmap(
+                    lambda s, m: stage_rng_key(base_key, s, m))(s_ids, m_ids)
+                y = _vmap_chunks(stage_fn, local, x_in, keys)
+            else:
+                y = _vmap_chunks(stage_fn, local, x_in)
             # microbatch m leaves the last chunk of the last rank at
             # t = m + s_total - 1
             m = t - (s_total - 1)
@@ -149,14 +224,25 @@ def spmd_pipeline_interleaved(stage_fn, n_stages, n_chunks, n_micro,
         return jax.lax.psum(
             jnp.where(is_last, outs, jnp.zeros_like(outs)), "pp")
 
-    def _vmap_chunks(fn, local, x_in):
+    def _vmap_chunks(fn, local, x_in, keys=None):
         # vmap over the chunk axis of the local params and carries
-        return jax.vmap(lambda *args: fn(list(args[:-1]), args[-1]))(
-            *local, x_in)
+        if keys is None:
+            return jax.vmap(lambda *args: fn(list(args[:-1]), args[-1]))(
+                *local, x_in)
+        return jax.vmap(
+            lambda *args: fn(list(args[:-2]), args[-2], args[-1]))(
+            *local, x_in, keys)
 
+    extra = ()
+    extra_specs = ()
+    if rng_key is not None:
+        # raw uint32 key data crosses the shard_map boundary (replicated);
+        # typed keys are rewrapped inside per_rank
+        extra = (jax.random.key_data(rng_key),)
+        extra_specs = (P(),)
     f = jax.shard_map(
         per_rank, mesh=mesh,
-        in_specs=(tuple(P("pp") for _ in stacked_params), P()),
+        in_specs=(tuple(P("pp") for _ in stacked_params), P()) + extra_specs,
         out_specs=P(), axis_names={"pp"}, check_vma=False)
-    outs = f(tuple(stacked_params), xm)
+    outs = f(tuple(stacked_params), xm, *extra)
     return outs.reshape((B,) + outs.shape[2:])

@@ -391,16 +391,24 @@ class DataLoader:
         method = flag_value("dataloader_mp_method")
         if method != "fork":
             import sys as _sys
-            main_file = getattr(_sys.modules.get("__main__"), "__file__", None)
-            if main_file is not None and main_file.startswith("<"):
-                # spawn bootstrap re-runs the parent's __main__ by path; a
-                # pseudo-file parent ("<stdin>" heredoc) has none, so workers
-                # would die at startup — fork is the only viable context
-                # there. Real paths (including zipapp members) stay on spawn.
+            main_mod = _sys.modules.get("__main__")
+            main_file = getattr(main_mod, "__file__", None)
+            not_reimportable = (
+                # pseudo-file parent: "<stdin>" heredoc and friends
+                (main_file is not None and main_file.startswith("<"))
+                # interactive REPL / python -c: no file and no module spec —
+                # __main__-defined datasets can never unpickle in a spawn child
+                or (main_file is None
+                    and getattr(main_mod, "__spec__", None) is None))
+            if not_reimportable:
+                # spawn bootstrap re-runs the parent's __main__ by path, so
+                # workers would die at startup — fork is the only viable
+                # context there. Real paths (including zipapp members) stay
+                # on spawn.
                 import warnings
                 warnings.warn(
-                    "DataLoader: parent __main__ is not a re-importable file"
-                    f" ({main_file!r}); falling back to fork workers",
+                    "DataLoader: parent __main__ is not re-importable"
+                    f" (file={main_file!r}); falling back to fork workers",
                     RuntimeWarning)
                 method = "fork"
         ctx = mp.get_context(method)

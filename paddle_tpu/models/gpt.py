@@ -249,6 +249,79 @@ class GPTForCausalLM(nn.Layer):
         return paddle.concat(out_ids, axis=1)
 
 
+class GPTEmbeddingPipe(nn.Layer):
+    """wte + wpe + dropout as the pipeline's first entry, SHARED with the
+    tied LM head (ref `pp_layers.py:520` shared-weight descs). The reference
+    all-reduces the shared weight's grad between first/last stages; here both
+    uses live in ONE XLA program, so autograd sums the two contributions and
+    GSPMD moves whatever bytes the sharding requires — the sync is derived,
+    not hand-coded."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        winit = ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+        self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
+                                          weight_attr=winit)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size,
+                                weight_attr=winit)
+        self.drop = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, input_ids):
+        S = input_ids.shape[1]
+        pos = paddle.arange(0, S, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        return _sp_constrain(x, self.cfg)
+
+
+def _lm_head_forward(embed_layer, h):
+    """Tied head: logits = h @ wte^T (the SharedLayerDesc forward_func)."""
+    return paddle.matmul(h, embed_layer.wte.weight, transpose_y=True)
+
+
+class GPTForCausalLMPipe(nn.Layer):
+    """GPT through PipelineLayer — the flagship pipelined config (ref
+    PaddleNLP GPTForCausalLMPipe over `pp_layers.py:209`): tied input/output
+    embeddings via SharedLayerDesc, dropout>0 supported inside stages (the
+    engine threads per-(stage, micro) functional keys), and the 'pp' axis
+    composes with dp/mp/sp on one mesh (stacked block params keep their 'mp'
+    sub-shardings; dp/sp ride GSPMD's auto axes through the manual-pp
+    shard_map)."""
+
+    def __init__(self, cfg: GPTConfig, num_stages=1, micro_batches=1,
+                 seg_method="uniform", num_virtual_pipeline_stages=1):
+        super().__init__()
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer, SharedLayerDesc)
+        self.cfg = cfg
+        descs = [
+            SharedLayerDesc("embed", GPTEmbeddingPipe, cfg),
+            *[LayerDesc(GPTBlock, cfg) for _ in range(cfg.num_layers)],
+            LayerDesc(nn.LayerNorm, cfg.hidden_size),
+            SharedLayerDesc("embed", GPTEmbeddingPipe, cfg,
+                            forward_func=_lm_head_forward),
+        ]
+        self.pipeline = PipelineLayer(
+            descs, num_stages=num_stages, micro_batches=micro_batches,
+            seg_method=seg_method,
+            num_virtual_pipeline_stages=num_virtual_pipeline_stages)
+
+    def forward(self, input_ids, labels=None, loss_mask=None):
+        logits = self.pipeline(input_ids)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.cfg.vocab_size]).astype("float32"),
+            labels.reshape([-1]), reduction="none")
+        if loss_mask is not None:
+            m = loss_mask.reshape([-1]).astype("float32")
+            loss = (loss * m).sum() / m.sum()
+        else:
+            loss = loss.mean()
+        return logits, loss
+
+
 def gpt2_small(**kwargs):
     return GPTForCausalLM(GPTConfig(**kwargs))
 

@@ -72,6 +72,36 @@ class Generator:
         return sub
 
 
+class FunctionalGenerator:
+    """Generator view over a FIXED functional key (possibly a tracer): each
+    ``next_key`` folds a deterministic per-call counter into the key instead
+    of mutating global state. Installed while pipeline stage / MoE expert
+    bodies trace (fleet/pipeline.functional_rng) so nn.Dropout works there —
+    the placement-independent analog of the reference's RNGStatesTracker
+    (`fleet/layers/mpu/random.py:34`). Draw order is trace order, which is
+    deterministic per stage body, so every retrace sees the same folds."""
+
+    def __init__(self, key):
+        self._key = key
+        self._calls = 0
+
+    def next_key(self):
+        sub = jax.random.fold_in(self._key, self._calls)
+        self._calls += 1
+        return sub
+
+    def manual_seed(self, seed):
+        raise RuntimeError(
+            "FunctionalGenerator is immutable — seed the surrounding step's "
+            "generator instead (the key is threaded in from outside)")
+
+    def get_state(self):
+        return Tensor(jax.random.key_data(self._key), _internal=True)
+
+    def set_state(self, state):
+        self.manual_seed(None)
+
+
 _default_generator = Generator(np.random.randint(0, 2**31 - 1))
 
 
