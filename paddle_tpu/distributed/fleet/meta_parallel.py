@@ -55,6 +55,18 @@ def _constrain(t: Tensor, spec: PartitionSpec) -> Tensor:
                  op_name="sharding_constraint")
 
 
+_U = PartitionSpec.UNCONSTRAINED
+
+
+def _last_dim_spec(nd, last):
+    """Constrain ONLY the feature (last) dim; batch/seq dims stay
+    UNCONSTRAINED so GSPMD keeps whatever dp/sp sharding flows in. Pinning
+    them (P() replication) made the partitioner flip between dp x sp and mp
+    layouts in the linear backward — the 'Involuntary full rematerialization'
+    the round-2 review flagged."""
+    return PartitionSpec(*([_U] * (nd - 1)), last)
+
+
 # --------------------------------------------------------------------- TP RNG
 
 
@@ -151,9 +163,8 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
-            return _constrain(out, PartitionSpec())
-        nd = out.ndim
-        return _constrain(out, PartitionSpec(*([None] * (nd - 1) + ["mp"])))
+            return _constrain(out, _last_dim_spec(out.ndim, None))
+        return _constrain(out, _last_dim_spec(out.ndim, "mp"))
 
 
 class RowParallelLinear(Layer):
@@ -176,10 +187,9 @@ class RowParallelLinear(Layer):
     def forward(self, x):
         x = ensure_tensor(x)
         if self.input_is_parallel:
-            nd = x.ndim
-            x = _constrain(x, PartitionSpec(*([None] * (nd - 1) + ["mp"])))
+            x = _constrain(x, _last_dim_spec(x.ndim, "mp"))
         out = F.linear(x, self.weight, self.bias)
-        return _constrain(out, PartitionSpec())
+        return _constrain(out, _last_dim_spec(out.ndim, None))
 
 
 class ParallelCrossEntropy(Layer):

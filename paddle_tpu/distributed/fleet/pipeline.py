@@ -243,6 +243,12 @@ def spmd_pipeline_interleaved(stage_fn, n_stages, n_chunks, n_micro,
     f = jax.shard_map(
         per_rank, mesh=mesh,
         in_specs=(tuple(P("pp") for _ in stacked_params), P()) + extra_specs,
-        out_specs=P(), axis_names={"pp"}, check_vma=False)
+        out_specs=P(), axis_names={"pp"},
+        # check_vma must stay off here: the stage bodies run
+        # with_sharding_constraint on AUTO axes (dp/mp/sp), and jax's
+        # vma checker rejects auto-typed axes inside a manual region
+        # (ValueError: axes in vma should be Manual). The ring/ulysses
+        # shard_maps, which constrain nothing, run with check_vma=True.
+        check_vma=False)
     outs = f(tuple(stacked_params), xm, *extra)
     return outs.reshape((B,) + outs.shape[2:])

@@ -93,7 +93,7 @@ def _ring_fwd(q, k, v, causal, scale, mesh, axis):
     spec3 = P(None, None, axis)
     f = jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
                       out_specs=(spec, spec3), axis_names={axis},
-                      check_vma=False)
+                      check_vma=True)
     out, lse = f(q, k, v)
     return out, (q, k, v, out, lse)
 
@@ -153,7 +153,7 @@ def _ring_bwd(causal, scale, mesh, axis, res, do):
     f = jax.shard_map(
         per_rank, mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec3, spec),
-        out_specs=(spec, spec, spec), axis_names={axis}, check_vma=False)
+        out_specs=(spec, spec, spec), axis_names={axis}, check_vma=True)
     return f(q, k, v, out, lse, do)
 
 
@@ -186,5 +186,5 @@ def ulysses_attention(q, k, v, causal, scale, mesh, axis="sp"):
 
     spec = P(None, None, axis, None)
     f = jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
-                      out_specs=spec, axis_names={axis}, check_vma=False)
+                      out_specs=spec, axis_names={axis}, check_vma=True)
     return f(q, k, v)
