@@ -108,6 +108,10 @@ define_flag("tpu_donate_buffers", True,
 define_flag("tpu_fused_optimizer", True,
             "multi-tensor optimizer path: one fused update over concatenated "
             "flat param/state buffers per dtype group (ref fused adam kernels)")
+define_flag("moe_dispatch", "auto",
+            "MoE token dispatch path: auto | scatter (index scatter/gather, "
+            "O(N*K*D) movement — the global_scatter analog) | einsum "
+            "(one-hot [N,E,C] einsum, O(N*E*C*D) FLOPs; fine at tiny scale)")
 define_flag("dataloader_mp_method", "spawn",
             "multiprocessing start method for DataLoader workers: spawn "
             "(default — fork is unsafe under the multithreaded JAX runtime) "

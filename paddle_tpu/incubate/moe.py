@@ -42,28 +42,28 @@ def _one_hot(idx, n):
     return jax.nn.one_hot(idx, n, dtype=jnp.float32)
 
 
-def _top1_dispatch(probs, capacity):
-    """Switch routing (ref `switch_gate.py`): top-1 with capacity.
-    Returns (dispatch [N,E,C], combine [N,E,C], aux_loss)."""
+def _top1_indices(probs, capacity):
+    """Switch routing (ref `switch_gate.py`): top-1 with capacity. Index
+    form: (expert_idx [N,1], pos [N,1], gate [N,1], kept [N,1], aux)."""
     n, e = probs.shape
     idx = jnp.argmax(probs, axis=-1)                       # [N]
     mask = _one_hot(idx, e)                                # [N, E]
     # position of each token inside its expert's buffer
-    pos = jnp.cumsum(mask, axis=0) * mask - mask           # [N, E] 0-based
-    keep = (pos < capacity) * mask                         # overflow drops
-    pos = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)   # [N]
+    pos_d = jnp.cumsum(mask, axis=0) * mask - mask         # [N, E] 0-based
+    keep = (pos_d < capacity) * mask                       # overflow drops
+    pos = jnp.sum(pos_d * keep, axis=-1).astype(jnp.int32)  # [N]
+    kept = jnp.sum(keep, axis=-1)                          # [N] 0/1
     gate = jnp.sum(probs * keep, axis=-1)                  # selected prob
-    dispatch = keep[:, :, None] * _one_hot(pos, capacity)[:, None, :]
-    combine = dispatch * gate[:, None, None]
     # switch aux loss: E * sum_e (fraction_tokens_e * mean_prob_e)
     frac = jnp.mean(mask, axis=0)
     mean_p = jnp.mean(probs, axis=0)
     aux = e * jnp.sum(frac * mean_p)
-    return dispatch, combine, aux
+    return (idx[:, None].astype(jnp.int32), pos[:, None], gate[:, None],
+            kept[:, None], aux)
 
 
-def _top2_dispatch(probs, capacity):
-    """GShard top-2 routing (ref `gshard_gate.py`)."""
+def _top2_indices(probs, capacity):
+    """GShard top-2 routing (ref `gshard_gate.py`) in index form."""
     n, e = probs.shape
     idx1 = jnp.argmax(probs, axis=-1)
     mask1 = _one_hot(idx1, e)
@@ -71,28 +71,88 @@ def _top2_dispatch(probs, capacity):
     idx2 = jnp.argmax(probs2, axis=-1)
     mask2 = _one_hot(idx2, e)
 
-    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1
-    keep1 = (pos1 < capacity) * mask1
+    pos1_d = jnp.cumsum(mask1, axis=0) * mask1 - mask1
+    keep1 = (pos1_d < capacity) * mask1
     # expert buffers already hold count1 tokens when the 2nd choices land
     count1 = jnp.sum(mask1, axis=0, keepdims=True)
-    pos2 = (jnp.cumsum(mask2, axis=0) * mask2 - mask2) + count1 * mask2
-    keep2 = (pos2 < capacity) * mask2
+    pos2_d = (jnp.cumsum(mask2, axis=0) * mask2 - mask2) + count1 * mask2
+    keep2 = (pos2_d < capacity) * mask2
 
     g1 = jnp.sum(probs * keep1, axis=-1)
     g2 = jnp.sum(probs * keep2, axis=-1)
     denom = jnp.maximum(g1 + g2, 1e-9)
     g1, g2 = g1 / denom, g2 / denom
 
-    p1 = jnp.sum(pos1 * keep1, axis=-1).astype(jnp.int32)
-    p2 = jnp.sum(pos2 * keep2, axis=-1).astype(jnp.int32)
-    d1 = keep1[:, :, None] * _one_hot(p1, capacity)[:, None, :]
-    d2 = keep2[:, :, None] * _one_hot(p2, capacity)[:, None, :]
-    dispatch = jnp.minimum(d1 + d2, 1.0)
-    combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
+    p1 = jnp.sum(pos1_d * keep1, axis=-1).astype(jnp.int32)
+    p2 = jnp.sum(pos2_d * keep2, axis=-1).astype(jnp.int32)
     frac = jnp.mean(mask1, axis=0)
     mean_p = jnp.mean(probs, axis=0)
     aux = e * jnp.sum(frac * mean_p)
-    return dispatch, combine, aux
+    idx = jnp.stack([idx1, idx2], axis=1).astype(jnp.int32)   # [N, 2]
+    pos = jnp.stack([p1, p2], axis=1)
+    gate = jnp.stack([g1, g2], axis=1)
+    kept = jnp.stack([jnp.sum(keep1, axis=-1),
+                      jnp.sum(keep2, axis=-1)], axis=1)
+    return idx, pos, gate, kept, aux
+
+
+def _naive_topk_indices(probs, capacity, k):
+    """True naive top-k (ref `moe/gate/naive_gate.py`): top-k by value, gate
+    values UNNORMALIZED (the reference returns raw softmax scores — no
+    GShard renorm), capacity only as the static-shape bound."""
+    n, e = probs.shape
+    vals, idx = jax.lax.top_k(probs, k)                    # [N, K]
+    # buffer positions: count earlier (token, choice) pairs per expert over
+    # the token-major flattening — matches the sequential-argmax order
+    flat_mask = _one_hot(idx.reshape(-1), e)               # [N*K, E]
+    pos_d = jnp.cumsum(flat_mask, axis=0) * flat_mask - flat_mask
+    keep = (pos_d < capacity) * flat_mask
+    pos = jnp.sum(pos_d * keep, axis=-1).astype(jnp.int32).reshape(n, k)
+    kept = jnp.sum(keep, axis=-1).reshape(n, k)
+    frac = jnp.mean(flat_mask.reshape(n, k, e)[:, 0], axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_p)
+    return idx.astype(jnp.int32), pos, vals, kept, aux
+
+
+def _dense_from_indices(idx, pos, gate, kept, e, capacity):
+    """Index form -> dense GShard dispatch/combine [N, E, C] (the einsum
+    fallback path; also the back-compat Gate.routing return value)."""
+    d_k = (kept[..., None, None]
+           * _one_hot(idx, e)[..., None]
+           * _one_hot(pos, capacity)[..., None, :])        # [N, K, E, C]
+    dispatch = jnp.minimum(jnp.sum(d_k, axis=1), 1.0)
+    combine = jnp.sum(d_k * gate[..., None, None], axis=1)
+    return dispatch, combine
+
+
+def _scatter_dispatch(flat, idx, pos, kept, e, capacity):
+    """Token -> expert-buffer movement WITHOUT the [N,E,C] one-hot tensor:
+    each (token, choice) writes into slot expert*C + pos via scatter-add —
+    O(N*K*D) data movement, the static-shape analog of the reference's
+    `global_scatter` (`global_scatter_op.cc:80`), vs the einsum's
+    O(N*E*C*D) FLOPs. Slots are unique per (expert, pos) by construction,
+    and dropped pairs target a sentinel row that is sliced off."""
+    n, k = idx.shape
+    d = flat.shape[-1]
+    slot = idx * capacity + pos                            # [N, K]
+    slot = jnp.where(kept > 0, slot, e * capacity)         # sentinel
+    buf = jnp.zeros((e * capacity + 1, d), flat.dtype)
+    src = jnp.broadcast_to(flat[:, None, :], (n, k, d)).reshape(n * k, d)
+    buf = buf.at[slot.reshape(-1)].add(src)
+    return buf[:-1].reshape(e, capacity, d)
+
+
+def _gather_combine(exp_out, idx, pos, gate, kept, capacity):
+    """Expert buffers -> tokens (the `global_gather` analog): gather each
+    (token, choice)'s slot and mix by gate weight."""
+    e = exp_out.shape[0]
+    d = exp_out.shape[-1]
+    flat_out = exp_out.reshape(e * capacity, d)
+    slot = jnp.clip(idx * capacity + pos, 0, e * capacity - 1)
+    vals = flat_out[slot.reshape(-1)].reshape(idx.shape + (d,))  # [N, K, D]
+    w = (gate * (kept > 0)).astype(vals.dtype)
+    return jnp.sum(vals * w[..., None], axis=1)            # [N, D]
 
 
 class BaseGate(Layer):
@@ -107,37 +167,63 @@ class BaseGate(Layer):
             [d_model, num_experts], attr=ParamAttr._to_attr(weight_attr),
             default_initializer=I.Normal(0.0, 0.02))
 
-    def routing(self, probs, capacity):
+    def routing_indices(self, probs, capacity):
+        """(expert_idx [N,K], pos [N,K], gate [N,K], kept [N,K], aux)."""
         raise NotImplementedError
+
+    def effective_capacity(self, n_tokens, capacity):
+        """Static per-expert buffer size the layer must allocate."""
+        return capacity
+
+    def routing(self, probs, capacity):
+        """Dense GShard (dispatch [N,E,C], combine [N,E,C], aux) — derived
+        from the index form; kept for the einsum path and back-compat."""
+        idx, pos, gate, kept, aux = self.routing_indices(probs, capacity)
+        e = self.num_experts
+        dispatch, combine = _dense_from_indices(idx, pos, gate, kept, e,
+                                                capacity)
+        return dispatch, combine, aux
 
 
 class SwitchGate(BaseGate):
     """ref `moe/gate/switch_gate.py` — top-1 capacity routing."""
     top_k = 1
 
-    def routing(self, probs, capacity):
-        return _top1_dispatch(probs, capacity)
+    def routing_indices(self, probs, capacity):
+        return _top1_indices(probs, capacity)
 
 
 class GShardGate(BaseGate):
-    """ref `moe/gate/gshard_gate.py` — top-2 capacity routing."""
+    """ref `moe/gate/gshard_gate.py` — top-2 capacity routing with
+    normalized gate weights."""
     top_k = 2
 
-    def routing(self, probs, capacity):
-        return _top2_dispatch(probs, capacity)
+    def routing_indices(self, probs, capacity):
+        return _top2_indices(probs, capacity)
 
 
 class NaiveGate(BaseGate):
-    """ref `moe/gate/naive_gate.py` — top-k softmax gate; implemented as top-2
-    with a generous default capacity (static shapes need a capacity bound)."""
+    """ref `moe/gate/naive_gate.py` — true naive top-k: raw (unnormalized)
+    softmax scores as gate weights, NO GShard renorm. The reference drops
+    nothing (dynamic counts over brpc); static TPU shapes need a capacity
+    bound, so the default capacity_factor is sized to make drops impossible
+    for the worst case only when ``no_drop=True`` (capacity = N), else the
+    generous 4.0 bound applies."""
     top_k = 2
 
     def __init__(self, d_model, num_experts, capacity_factor=4.0,
-                 weight_attr=None):
+                 weight_attr=None, top_k=2, no_drop=False):
         super().__init__(d_model, num_experts, capacity_factor, weight_attr)
+        self.top_k = int(top_k)
+        self.no_drop = bool(no_drop)
 
-    def routing(self, probs, capacity):
-        return _top2_dispatch(probs, capacity)
+    def effective_capacity(self, n_tokens, capacity):
+        # top_k returns DISTINCT experts per token, so one expert receives at
+        # most n_tokens (token, choice) pairs — that is the no-drop bound
+        return n_tokens if self.no_drop else capacity
+
+    def routing_indices(self, probs, capacity):
+        return _naive_topk_indices(probs, capacity, self.top_k)
 
 
 class MoELayer(Layer):
@@ -198,26 +284,40 @@ class MoELayer(Layer):
         d_model = orig_shape[-1]
         n_tokens = int(np.prod(orig_shape[:-1]))
         e = self.num_experts
-        cap = _capacity(n_tokens, e, self.gate.top_k,
-                        self.gate.capacity_factor)
+        cap = self.gate.effective_capacity(
+            n_tokens, _capacity(n_tokens, e, self.gate.top_k,
+                                self.gate.capacity_factor))
         mesh = get_mesh()
         ep_ok = (mesh is not None and "ep" in mesh.axis_names
                  and e % mesh.shape["ep"] == 0 and mesh.shape["ep"] > 1)
         tpl_params = self._template_params
         template = self._template
         template.train() if self.training else template.eval()
-        routing = self.gate.routing
+        routing_indices = self.gate.routing_indices
+        from paddle_tpu.framework.flags import flag_value
+        mode = flag_value("moe_dispatch")
+        # einsum pays O(N*E*C*D) FLOPs for what is data MOVEMENT; scatter
+        # moves O(N*K*D). Keep einsum only where the one-hot tensor is tiny
+        # (XLA fuses it well there and the scatter has fixed overheads).
+        use_scatter = mode == "scatter" or (
+            mode == "auto" and n_tokens * e * cap * d_model > (1 << 22))
 
         def prim(gw, xa, *stacked):
             flat = xa.reshape(n_tokens, d_model)
             logits = jnp.dot(flat.astype(jnp.float32),
                              gw.astype(jnp.float32))
             probs = jax.nn.softmax(logits, axis=-1)         # [N, E]
-            dispatch, combine, aux = routing(probs, cap)
-            # token -> expert buffers; GSPMD turns the 'ep' resharding into
-            # the global_scatter all-to-all
-            exp_in = jnp.einsum("nec,nd->ecd",
-                                dispatch.astype(flat.dtype), flat)
+            idx, pos, gate_w, kept, aux = routing_indices(probs, cap)
+            if use_scatter:
+                # sort-free index dispatch (the global_scatter analog)
+                exp_in = _scatter_dispatch(flat, idx, pos, kept, e, cap)
+            else:
+                dispatch, combine = _dense_from_indices(
+                    idx, pos, gate_w, kept, e, cap)
+                # token -> expert buffers; GSPMD turns the 'ep' resharding
+                # into the global_scatter all-to-all
+                exp_in = jnp.einsum("nec,nd->ecd",
+                                    dispatch.astype(flat.dtype), flat)
             if ep_ok:
                 exp_in = jax.lax.with_sharding_constraint(
                     exp_in, NamedSharding(mesh, P("ep", None, None)))
@@ -243,8 +343,12 @@ class MoELayer(Layer):
             if ep_ok:
                 exp_out = jax.lax.with_sharding_constraint(
                     exp_out, NamedSharding(mesh, P("ep", None, None)))
-            out = jnp.einsum("ecd,nec->nd", exp_out.astype(jnp.float32),
-                             combine).astype(xa.dtype)
+            if use_scatter:
+                out = _gather_combine(exp_out.astype(jnp.float32), idx, pos,
+                                      gate_w, kept, cap).astype(xa.dtype)
+            else:
+                out = jnp.einsum("ecd,nec->nd", exp_out.astype(jnp.float32),
+                                 combine).astype(xa.dtype)
             return out.reshape(orig_shape), aux
 
         out, aux = apply(prim, self.gate.weight, x, *self._stacked,

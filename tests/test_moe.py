@@ -91,6 +91,16 @@ class TestEpParity:
         dist = run(True)
         np.testing.assert_allclose(serial, dist, rtol=1e-3)
 
+    def test_ep8_scatter_dispatch_matches_serial(self):
+        """The scatter/gather dispatch under a real ep mesh (scatter-add +
+        GSPMD 'ep' constraints is the risky interaction)."""
+        from paddle_tpu.framework.flags import set_flags
+        set_flags({"moe_dispatch": "scatter"})
+        try:
+            self.test_ep8_matches_serial(GShardGate)
+        finally:
+            set_flags({"moe_dispatch": "auto"})
+
 
 class TestRouting:
     def test_aux_loss_grad_reaches_gate(self):
@@ -159,3 +169,97 @@ class TestTemplateHygiene:
         moe.train()
         with pytest.raises(RuntimeError, match="stateful RNG"):
             moe(paddle.to_tensor(_x()))
+
+
+class TestScatterDispatch:
+    """The index scatter/gather dispatch (round-2 VERDICT #5: data movement,
+    not one-hot einsum FLOPs) must match the einsum path bit-for-bit."""
+
+    @pytest.mark.parametrize("gate_cls", [SwitchGate, GShardGate, NaiveGate])
+    def test_scatter_matches_einsum_forward_and_grads(self, gate_cls):
+        from paddle_tpu.framework.flags import set_flags
+
+        def run(mode):
+            set_mesh(None)
+            paddle.seed(3)
+            set_flags({"moe_dispatch": mode})
+            try:
+                moe = MoELayer(d_model=D, experts=[Expert() for _ in range(4)],
+                               gate=gate_cls(D, 4), capacity_factor=2.0)
+                x = paddle.to_tensor(_x(seed=7))
+                x.stop_gradient = False
+                out = moe(x)
+                (out ** 2).sum().backward()
+                return (np.asarray(out._data),
+                        np.asarray(moe.moe_expert_param_0.grad._data),
+                        np.asarray(x.grad._data))
+            finally:
+                set_flags({"moe_dispatch": "auto"})
+
+        o1, g1, xg1 = run("einsum")
+        o2, g2, xg2 = run("scatter")
+        np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(xg1, xg2, rtol=1e-5, atol=1e-6)
+
+    def test_scatter_flops_scale_with_tokens_not_capacity(self):
+        """Compiled-FLOP proof that the scatter path removes the O(N*E*C*D)
+        dispatch cost (the bench-rung criterion from the VERDICT, measured
+        via XLA cost analysis instead of wall clock)."""
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.moe import (
+            _scatter_dispatch, _dense_from_indices, _top1_indices)
+
+        n, e, cap, d = 256, 32, 64, 64
+        rng = np.random.RandomState(0)
+        flat = jnp.asarray(rng.randn(n, d).astype(np.float32))
+        probs = jax.nn.softmax(
+            jnp.asarray(rng.randn(n, e).astype(np.float32)), -1)
+
+        def einsum_path(flat, probs):
+            idx, pos, gate, kept, _ = _top1_indices(probs, cap)
+            dispatch, _ = _dense_from_indices(idx, pos, gate, kept, e, cap)
+            return jnp.einsum("nec,nd->ecd", dispatch, flat)
+
+        def scatter_path(flat, probs):
+            idx, pos, gate, kept, _ = _top1_indices(probs, cap)
+            return _scatter_dispatch(flat, idx, pos, kept, e, cap)
+
+        fe = jax.jit(einsum_path).lower(flat, probs).compile()
+        fs = jax.jit(scatter_path).lower(flat, probs).compile()
+        flops_e = fe.cost_analysis()["flops"]
+        flops_s = fs.cost_analysis()["flops"]
+        # einsum pays ~N*E*C*D multiply-adds (~2.1e9 here); scatter only the
+        # routing math. An order of magnitude is the point, 4x is the gate.
+        assert flops_s * 4 < flops_e, (flops_s, flops_e)
+        # and the two produce the same buffers
+        np.testing.assert_allclose(np.asarray(fe(flat, probs)),
+                                   np.asarray(fs(flat, probs)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_naive_gate_reference_semantics(self):
+        """NaiveGate = raw top-k softmax scores (NO GShard renorm) and
+        no_drop=True drops nothing even under pathological routing."""
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.moe import _naive_topk_indices
+
+        rng = np.random.RandomState(1)
+        probs = jax.nn.softmax(
+            jnp.asarray(rng.randn(32, 4).astype(np.float32)), -1)
+        idx, pos, gate, kept, _ = _naive_topk_indices(probs, 32 * 2, 2)
+        vals, ref_idx = jax.lax.top_k(probs, 2)
+        np.testing.assert_array_equal(np.asarray(idx), np.asarray(ref_idx))
+        # gate weights are the raw softmax values — sum < 1, unnormalized
+        np.testing.assert_allclose(np.asarray(gate), np.asarray(vals),
+                                   rtol=1e-6)
+        assert np.all(np.asarray(kept) == 1.0)  # ample capacity: no drops
+
+        # pathological: all tokens to one expert; no_drop capacity keeps all
+        g = NaiveGate(D, 4, top_k=2, no_drop=True)
+        # top-k experts are distinct per token -> no-drop bound is N, not N*k
+        assert g.effective_capacity(32, 4) == 32
+        one_sided = jnp.zeros((32, 4)).at[:, 0].set(100.0)
+        probs1 = jax.nn.softmax(one_sided, -1)
+        _, _, _, kept1, _ = _naive_topk_indices(
+            probs1, g.effective_capacity(32, 4), 2)
+        assert np.all(np.asarray(kept1) == 1.0)
