@@ -62,21 +62,99 @@ def shard_parameters(model, mesh=None, axis="dp"):
     return model
 
 
+def _host_device_shardings(shape, mesh, axis):
+    """(host, device) sharding pair for one state array."""
+    if mesh is not None:
+        spec = _shard_spec_for(shape, mesh, axis)
+        return (NamedSharding(mesh, spec, memory_kind="pinned_host"),
+                NamedSharding(mesh, spec))
+    dev = jax.devices()[0]
+    return (jax.sharding.SingleDeviceSharding(dev, memory_kind="pinned_host"),
+            jax.sharding.SingleDeviceSharding(dev))
+
+
+def _flag_offload(t, mesh, axis):
+    host, devsh = _host_device_shardings(tuple(t._data.shape), mesh, axis)
+    t._offload_host = host
+    t._offload_device = devsh
+    return t
+
+
+def offload_optimizer_states(optimizer, mesh=None, axis="dp"):
+    """Stage-3 host offload (ref `group_sharded_stage3.py:61` offload=True,
+    `_param2buffer` :133): optimizer accumulators, fused flat state buffers
+    and fp32 master weights RESIDE in host memory (``pinned_host``) between
+    steps. The step runner fetches them to device memory for the update and
+    pushes the new values home afterwards — donate+fetch, so HBM holds
+    optimizer state only transiently during the step. The compiled program
+    itself stays memory-kind-free (portable across backends; the transfers
+    happen at the call boundary, see jit/static_function.py)."""
+    mesh = mesh or get_mesh()
+    if getattr(optimizer, "_offloaded_states", None) is not None:
+        return optimizer
+    optimizer._offloaded_states = []
+
+    def collect():
+        """The CURRENT state tensors — recomputed every step so that
+        set_state_dict (which rebinds whole accumulator dicts) and fused
+        freeze/unfreeze rebuilds self-heal instead of leaving stale entries
+        shuttling dead arrays (round-3 review finding)."""
+        out = []
+        for store in optimizer._accumulators.values():
+            out.extend(store.values())
+        out.extend(optimizer._master_weights.values())
+        for meta in getattr(optimizer, "_fused_parts", {}).values():
+            out.extend(meta["states"])
+        for t in out:
+            if not hasattr(t, "_offload_host"):
+                _flag_offload(t, mesh, axis)
+        optimizer._offloaded_states = out
+        return out
+
+    orig_step = optimizer.step
+
+    def step():
+        # eager fetch: concrete host-resident state moves to device before
+        # the update math touches it (inside a capture probe the arrays are
+        # concrete at entry too, so the probe never sees host avals)
+        for t in collect():
+            d = t._data
+            if not isinstance(d, jax.core.Tracer) and \
+                    getattr(d.sharding, "memory_kind", None) == "pinned_host":
+                t._data = jax.device_put(d, t._offload_device)
+        out = orig_step()
+        # eager push-back over the post-step state set (lazy creation happens
+        # inside the step); during capture the new values are tracers and the
+        # compiled-step runner does the push-back instead
+        for t in collect():
+            d = t._data
+            if not isinstance(d, jax.core.Tracer) and \
+                    getattr(d.sharding, "memory_kind", None) != "pinned_host":
+                t._data = jax.device_put(d, t._offload_host)
+        return out
+
+    optimizer.step = step
+    return optimizer
+
+
 def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
                            offload=False, sync_buffers=False, buffer_max_size=2**23,
                            segment_size=2**20, sync_comm=False,
                            dp_group=None, exclude_layer=None):
     """ref signature: `distributed/sharding/group_sharded.py:54`.
-    level: 'os' (stage1), 'os_g' (stage2), 'p_g_os' (stage3)."""
+    level: 'os' (stage1), 'os_g' (stage2), 'p_g_os' (stage3).
+    ``offload=True`` additionally homes optimizer state in host memory
+    (works on a single device too, like the reference's CPU offload)."""
     mesh = get_mesh()
     if mesh is None and len(jax.devices()) > 1:
         mesh = auto_mesh(dp=len(jax.devices()))
-    if mesh is None:
-        return model, optimizer, scaler
-    if level in ("os", "os_g", "p_g_os"):
-        shard_optimizer_states(optimizer, mesh)
-    if level == "p_g_os":
-        shard_parameters(model, mesh)
+    if mesh is not None:
+        if level in ("os", "os_g", "p_g_os"):
+            shard_optimizer_states(optimizer, mesh)
+        if level == "p_g_os":
+            shard_parameters(model, mesh)
+    if offload:
+        offload_optimizer_states(optimizer, mesh)
     return model, optimizer, scaler
 
 

@@ -294,26 +294,36 @@ class MoELayer(Layer):
         template = self._template
         template.train() if self.training else template.eval()
         routing_indices = self.gate.routing_indices
+        # a pre-round-3 custom gate may override only the dense routing()
+        # contract: honor it through the einsum path
+        legacy_dense = (
+            type(self.gate).routing_indices is BaseGate.routing_indices
+            and type(self.gate).routing is not BaseGate.routing)
         from paddle_tpu.framework.flags import flag_value
         mode = flag_value("moe_dispatch")
         # einsum pays O(N*E*C*D) FLOPs for what is data MOVEMENT; scatter
         # moves O(N*K*D). Keep einsum only where the one-hot tensor is tiny
         # (XLA fuses it well there and the scatter has fixed overheads).
-        use_scatter = mode == "scatter" or (
-            mode == "auto" and n_tokens * e * cap * d_model > (1 << 22))
+        use_scatter = not legacy_dense and (mode == "scatter" or (
+            mode == "auto" and n_tokens * e * cap * d_model > (1 << 22)))
+        legacy_routing = self.gate.routing if legacy_dense else None
 
         def prim(gw, xa, *stacked):
             flat = xa.reshape(n_tokens, d_model)
             logits = jnp.dot(flat.astype(jnp.float32),
                              gw.astype(jnp.float32))
             probs = jax.nn.softmax(logits, axis=-1)         # [N, E]
-            idx, pos, gate_w, kept, aux = routing_indices(probs, cap)
             if use_scatter:
+                idx, pos, gate_w, kept, aux = routing_indices(probs, cap)
                 # sort-free index dispatch (the global_scatter analog)
                 exp_in = _scatter_dispatch(flat, idx, pos, kept, e, cap)
             else:
-                dispatch, combine = _dense_from_indices(
-                    idx, pos, gate_w, kept, e, cap)
+                if legacy_dense:
+                    dispatch, combine, aux = legacy_routing(probs, cap)
+                else:
+                    idx, pos, gate_w, kept, aux = routing_indices(probs, cap)
+                    dispatch, combine = _dense_from_indices(
+                        idx, pos, gate_w, kept, e, cap)
                 # token -> expert buffers; GSPMD turns the 'ep' resharding
                 # into the global_scatter all-to-all
                 exp_in = jnp.einsum("nec,nd->ecd",

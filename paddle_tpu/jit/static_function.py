@@ -151,6 +151,8 @@ def _sig_of(args, kwargs):
     # toggling them after first compile is silently ignored
     from paddle_tpu.framework.flags import flag_value
     parts.append(("F", flag_value("use_bfloat16_matmul")))
+    parts.append(("F", flag_value("moe_dispatch")))
+    parts.append(("F", flag_value("tpu_flash_impl")))
     return tuple(parts)
 
 
@@ -213,13 +215,24 @@ class StaticFunction:
         if compiled is None:
             compiled = self._capture(key, args, kwargs)
         arg_tensors, _, _ = _tree_flatten_tensors((args, kwargs))
-        state_in = [t._data for t in compiled.state_tensors]
+        # host-offloaded state (distributed/sharding.offload_optimizer_states):
+        # fetch to device memory for the step, push the new value home after —
+        # HBM holds these arrays only while the step runs
+        state_in = []
+        for t in compiled.state_tensors:
+            d = t._data
+            if getattr(d.sharding, "memory_kind", None) == "pinned_host" \
+                    and hasattr(t, "_offload_device"):
+                d = jax.device_put(d, t._offload_device)
+            state_in.append(d)
         grad_in = [t._grad._data for t, m in zip(compiled.state_tensors,
                                                  compiled.grad_mask) if m]
         arg_in = [t._data for t in arg_tensors]
         outs = compiled.jitted(state_in, grad_in, arg_in)
         out_arrays, new_state, new_grads = outs
         for t, arr in zip(compiled.state_tensors, new_state):
+            if hasattr(t, "_offload_host"):
+                arr = jax.device_put(arr, t._offload_host)
             t._data = arr  # direct rebind; hooks not needed outside capture
         for t, g in zip(compiled.state_tensors, new_grads):
             t._grad = None if g is None else Tensor(g, stop_gradient=True,

@@ -263,3 +263,25 @@ class TestScatterDispatch:
         _, _, _, kept1, _ = _naive_topk_indices(
             probs1, g.effective_capacity(32, 4), 2)
         assert np.all(np.asarray(kept1) == 1.0)
+
+    def test_legacy_dense_only_gate_still_works(self):
+        """A custom gate overriding only the old dense routing() contract
+        must keep working through the einsum path."""
+        from paddle_tpu.incubate.moe import (
+            BaseGate, _dense_from_indices, _top1_indices)
+
+        class LegacyGate(BaseGate):
+            top_k = 1
+
+            def routing(self, probs, capacity):
+                idx, pos, gate, kept, aux = _top1_indices(probs, capacity)
+                d, c = _dense_from_indices(idx, pos, gate, kept,
+                                           self.num_experts, capacity)
+                return d, c, aux
+
+        set_mesh(None)
+        paddle.seed(0)
+        moe = MoELayer(d_model=D, experts=[Expert() for _ in range(4)],
+                       gate=LegacyGate(D, 4), capacity_factor=2.0)
+        out = moe(paddle.to_tensor(_x()))
+        assert np.isfinite(np.asarray(out._data)).all()
