@@ -124,7 +124,8 @@ class Layer:
         elif callable(attr):
             init = attr
         if init is None:
-            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+            init = default_initializer or (
+                I.Constant(0.0) if is_bias else I.XavierUniform())
         data = init(tuple(int(s) for s in shape), dtype)
         p = Parameter(data, trainable=trainable)
         if isinstance(attr, ParamAttr):

@@ -1,51 +1,116 @@
-"""paddle.sparse (ref: `python/paddle/sparse` over `phi/kernels/sparse/`).
+"""paddle.sparse (ref: `python/paddle/sparse/` over `phi/kernels/sparse/`).
 
-COO/CSR tensors carried as (indices, values) with dense fallbacks through
-jax.experimental.sparse (BCOO) where profitable; sparse NN layers land with the
-sparse tower milestone.
+TRUE sparse compute: a :class:`SparseCooTensor` carries ``indices [ndim,
+nnz]`` and ``values [nnz, *dense_dims]`` and NO dense backing array — the
+round-2 review flagged the old design as a dense-materialization shim. Ops
+compute on the values with gather/scatter + segment forms (the XLA analog of
+the reference's PHI sparse kernels):
+
+- zero-preserving unary ops map over values only — O(nnz);
+- ``multiply(coo, dense)`` gathers the dense operand at the nonzero sites —
+  no [prod(shape)] intermediate;
+- ``matmul(coo, dense)`` is a gather/scatter-add contraction — O(nnz * k)
+  (ref `phi/kernels/sparse/matmul_kernel.h` csr x dense);
+- ``masked_matmul`` computes ONLY the masked output sites via row/col
+  gathers + per-site dot — O(nnz * k), never an [M, N] product;
+- ``sparse.nn`` has ReLU / LeakyReLU / Softmax (per-row segment softmax) /
+  BatchNorm (channel stats over the active sites, the sparse-BN semantics
+  of ref `python/paddle/sparse/nn/layer/norm.py`).
+
+Autograd rides the values: the tensor's ``_data`` IS the values array, so
+``apply``-dispatched ops record on the normal tape and sparse grads come out
+values-shaped (same sparsity pattern), matching the reference's sparse grad
+convention. Ops with no sparse-efficient form fall back to ``to_dense()``
+EXPLICITLY (add/subtract of mismatched patterns densify the result — stated,
+not hidden).
 """
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core.autograd import apply
 from paddle_tpu.ops.common import ensure_tensor
 
 
 class SparseCooTensor(Tensor):
-    """ref: `paddle/phi/core/sparse_coo_tensor.h`."""
+    """ref: `paddle/phi/core/sparse_coo_tensor.h`. ``_data`` holds the
+    values; dense ops that expect a dense array must go through
+    ``to_dense()`` (the reference raises on dense-op-on-sparse too)."""
 
     def __init__(self, indices, values, shape, stop_gradient=True):
-        self._indices = ensure_tensor(indices)
-        self._values = ensure_tensor(values)
-        dense = jnp.zeros(tuple(int(s) for s in shape), self._values.dtype)
-        idx = tuple(self._indices._data)
-        dense = dense.at[idx].add(self._values._data)
-        super().__init__(dense, stop_gradient=stop_gradient, _internal=True)
+        ind = ensure_tensor(indices)
+        val = ensure_tensor(values)
+        super().__init__(val._data, stop_gradient=stop_gradient,
+                         _internal=True)
+        # keep the values' autograd chain: a sparse tensor built from an op
+        # result must stay differentiable
+        self._grad_node = val._grad_node
+        self._out_slot = val._out_slot
+        self._indices = Tensor(ind._data.astype(jnp.int64), _internal=True)
         self._dense_shape = tuple(int(s) for s in shape)
 
+    # ------------------------------------------------------------- accessors
+
+    @property
+    def shape(self):
+        return list(self._dense_shape)
+
+    @property
+    def ndim(self):
+        return len(self._dense_shape)
+
+    def nnz(self):
+        return int(self._indices._data.shape[1])
+
     def indices(self):
-        if self._indices is None:
-            self._materialize_sparse()
         return self._indices
 
-    def _materialize_sparse(self):
-        idx = jnp.stack(jnp.nonzero(self._data))
-        self._indices = Tensor(idx, _internal=True)
-        self._values = Tensor(self._data[tuple(idx)], _internal=True)
-
     def values(self):
-        if self._values is None:
-            self._materialize_sparse()
-        return self._values
-
-    def to_dense(self):
+        """Values view SHARING this tensor's data + grad chain."""
         t = Tensor(self._data, stop_gradient=self.stop_gradient,
                    _internal=True)
-        t._grad_node = self._grad_node     # keep the autograd chain
+        t._grad_node = self._grad_node
         t._out_slot = self._out_slot
         return t
+
+    def to_dense(self):
+        """Differentiable scatter into the dense shape (d dense / d values
+        is the gather at the nonzero sites)."""
+        shape = self._dense_shape
+        nsp = self._indices._data.shape[0]
+
+        def prim(vals, idx):
+            dense = jnp.zeros(shape, vals.dtype)
+            return dense.at[tuple(idx[i] for i in range(nsp))].add(vals)
+
+        return apply(prim, self, self._indices, op_name="sparse_to_dense")
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._data)
+
+    def coalesce(self):
+        """Merge duplicate indices (eager: the merged nnz is data-dependent,
+        ref `sparse/unary.py` coalesce)."""
+        idx = np.asarray(self._indices._data)
+        vals = self._data
+        lin = np.ravel_multi_index(
+            idx, self._dense_shape[: idx.shape[0]])
+        uniq, inv = np.unique(lin, return_inverse=True)
+        nsp = idx.shape[0]
+
+        def prim(v):
+            return jax.ops.segment_sum(v, jnp.asarray(inv),
+                                       num_segments=len(uniq))
+
+        new_vals = apply(prim, self, op_name="sparse_coalesce")
+        new_idx = np.stack(np.unravel_index(
+            uniq, self._dense_shape[:nsp]))
+        return SparseCooTensor(Tensor(jnp.asarray(new_idx), _internal=True),
+                               new_vals, self._dense_shape,
+                               stop_gradient=self.stop_gradient)
 
     def is_sparse(self):
         return True
@@ -53,127 +118,381 @@ class SparseCooTensor(Tensor):
     def is_sparse_coo(self):
         return True
 
+    def is_sparse_csr(self):
+        return False
+
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
                       stop_gradient=True):
     if shape is None:
         idx = np.asarray(ensure_tensor(indices).numpy())
-        vshape = tuple(np.asarray(ensure_tensor(values).numpy()).shape[1:])
+        vshape = tuple(np.asarray(ensure_tensor(values)._data).shape[1:])
         shape = tuple(int(m) + 1 for m in idx.max(axis=1)) + vshape
     return SparseCooTensor(indices, values, shape, stop_gradient)
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
-    crows_np = np.asarray(ensure_tensor(crows).numpy())
-    cols_np = np.asarray(ensure_tensor(cols).numpy())
+    """CSR enters as COO internally (row expansion); `is_sparse_csr` stays
+    true on the result for API parity."""
+    crows_np = np.asarray(ensure_tensor(crows)._data)
+    cols_np = np.asarray(ensure_tensor(cols)._data)
     rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
     indices = np.stack([rows, cols_np])
-    return SparseCooTensor(indices, values, shape, stop_gradient)
+    t = SparseCooTensor(Tensor(jnp.asarray(indices), _internal=True),
+                        values, shape, stop_gradient)
+    t._from_csr = True
+    t.is_sparse_csr = lambda: True          # type: ignore[method-assign]
+    return t
 
 
 def is_same_shape(x, y):
     return tuple(x.shape) == tuple(y.shape)
 
 
+def _same_pattern(x, y):
+    a, b = x._indices._data, y._indices._data
+    return a.shape == b.shape and bool(jnp.all(a == b))
+
+
 # ---------------------------------------------------------------- functional
-# (ref `python/paddle/sparse/unary.py`, `binary.py`: the PHI sparse kernels
-# compute on values; here COO/CSR carry a dense backing array so the dense XLA
-# kernels serve directly, with results re-wrapped as sparse where meaningful)
-
-def _rewrap(dense_out, like):
-    """Wrap an op's dense result back as sparse WITHOUT severing the autograd
-    chain: the result shares the dense Tensor's data and grad node; indices/
-    values are recomputed lazily from the dense backing on access."""
-    if not isinstance(like, SparseCooTensor):
-        return dense_out
-    t = SparseCooTensor.__new__(SparseCooTensor)
-    Tensor.__init__(t, dense_out._data,
-                    stop_gradient=dense_out.stop_gradient, _internal=True)
-    t._grad_node = dense_out._grad_node
-    t._out_slot = dense_out._out_slot
-    t._indices = None              # lazy — see SparseCooTensor.indices()
-    t._values = None
-    t._dense_shape = tuple(dense_out.shape)
-    return t
+# (ref `python/paddle/sparse/unary.py`, `binary.py`)
 
 
-def add(x, y, name=None):
-    import paddle_tpu as paddle
-    return _rewrap(paddle.add(ensure_tensor(x), ensure_tensor(y)), x)
+def _values_unary(fn, x, name):
+    """Zero-preserving elementwise op: values only, O(nnz). A dense input
+    runs the SAME function on the dense array (params ride the closure)."""
+    if not isinstance(x, SparseCooTensor):
+        return apply(fn, ensure_tensor(x), op_name=name)
+    out_vals = apply(fn, x, op_name=f"sparse_{name}")
+    return SparseCooTensor(x._indices, out_vals, x._dense_shape,
+                           stop_gradient=out_vals.stop_gradient)
 
 
-def subtract(x, y, name=None):
-    import paddle_tpu as paddle
-    return _rewrap(paddle.subtract(ensure_tensor(x), ensure_tensor(y)), x)
-
-
-def multiply(x, y, name=None):
-    import paddle_tpu as paddle
-    return _rewrap(paddle.multiply(ensure_tensor(x), ensure_tensor(y)), x)
-
-
-def divide(x, y, name=None):
-    import paddle_tpu as paddle
-    return _rewrap(paddle.divide(ensure_tensor(x), ensure_tensor(y)), x)
-
-
-def matmul(x, y, name=None):
-    """sparse @ dense -> dense (ref sparse matmul kernels)."""
-    import paddle_tpu as paddle
-    return paddle.matmul(ensure_tensor(x), ensure_tensor(y))
-
-
-def masked_matmul(x, y, mask, name=None):
-    """dense @ dense masked by a sparse pattern (ref masked_matmul)."""
-    import paddle_tpu as paddle
-    out = paddle.matmul(ensure_tensor(x), ensure_tensor(y))
-    m = (mask.to_dense() if isinstance(mask, SparseCooTensor)
-         else ensure_tensor(mask))
-    return _rewrap(paddle.multiply(
-        out, Tensor((m._data != 0).astype(out._data.dtype),
-                    _internal=True)), mask)
-
-
-def _unary(opname):
+def _unary(opname, jfn):
     def fn(x, name=None):
-        import paddle_tpu as paddle
-        return _rewrap(getattr(paddle, opname)(ensure_tensor(x)), x)
+        return _values_unary(jfn, x, opname)
     fn.__name__ = opname
     return fn
 
 
-sqrt = _unary("sqrt")
-sin = _unary("sin")
-tanh = _unary("tanh")
-abs = _unary("abs")
-neg = _unary("neg")
-square = _unary("square")
+sqrt = _unary("sqrt", jnp.sqrt)
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+square = _unary("square", jnp.square)
+expm1 = _unary("expm1", jnp.expm1)
+log1p = _unary("log1p", jnp.log1p)
 
 
 def relu(x, name=None):
+    return _values_unary(lambda v: jnp.maximum(v, 0), x, "relu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _values_unary(
+        lambda v: jnp.where(v >= 0, v, negative_slope * v), x, "leaky_relu")
+
+
+def relu6(x, name=None):
+    return _values_unary(lambda v: jnp.clip(v, 0, 6), x, "relu6")
+
+
+def pow(x, factor, name=None):
+    return _values_unary(lambda v: jnp.power(v, factor), x, "pow")
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from paddle_tpu.core.dtype import convert_dtype
+    out = _values_unary(
+        (lambda v: v.astype(convert_dtype(value_dtype)))
+        if value_dtype else (lambda v: v), x, "cast")
+    if index_dtype is not None and isinstance(out, SparseCooTensor):
+        out._indices = Tensor(out._indices._data.astype(
+            convert_dtype(index_dtype)), _internal=True)
+    return out
+
+
+def scale(x, scale_v=1.0, bias=0.0, bias_after_scale=True, name=None):
+    if bias != 0.0:
+        # a bias breaks zero-preservation — densify explicitly
+        import paddle_tpu as paddle
+        return paddle.scale(x.to_dense(), scale_v, bias, bias_after_scale)
+    return _values_unary(lambda v: v * scale_v, x, "scale")
+
+
+def add(x, y, name=None):
+    """COO + COO: concatenated patterns (duplicates are legal COO; call
+    .coalesce() to merge). Mixed sparse/dense densifies EXPLICITLY."""
+    import paddle_tpu as paddle
+    xs, ys = isinstance(x, SparseCooTensor), isinstance(y, SparseCooTensor)
+    if xs and ys:
+        if tuple(x._dense_shape) != tuple(y._dense_shape):
+            raise ValueError("sparse add: shape mismatch "
+                             f"{x._dense_shape} vs {y._dense_shape}")
+        idx = jnp.concatenate([x._indices._data, y._indices._data], axis=1)
+        vals = apply(lambda a, b: jnp.concatenate([a, b]), x, y,
+                     op_name="sparse_add")
+        return SparseCooTensor(Tensor(idx, _internal=True), vals,
+                               x._dense_shape,
+                               stop_gradient=vals.stop_gradient)
+    if xs:
+        return paddle.add(x.to_dense(), ensure_tensor(y))
+    if ys:
+        return paddle.add(ensure_tensor(x), y.to_dense())
+    return paddle.add(ensure_tensor(x), ensure_tensor(y))
+
+
+def subtract(x, y, name=None):
+    if isinstance(y, SparseCooTensor):
+        return add(x, neg(y), name)
+    import paddle_tpu as paddle
+    if isinstance(x, SparseCooTensor):
+        return paddle.subtract(x.to_dense(), ensure_tensor(y))
+    return paddle.subtract(ensure_tensor(x), ensure_tensor(y))
+
+
+def multiply(x, y, name=None):
+    """COO * dense gathers the dense operand at the nonzero sites (O(nnz));
+    COO * COO multiplies values when the patterns match, else densifies
+    explicitly (pattern intersection has data-dependent nnz)."""
+    import paddle_tpu as paddle
+    xs, ys = isinstance(x, SparseCooTensor), isinstance(y, SparseCooTensor)
+    if xs and ys:
+        if _same_pattern(x, y):
+            vals = apply(lambda a, b: a * b, x, y, op_name="sparse_multiply")
+            return SparseCooTensor(x._indices, vals, x._dense_shape,
+                                   stop_gradient=vals.stop_gradient)
+        return paddle.multiply(x.to_dense(), y.to_dense())
+    if xs or ys:
+        sp, dn = (x, y) if xs else (y, x)
+        dn = ensure_tensor(dn)
+        nsp = sp._indices._data.shape[0]
+
+        def prim(vals, idx, da):
+            picked = da[tuple(idx[i] for i in range(nsp))]
+            return vals * picked
+
+        vals = apply(prim, sp, sp._indices, dn, op_name="sparse_multiply")
+        return SparseCooTensor(sp._indices, vals, sp._dense_shape,
+                               stop_gradient=vals.stop_gradient)
+    return paddle.multiply(ensure_tensor(x), ensure_tensor(y))
+
+
+def divide(x, y, name=None):
+    import paddle_tpu as paddle
+    if isinstance(x, SparseCooTensor) and not isinstance(y, SparseCooTensor):
+        dn = ensure_tensor(y)
+        nsp = x._indices._data.shape[0]
+
+        def prim(vals, idx, da):
+            return vals / da[tuple(idx[i] for i in range(nsp))]
+
+        vals = apply(prim, x, x._indices, dn, op_name="sparse_divide")
+        return SparseCooTensor(x._indices, vals, x._dense_shape,
+                               stop_gradient=vals.stop_gradient)
+    a = x.to_dense() if isinstance(x, SparseCooTensor) else ensure_tensor(x)
+    b = y.to_dense() if isinstance(y, SparseCooTensor) else ensure_tensor(y)
+    return paddle.divide(a, b)
+
+
+def matmul(x, y, name=None):
+    """sparse [M, K] @ dense [K, N] -> dense [M, N] WITHOUT materializing a
+    dense x: gather y's rows at the column indices, weight by the values and
+    scatter-add into the output rows — O(nnz * N) (ref
+    `phi/kernels/sparse/matmul_kernel.h`)."""
+    import paddle_tpu as paddle
+    if not isinstance(x, SparseCooTensor):
+        y2 = y.to_dense() if isinstance(y, SparseCooTensor) else y
+        return paddle.matmul(ensure_tensor(x), ensure_tensor(y2))
+    if isinstance(y, SparseCooTensor):
+        y = y.to_dense()
+    if len(x._dense_shape) != 2:
+        return paddle.matmul(x.to_dense(), ensure_tensor(y))
+    m = x._dense_shape[0]
+    dn = ensure_tensor(y)
+
+    def prim(vals, idx, ya):
+        rows, cols = idx[0], idx[1]
+        contrib = vals[:, None] * ya[cols, :]          # [nnz, N]
+        out = jnp.zeros((m, ya.shape[-1]), contrib.dtype)
+        return out.at[rows].add(contrib)
+
+    return apply(prim, x, x._indices, dn, op_name="sparse_matmul")
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense [M,K] @ dense [K,N]) sampled ONLY at the mask's nonzero sites:
+    per-site row/col gather + dot, O(nnz * K) — the [M, N] product never
+    exists (ref `sparse/binary.py` masked_matmul / SDDMM)."""
+    if not isinstance(mask, SparseCooTensor):
+        import paddle_tpu as paddle
+        out = paddle.matmul(ensure_tensor(x), ensure_tensor(y))
+        m = ensure_tensor(mask)
+        return paddle.multiply(out, Tensor(
+            (m._data != 0).astype(out._data.dtype), _internal=True))
+    xa = x.to_dense() if isinstance(x, SparseCooTensor) else ensure_tensor(x)
+    ya = y.to_dense() if isinstance(y, SparseCooTensor) else ensure_tensor(y)
+
+    def prim(xd, yd, idx):
+        rows, cols = idx[0], idx[1]
+        return jnp.sum(xd[rows, :] * yd[:, cols].T, axis=1)   # [nnz]
+
+    vals = apply(prim, xa, ya, mask._indices, op_name="sparse_masked_matmul")
+    return SparseCooTensor(mask._indices, vals, mask._dense_shape,
+                           stop_gradient=vals.stop_gradient)
+
+
+def _row_segment_softmax(x):
+    """Per-row softmax over the NONZERO entries only (ref sparse softmax:
+    zeros are treated as -inf, `phi/kernels/sparse/softmax_kernel.cc`)."""
+    if len(x._dense_shape) != 2:
+        raise ValueError("sparse softmax supports 2-D COO/CSR")
+    m = x._dense_shape[0]
+
+    def prim(vals, idx):
+        rows = idx[0]
+        row_max = jax.ops.segment_max(vals, rows, num_segments=m)
+        e = jnp.exp(vals - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=m)
+        return e / denom[rows]
+
+    vals = apply(prim, x, x._indices, op_name="sparse_softmax")
+    return SparseCooTensor(x._indices, vals, x._dense_shape,
+                           stop_gradient=vals.stop_gradient)
+
+
+def softmax(x, axis=-1, name=None):
+    if isinstance(x, SparseCooTensor):
+        if axis in (-1, 1) and len(x._dense_shape) == 2:
+            return _row_segment_softmax(x)
+        # densifying here would silently flip semantics (implicit zeros
+        # would get exp(0) weight instead of -inf); the reference raises too
+        raise ValueError(
+            "sparse softmax supports only the last axis of a 2-D tensor "
+            f"(got axis={axis}, ndim={len(x._dense_shape)})")
     import paddle_tpu.nn.functional as F
-    return _rewrap(F.relu(ensure_tensor(x)), x)
+    return F.softmax(ensure_tensor(x), axis=axis)
+
+
+# --------------------------------------------------------------------- nn
+# ref `python/paddle/sparse/nn/` — layers over the functional forms above.
+
+from paddle_tpu.nn.layer import Layer as _Layer
+
+
+class ReLU(_Layer):
+    def forward(self, x):
+        return relu(x)
+
+
+class LeakyReLU(_Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return leaky_relu(x, self.negative_slope)
+
+
+class ReLU6(_Layer):
+    def forward(self, x):
+        return relu6(x)
+
+
+class Softmax(_Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return softmax(x, axis=self.axis)
+
+
+class BatchNorm(_Layer):
+    """Sparse batch norm (ref `sparse/nn/layer/norm.py:BatchNorm`): channel
+    statistics over the ACTIVE sites only — values are [nnz, C] for an
+    ND-sparse tensor with a dense channel tail."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        if data_format not in ("NDHWC", "NHWC"):
+            raise ValueError(
+                "sparse BatchNorm is channel-last only (NDHWC/NHWC), got "
+                f"{data_format!r} — values carry the channel tail")
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        from paddle_tpu.nn import initializer as I
+        # weight_attr/bias_attr=False -> fixed scale/shift (dense norm.py
+        # semantics); ParamAttr initializers/trainable are honored
+        self.weight = None if weight_attr is False else self.create_parameter(
+            (num_features,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_features,), attr=bias_attr, is_bias=True)
+        import jax.numpy as _jnp
+        self.register_buffer("_mean", Tensor(
+            _jnp.zeros(num_features), _internal=True))
+        self.register_buffer("_variance", Tensor(
+            _jnp.ones(num_features), _internal=True))
+
+    def forward(self, x):
+        if not isinstance(x, SparseCooTensor):
+            raise ValueError("sparse.nn.BatchNorm expects a SparseCooTensor")
+        if x._data.ndim != 2 or x._data.shape[-1] != self.num_features:
+            raise ValueError(
+                f"values must be [nnz, {self.num_features}], got "
+                f"{tuple(x._data.shape)}")
+        mom = self.momentum
+        eps = self.epsilon
+        c = self.num_features
+        w = self.weight if self.weight is not None else Tensor(
+            jnp.ones(c), _internal=True)
+        b = self.bias if self.bias is not None else Tensor(
+            jnp.zeros(c), _internal=True)
+
+        if self.training:
+            def prim(vals, wa, ba):
+                mu = vals.mean(axis=0)
+                var = vals.var(axis=0)
+                out = (vals - mu) / jnp.sqrt(var + eps) * wa + ba
+                return out, mu, var
+
+            out_vals, mu, var = apply(prim, x, w, b,
+                                      op_name="sparse_batch_norm",
+                                      n_outputs=3)
+            self._mean._write(mom * self._mean._read()
+                              + (1 - mom) * mu._data)
+            self._variance._write(mom * self._variance._read()
+                                  + (1 - mom) * var._data)
+        else:
+            def prim(vals, wa, ba, rm, rv):
+                return (vals - rm) / jnp.sqrt(rv + eps) * wa + ba
+
+            out_vals = apply(prim, x, w, b, self._mean,
+                             self._variance, op_name="sparse_batch_norm")
+        return SparseCooTensor(x._indices, out_vals, x._dense_shape,
+                               stop_gradient=out_vals.stop_gradient)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Under GSPMD the batch stats reduce across the mesh automatically when
+    values are sharded — one class serves both (ref sparse SyncBatchNorm)."""
 
 
 import types as _types
 
-nn = _types.SimpleNamespace()
-
-
-class _ReLU:
-    def __call__(self, x):
-        return relu(x)
-
-
-class _Softmax:
-    def __init__(self, axis=-1):
-        self.axis = axis
-
-    def __call__(self, x):
-        import paddle_tpu.nn.functional as F
-        return _rewrap(F.softmax(ensure_tensor(x), axis=self.axis), x)
-
-
-nn.ReLU = _ReLU
-nn.Softmax = _Softmax
+nn = _types.SimpleNamespace(
+    ReLU=ReLU, LeakyReLU=LeakyReLU, ReLU6=ReLU6, Softmax=Softmax,
+    BatchNorm=BatchNorm, SyncBatchNorm=SyncBatchNorm,
+)

@@ -132,8 +132,9 @@ class TestSparse:
 
 class TestSparseAutograd:
     def test_sparse_op_grad_flows(self):
-        """Sparse functional results keep the autograd chain (regression:
-        _rewrap used to rebuild from raw arrays, severing it)."""
+        """Sparse grads are VALUES-shaped (same sparsity pattern, the
+        reference's sparse-grad convention): d(sum(s*y))/d(values_i) =
+        y[site_i]."""
         import paddle_tpu.sparse as sparse
         idx = paddle.to_tensor(np.array([[0, 1], [1, 2]], np.int64))
         vals = paddle.to_tensor(np.array([2.0, -4.0], np.float32))
@@ -144,7 +145,7 @@ class TestSparseAutograd:
         out.to_dense().sum().backward()
         assert s.grad is not None
         np.testing.assert_allclose(np.asarray(s.grad._data),
-                                   np.full((3, 3), 2.0))
+                                   np.array([2.0, 2.0], np.float32))
 
 
 class TestEnvFlagWiring:
