@@ -312,6 +312,10 @@ class Tensor:
             yield self[i]
 
     def __bool__(self):
+        if isinstance(self._data, jax.core.Tracer):
+            from paddle_tpu.jit.dy2static import (
+                DataDependentControlFlowError, _HINT)
+            raise DataDependentControlFlowError(_HINT)
         return bool(self._data)
 
     def __int__(self):

@@ -16,3 +16,6 @@ instruction replay (`new_executor/interpretercore.cc:211`) but compiled.
 from paddle_tpu.jit.static_function import to_static, StaticFunction, not_to_static  # noqa: F401
 from paddle_tpu.jit.save_load import save, load, TranslatedLayer  # noqa: F401
 from paddle_tpu.jit.static_function import ignore_module  # noqa: F401
+from paddle_tpu.jit.dy2static import (  # noqa: F401
+    cond, while_loop, ifelse, whileloop, convert_to_static,
+    DataDependentControlFlowError)

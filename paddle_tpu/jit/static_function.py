@@ -250,8 +250,12 @@ class StaticFunction:
 
     # ------------------------------------------------------------------ capture
 
-    def _capture(self, key, args, kwargs):
-        fn = self._fn
+    def _capture(self, key, args, kwargs, _converted=False):
+        if not _converted and getattr(self, "_fn_dy2static", None) is not None:
+            # a previous signature already needed conversion — start from
+            # the converted fn instead of re-probing the original
+            _converted = True
+        fn = self._fn if not _converted else self._fn_dy2static
         cap = _CaptureSet(tensor_mod.current_stamp())
         arg_tensors, _, _ = _tree_flatten_tensors((args, kwargs))
         arg_ids = {id(t) for t in arg_tensors}
@@ -287,12 +291,27 @@ class StaticFunction:
                     t._out_slot = s
                     t._grad = g
 
+        retry_dy2static = False
         try:
             jax.eval_shape(probe, [t._data for t in arg_tensors])
+        except Exception as e:
+            from paddle_tpu.jit.dy2static import (
+                DataDependentControlFlowError)
+            if _converted or not isinstance(
+                    e, DataDependentControlFlowError):
+                raise
+            retry_dy2static = True
         finally:
             # roll the probe's state mutations back (tracer writes must not
             # escape; the first compiled call must observe pre-call state)
             cap.rollback()
+        if retry_dy2static:
+            # data-dependent Python control flow: retry with the AST-
+            # converted function (ref ProgramTranslator's transparent
+            # dy2static conversion, `program_translator.py:283`)
+            from paddle_tpu.jit.dy2static import convert_to_static
+            self._fn_dy2static = convert_to_static(self._fn)
+            return self._capture(key, args, kwargs, _converted=True)
         result = result_box[0]
 
         state_tensors = [cap.reads[k] for k in cap.order]

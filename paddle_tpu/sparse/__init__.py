@@ -95,7 +95,6 @@ class SparseCooTensor(Tensor):
         """Merge duplicate indices (eager: the merged nnz is data-dependent,
         ref `sparse/unary.py` coalesce)."""
         idx = np.asarray(self._indices._data)
-        vals = self._data
         lin = np.ravel_multi_index(
             idx, self._dense_shape[: idx.shape[0]])
         uniq, inv = np.unique(lin, return_inverse=True)
@@ -220,12 +219,14 @@ def cast(x, index_dtype=None, value_dtype=None, name=None):
     return out
 
 
-def scale(x, scale_v=1.0, bias=0.0, bias_after_scale=True, name=None):
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    factor = scale
     if bias != 0.0:
         # a bias breaks zero-preservation — densify explicitly
         import paddle_tpu as paddle
-        return paddle.scale(x.to_dense(), scale_v, bias, bias_after_scale)
-    return _values_unary(lambda v: v * scale_v, x, "scale")
+        d = x.to_dense() if isinstance(x, SparseCooTensor) else x
+        return paddle.scale(d, factor, bias, bias_after_scale)
+    return _values_unary(lambda v: v * factor, x, "scale")
 
 
 def add(x, y, name=None):

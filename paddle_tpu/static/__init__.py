@@ -57,3 +57,10 @@ def accuracy(input, label, k=1):
     """ref `paddle.static.accuracy` — same math as paddle.metric.accuracy."""
     from paddle_tpu.metric import accuracy as _acc
     return _acc(input, label, k=k)
+
+# ``paddle.static.nn`` — the control-flow ops are REAL (lax.cond/while
+# through the dispatcher, `jit/dy2static.py`); layer builders stay collapsed
+import types as _types
+from paddle_tpu.jit.dy2static import cond, while_loop  # noqa: F401
+
+nn = _types.SimpleNamespace(cond=cond, while_loop=while_loop)

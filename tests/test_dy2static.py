@@ -1,0 +1,273 @@
+"""Dy2static control-flow conversion (round-2 VERDICT #8; ref the
+`dygraph_to_static` suite): eager-vs-captured parity for data-dependent
+if/while, explicit cond/while_loop ops, clear unsupported errors."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+class TestExplicitOps:
+    def test_cond_concrete_and_traced(self):
+        def f(x):
+            return paddle.static.nn.cond(
+                x.sum() > 0, lambda: x * 2, lambda: x - 1)
+
+        x = _t([1.0, 2.0])
+        np.testing.assert_allclose(np.asarray(f(x)._data), [2.0, 4.0])
+        xneg = _t([-1.0, -2.0])
+        np.testing.assert_allclose(np.asarray(f(xneg)._data), [-2.0, -3.0])
+
+        @paddle.jit.to_static
+        def g(x):
+            return paddle.static.nn.cond(
+                x.sum() > 0, lambda: x * 2, lambda: x - 1)
+
+        np.testing.assert_allclose(np.asarray(g(x)._data), [2.0, 4.0])
+        np.testing.assert_allclose(np.asarray(g(xneg)._data), [-2.0, -3.0])
+
+    def test_cond_grads_flow(self):
+        x = _t([3.0, -1.0])
+        x.stop_gradient = False
+        out = paddle.jit.ifelse(x.sum() > 0,
+                                lambda a: (a * 3,),
+                                lambda a: (a * 5,), (x,))[0]
+        # concrete pred -> python path; grads via normal tape
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data), [3.0, 3.0])
+
+    def test_traced_cond_grads(self):
+        @paddle.jit.to_static
+        def step(x):
+            out = paddle.jit.ifelse(x.sum() > 0,
+                                    lambda a: (a * 3,),
+                                    lambda a: (a * 5,), (x,))[0]
+            loss = out.sum()
+            loss.backward()
+            return loss, x.grad
+
+        x = _t([3.0, -1.0])
+        x.stop_gradient = False
+        loss, g = step(x)
+        np.testing.assert_allclose(np.asarray(g._data), [3.0, 3.0])
+        xneg = _t([-3.0, -1.0])
+        xneg.stop_gradient = False
+        loss, g = step(xneg)
+        np.testing.assert_allclose(np.asarray(g._data), [5.0, 5.0])
+
+    def test_while_loop(self):
+        def double_until(x):
+            return paddle.static.nn.while_loop(
+                lambda v: v.sum() < 100.0, lambda v: v * 2, [x])[0]
+
+        # doubling stops once the sum reaches 100: [1,2]->...->[64,128]
+        out = double_until(_t([1.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(out._data), [64.0, 128.0])
+
+        @paddle.jit.to_static
+        def g(x):
+            return paddle.static.nn.while_loop(
+                lambda v: v.sum() < 100.0, lambda v: v * 2, [x])[0]
+
+        np.testing.assert_allclose(np.asarray(g(_t([1.0, 2.0]))._data),
+                                   [64.0, 128.0])
+
+
+class TestAutoConversion:
+    def test_data_dependent_if_auto_converts(self):
+        """The canonical dygraph_to_static if/else case runs unmodified."""
+        def model(x):
+            if x.mean() > 0:
+                y = x + 10.0
+            else:
+                y = x - 10.0
+            return y * 2
+
+        xs = [_t([1.0, 3.0]), _t([-5.0, -1.0])]
+        eager = [np.asarray(model(x)._data) for x in xs]
+
+        compiled = paddle.jit.to_static(model)
+        got = [np.asarray(compiled(x)._data) for x in xs]
+        for e, g in zip(eager, got):
+            np.testing.assert_allclose(g, e)
+
+    def test_data_dependent_while_auto_converts(self):
+        def model(x):
+            s = x
+            while s.sum() < 50.0:
+                s = s * 2
+            return s + 1
+
+        xs = [_t([1.0, 2.0]), _t([30.0, 30.0])]
+        eager = [np.asarray(model(x)._data) for x in xs]
+        compiled = paddle.jit.to_static(model)
+        got = [np.asarray(compiled(x)._data) for x in xs]
+        for e, g in zip(eager, got):
+            np.testing.assert_allclose(g, e)
+
+    def test_nested_if_in_while(self):
+        def model(x):
+            s = x
+            n = paddle.to_tensor(np.float32(0.0))
+            while s.sum() < 40.0:
+                if s.mean() > 2.0:
+                    s = s * 3
+                else:
+                    s = s * 2
+                n = n + 1
+            return s, n
+
+        x = _t([1.0, 1.5])
+        es, en = model(x)
+        cs, cn = paddle.jit.to_static(model)(x)
+        np.testing.assert_allclose(np.asarray(cs._data),
+                                   np.asarray(es._data))
+        np.testing.assert_allclose(np.asarray(cn._data),
+                                   np.asarray(en._data))
+
+    def test_branch_assigning_closure_weights(self):
+        """Converted branches may READ closure vars (layer weights)."""
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+
+        def model(x):
+            if x.mean() > 0:
+                h = lin(x)
+            else:
+                h = lin(x) * 0.5
+            return h.sum()
+
+        x = _t(np.ones((2, 4)))
+        eager = float(model(x))
+        got = float(paddle.jit.to_static(model)(x))
+        np.testing.assert_allclose(got, eager, rtol=1e-6)
+
+    def test_layer_params_get_grads_inside_traced_branch(self):
+        """Weights reached THROUGH a Layer operand must receive gradients
+        (round-3 review: they were silently zero)."""
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+
+        def eager_ref(x):
+            h = lin(x) if float(x.mean()) > 0 else lin(x) * 0.5
+            return h.sum()
+
+        x = _t(np.ones((2, 4)))
+        eager_ref(x).backward()
+        want = np.asarray(lin.weight.grad._data).copy()
+        lin.clear_gradients()
+
+        @paddle.jit.to_static
+        def step(x):
+            if x.mean() > 0:
+                h = lin(x)
+            else:
+                h = lin(x) * 0.5
+            loss = h.sum()
+            loss.backward()
+            return loss, lin.weight.grad
+
+        _, g = step(x)
+        assert g is not None, "no grad reached the layer weight"
+        np.testing.assert_allclose(np.asarray(g._data), want, rtol=1e-5)
+
+    def test_while_counter_auto_promotes(self):
+        """Python int counters in a traced while body are promoted to
+        loop-carried Tensors instead of silently freezing."""
+        def model(x):
+            s = x
+            i = 0
+            while s.sum() < 50.0:
+                s = s * 2
+                i = i + 1
+            return s, i
+
+        x = _t([1.0, 2.0])
+        es, ei = model(x)
+        cs, ci = paddle.jit.to_static(model)(x)
+        np.testing.assert_allclose(np.asarray(cs._data),
+                                   np.asarray(es._data))
+        assert int(np.asarray(ci._data)) == ei
+
+    def test_python_condition_stays_python(self):
+        """Concrete (non-tensor) conditions keep plain Python semantics
+        through the same transformed code."""
+        def model(x, flag):
+            if flag:
+                y = x + 1
+            else:
+                y = x - 1
+            return y
+
+        f = paddle.jit.to_static(model)
+        np.testing.assert_allclose(np.asarray(f(_t([1.0]), True)._data),
+                                   [2.0])
+
+    def test_unconvertible_raises_clearly(self):
+        """return inside a data-dependent branch: not converted, and the
+        failure names the problem instead of a raw tracer error."""
+        from paddle_tpu.jit.dy2static import DataDependentControlFlowError
+
+        def model(x):
+            if x.mean() > 0:
+                return x * 2
+            return x - 2
+
+        f = paddle.jit.to_static(model)
+        with pytest.raises(DataDependentControlFlowError,
+                           match="cond|branch|condition"):
+            f(_t([1.0, 2.0]))
+
+
+class TestConverterUnit:
+    def test_convert_to_static_source_shape(self):
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        def fn(x):
+            if x.mean() > 0:
+                y = x * 2
+            else:
+                y = x / 2
+            return y
+
+        conv = convert_to_static(fn)
+        x = _t([4.0])
+        np.testing.assert_allclose(np.asarray(conv(x)._data), [8.0])
+        np.testing.assert_allclose(np.asarray(conv(_t([-4.0]))._data),
+                                   [-2.0])
+
+
+class TestTrainingIntegration:
+    def test_branching_train_step_converges(self):
+        """The round-3 regression: a branch READING a local tensor (loss)
+        must stay differentiable — loads enter as explicit operands, not
+        closure captures, or backward silently produces no grads."""
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(),
+                              nn.Linear(64, 2))
+        opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                    parameters=model.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        rng = np.random.RandomState(0)
+        X = paddle.to_tensor(rng.randn(128, 16).astype(np.float32))
+        Y = paddle.to_tensor(rng.randint(0, 2, 128).astype(np.int64))
+
+        @paddle.jit.to_static
+        def step(x, y):
+            loss = loss_fn(model(x), y)
+            if loss > 1.0:
+                scaled = loss * 0.5
+            else:
+                scaled = loss
+            scaled.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        losses = [float(step(X, Y)) for _ in range(25)]
+        assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
