@@ -6,3 +6,6 @@ pocketfft), mel filterbank, DCT-II MFCC. Layers live in
 """
 from paddle_tpu.audio import features  # noqa: F401
 from paddle_tpu.audio import functional  # noqa: F401
+from paddle_tpu.audio import backends  # noqa: F401
+from paddle_tpu.audio import datasets  # noqa: F401
+from paddle_tpu.audio.backends import info, load, save  # noqa: F401

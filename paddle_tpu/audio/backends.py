@@ -1,0 +1,140 @@
+"""``paddle.audio.backends`` — wav I/O (ref:
+`python/paddle/audio/backends/wave_backend.py` info :37 / load :89 /
+save :168, backend registry `init_backend.py:37`).
+
+The built-in backend reads/writes PCM16 WAV through the stdlib ``wave``
+module (exactly the reference's fallback backend); a ``soundfile`` backend
+registers automatically when the optional package is importable.
+"""
+from __future__ import annotations
+
+import wave as _wave
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save",
+           "list_available_backends", "get_current_backend", "set_backend"]
+
+
+@dataclass
+class AudioInfo:
+    """ref `backends/backend.py` AudioInfo."""
+    sample_rate: int
+    num_frames: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def _soundfile_available():
+    try:
+        import soundfile  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+_BACKEND = "wave"
+
+
+def list_available_backends():
+    out = ["wave"]
+    if _soundfile_available():
+        out.append("soundfile")
+    return out
+
+
+def get_current_backend():
+    return _BACKEND
+
+
+def set_backend(backend_name):
+    global _BACKEND
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable; choose from "
+            f"{list_available_backends()}")
+    _BACKEND = backend_name
+
+
+def info(filepath):
+    """Signal info of a PCM WAV file (ref wave_backend.py:37)."""
+    if _BACKEND == "soundfile":
+        import soundfile as sf
+        i = sf.info(str(filepath))
+        return AudioInfo(int(i.samplerate), int(i.frames), int(i.channels),
+                         16, i.subtype or "PCM_S")
+    with _wave.open(str(filepath), "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Load a PCM16 WAV -> (Tensor, sample_rate) (ref wave_backend.py:89).
+
+    normalize=True returns float32 in (-1, 1); False returns raw int16
+    values (as float32, matching the reference). channels_first=True
+    returns [channels, time].
+    """
+    import paddle_tpu as paddle
+
+    if _BACKEND == "soundfile":
+        import soundfile as sf
+        data, sr = sf.read(str(filepath), dtype="int16")
+        data = np.atleast_2d(data.T).T            # [frames, channels]
+        channels = data.shape[1]
+        frames = data.shape[0]
+        audio = data.astype(np.float32)
+    else:
+        with _wave.open(str(filepath), "rb") as f:
+            channels = f.getnchannels()
+            sr = f.getframerate()
+            frames = f.getnframes()
+            if f.getsampwidth() != 2:
+                raise NotImplementedError(
+                    "only PCM16 WAV supported by the wave backend; "
+                    "set_backend('soundfile') for other encodings")
+            raw = f.readframes(frames)
+        audio = np.frombuffer(raw, dtype=np.int16).astype(np.float32)
+        audio = audio.reshape(frames, channels)
+    if normalize:
+        audio = audio / (2 ** 15)
+    if num_frames != -1:
+        audio = audio[frame_offset: frame_offset + num_frames, :]
+    elif frame_offset:
+        audio = audio[frame_offset:, :]
+    if channels_first:
+        audio = audio.T
+    return paddle.to_tensor(np.ascontiguousarray(audio)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    """Save a waveform Tensor as WAV (ref wave_backend.py:168). The wave
+    backend writes PCM_16; the soundfile backend honors other encodings."""
+    arr = np.asarray(src._data if hasattr(src, "_data") else src)
+    if _BACKEND == "soundfile":
+        import soundfile as sf
+        a = arr.T if channels_first and arr.ndim == 2 else arr
+        sf.write(str(filepath), a, int(sample_rate), subtype=encoding)
+        return
+    if encoding != "PCM_16" or bits_per_sample != 16:
+        raise NotImplementedError(
+            "the wave backend writes PCM_16 only; "
+            "set_backend('soundfile') for other encodings")
+    if arr.ndim == 1:
+        arr = arr[None, :] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T                                # -> [frames, channels]
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * (2 ** 15 - 1)).astype(np.int16)
+    else:
+        arr = arr.astype(np.int16)
+    with _wave.open(str(filepath), "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(arr).tobytes())

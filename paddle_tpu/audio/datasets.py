@@ -1,0 +1,159 @@
+"""``paddle.audio.datasets`` (ref: `python/paddle/audio/datasets/` —
+AudioClassificationDataset `dataset.py:29`, TESS `tess.py:26`, ESC50
+`esc50.py:26`).
+
+Zero-egress environment: datasets read from a LOCAL directory (pass
+``data_dir``, or set ``PADDLE_AUDIO_DATA_HOME``); when the files are
+missing the error names the archive the reference would download, instead
+of silently fetching.
+"""
+from __future__ import annotations
+
+import collections
+import os
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+from paddle_tpu.audio.features import (
+    MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram)
+
+__all__ = ["AudioClassificationDataset", "TESS", "ESC50"]
+
+feat_classes = {
+    "raw": None,
+    "melspectrogram": MelSpectrogram,
+    "mfcc": MFCC,
+    "logmelspectrogram": LogMelSpectrogram,
+    "spectrogram": Spectrogram,
+}
+
+
+def _data_home(data_dir):
+    return data_dir or os.environ.get(
+        "PADDLE_AUDIO_DATA_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle", "datasets"))
+
+
+class AudioClassificationDataset(Dataset):
+    """ref `dataset.py:29`: (waveform-or-feature, label) pairs over wav
+    files, with the feature extractor chosen by ``feat_type``."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        super().__init__()
+        if feat_type not in feat_classes:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, must be one of "
+                f"{list(feat_classes)}")
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self._feat_kwargs = kwargs
+        self._extractors = {}       # keyed by sample rate: mixed-rate
+        # datasets must not reuse a filterbank built for another rate
+
+    def _feature(self, waveform, sr):
+        cls = feat_classes[self.feat_type]
+        if cls is None:
+            return waveform
+        rate = self.sample_rate or sr
+        ex = self._extractors.get(rate)
+        if ex is None:
+            if cls is Spectrogram:      # rate-free transform: no sr param
+                ex = cls(**self._feat_kwargs)
+            else:
+                ex = cls(sr=rate, **self._feat_kwargs)
+            self._extractors[rate] = ex
+        out = ex(waveform.unsqueeze(0))
+        return out.squeeze(0)
+
+    def __getitem__(self, idx):
+        from paddle_tpu.audio import backends
+        waveform, sr = backends.load(self.files[idx], channels_first=False)
+        waveform = waveform.reshape([-1])           # mono [time]
+        return self._feature(waveform, sr), np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(AudioClassificationDataset):
+    """ref `tess.py:26` — 2800 emotional-speech wavs, 7 classes, n-fold
+    split by file order."""
+
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+    audio_path = "TESS_Toronto_emotional_speech_set"
+    archive_url = ("https://bj.bcebos.com/paddleaudio/datasets/"
+                   "TESS_Toronto_emotional_speech_set.zip")
+    meta_info = collections.namedtuple("META_INFO",
+                                       ("speaker", "word", "emotion"))
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_dir=None, **kwargs):
+        assert isinstance(n_folds, int) and n_folds >= 1
+        assert split in range(1, n_folds + 1)
+        files, labels = self._get_data(mode, n_folds, split, data_dir)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_data(self, mode, n_folds, split, data_dir):
+        root = os.path.join(_data_home(data_dir), self.audio_path)
+        if not os.path.isdir(root):
+            raise FileNotFoundError(
+                f"TESS data not found under {root}; this environment does "
+                f"not download — fetch {self.archive_url} and unzip it "
+                "there (or pass data_dir=)")
+        wav_files = []
+        for r, _, files in os.walk(root):
+            wav_files.extend(os.path.join(r, f) for f in sorted(files)
+                             if f.endswith(".wav"))
+        files, labels = [], []
+        for i, f in enumerate(sorted(wav_files)):
+            emotion = os.path.basename(f)[:-4].split("_")[-1].lower()
+            if emotion not in self.label_list:
+                continue
+            fold = i % n_folds + 1
+            if (mode == "train") == (fold != split):
+                files.append(f)
+                labels.append(self.label_list.index(emotion))
+        return files, labels
+
+
+class ESC50(AudioClassificationDataset):
+    """ref `esc50.py:26` — 2000 environmental sounds, 50 classes, the
+    meta CSV's fold column drives the train/dev split."""
+
+    audio_path = os.path.join("ESC-50-master", "audio")
+    meta_path = os.path.join("ESC-50-master", "meta", "esc50.csv")
+    archive_url = ("https://bj.bcebos.com/paddleaudio/datasets/"
+                   "ESC-50-master.zip")
+    meta_info = collections.namedtuple(
+        "META_INFO", ("filename", "fold", "target", "category",
+                      "esc10", "src_file", "take"))
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_dir=None, **kwargs):
+        files, labels = self._get_data(mode, split, data_dir)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_data(self, mode, split, data_dir):
+        home = _data_home(data_dir)
+        meta = os.path.join(home, self.meta_path)
+        if not os.path.isfile(meta):
+            raise FileNotFoundError(
+                f"ESC-50 meta not found at {meta}; this environment does "
+                f"not download — fetch {self.archive_url} and unzip it "
+                "there (or pass data_dir=)")
+        files, labels = [], []
+        with open(meta) as rf:
+            lines = rf.readlines()[1:]              # skip header
+        for line in lines:
+            m = self.meta_info(*line.strip().split(","))
+            if (mode == "train") == (int(m.fold) != split):
+                files.append(os.path.join(home, self.audio_path, m.filename))
+                labels.append(int(m.target))
+        return files, labels

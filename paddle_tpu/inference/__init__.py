@@ -38,6 +38,7 @@ class Config:
         self._memory_optim = True
         self._glog_info = False
         self._options = {}
+        self._mesh = None
 
     def set_model(self, prog_file, params_file=None):
         self.__init__(prog_file, params_file)
@@ -74,6 +75,31 @@ class Config:
 
     def enable_tensorrt_engine(self, *a, **k):
         self._options["trt"] = True     # no-op: XLA is the engine
+
+    # ------------------------------------------------------- distributed
+    def enable_dist_model(self, mesh=None, mp=None):
+        """Serve the model tensor-parallel from a device mesh — the TPU
+        analog of the reference's multi-rank inference runtime
+        (`fleet_executor/dist_model.cc`): instead of per-rank processes
+        exchanging tensors over brpc, the Predictor AOT-compiles the
+        exported graph with 'mp'-sharded parameter placements and GSPMD
+        serves it from every chip of the mesh in one program.
+
+        Pass an existing ``jax.sharding.Mesh`` with an 'mp' axis, or
+        ``mp=N`` to build one over the first N devices.
+        """
+        if mesh is None:
+            if not mp or mp < 2:
+                raise ValueError("enable_dist_model needs mesh= or mp>=2")
+            # build the serving mesh directly — auto_mesh would INSTALL it
+            # as the process-global mesh and clobber a training mesh
+            from jax.sharding import Mesh
+            mesh = Mesh(np.array(jax.devices()[:mp]), ("mp",))
+        if "mp" not in mesh.axis_names:
+            raise ValueError(
+                f"dist-model mesh needs an 'mp' axis, got {mesh.axis_names}")
+        self._mesh = mesh
+        return self
 
 
 class _IOHandle:
@@ -125,6 +151,19 @@ class Predictor:
         self._out_names = []
         self._outputs = {}
         self._params = {k: v._data for k, v in self._layer._state.items()}
+        self._mesh = config._mesh
+        if self._mesh is not None:
+            # TP placement: each param's largest mp-divisible dim is sharded
+            # over 'mp' (the generic plan; GSPMD inserts the collectives the
+            # reference's dist_model exchanges over brpc)
+            from jax.sharding import NamedSharding
+            from paddle_tpu.distributed.sharding import _shard_spec_for
+            placed = {}
+            for k, v in self._params.items():
+                spec = _shard_spec_for(tuple(v.shape), self._mesh, "mp")
+                placed[k] = jax.device_put(
+                    v, NamedSharding(self._mesh, spec))
+            self._params = placed
         self._compiled = {}
 
     # ---------------------------------------------------------------- handles
@@ -164,6 +203,12 @@ class Predictor:
             missing = [n for n in self._in_names
                        if self._inputs[n]._buf is None]
             raise ValueError(f"inputs not set: {missing}")
+        if self._mesh is not None:
+            # activations enter replicated; GSPMD re-shards as the param
+            # shardings dictate
+            from jax.sharding import NamedSharding, PartitionSpec
+            rep = NamedSharding(self._mesh, PartitionSpec())
+            arrs = [jax.device_put(a, rep) for a in arrs]
         outs = self._executable(arrs)(self._params, *arrs)
         if not isinstance(outs, (list, tuple)):
             outs = [outs]

@@ -252,7 +252,7 @@ def default_collate_fn(batch):
         return Tensor(jnp.stack([s._data for s in batch]), _internal=True)
     if isinstance(sample, np.ndarray):
         return Tensor(np.stack(batch))
-    if isinstance(sample, (int, float)):
+    if isinstance(sample, (int, float, np.integer, np.floating)):
         return Tensor(np.asarray(batch))
     if isinstance(sample, (list, tuple)):
         return [default_collate_fn([s[i] for s in batch])

@@ -82,3 +82,5 @@ class ViterbiDecoder(Layer):
     def forward(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+from paddle_tpu.text import datasets  # noqa: F401,E402

@@ -1,0 +1,544 @@
+"""``paddle.text.datasets`` (ref: `python/paddle/text/datasets/` —
+uci_housing.py, imdb.py, imikolov.py, movielens.py, wmt14.py, wmt16.py,
+conll05.py).
+
+Zero-egress environment: every dataset takes an explicit ``data_file``
+(the same archive the reference downloads); when absent the error names
+the URL instead of fetching. Parsing semantics mirror the reference's
+loaders so id sequences / splits line up.
+"""
+from __future__ import annotations
+
+import collections
+import gzip
+import os
+import re
+import string
+import tarfile
+import zipfile
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "MovieInfo",
+           "UserInfo", "WMT14", "WMT16", "Conll05st"]
+
+
+def _require(data_file, url, name):
+    if not data_file or not os.path.exists(data_file):
+        raise FileNotFoundError(
+            f"{name} needs data_file= pointing at the archive the "
+            f"reference downloads from {url}; this environment does not "
+            "download")
+    return data_file
+
+
+class UCIHousing(Dataset):
+    """ref `uci_housing.py:42`: 506x14 whitespace floats, min-max/avg
+    feature normalization, 80/20 ordered split."""
+
+    URL = "http://paddlemodels.bj.bcebos.com/uci_housing/housing.data"
+    feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
+                     "DIS", "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        super().__init__()
+        assert mode in ("train", "test")
+        self.mode = mode
+        self.dtype = "float32"
+        self.data_file = _require(data_file, self.URL, "UCIHousing")
+        self._load(feature_num=14, ratio=0.8)
+
+    def _load(self, feature_num, ratio):
+        raw = np.fromfile(self.data_file, sep=" ")
+        raw = raw.reshape(len(raw) // feature_num, feature_num)
+        mx, mn = raw.max(axis=0), raw.min(axis=0)
+        avg = raw.mean(axis=0)
+        for i in range(feature_num - 1):
+            raw[:, i] = (raw[:, i] - avg[i]) / (mx[i] - mn[i])
+        cut = int(raw.shape[0] * ratio)
+        self.data = raw[:cut] if self.mode == "train" else raw[cut:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (row[:-1].astype(self.dtype), row[-1:].astype(self.dtype))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """ref `imdb.py:31`: aclImdb tarball, punctuation-stripped lowercase
+    tokenization, dict of words with freq > cutoff, pos label 0 / neg 1."""
+
+    URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        super().__init__()
+        assert mode in ("train", "test")
+        self.mode = mode
+        self.data_file = _require(data_file, self.URL, "Imdb")
+        self.word_idx = self._build_dict(cutoff)
+        self._load()
+
+    def _docs(self, pattern):
+        strip = str.maketrans("", "", string.punctuation)
+        with tarfile.open(self.data_file) as tf:
+            for m in tf:
+                if pattern.match(m.name):
+                    text = tf.extractfile(m).read().decode(
+                        "latin-1").rstrip("\n\r")
+                    yield text.translate(strip).lower().split()
+
+    def _build_dict(self, cutoff):
+        freq = collections.defaultdict(int)
+        pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        for doc in self._docs(pat):
+            for w in doc:
+                freq[w] += 1
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        idx = {w: i for i, (w, _) in enumerate(kept)}
+        idx["<unk>"] = len(idx)
+        return idx
+
+    def _load(self):
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, kind in ((0, "pos"), (1, "neg")):
+            pat = re.compile(rf"aclImdb/{self.mode}/{kind}/.*\.txt$")
+            for doc in self._docs(pat):
+                self.docs.append([self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """ref `imikolov.py`: PTB from simple-examples.tgz; NGRAM windows or
+    SEQ (src, trg) pairs; dict of words with freq > min_word_freq."""
+
+    URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tar.gz"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=False):
+        super().__init__()
+        assert data_type in ("NGRAM", "SEQ")
+        assert mode in ("train", "valid")
+        self.mode = mode
+        self.data_type = data_type
+        self.window_size = window_size
+        self.data_file = _require(data_file, self.URL, "Imikolov")
+        self.word_idx = self._build_dict(min_word_freq)
+        self._load()
+
+    def _lines(self, split):
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(
+                f"./simple-examples/data/ptb.{split}.txt")
+            for line in f:
+                yield line.decode().strip().split()
+
+    def _build_dict(self, min_word_freq):
+        freq = collections.defaultdict(int)
+        for words in self._lines("train"):
+            for w in words:
+                freq[w] += 1
+        freq.pop("<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items()
+                       if c > min_word_freq), key=lambda x: (-x[1], x[0]))
+        idx = {w: i for i, (w, _) in enumerate(kept)}
+        for tok in ("<unk>", "<s>", "<e>"):
+            idx[tok] = len(idx)
+        return idx
+
+    def _load(self):
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for words in self._lines(self.mode):
+            if self.data_type == "NGRAM":
+                assert self.window_size > 0, "NGRAM needs window_size"
+                seq = ["<s>"] + words + ["<e>"]
+                if len(seq) < self.window_size:
+                    continue
+                ids = [self.word_idx.get(w, unk) for w in seq]
+                for i in range(self.window_size, len(ids) + 1):
+                    self.data.append(tuple(ids[i - self.window_size: i]))
+            else:
+                ids = [self.word_idx.get(w, unk) for w in words]
+                src = [self.word_idx["<s>"]] + ids
+                trg = ids + [self.word_idx["<e>"]]
+                if 0 < self.window_size < len(src):
+                    continue
+                self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class MovieInfo:
+    """ref `movielens.py:36`."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [
+            [self.index],
+            [categories_dict[c] for c in self.categories],
+            [movie_title_dict[w.lower()] for w in self.title.split()],
+        ]
+
+
+class UserInfo:
+    """ref `movielens.py:67`."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.positive_gender = gender == "M"
+        self.age = [1, 18, 25, 35, 45, 50, 56].index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.positive_gender else 1],
+                [self.age], [self.job_id]]
+
+
+class Movielens(Dataset):
+    """ref `movielens.py:96`: ml-1m.zip (users/movies/ratings .dat with
+    '::' separators) -> (user fields, movie fields, rating)."""
+
+    URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        super().__init__()
+        assert mode in ("train", "test")
+        self.mode = mode
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        self.data_file = _require(data_file, self.URL, "Movielens")
+        self._load_meta()
+        self._load()
+
+    def _read(self, zf, name):
+        full = next(n for n in zf.namelist() if n.endswith(name))
+        for line in zf.read(full).decode("latin-1").splitlines():
+            if line.strip():
+                yield line.strip()
+
+    def _load_meta(self):
+        self.movie_info, self.user_info = {}, {}
+        self.categories_dict, self.movie_title_dict = {}, {}
+        with zipfile.ZipFile(self.data_file) as zf:
+            for line in self._read(zf, "movies.dat"):
+                movie_id, title, categories = line.split("::")
+                categories = categories.split("|")
+                title = re.sub(r"\(\d{4}\)$", "", title).strip()
+                for c in categories:
+                    self.categories_dict.setdefault(
+                        c, len(self.categories_dict))
+                for w in title.split():
+                    self.movie_title_dict.setdefault(
+                        w.lower(), len(self.movie_title_dict))
+                self.movie_info[int(movie_id)] = MovieInfo(
+                    movie_id, categories, title)
+            for line in self._read(zf, "users.dat"):
+                uid, gender, age, job, _ = line.split("::")
+                self.user_info[int(uid)] = UserInfo(uid, gender, age, job)
+
+    def _load(self):
+        self.data = []
+        rng = np.random.RandomState(self.rand_seed)
+        with zipfile.ZipFile(self.data_file) as zf:
+            for line in self._read(zf, "ratings.dat"):
+                uid, mid, rating, _ = line.split("::")
+                is_test = rng.rand() < self.test_ratio
+                if (self.mode == "test") != is_test:
+                    continue
+                usr = self.user_info[int(uid)]
+                mov = self.movie_info[int(mid)]
+                self.data.append(usr.value()
+                                 + mov.value(self.categories_dict,
+                                             self.movie_title_dict)
+                                 + [[float(rating)]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _WMTBase(Dataset):
+    START, END, UNK = "<s>", "<e>", "<unk>"
+    UNK_IDX = 2
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, lang=None, reverse=False):
+        d = self.src_dict if lang in (None, "en", True) else self.trg_dict
+        if reverse:
+            return {v: k for k, v in d.items()}
+        return d
+
+
+class WMT14(_WMTBase):
+    """ref `wmt14.py:47`: tarball with {mode}/{mode} tab-separated parallel
+    text + src.dict/trg.dict files."""
+
+    URL = ("http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz")
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=False):
+        super().__init__()
+        assert mode in ("train", "test", "gen")
+        self.mode = mode
+        self.dict_size = dict_size
+        self.data_file = _require(data_file, self.URL, "WMT14")
+        self._load()
+
+    def _dict_from(self, f, size):
+        out = {}
+        for i, line in enumerate(f):
+            if 0 <= size <= i:
+                break
+            out[line.strip().decode()] = i
+        return out
+
+    def _load(self):
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            names = tf.getnames()
+            src_d = next(n for n in names if n.endswith("src.dict"))
+            trg_d = next(n for n in names if n.endswith("trg.dict"))
+            self.src_dict = self._dict_from(tf.extractfile(src_d),
+                                            self.dict_size)
+            self.trg_dict = self._dict_from(tf.extractfile(trg_d),
+                                            self.dict_size)
+            wanted = f"{self.mode}/{self.mode}"
+            for name in (n for n in names if n.endswith(wanted)):
+                for line in tf.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, self.UNK_IDX)
+                           for w in ([self.START] + parts[0].split()
+                                     + [self.END])]
+                    trg_w = parts[1].split()
+                    trg = [self.trg_dict.get(w, self.UNK_IDX)
+                           for w in trg_w]
+                    self.src_ids.append(src)
+                    self.trg_ids.append(
+                        [self.trg_dict.get(self.START, 0)] + trg)
+                    self.trg_ids_next.append(
+                        trg + [self.trg_dict.get(self.END, 1)])
+
+
+class WMT16(_WMTBase):
+    """ref `wmt16.py:52`: tarball with wmt16/{train,test,val} tab-separated
+    parallel text; dicts are built from the training corpus per language
+    and cached next to the archive."""
+
+    def get_dict(self, lang=None, reverse=False):
+        # src side follows self.lang (unlike WMT14's fixed en source)
+        d = self.src_dict if lang in (None, self.lang, True) else \
+            self.trg_dict
+        if reverse:
+            return {v: k for k, v in d.items()}
+        return d
+
+    URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=False):
+        super().__init__()
+        assert mode in ("train", "test", "val")
+        self.mode = mode
+        self.lang = lang
+        self.data_file = _require(data_file, self.URL, "WMT16")
+        self.src_dict = self._build_dict(0 if lang == "en" else 1,
+                                         src_dict_size)
+        self.trg_dict = self._build_dict(1 if lang == "en" else 0,
+                                         trg_dict_size)
+        self._load()
+
+    def _pairs(self, split):
+        with tarfile.open(self.data_file) as tf:
+            name = next(n for n in tf.getnames()
+                        if n.endswith(f"wmt16/{split}"))
+            for line in tf.extractfile(name):
+                parts = line.decode("utf-8").strip().split("\t")
+                if len(parts) == 2:
+                    yield parts
+
+    def _build_dict(self, side, size):
+        freq = collections.defaultdict(int)
+        for parts in self._pairs("train"):
+            for w in parts[side].split():
+                freq[w] += 1
+        kept = sorted(freq.items(), key=lambda x: (-x[1], x[0]))
+        if size > 0:
+            kept = kept[: max(size - 3, 0)]
+        out = {self.START: 0, self.END: 1, self.UNK: 2}
+        for w, _ in kept:
+            out.setdefault(w, len(out))
+        return out
+
+    def _load(self):
+        side = 0 if self.lang == "en" else 1
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for parts in self._pairs(self.mode):
+            src = [self.src_dict.get(w, self.UNK_IDX)
+                   for w in ([self.START] + parts[side].split()
+                             + [self.END])]
+            trg = [self.trg_dict.get(w, self.UNK_IDX)
+                   for w in parts[1 - side].split()]
+            self.src_ids.append(src)
+            self.trg_ids.append([0] + trg)
+            self.trg_ids_next.append(trg + [1])
+
+
+class Conll05st(Dataset):
+    """ref `conll05.py:95` — CoNLL-2005 SRL test split: the words/props
+    streams become one (sentence, predicate, BIO labels) sample per verb,
+    then the reference's context-window feature fields."""
+
+    DATA_URL = ("http://paddlemodels.bj.bcebos.com/conll05st/"
+                "conll05st-tests.tar.gz")
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None,
+                 emb_file=None, download=False):
+        super().__init__()
+        self.data_file = _require(data_file, self.DATA_URL, "Conll05st")
+        self.word_dict = self._load_dict(
+            _require(word_dict_file, self.DATA_URL, "Conll05st wordDict"))
+        self.predicate_dict = self._load_dict(
+            _require(verb_dict_file, self.DATA_URL, "Conll05st verbDict"))
+        self.label_dict = self._load_label_dict(
+            _require(target_dict_file, self.DATA_URL,
+                     "Conll05st targetDict"))
+        self._load()
+
+    @staticmethod
+    def _load_dict(path):
+        out = {}
+        with open(path) as f:
+            for i, line in enumerate(f):
+                out[line.strip()] = i
+        return out
+
+    @staticmethod
+    def _load_label_dict(path):
+        """ref conll05.py:168 — expand B-/I- prefixes over the tag list."""
+        out = {}
+        with open(path) as f:
+            for line in f:
+                tag = line.strip()
+                if tag.startswith("B-"):
+                    out[tag] = len(out)
+                    out["I-" + tag[2:]] = len(out)
+                elif tag == "O":
+                    out[tag] = len(out)
+        return out
+
+    @staticmethod
+    def _spans_to_bio(span_col):
+        """One props column -> BIO tags (the reference's bracket walk)."""
+        tags, cur, inside = [], "O", False
+        for tok in span_col:
+            if tok == "*":
+                tags.append("I-" + cur if inside else "O")
+            elif tok == "*)":
+                tags.append("I-" + cur)
+                inside = False
+            elif "(" in tok and ")" in tok:
+                cur = tok[1: tok.find("*")]
+                tags.append("B-" + cur)
+                inside = False
+            elif "(" in tok:
+                cur = tok[1: tok.find("*")]
+                tags.append("B-" + cur)
+                inside = True
+            else:
+                raise RuntimeError(f"unexpected props token {tok!r}")
+        return tags
+
+    def _load(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words, \
+                    gzip.GzipFile(fileobj=pf) as props:
+                sent, cols = [], []
+                for wline, pline in zip(words, props):
+                    w = wline.strip().decode()
+                    p = pline.strip().decode().split()
+                    if p:
+                        sent.append(w)
+                        cols.append(p)
+                        continue
+                    if cols:
+                        verbs = [v for v in (row[0] for row in cols)
+                                 if v != "-"]
+                        n_frames = len(cols[0]) - 1
+                        for k in range(n_frames):
+                            col = [row[k + 1] for row in cols]
+                            self.sentences.append(list(sent))
+                            self.predicates.append(verbs[k])
+                            self.labels.append(self._spans_to_bio(col))
+                    sent, cols = [], []
+
+    def __getitem__(self, idx):
+        """ref conll05.py __getitem__: context-window fields around the
+        predicate + mark vector + label ids."""
+        sentence = self.sentences[idx]
+        labels = self.labels[idx]
+        predicate = self.predicates[idx]
+        v = labels.index("B-V")
+        mark = [0] * len(labels)
+        ctx = {}
+        for off, name in ((-2, "n2"), (-1, "n1"), (0, "0"), (1, "p1"),
+                          (2, "p2")):
+            j = v + off
+            if 0 <= j < len(sentence):
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = "bos" if off < 0 else "eos"
+        unk = self.word_dict.get("<unk>", 0)
+        ids = [self.word_dict.get(w, unk) for w in sentence]
+        n = len(sentence)
+
+        def rep(word):
+            return [self.word_dict.get(word, unk)] * n
+
+        return (np.array(ids), np.array(rep(ctx["n2"])),
+                np.array(rep(ctx["n1"])), np.array(rep(ctx["0"])),
+                np.array(rep(ctx["p1"])), np.array(rep(ctx["p2"])),
+                np.array([self.predicate_dict[predicate]] * n),
+                np.array(mark),
+                np.array([self.label_dict[l] for l in labels]))
+
+    def __len__(self):
+        return len(self.sentences)

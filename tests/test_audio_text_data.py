@@ -1,0 +1,265 @@
+"""audio backends/datasets + text datasets (round-2 VERDICT missing #8):
+everything runs against synthetic local archives — no network."""
+import gzip
+import os
+import tarfile
+import wave
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _write_wav(path, sr=16000, n=800, channels=1, freq=440.0):
+    t = np.arange(n) / sr
+    sig = (0.3 * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+    data = (sig * (2 ** 15 - 1)).astype(np.int16)
+    if channels == 2:
+        data = np.stack([data, data], axis=1).reshape(-1)
+    with wave.open(str(path), "wb") as f:
+        f.setnchannels(channels)
+        f.setsampwidth(2)
+        f.setframerate(sr)
+        f.writeframes(data.tobytes())
+    return sig
+
+
+class TestAudioBackends:
+    def test_save_load_roundtrip(self, tmp_path):
+        from paddle_tpu import audio
+        sr = 16000
+        wav = np.linspace(-0.5, 0.5, sr // 2).astype(np.float32)
+        path = str(tmp_path / "t.wav")
+        audio.save(path, paddle.to_tensor(wav[None, :]), sr)
+        back, got_sr = audio.load(path)
+        assert got_sr == sr
+        np.testing.assert_allclose(np.asarray(back._data)[0], wav,
+                                   atol=2 / (2 ** 15))
+
+    def test_info(self, tmp_path):
+        from paddle_tpu import audio
+        p = tmp_path / "i.wav"
+        _write_wav(p, sr=8000, n=400)
+        i = audio.info(str(p))
+        assert (i.sample_rate, i.num_frames, i.num_channels,
+                i.bits_per_sample) == (8000, 400, 1, 16)
+
+    def test_load_offsets_and_raw(self, tmp_path):
+        from paddle_tpu import audio
+        p = tmp_path / "o.wav"
+        _write_wav(p, n=100)
+        t, _ = audio.load(str(p), frame_offset=10, num_frames=20)
+        assert tuple(t.shape) == (1, 20)
+        raw, _ = audio.load(str(p), normalize=False)
+        assert np.abs(np.asarray(raw._data)).max() > 1.0   # int16 scale
+
+    def test_backend_registry(self):
+        from paddle_tpu.audio import backends
+        assert "wave" in backends.list_available_backends()
+        assert backends.get_current_backend() == "wave"
+        with pytest.raises(NotImplementedError):
+            backends.set_backend("nonexistent")
+
+
+class TestAudioDatasets:
+    def _make_tess(self, tmp_path, n_per_class=2):
+        root = tmp_path / "TESS_Toronto_emotional_speech_set"
+        root.mkdir()
+        emotions = ["angry", "happy", "sad"]
+        k = 0
+        for e in emotions:
+            for i in range(n_per_class):
+                _write_wav(root / f"OAF_word{k}_{e}.wav", n=600,
+                           freq=200 + 50 * k)
+                k += 1
+        return tmp_path
+
+    def test_tess_raw_and_mfcc(self, tmp_path):
+        from paddle_tpu.audio.datasets import TESS
+        home = self._make_tess(tmp_path)
+        ds = TESS(mode="train", n_folds=3, split=1, data_dir=str(home))
+        dev = TESS(mode="dev", n_folds=3, split=1, data_dir=str(home))
+        assert len(ds) + len(dev) == 6 and len(dev) == 2
+        wavf, label = ds[0]
+        assert wavf.ndim == 1 and 0 <= int(label) < 7
+        mf = TESS(mode="train", n_folds=3, split=1, data_dir=str(home),
+                  feat_type="mfcc", n_mfcc=13)
+        feat, _ = mf[0]
+        assert feat.ndim == 2 and feat.shape[0] == 13
+
+    def test_tess_missing_data_raises(self, tmp_path):
+        from paddle_tpu.audio.datasets import TESS
+        with pytest.raises(FileNotFoundError, match="download"):
+            TESS(data_dir=str(tmp_path / "nope"))
+
+    def test_esc50(self, tmp_path):
+        from paddle_tpu.audio.datasets import ESC50
+        audio_dir = tmp_path / "ESC-50-master" / "audio"
+        meta_dir = tmp_path / "ESC-50-master" / "meta"
+        audio_dir.mkdir(parents=True)
+        meta_dir.mkdir(parents=True)
+        rows = ["filename,fold,target,category,esc10,src_file,take"]
+        for i in range(4):
+            name = f"clip{i}.wav"
+            _write_wav(audio_dir / name, n=400)
+            rows.append(f"{name},{i % 2 + 1},{i},cat{i},False,src,{i}")
+        (meta_dir / "esc50.csv").write_text("\n".join(rows) + "\n")
+        tr = ESC50(mode="train", split=1, data_dir=str(tmp_path))
+        dv = ESC50(mode="dev", split=1, data_dir=str(tmp_path))
+        assert len(tr) == 2 and len(dv) == 2
+        x, y = tr[0]
+        assert x.ndim == 1 and isinstance(int(y), int)
+
+
+class TestTextDatasets:
+    def test_uci_housing(self, tmp_path):
+        from paddle_tpu.text.datasets import UCIHousing
+        rng = np.random.RandomState(0)
+        raw = rng.rand(50, 14).astype(np.float64)
+        p = tmp_path / "housing.data"
+        with open(p, "w") as f:
+            for row in raw:
+                f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+        tr = UCIHousing(data_file=str(p), mode="train")
+        te = UCIHousing(data_file=str(p), mode="test")
+        assert len(tr) == 40 and len(te) == 10
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_imdb(self, tmp_path):
+        from paddle_tpu.text.datasets import Imdb
+        arc = tmp_path / "aclImdb_v1.tar.gz"
+        with tarfile.open(arc, "w:gz") as tf:
+            def add(name, text):
+                import io
+                data = text.encode()
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+            for i in range(3):
+                add(f"aclImdb/train/pos/{i}.txt", "good movie great fun")
+                add(f"aclImdb/train/neg/{i}.txt", "bad movie awful, bore!")
+                add(f"aclImdb/test/pos/{i}.txt", "good fun")
+                add(f"aclImdb/test/neg/{i}.txt", "awful bore")
+        ds = Imdb(data_file=str(arc), mode="train", cutoff=2)
+        assert len(ds) == 6
+        doc, label = ds[0]
+        assert doc.ndim == 1 and label[0] in (0, 1)
+        assert "movie" in ds.word_idx      # freq 6 > cutoff
+        assert "<unk>" in ds.word_idx
+
+    def test_imikolov_ngram_and_seq(self, tmp_path):
+        from paddle_tpu.text.datasets import Imikolov
+        arc = tmp_path / "simple-examples.tgz"
+        text = "the cat sat on the mat\nthe dog sat on the log\n"
+        with tarfile.open(arc, "w:gz") as tf:
+            import io
+            for split in ("train", "valid"):
+                data = text.encode()
+                ti = tarfile.TarInfo(
+                    f"./simple-examples/data/ptb.{split}.txt")
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        ng = Imikolov(data_file=str(arc), data_type="NGRAM", window_size=3,
+                      mode="train", min_word_freq=1)
+        assert len(ng) > 0 and len(ng[0]) == 3
+        sq = Imikolov(data_file=str(arc), data_type="SEQ", mode="valid",
+                      min_word_freq=1)
+        src, trg = sq[0]
+        assert len(src) == len(trg)
+
+    def test_movielens(self, tmp_path):
+        from paddle_tpu.text.datasets import Movielens
+        arc = tmp_path / "ml-1m.zip"
+        with zipfile.ZipFile(arc, "w") as zf:
+            zf.writestr("ml-1m/movies.dat",
+                        "1::Toy Story (1995)::Animation|Comedy\n"
+                        "2::Jumanji (1995)::Adventure\n")
+            zf.writestr("ml-1m/users.dat",
+                        "1::F::1::10::48067\n2::M::25::16::70072\n")
+            zf.writestr("ml-1m/ratings.dat",
+                        "1::1::5::978300760\n2::2::3::978302109\n"
+                        "1::2::4::978301968\n")
+        tr = Movielens(data_file=str(arc), mode="train", test_ratio=0.0)
+        assert len(tr) == 3
+        sample = tr[0]
+        assert len(sample) == 8            # 4 user + 3 movie + rating
+        assert sample[-1].shape == (1,)
+
+    def test_wmt14(self, tmp_path):
+        from paddle_tpu.text.datasets import WMT14
+        arc = tmp_path / "wmt14.tgz"
+        with tarfile.open(arc, "w:gz") as tf:
+            import io
+
+            def add(name, text):
+                data = text.encode()
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+            vocab = "\n".join(["<s>", "<e>", "<unk>", "hello", "world",
+                               "bonjour", "monde"]) + "\n"
+            add("wmt14/src.dict", vocab)
+            add("wmt14/trg.dict", vocab)
+            add("wmt14/train/train", "hello world\tbonjour monde\n")
+            add("wmt14/test/test", "hello\tbonjour\n")
+        tr = WMT14(data_file=str(arc), mode="train", dict_size=7)
+        assert len(tr) == 1
+        src, trg, trg_next = tr[0]
+        assert src[0] == tr.src_dict["<s>"] and src[-1] == tr.src_dict["<e>"]
+        assert list(trg[1:]) == list(trg_next[:-1])
+
+    def test_wmt16(self, tmp_path):
+        from paddle_tpu.text.datasets import WMT16
+        arc = tmp_path / "wmt16.tar.gz"
+        with tarfile.open(arc, "w:gz") as tf:
+            import io
+
+            def add(name, text):
+                data = text.encode()
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+            add("wmt16/train", "a b c\tx y z\na b\tx y\n")
+            add("wmt16/test", "a c\tx z\n")
+        ds = WMT16(data_file=str(arc), mode="test", src_dict_size=10,
+                   trg_dict_size=10)
+        assert len(ds) == 1
+        src, trg, trg_next = ds[0]
+        assert len(trg) == len(trg_next)
+        assert "a" in ds.src_dict and "x" in ds.trg_dict
+
+    def test_conll05st(self, tmp_path):
+        from paddle_tpu.text.datasets import Conll05st
+        words = "The\ncat\nsat\n\n"
+        props = "-\t*\n-\t*\nsat\t(V*)\n\n".replace("\t", " ")
+        arc = tmp_path / "conll05st-tests.tar.gz"
+        with tarfile.open(arc, "w:gz") as tf:
+            import io
+
+            def addgz(name, text):
+                buf = io.BytesIO()
+                with gzip.GzipFile(fileobj=buf, mode="wb") as g:
+                    g.write(text.encode())
+                data = buf.getvalue()
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+            addgz("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                  words)
+            addgz("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                  props)
+        wd = tmp_path / "wordDict.txt"
+        wd.write_text("<unk>\nThe\ncat\nsat\n")
+        vd = tmp_path / "verbDict.txt"
+        vd.write_text("sat\n")
+        td = tmp_path / "targetDict.txt"
+        td.write_text("B-V\nO\n")
+        ds = Conll05st(data_file=str(arc), word_dict_file=str(wd),
+                       verb_dict_file=str(vd), target_dict_file=str(td))
+        assert len(ds) == 1
+        fields = ds[0]
+        assert len(fields) == 9
+        assert fields[0].shape == (3,) and fields[7].tolist()[2] == 1

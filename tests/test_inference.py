@@ -46,3 +46,30 @@ def test_predictor_executable_cache(tmp_path):
     assert len(predictor._compiled) == 1          # cache hit, no recompile
     predictor.try_shrink_memory()
     assert len(predictor._compiled) == 0
+
+
+def test_dist_model_mp2_matches_single_device(tmp_path):
+    """TP-sharded serving (round-2 VERDICT #10, ref dist_model.cc): the
+    predictor under an mp=2 mesh must reproduce single-device outputs, with
+    params actually sharded over 'mp'."""
+    import jax
+    from paddle_tpu.inference import Config, create_predictor
+    model, prefix = _save_model(tmp_path)
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32)
+
+    solo = create_predictor(Config(prefix))
+    solo.run([x])
+    want = solo.get_output_handle(solo.get_output_names()[0]).copy_to_cpu()
+
+    config = Config(prefix).enable_dist_model(mp=2)
+    dist = create_predictor(config)
+    # at least one parameter is genuinely sharded over the mesh
+    specs = [v.sharding.spec for v in dist._params.values()
+             if hasattr(v.sharding, "spec")]
+    assert any("mp" in str(s) for s in specs), specs
+    dist.run([x])
+    got = dist.get_output_handle(dist.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # compiled program actually spans the mesh devices
+    assert any(len(v.devices()) == 2 for v in dist._params.values())
