@@ -138,6 +138,24 @@ def bench_bert():
     return batch / dt, dt, loss
 
 
+def _chw_to_hwc_u8(img):
+    # CHW float [0,1] -> HWC uint8 [0,255]: the jitter family operates on
+    # image-range uint8 like real decoded inputs. Module-level: spawn
+    # workers must pickle the transform pipeline.
+    return (img.transpose(1, 2, 0) * 255).astype(np.uint8)
+
+
+def _hwc_u8_to_chw(img):
+    return np.ascontiguousarray(
+        np.asarray(img, np.float32).transpose(2, 0, 1) / 255.0)
+
+
+def _host_collate(batch):
+    # measure the pipeline (workers + transport), not the device link:
+    # the tunnel's host->device path would otherwise dominate
+    return np.stack([b[0] for b in batch])
+
+
 def bench_dataloader():
     """Data-pipeline rung (SURVEY §7 hard-part #4): multi-worker DataLoader
     throughput over the native shared-memory transport vs in-process."""
@@ -150,22 +168,14 @@ def bench_dataloader():
     # realistic per-sample CPU cost (decode-ish augmentation) so the worker
     # pipeline has actual work to parallelize
     aug = T.Compose([
-        # CHW float [0,1] -> HWC uint8 [0,255]: the jitter family operates on
-        # image-range uint8 like real decoded inputs
-        lambda img: (img.transpose(1, 2, 0) * 255).astype(np.uint8),
+        _chw_to_hwc_u8,
         T.RandomResizedCrop(224),
         T.RandomHorizontalFlip(),
         T.ColorJitter(0.4, 0.4, 0.4),
-        lambda img: np.ascontiguousarray(
-            np.asarray(img, np.float32).transpose(2, 0, 1) / 255.0),
+        _hwc_u8_to_chw,
     ])
     ds = FakeData(size=512, image_shape=(3, 256, 256), transform=aug)
-
-    def host_collate(batch):
-        # measure the pipeline (workers + transport), not the device link:
-        # the tunnel's host->device path would otherwise dominate
-        import numpy as _np
-        return _np.stack([b[0] for b in batch])
+    host_collate = _host_collate
 
     def pump(num_workers, use_shared_memory):
         dl = DataLoader(ds, batch_size=64, num_workers=num_workers,

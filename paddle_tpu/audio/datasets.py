@@ -72,7 +72,11 @@ class AudioClassificationDataset(Dataset):
     def __getitem__(self, idx):
         from paddle_tpu.audio import backends
         waveform, sr = backends.load(self.files[idx], channels_first=False)
-        waveform = waveform.reshape([-1])           # mono [time]
+        if waveform.shape[-1] > 1:
+            waveform = waveform.mean(axis=-1)   # downmix — interleaving
+            # channels via reshape would corrupt the signal
+        else:
+            waveform = waveform.reshape([-1])   # mono [time]
         return self._feature(waveform, sr), np.int64(self.labels[idx])
 
     def __len__(self):
@@ -93,6 +97,7 @@ class TESS(AudioClassificationDataset):
 
     def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
                  data_dir=None, **kwargs):
+        assert mode in ("train", "dev"), mode
         assert isinstance(n_folds, int) and n_folds >= 1
         assert split in range(1, n_folds + 1)
         files, labels = self._get_data(mode, n_folds, split, data_dir)
@@ -136,6 +141,7 @@ class ESC50(AudioClassificationDataset):
 
     def __init__(self, mode="train", split=1, feat_type="raw",
                  data_dir=None, **kwargs):
+        assert mode in ("train", "dev"), mode
         files, labels = self._get_data(mode, split, data_dir)
         super().__init__(files=files, labels=labels, feat_type=feat_type,
                          **kwargs)

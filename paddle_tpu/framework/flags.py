@@ -118,8 +118,11 @@ define_flag("dataloader_mp_method", "spawn",
             "| forkserver | fork (requires a single-threaded parent; kept "
             "for unpicklable datasets at the caller's risk)")
 define_flag("tpu_flash_impl", "auto",
-            "flash-attention backend: auto | splash (Pallas splash kernel) | "
-            "mosaic (jax-bundled Pallas flash) | authored (in-repo Pallas "
-            "kernel, kernels/pallas/flash_attention.py) | xla (pure-XLA "
-            "flash-style custom vjp, also the fallback for non-tileable "
-            "shapes)")
+            "flash-attention backend: auto (measured per-shape selection, "
+            "kernels/autotune.py — ref phi/kernels/autotune) | splash "
+            "(Pallas splash kernel) | mosaic (jax-bundled Pallas flash) | "
+            "authored (in-repo Pallas fwd+bwd kernels, "
+            "kernels/pallas/flash_attention.py) | xla (pure-XLA flash-style "
+            "custom vjp, also the fallback for non-tileable shapes)")
+define_flag("autotune_verbose", False,
+            "log kernel autotune decisions with measured timings")

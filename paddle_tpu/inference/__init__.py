@@ -91,6 +91,10 @@ class Config:
         if mesh is None:
             if not mp or mp < 2:
                 raise ValueError("enable_dist_model needs mesh= or mp>=2")
+            if len(jax.devices()) < mp:
+                raise ValueError(
+                    f"enable_dist_model(mp={mp}) needs {mp} devices, have "
+                    f"{len(jax.devices())}")
             # build the serving mesh directly — auto_mesh would INSTALL it
             # as the process-global mesh and clobber a training mesh
             from jax.sharding import Mesh

