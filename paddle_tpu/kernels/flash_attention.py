@@ -156,10 +156,18 @@ def _splash_attention(q, k, v, causal, scale):
 
 
 def _qblocks(S):
-    """Static q-block size: single block up to 2k, else 1k blocks (bounds the
-    transient [Bq, S] logits while staying unrolled — lax.scan variants hit
-    pathological compile paths on the current TPU toolchain)."""
-    return S if S <= 2048 else 1024
+    """Static q-block size (unrolled python loop — lax.scan variants hit
+    pathological compile paths on the current TPU toolchain).
+
+    256 measured best on v5e (round-4 sweep, GPT-2s B16/S1024, fwd+bwd
+    per-12-layers: bq=1024 74.6 ms, 512 54.7, 256 48.5, 128 50.3): small
+    blocks make the causal ``kend`` truncation real — with bq == S the whole
+    [S, S] logits block is computed then half masked away, while bq=256 skips
+    the upper-triangular blocks' FLOPs and HBM traffic entirely. Whole-step
+    effect: 101.0k -> 120.7k tok/s (MFU 0.383 -> 0.458). Above 4k the block
+    size grows back to 1024 to bound the unrolled block count (compile
+    time)."""
+    return min(256, S) if S <= 4096 else 1024
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -199,8 +207,10 @@ def _xla_flash_fwd(q, k, v, causal, scale):
     for q0 in range(0, Sq, bq):
         qb = q[:, :, q0:q0 + bq]
         # causal: later K positions can't be attended by this q block — slice
-        # them off entirely (real FLOP/traffic saving, not just masking)
-        kend = min(q0 + bq + (Sk - Sq), Sk) if causal else Sk
+        # them off entirely (real FLOP/traffic saving, not just masking).
+        # Clamp to >= 1: Sq > Sk causal rows with no visible key keep the
+        # degenerate single-block behavior (all-masked -> uniform weights)
+        kend = min(max(q0 + bq + (Sk - Sq), 1), Sk) if causal else Sk
         kb, vb = k[:, :, :kend], v[:, :, :kend]
         logits = _block_logits(qb, kb, s)                   # bf16 [B,H,Bq,kend]
         if causal:
@@ -235,7 +245,7 @@ def _xla_flash_bwd(causal, scale, res, do):
         dob = do[:, :, q0:q0 + bq]
         ob = out[:, :, q0:q0 + bq]
         lseb = lse[:, :, q0:q0 + bq]
-        kend = min(q0 + bq + (Sk - Sq), Sk) if causal else Sk
+        kend = min(max(q0 + bq + (Sk - Sq), 1), Sk) if causal else Sk
         kb, vb = k[:, :, :kend], v[:, :, :kend]
         logits = _block_logits(qb, kb, s)
         if causal:
