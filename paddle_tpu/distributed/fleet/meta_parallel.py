@@ -45,9 +45,18 @@ def _place_param(p: Parameter, spec: PartitionSpec):
         p._write(jax.device_put(p._data, NamedSharding(mesh, spec)))
 
 
+# True while a heterogeneous pipeline stage body traces: sharding
+# constraints on auto axes inside the lax.switch branches segfault jax's
+# linearizer (pjit-in-switch-in-manual-shard_map), and the packed per-stage
+# params carry no 'mp' sub-sharding for them to pin anyway — so mpu layers
+# run unconstrained there and GSPMD picks layouts freely.
+_IN_HETERO_STAGE = False
+
+
 def _constrain(t: Tensor, spec: PartitionSpec) -> Tensor:
     mesh = get_mesh()
-    if mesh is None or not isinstance(t._data, jax.core.Tracer):
+    if mesh is None or _IN_HETERO_STAGE or not isinstance(
+            t._data, jax.core.Tracer):
         return t
     from paddle_tpu.core.autograd import apply
     sh = NamedSharding(mesh, spec)
@@ -365,8 +374,43 @@ class PipelineLayer(Layer):
         self._pp_micro = micro_batches
         self._pp_chunks = int(num_virtual_pipeline_stages or 1)
         self._pp_mode = False
+        self._pp_hetero = False
         if self._num_stages > 1 and _mesh_axis_size("pp") == self._num_stages:
-            self._init_spmd_pipeline(built)
+            # explicit balanced/manual segmentation = the user wants THOSE
+            # stage cuts — the heterogeneous engine honors them; "uniform"
+            # keeps the homogeneous fast path (stacked params + interleave,
+            # preserves mp sub-shardings) when the layer list allows it
+            prefer_hetero = (seg_method == "param"
+                             or isinstance(seg_method, (list, tuple)))
+            hetero_err = None
+            if prefer_hetero:
+                try:
+                    self._init_hetero_pipeline(built)
+                except NotImplementedError as e:
+                    hetero_err = e
+                if not self._pp_mode:
+                    self._init_spmd_pipeline(built)
+            else:
+                self._init_spmd_pipeline(built)
+                if not self._pp_mode:
+                    try:
+                        self._init_hetero_pipeline(built)
+                    except NotImplementedError as e:
+                        hetero_err = e
+            if not self._pp_mode:
+                import warnings
+                warnings.warn(
+                    f"PipelineLayer: pp={self._num_stages} was requested "
+                    f"but SPMD pipelining is unavailable ({hetero_err}); "
+                    "FALLING BACK TO SEQUENTIAL execution — no pipeline "
+                    "parallelism will happen", stacklevel=3)
+        elif self._num_stages > 1:
+            import warnings
+            warnings.warn(
+                f"PipelineLayer: num_stages={self._num_stages} but the "
+                f"current mesh has no matching 'pp' axis "
+                f"(size {_mesh_axis_size('pp')}); running SEQUENTIALLY",
+                stacklevel=3)
         if not self._pp_mode:
             from paddle_tpu.nn.layers.container import LayerList
             self._layers_list = LayerList([l for l, _ in built])
@@ -460,6 +504,249 @@ class PipelineLayer(Layer):
     def get_stage_layers(self, stage_id):
         lo, hi = self._segments[stage_id], self._segments[stage_id + 1]
         return self.run_funcs[lo:hi]
+
+    # -------------------------------------------------------- hetero pp setup
+
+    def _init_hetero_pipeline(self, built):
+        """Heterogeneous/buffered stages (ref `pp_layers.py:93,209`): the
+        segment bounds from ``seg_method`` become the stages; each stage's
+        params/buffers are packed into per-stage f32 vectors stacked on a
+        'pp'-sharded leading axis (see fleet/pipeline_hetero.py). Unlike the
+        homogeneous engine, stages may differ structurally and may carry
+        buffers (BN running stats)."""
+        from paddle_tpu.nn.layers.container import LayerList
+        from paddle_tpu.distributed.fleet import pipeline_hetero as ph
+        mesh = get_mesh()
+        n_stages = self._num_stages
+        if self._pp_chunks > 1:
+            raise NotImplementedError(
+                "interleaved virtual stages require homogeneous layers")
+        segs = self._segments
+        stage_slices = [built[segs[s]:segs[s + 1]] for s in range(n_stages)]
+        if any(len(sl) == 0 for sl in stage_slices):
+            raise NotImplementedError(
+                f"segment bounds {segs} produce an empty pipeline stage")
+        owner = {}
+        for s, sl in enumerate(stage_slices):
+            for layer, _ in sl:
+                if owner.setdefault(id(layer), s) != s:
+                    raise NotImplementedError(
+                        "a SharedLayerDesc layer appears in two different "
+                        "stages — weight tying across heterogeneous stages "
+                        "is only supported by the homogeneous engine")
+        param_objs, buf_objs, pmetas, bmetas = [], [], [], []
+        for sl in stage_slices:
+            ps, bs, seen = [], [], set()
+            for layer, _ in sl:
+                for p in layer.parameters():
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        ps.append(p)
+                for b in layer.buffers():
+                    if id(b) not in seen:
+                        seen.add(id(b))
+                        bs.append(b)
+            param_objs.append(ps)
+            buf_objs.append(bs)
+            pm = ph.leaf_metas([p._data for p in ps])
+            bm = ph.leaf_metas([b._data for b in bs])
+            ph._check_packable(pm, "stage parameters",
+                               concrete=[p._data for p in ps])
+            ph._check_packable(bm, "stage buffers",
+                               concrete=[b._data for b in bs])
+            pmetas.append(pm)
+            bmetas.append(bm)
+        plen = max(1, max(ph.packed_len(m) for m in pmetas))
+        blen = max(1, max(ph.packed_len(m) for m in bmetas))
+        packed_p = jnp.stack([ph.pack_leaves([p._data for p in ps], plen)
+                              for ps in param_objs])
+        packed_b = jnp.stack([ph.pack_leaves([b._data for b in bs], blen)
+                              for bs in buf_objs])
+        packed_p = jax.device_put(
+            packed_p, NamedSharding(mesh, PartitionSpec("pp", None)))
+        packed_b = jax.device_put(
+            packed_b, NamedSharding(mesh, PartitionSpec("pp", None)))
+        prm = Parameter(packed_p)
+        prm.name = "pp_hetero_params"
+        self.add_parameter("pp_hetero_params", prm)
+        bufs = Tensor(packed_b, _internal=True)
+        bufs.stop_gradient = True
+        self.register_buffer("pp_hetero_bufs", bufs)
+        self._ph_params = prm
+        self._ph_bufs = bufs
+        self._ph_stage_slices = stage_slices
+        self._ph_param_objs = param_objs
+        self._ph_buf_objs = buf_objs
+        self._ph_pmetas, self._ph_bmetas = pmetas, bmetas
+        self._ph_plen, self._ph_blen = plen, blen
+        # stage layers stay UNREGISTERED: the packed param/buffer replace them
+        self._layers_list = LayerList([])
+        self._pp_hetero = True
+        self._pp_mode = True
+
+    def _hetero_stage_fn(self, s, in_meta, act_len):
+        """fn(p_flat, b_flat, x_flat[, key]) -> (y_flat[act_len], b_flat')"""
+        from paddle_tpu.core import tensor as tensor_mod
+        from paddle_tpu.distributed.fleet import pipeline_hetero as ph
+        from paddle_tpu.distributed.fleet.pipeline import (
+            functional_rng, template_rng_guard)
+        players = self._ph_stage_slices[s]
+        pobjs, bobjs = self._ph_param_objs[s], self._ph_buf_objs[s]
+        pmetas, bmetas = self._ph_pmetas[s], self._ph_bmetas[s]
+        blen = self._ph_blen
+        n_in = ph.packed_len([in_meta])
+
+        def fn(p_flat, b_flat, x_flat, key=None):
+            pvals = ph.unpack_leaves(p_flat, pmetas)
+            bvals = ph.unpack_leaves(b_flat, bmetas)
+            xin = ph.unpack_leaves(x_flat[:n_in], [in_meta])[0]
+            saved_p = [(t._data, t._grad_node, t._out_slot) for t in pobjs]
+            saved_b = [t._data for t in bobjs]
+            prev_hooks = tensor_mod.set_capture_hooks(None, None)
+            for t, a in zip(pobjs, pvals):
+                t._data = a
+                t._grad_node = None
+            for t, a in zip(bobjs, bvals):
+                t._data = a
+            ctx = (functional_rng(key) if key is not None else
+                   template_rng_guard("the heterogeneous pipeline stage body"))
+            global _IN_HETERO_STAGE
+            prev_stage = _IN_HETERO_STAGE
+            _IN_HETERO_STAGE = True
+            try:
+                with ctx:
+                    out = Tensor(xin, _internal=True)
+                    for layer, ffunc in players:
+                        out = (ffunc(layer, out) if ffunc is not None
+                               else layer(out))
+                    new_bufs = [t._data for t in bobjs]  # BN wrote updates
+                    y = ph.pack_leaves([out._data], act_len)
+                    nb = ph.pack_leaves(new_bufs, blen)
+            finally:
+                _IN_HETERO_STAGE = prev_stage
+                tensor_mod.set_capture_hooks(*prev_hooks)
+                for t, (d, nd, sl) in zip(pobjs, saved_p):
+                    t._data = d
+                    t._grad_node = nd
+                    t._out_slot = sl
+                for t, d in zip(bobjs, saved_b):
+                    t._data = d
+            return y, nb
+
+        return fn
+
+    def _hetero_boundary_metas(self, x, mb):
+        """Abstract-eval each stage on an mb-sized input -> boundary metas.
+        Hooks are disabled (original params must not enter the capture's
+        read set — the packed vector replaces them) and buffer bindings are
+        restored (BN's running-stat write under eval_shape is a tracer)."""
+        from paddle_tpu.core import tensor as tensor_mod
+        from paddle_tpu.core.autograd import no_grad
+        from paddle_tpu.distributed.fleet.pipeline import functional_rng
+
+        def raw_stage(sl):
+            def f(a):
+                out = Tensor(a, _internal=True)
+                for layer, ffunc in sl:
+                    out = ffunc(layer, out) if ffunc is not None else layer(out)
+                if not isinstance(out, Tensor):
+                    raise NotImplementedError(
+                        "heterogeneous pipeline stages must map one tensor "
+                        f"to one tensor; got {type(out).__name__}")
+                return out._data
+            return f
+
+        metas = [(tuple((mb,) + tuple(x.shape[1:])),
+                  jnp.result_type(x.dtype))]
+        saved_b = [(t, t._data) for bl in self._ph_buf_objs for t in bl]
+        prev_hooks = tensor_mod.set_capture_hooks(None, None)
+        global _IN_HETERO_STAGE
+        prev_stage = _IN_HETERO_STAGE
+        _IN_HETERO_STAGE = True
+        try:
+            with no_grad(), functional_rng(jax.random.PRNGKey(0)):
+                aval = jax.ShapeDtypeStruct(*metas[0])
+                for sl in self._ph_stage_slices:
+                    aval = jax.eval_shape(raw_stage(sl), aval)
+                    metas.append((tuple(aval.shape),
+                                  jnp.result_type(aval.dtype)))
+        finally:
+            _IN_HETERO_STAGE = prev_stage
+            tensor_mod.set_capture_hooks(*prev_hooks)
+            for t, d in saved_b:
+                t._data = d
+        from paddle_tpu.distributed.fleet import pipeline_hetero as ph
+        ph._check_packable(metas, "stage boundary activations")
+        return metas
+
+    def _run_hetero_pipeline(self, x):
+        from paddle_tpu.core.autograd import apply, no_grad
+        from paddle_tpu.distributed.fleet import pipeline_hetero as ph
+        mesh = get_mesh()
+        x = ensure_tensor(x)
+        n_micro = self._pp_micro or 1
+        n_stages = self._num_stages
+        B = int(x.shape[0])
+        if B % n_micro != 0:
+            raise ValueError(f"batch {B} not divisible into {n_micro} micro")
+        mb = B // n_micro
+        use_rng = self.training
+        if use_rng and not hasattr(self, "_pp_generator"):
+            from paddle_tpu.ops import random as rnd
+            from paddle_tpu.ops.random import Generator
+            self._pp_generator = Generator(
+                rnd._default_generator.initial_seed() + 2718)
+            with jax.ensure_compile_time_eval():
+                self._pp_generator._state
+        for sl in self._ph_stage_slices:
+            for layer, _ in sl:
+                layer.train() if self.training else layer.eval()
+        cache_key = (tuple(mesh.axis_names), tuple(mesh.shape.items()),
+                     tuple(d.id for d in mesh.devices.flat), n_micro,
+                     self.training, tuple(x.shape), str(x.dtype))
+        cache = getattr(self, "_ph_prim_cache", None)
+        if cache is None:
+            cache = self._ph_prim_cache = {}
+        jitted = cache.get(cache_key)
+        if jitted is None:
+            metas = self._hetero_boundary_metas(x, mb)
+            act_len = max(ph.packed_len([m]) for m in metas)
+            out_meta = metas[-1]
+            out_len = ph.packed_len([out_meta])
+            stage_fns = [self._hetero_stage_fn(s, metas[s], act_len)
+                         for s in range(n_stages)]
+
+            def prim(packed_p, packed_b, xa, *kd):
+                xm = xa.reshape((n_micro, mb) + xa.shape[1:])
+                xm_flat = jnp.stack(
+                    [ph.pack_leaves([xm[m]], act_len)
+                     for m in range(n_micro)])
+                base_key = (jax.random.wrap_key_data(kd[0]) if kd else None)
+                outs, new_b = ph.spmd_pipeline_hetero(
+                    stage_fns, n_stages, n_micro, packed_p, packed_b,
+                    xm_flat, out_len, mesh, rng_key=base_key)
+                res = [ph.unpack_leaves(outs[m], [out_meta])[0]
+                       for m in range(n_micro)]
+                return jnp.concatenate(res, axis=0), new_b
+
+            jitted = jax.jit(prim)
+            cache[cache_key] = jitted
+        args = [self._ph_params, self._ph_bufs, x]
+        if use_rng:
+            kd = jax.random.key_data(self._pp_generator.next_key())
+            args.append(Tensor(kd, _internal=True))
+        out, new_b = apply(jitted, *args, op_name="spmd_pipeline_hetero")
+        with no_grad():
+            self._ph_bufs._write(new_b._data)
+            # refresh the original layer buffer objects so introspection /
+            # a later sequential run sees the updated running stats
+            for s, (bl, bm) in enumerate(zip(self._ph_buf_objs,
+                                             self._ph_bmetas)):
+                if bl:
+                    vals = ph.unpack_leaves(new_b._data[s], bm)
+                    for t, v in zip(bl, vals):
+                        t._data = v
+        return out
 
     def _run_spmd_pipeline(self, x):
         from paddle_tpu.core.autograd import apply
@@ -558,6 +845,10 @@ class PipelineLayer(Layer):
 
     def forward(self, x):
         from paddle_tpu.distributed.fleet.recompute import recompute
+        if self._pp_mode and self._pp_hetero:
+            # heterogeneous engine spans the WHOLE layer list (the segment
+            # bounds are the stages) — no sequential prefix/suffix
+            return self._run_hetero_pipeline(x)
         if self._pp_mode:
             start, end = self._pp_run
             runs = (self.run_funcs[:start]

@@ -191,13 +191,18 @@ def spmd_pipeline_interleaved(stage_fn, n_stages, n_chunks, n_micro,
         base_key = (jax.random.wrap_key_data(key_data[0])
                     if key_data else None)
         carry = jnp.zeros((n_chunks, mb) + xs.shape[2:], xs.dtype)
-        outs = jnp.zeros_like(xs)
+        ys_hist = []
         total_ticks = n_micro + s_total - 1
         for t in range(total_ticks):
             feed = xs[min(t, n_micro - 1)]
             x0 = jnp.where(is_first, feed, carry[0]) \
                 if t < n_micro else carry[0]
-            x_in = carry.at[0].set(x0)
+            # concatenate, not carry.at[0].set: an in-place update on the
+            # big carried buffer creates a full un-aliasable buffer version
+            # per unrolled tick in the compiled vjp (measured: ~1 MB/tick
+            # fixed temp overhead that erased the pipeline's memory win)
+            x_in = (jnp.concatenate([x0[None], carry[1:]], axis=0)
+                    if n_chunks > 1 else x0[None])
             # all chunks advance one tick in parallel (independent microbatches)
             if base_key is not None:
                 # chunk ci runs LOGICAL stage s = ci*n_stages + r, which at
@@ -211,16 +216,17 @@ def spmd_pipeline_interleaved(stage_fn, n_stages, n_chunks, n_micro,
             else:
                 y = _vmap_chunks(stage_fn, local, x_in)
             # microbatch m leaves the last chunk of the last rank at
-            # t = m + s_total - 1
-            m = t - (s_total - 1)
-            if 0 <= m < n_micro:
-                outs = outs.at[m].set(jnp.where(is_last, y[-1], outs[m]))
+            # t = m + s_total - 1; stash this tick's output instead of
+            # updating an [n_micro, ...] buffer in place (aliasing, above)
+            ys_hist.append(y)
             if t < total_ticks - 1:
                 moved = jax.lax.ppermute(y, "pp", perm)
                 # the wrap-around from the last rank enters the NEXT chunk on
                 # rank 0; other ranks keep chunk alignment
                 rolled = jnp.roll(moved, 1, axis=0)
                 carry = jnp.where(is_first, rolled, moved)
+        outs = jnp.stack([ys_hist[m + s_total - 1][-1]
+                          for m in range(n_micro)])
         return jax.lax.psum(
             jnp.where(is_last, outs, jnp.zeros_like(outs)), "pp")
 

@@ -1,0 +1,186 @@
+"""Heterogeneous SPMD pipeline engine: arbitrary per-stage graphs + buffers.
+
+Counterpart of the reference's general pipeline — `SegmentLayers`
+(`python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py:93`)
+segments ANY layer list (uniform / param-count / manual) and each stage runs its
+own sub-graph (`pp_layers.py:209`), including BN layers with running stats.
+The homogeneous engine (`fleet/pipeline.py`) requires structurally identical,
+buffer-free stages; this module removes both restrictions, TPU-style:
+
+- Each stage's parameter tree is FLATTENED into one f32 vector, padded to the
+  widest stage, and stacked into a [pp, max_len] array sharded over 'pp' — so
+  every rank holds exactly one stage's weights (1/pp of the model) even when
+  stages differ structurally. Buffers (BN running stats) get the same packing
+  and ride the schedule as per-rank state, updated only on valid ticks.
+- Activations crossing stage boundaries are packed into fixed-size f32
+  buffers (padded to the widest boundary), so `lax.ppermute` can hand them to
+  the next stage even when boundary shapes differ (a ResNet's stage cut
+  changes [B,C,H,W] between stages; the reference's p2p layer solves this
+  with a tensor-meta handshake, `pp_utils/p2p_communication.py:74-154`).
+- Inside the shard_map body, `lax.switch(axis_index('pp'), branches)` selects
+  the rank's stage sub-graph; XLA compiles all branches into one SPMD program.
+  The backward pipeline (reversed ring + branch transposes) falls out of vjp.
+
+Packing is exact for f32/bf16/f16 (sub-ranges of f32) and for integers up to
+2^24 (float32 mantissa); pipeline-boundary ints above that are rejected.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.fleet.pipeline import (
+    functional_rng, stage_rng_key, template_rng_guard)
+
+
+# Packing carrier dtype. float32 default; tests (and x64 users chasing exact
+# parity) may set float64 — ResNet50-depth f32 reassociation noise is ~1e-3
+# on logits, while the f64 carrier agrees with the serial run to 1e-7.
+CARRIER_DTYPE = jnp.float32
+
+
+def _nelems(shape):
+    return int(np.prod(shape)) if len(shape) else 1
+
+
+def leaf_metas(arrays):
+    return [(tuple(a.shape), jnp.result_type(a.dtype)) for a in arrays]
+
+
+def packed_len(metas):
+    return sum(_nelems(s) for s, _ in metas)
+
+
+def _check_packable(metas, what, concrete=None):
+    """Reject dtypes the f32 carrier cannot round-trip. 64-bit ints are
+    rejected statically; for CONCRETE arrays (params/buffers, packed
+    eagerly) int32 VALUES beyond the f32 mantissa (2^24) are rejected too.
+    Traced boundary activations cannot be value-checked — ints there (e.g.
+    token ids) must stay under 2^24, see the module docstring."""
+    for i, (shape, dt) in enumerate(metas):
+        if not jnp.issubdtype(dt, jnp.integer):
+            continue
+        if jnp.dtype(dt).itemsize > 4:
+            raise NotImplementedError(
+                f"heterogeneous pipeline cannot pack {what} of dtype {dt} "
+                "(f32 carrier); cast to int32/float at the stage boundary")
+        if concrete is not None:
+            a = concrete[i]
+            if a.size and int(np.abs(np.asarray(a)).max()) > (1 << 24):
+                raise NotImplementedError(
+                    f"heterogeneous pipeline cannot pack {what}: {dt} "
+                    "values exceed 2^24 and would be rounded by the f32 "
+                    "carrier")
+
+
+def pack_leaves(arrays, length):
+    """Flatten+concat arrays as the carrier dtype, zero-padded to
+    ``length``."""
+    parts = [jnp.ravel(a).astype(CARRIER_DTYPE) for a in arrays]
+    flat = (jnp.concatenate(parts) if parts
+            else jnp.zeros((0,), CARRIER_DTYPE))
+    pad = length - flat.shape[0]
+    return jnp.pad(flat, (0, pad)) if pad else flat
+
+
+def unpack_leaves(flat, metas):
+    out, off = [], 0
+    for shape, dtype in metas:
+        n = _nelems(shape)
+        out.append(flat[off:off + n].reshape(shape).astype(dtype))
+        off += n
+    return out
+
+
+def spmd_pipeline_hetero(stage_fns, n_stages, n_micro, packed_params,
+                         packed_bufs, xm_flat, out_len, mesh, rng_key=None):
+    """GPipe schedule over heterogeneous stages.
+
+    stage_fns: per-stage ``fn(param_flat, buf_flat, x_flat[, key]) ->
+    (y_flat, new_buf_flat)`` where y_flat is padded to the shared activation
+    length; branches must agree on output shapes (they do, by padding).
+    packed_params: [n_stages, plen] f32 (row s = stage s params).
+    packed_bufs:   [n_stages, blen] f32 (row s = stage s buffers).
+    xm_flat: [n_micro, act_len] f32 — stage-0 inputs, one row per microbatch.
+    out_len: valid prefix of the final stage's output rows.
+    Returns (outs [n_micro, out_len] replicated, new_bufs [n_stages, blen]).
+    """
+    act_len = xm_flat.shape[1]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def per_rank(params, bufs, xs, *key_data):
+        p = params[0]                      # [1, plen] local block -> [plen]
+        buf = bufs[0]
+        r = jax.lax.axis_index("pp")
+        is_first = (r == 0)
+        is_last = (r == n_stages - 1)
+        base_key = (jax.random.wrap_key_data(key_data[0])
+                    if key_data else None)
+        carry = jnp.zeros((act_len,), CARRIER_DTYPE)
+        ys_hist = []
+        total_ticks = n_micro + n_stages - 1
+        for t in range(total_ticks):
+            feed = xs[min(t, n_micro - 1)]
+            x0 = jnp.where(is_first, feed, carry) if t < n_micro else carry
+            m_id = jnp.clip(t - r, 0, n_micro - 1)
+            if base_key is not None:
+                key = stage_rng_key(base_key, r, m_id)
+                branches = [
+                    (lambda pp_, bb_, xx_, kk_, _f=f: _f(pp_, bb_, xx_, kk_))
+                    for f in stage_fns]
+                y, buf_new = jax.lax.switch(r, branches, p, buf, x0, key)
+            else:
+                branches = [
+                    (lambda pp_, bb_, xx_, _f=f: _f(pp_, bb_, xx_))
+                    for f in stage_fns]
+                y, buf_new = jax.lax.switch(r, branches, p, buf, x0)
+            # buffer updates (BN running stats) only land on ticks where this
+            # rank held a real microbatch — warmup/drain garbage is masked
+            valid = (t - r >= 0) & (t - r < n_micro)
+            buf = jnp.where(valid, buf_new, buf)
+            # stash per-tick outputs; stacking at the end avoids the
+            # per-tick in-place buffer versions that defeated XLA's
+            # aliasing in the homogeneous engine (see fleet/pipeline.py)
+            ys_hist.append(y)
+            if t < total_ticks - 1:
+                carry = jax.lax.ppermute(y, "pp", perm)
+        outs = jnp.stack([ys_hist[m + n_stages - 1][:out_len]
+                          for m in range(n_micro)])
+        outs = jax.lax.psum(
+            jnp.where(is_last, outs, jnp.zeros_like(outs)), "pp")
+        return outs, buf[None]
+
+    extra, extra_specs = (), ()
+    if rng_key is not None:
+        extra = (jax.random.key_data(rng_key),)
+        extra_specs = (P(),)
+    f = jax.shard_map(
+        per_rank, mesh=mesh,
+        in_specs=(P("pp", None), P("pp", None), P()) + extra_specs,
+        out_specs=(P(), P("pp", None)),
+        axis_names={"pp"},
+        # see fleet/pipeline.py: stage bodies may run with_sharding_constraint
+        # on AUTO axes, which the vma checker rejects inside manual regions
+        check_vma=False)
+    return f(packed_params, packed_bufs, xm_flat, *extra)
+
+
+def hetero_serial_reference(stage_fns, n_stages, n_micro, packed_params,
+                            packed_bufs, xm_flat, out_len, rng_key=None):
+    """Single-device oracle: same microbatching, same packing, same
+    `stage_rng_key` derivation, same per-stage buffer update order —
+    the parity reference for tests (cf. pipeline_serial_reference)."""
+    bufs = [packed_bufs[s] for s in range(n_stages)]
+    outs = []
+    for m in range(n_micro):
+        h = xm_flat[m]
+        for s in range(n_stages):
+            if rng_key is None:
+                h, bufs[s] = stage_fns[s](packed_params[s], bufs[s], h)
+            else:
+                h, bufs[s] = stage_fns[s](packed_params[s], bufs[s], h,
+                                          stage_rng_key(rng_key, s, m))
+        outs.append(h[:out_len])
+    return jnp.stack(outs), jnp.stack(bufs)
