@@ -473,4 +473,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
         else:
             out_tensors.append(T.Tensor(jnp.asarray(r), stop_gradient=True,
                                         _internal=True))
-    return out_tensors[0] if single_in else out_tensors
+    # ALWAYS a list, matching the reference ("a list of Tensors, whose
+    # length is the same as the Tensor number inside inputs") — unwrapping
+    # for a single bare-Tensor input made the common `paddle.grad(y, x)[0]`
+    # idiom silently index ELEMENT 0 of the gradient instead
+    return out_tensors
