@@ -1,0 +1,197 @@
+// C-ABI inference client — parity surface for the reference's C API
+// (`paddle/fluid/inference/capi_exp/pd_config.h`, `pd_predictor.h`): a C
+// program links this shim and runs inference OUT-OF-PROCESS against
+// `python -m paddle_tpu.inference.serve` over the wire protocol documented
+// in inference/serve.py (u32 magic 'PRPD' | op | n_arrays | arrays...).
+// No Python/JAX lives in the client process — the deployment shape the
+// reference's capi_exp + fluid/jit/layer.h provide.
+//
+// Build: paddle_tpu.utils.cpp_extension.load("pd_c_client", [this file]).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50445250;
+constexpr uint32_t kOpRun = 1;
+constexpr uint32_t kOpPing = 2;
+constexpr uint32_t kOpShutdown = 3;
+
+struct Array {
+  uint8_t dtype;
+  std::vector<uint32_t> dims;
+  std::vector<uint8_t> data;
+};
+
+struct Client {
+  int fd = -1;
+  std::vector<Array> outputs;
+  std::string last_error;
+};
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t k = ::send(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// dtype codes match serve.py's _DTYPES table
+// (0=f32 1=f64 2=i32 3=i64 4=u8 5=bool 6=f16 7=bf16 8=i8 ...).
+
+void* PD_RemotePredictorCreate(const char* host, int port) {
+  auto* c = new Client();
+  c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (c->fd < 0) {
+    delete c;
+    return nullptr;
+  }
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::connect(c->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+          0) {
+    ::close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+int PD_RemotePredictorPing(void* h) {
+  auto* c = static_cast<Client*>(h);
+  uint32_t head[3] = {kMagic, kOpPing, 0};
+  if (!send_all(c->fd, head, sizeof(head))) return 0;
+  uint32_t resp[3];
+  if (!recv_all(c->fd, resp, sizeof(resp))) return 0;
+  return resp[0] == kMagic && resp[1] == 0;
+}
+
+// ins_* are parallel arrays of length n_in; dims64 is the concatenation of
+// every input's dims (ndims[i] entries each); datas[i] points at input i's
+// contiguous bytes of nbytes[i].
+int PD_RemotePredictorRun(void* h, int n_in, const int* dtypes,
+                          const int* ndims, const int64_t* dims64,
+                          const void* const* datas, const int64_t* nbytes) {
+  auto* c = static_cast<Client*>(h);
+  c->outputs.clear();
+  c->last_error.clear();
+  uint32_t head[3] = {kMagic, kOpRun, static_cast<uint32_t>(n_in)};
+  if (!send_all(c->fd, head, sizeof(head))) return -1;
+  const int64_t* dp = dims64;
+  for (int i = 0; i < n_in; ++i) {
+    uint8_t meta[2] = {static_cast<uint8_t>(dtypes[i]),
+                       static_cast<uint8_t>(ndims[i])};
+    if (!send_all(c->fd, meta, 2)) return -1;
+    std::vector<uint32_t> dims(ndims[i]);
+    for (int d = 0; d < ndims[i]; ++d)
+      dims[static_cast<size_t>(d)] = static_cast<uint32_t>(*dp++);
+    if (ndims[i] &&
+        !send_all(c->fd, dims.data(), dims.size() * sizeof(uint32_t)))
+      return -1;
+    uint64_t nb = static_cast<uint64_t>(nbytes[i]);
+    if (!send_all(c->fd, &nb, 8)) return -1;
+    if (nb && !send_all(c->fd, datas[i], nb)) return -1;
+  }
+  uint32_t resp[3];
+  if (!recv_all(c->fd, resp, sizeof(resp))) return -1;
+  if (resp[0] != kMagic) return -1;
+  if (resp[1] != 0) {  // error payload
+    std::vector<char> msg(resp[2]);
+    if (resp[2] && !recv_all(c->fd, msg.data(), msg.size())) return -1;
+    c->last_error.assign(msg.begin(), msg.end());
+    return -2;
+  }
+  for (uint32_t i = 0; i < resp[2]; ++i) {
+    Array a;
+    uint8_t meta[2];
+    if (!recv_all(c->fd, meta, 2)) return -1;
+    a.dtype = meta[0];
+    a.dims.resize(meta[1]);
+    if (meta[1] &&
+        !recv_all(c->fd, a.dims.data(), a.dims.size() * sizeof(uint32_t)))
+      return -1;
+    uint64_t nb;
+    if (!recv_all(c->fd, &nb, 8)) return -1;
+    a.data.resize(nb);
+    if (nb && !recv_all(c->fd, a.data.data(), nb)) return -1;
+    c->outputs.push_back(std::move(a));
+  }
+  return static_cast<int>(c->outputs.size());
+}
+
+const char* PD_RemotePredictorLastError(void* h) {
+  return static_cast<Client*>(h)->last_error.c_str();
+}
+
+int PD_GetOutputNum(void* h) {
+  return static_cast<int>(static_cast<Client*>(h)->outputs.size());
+}
+
+int PD_GetOutputDtype(void* h, int i) {
+  return static_cast<Client*>(h)->outputs[static_cast<size_t>(i)].dtype;
+}
+
+int PD_GetOutputNdim(void* h, int i) {
+  return static_cast<int>(
+      static_cast<Client*>(h)->outputs[static_cast<size_t>(i)].dims.size());
+}
+
+void PD_GetOutputDims(void* h, int i, int64_t* dims) {
+  const auto& d =
+      static_cast<Client*>(h)->outputs[static_cast<size_t>(i)].dims;
+  for (size_t k = 0; k < d.size(); ++k) dims[k] = d[k];
+}
+
+int64_t PD_GetOutputNbytes(void* h, int i) {
+  return static_cast<int64_t>(
+      static_cast<Client*>(h)->outputs[static_cast<size_t>(i)].data.size());
+}
+
+const void* PD_GetOutputData(void* h, int i) {
+  return static_cast<Client*>(h)->outputs[static_cast<size_t>(i)].data.data();
+}
+
+int PD_RemotePredictorShutdownServer(void* h) {
+  auto* c = static_cast<Client*>(h);
+  uint32_t head[3] = {kMagic, kOpShutdown, 0};
+  if (!send_all(c->fd, head, sizeof(head))) return 0;
+  uint32_t resp[3];
+  recv_all(c->fd, resp, sizeof(resp));
+  return 1;
+}
+
+void PD_RemotePredictorDelete(void* h) {
+  auto* c = static_cast<Client*>(h);
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

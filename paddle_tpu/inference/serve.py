@@ -1,0 +1,220 @@
+"""Out-of-process inference: a standalone serving process + wire clients.
+
+Counterpart of the reference's out-of-process deployment surface — the C API
+(`paddle/fluid/inference/capi_exp/pd_config.h`, `pd_predictor.h`) and the
+C++ jit deploy runtime (`paddle/fluid/jit/layer.h`) — rebuilt TPU-style: the
+predictor process owns the chip and the AOT-compiled executables
+(`inference.Predictor`), and clients talk a tiny language-neutral binary
+protocol over TCP, so a C program (see `inference/native/pd_c_client.cpp`
+via `paddle_tpu.utils.cpp_extension`) or another Python process can run
+inference with NO Python/JAX in-process.
+
+Run:  python -m paddle_tpu.inference.serve --model /path/prefix --port 0
+(prints ``LISTENING <port>`` on stdout when ready).
+
+Wire protocol (little-endian):
+  request : u32 magic 'PRPD' | u32 op (1=run 2=ping 3=shutdown) |
+            u32 n_arrays | arrays...
+  array   : u8 dtype | u8 ndim | u32 dims[ndim] | u64 nbytes | bytes
+  response: u32 magic | u32 status (0 ok else error) |
+            ok: u32 n_arrays | arrays...   err: u32 len | utf8 message
+"""
+from __future__ import annotations
+
+import argparse
+import socket
+import struct
+import threading
+
+import numpy as np
+
+MAGIC = 0x50445250
+OP_RUN, OP_PING, OP_SHUTDOWN = 1, 2, 3
+
+_DTYPES = ["float32", "float64", "int32", "int64", "uint8", "bool",
+           "float16", "bfloat16", "int8", "int16", "uint16", "uint32",
+           "uint64"]
+_DTYPE_CODE = {n: i for i, n in enumerate(_DTYPES)}
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _np_dtype(name):
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def send_arrays(sock, arrays):
+    parts = []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        name = a.dtype.name
+        if name not in _DTYPE_CODE:
+            raise TypeError(f"unsupported wire dtype {name}")
+        parts.append(struct.pack("<BB", _DTYPE_CODE[name], a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}I", *a.shape))
+        parts.append(struct.pack("<Q", a.nbytes))
+        parts.append(a.tobytes())
+    sock.sendall(b"".join(parts))
+
+
+def recv_arrays(sock, n):
+    out = []
+    for _ in range(n):
+        code, ndim = struct.unpack("<BB", _recv_exact(sock, 2))
+        dims = struct.unpack(f"<{ndim}I", _recv_exact(sock, 4 * ndim))
+        (nbytes,) = struct.unpack("<Q", _recv_exact(sock, 8))
+        raw = _recv_exact(sock, nbytes)
+        out.append(np.frombuffer(raw, dtype=_np_dtype(_DTYPES[code]))
+                   .reshape(dims).copy())
+    return out
+
+
+class InferenceServer:
+    """Owns one in-process Predictor; serves run() over TCP."""
+
+    def __init__(self, model_prefix, host="127.0.0.1", port=0, config=None):
+        from paddle_tpu.inference import Config, Predictor
+        if config is None:
+            config = Config(model_prefix)
+        self._predictor = Predictor(config)
+        self._lock = threading.Lock()      # one chip, serialized runs
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+
+    def serve_forever(self):
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.5)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._client_loop, args=(conn,),
+                                 daemon=True)
+            t.start()
+        self._sock.close()
+
+    def _client_loop(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    head = _recv_exact(conn, 12)
+                except ConnectionError:
+                    return
+                magic, op, n = struct.unpack("<III", head)
+                if magic != MAGIC:
+                    self._send_err(conn, "bad magic")
+                    return
+                if op == OP_PING:
+                    conn.sendall(struct.pack("<III", MAGIC, 0, 0))
+                    continue
+                if op == OP_SHUTDOWN:
+                    conn.sendall(struct.pack("<III", MAGIC, 0, 0))
+                    self._stop.set()
+                    return
+                try:
+                    arrays = recv_arrays(conn, n)
+                    with self._lock:
+                        self._predictor.run(arrays)
+                        outs = [self._predictor.get_output_handle(nm)
+                                .copy_to_cpu()
+                                for nm in self._predictor.get_output_names()]
+                    conn.sendall(struct.pack("<III", MAGIC, 0, len(outs)))
+                    send_arrays(conn, outs)
+                except Exception as e:  # noqa: BLE001 — wire back to client
+                    self._send_err(conn, f"{type(e).__name__}: {e}")
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _send_err(conn, msg):
+        raw = msg.encode()
+        conn.sendall(struct.pack("<III", MAGIC, 1, len(raw)) + raw)
+
+
+class RemotePredictor:
+    """Python wire client mirroring the Predictor.run() surface."""
+
+    def __init__(self, host="127.0.0.1", port=None, timeout=60.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._outs = []
+
+    def ping(self):
+        self._sock.sendall(struct.pack("<III", MAGIC, OP_PING, 0))
+        magic, status, _ = struct.unpack(
+            "<III", _recv_exact(self._sock, 12))
+        return magic == MAGIC and status == 0
+
+    def run(self, inputs):
+        self._sock.sendall(struct.pack("<III", MAGIC, OP_RUN, len(inputs)))
+        send_arrays(self._sock, inputs)
+        magic, status, n = struct.unpack(
+            "<III", _recv_exact(self._sock, 12))
+        if magic != MAGIC:
+            raise ConnectionError("bad magic in response")
+        if status != 0:
+            raise RuntimeError(
+                _recv_exact(self._sock, n).decode(errors="replace"))
+        self._outs = recv_arrays(self._sock, n)
+        return True
+
+    def get_output_names(self):
+        return [f"out{i}" for i in range(len(self._outs))]
+
+    def get_output_handle(self, name):
+        class _H:
+            def __init__(self, buf):
+                self._buf = buf
+
+            def copy_to_cpu(self):
+                return self._buf
+
+        return _H(self._outs[int(name.removeprefix("out"))])
+
+    def shutdown_server(self):
+        self._sock.sendall(struct.pack("<III", MAGIC, OP_SHUTDOWN, 0))
+        try:
+            _recv_exact(self._sock, 12)
+        except ConnectionError:
+            pass
+
+    def close(self):
+        self._sock.close()
+
+
+def main(argv=None):
+    import os
+    if os.environ.get("JAX_PLATFORMS"):
+        # the env var alone does not override a sitecustomize-pinned
+        # backend; the config update does (same dance as tests/conftest.py)
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    ap = argparse.ArgumentParser("paddle_tpu.inference.serve")
+    ap.add_argument("--model", required=True,
+                    help="jit.save prefix of the deployed model")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    srv = InferenceServer(args.model, args.host, args.port)
+    print(f"LISTENING {srv.port}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()
