@@ -491,9 +491,18 @@ class SyncBatchNorm(BatchNorm):
     values are sharded — one class serves both (ref sparse SyncBatchNorm)."""
 
 
+from paddle_tpu.sparse.conv import (  # noqa: E402
+    Conv3D, SubmConv3D, MaxPool3D, conv3d, subm_conv3d, max_pool3d)
+
 import types as _types
+
+functional = _types.SimpleNamespace(
+    conv3d=conv3d, subm_conv3d=subm_conv3d, max_pool3d=max_pool3d,
+    relu=relu, softmax=softmax)
 
 nn = _types.SimpleNamespace(
     ReLU=ReLU, LeakyReLU=LeakyReLU, ReLU6=ReLU6, Softmax=Softmax,
     BatchNorm=BatchNorm, SyncBatchNorm=SyncBatchNorm,
+    Conv3D=Conv3D, SubmConv3D=SubmConv3D, MaxPool3D=MaxPool3D,
+    functional=functional,
 )

