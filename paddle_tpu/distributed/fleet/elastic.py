@@ -39,9 +39,129 @@ def start_heartbeat(path, interval=2.0):
     return t
 
 
+class NodeRegistry:
+    """Multi-node membership registry (ref the etcd node registry,
+    `fleet/elastic/manager.py:126,240-257`): every HOST publishes
+    ``node_<id>.json`` {endpoint, ts} under a shared directory and renews it
+    on a lease-like heartbeat; peers observe join/leave by polling mtime
+    freshness. A shared filesystem (the NFS/GCS mount every TPU pod has)
+    replaces etcd — the semantics map 1:1 (register = write, lease = mtime
+    TTL, watch = poll, delete = leave)."""
+
+    def __init__(self, registry_dir, node_id, endpoint, ttl=30.0,
+                 heartbeat_interval=2.0):
+        self.dir = registry_dir
+        self.node_id = str(node_id)
+        self.endpoint = endpoint
+        self.ttl = ttl
+        self._interval = heartbeat_interval
+        self._stop = threading.Event()
+        self._thread = None
+        os.makedirs(registry_dir, exist_ok=True)
+
+    def _path(self, node_id=None):
+        return os.path.join(self.dir, f"node_{node_id or self.node_id}.json")
+
+    def _write(self):
+        import json
+        tmp = self._path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"endpoint": self.endpoint, "ts": time.time(),
+                       "ttl": self.ttl}, f)
+        os.replace(tmp, self._path())
+
+    def register(self):
+        """Publish this node and keep renewing the lease (daemon thread)."""
+        self._write()
+
+        def renew():
+            while not self._stop.wait(self._interval):
+                try:
+                    self._write()
+                except OSError:
+                    pass
+
+        self._thread = threading.Thread(target=renew, daemon=True,
+                                        name="paddle-node-lease")
+        self._thread.start()
+        return self
+
+    def leave(self):
+        self._stop.set()
+        if self._thread is not None:
+            # join before unlinking: an in-flight _write() could otherwise
+            # land after the remove and resurrect the lease for a full TTL
+            self._thread.join(timeout=self._interval + 1.0)
+        try:
+            os.remove(self._path())
+        except OSError:
+            pass
+
+    def alive_nodes(self):
+        """{node_id: endpoint} for every node with a fresh lease."""
+        import json
+        now = time.time()
+        out = {}
+        for name in sorted(os.listdir(self.dir)):
+            if not (name.startswith("node_") and name.endswith(".json")):
+                continue
+            p = os.path.join(self.dir, name)
+            try:
+                with open(p) as f:
+                    info = json.load(f)
+                # per-lease TTL, like etcd leases (observer honors the
+                # registrant's own renewal contract)
+                if now - os.path.getmtime(p) > info.get("ttl", self.ttl):
+                    continue
+            except (OSError, ValueError):
+                continue
+            out[name[len("node_"):-len(".json")]] = info["endpoint"]
+        return out
+
+
+class ElasticJobManager:
+    """np-range elasticity (ref ``--np 2:4`` + `manager.py` scale
+    detection): watches the registry and tells the launch controller what
+    to do — WAIT below np_min, RESCALE when the committed member set
+    changed within [np_min, np_max] (rebuild PADDLE_TRAINER_ENDPOINTS and
+    restart from the latest auto-checkpoint — `incubate/checkpoint.py`
+    resumes the epoch), STEADY otherwise."""
+
+    WAIT, STEADY, RESCALE = "wait", "steady", "rescale"
+
+    def __init__(self, registry, np_min, np_max=None):
+        self.registry = registry
+        self.np_min = int(np_min)
+        self.np_max = int(np_max or np_min)
+        self._committed = None
+
+    def endpoints(self, alive):
+        return [alive[k] for k in sorted(alive)]
+
+    def poll(self):
+        alive = self.registry.alive_nodes()
+        n = len(alive)
+        if n < self.np_min:
+            # forget the committed set: when quorum returns — even with the
+            # IDENTICAL members — the stopped job must be relaunched
+            # (RESCALE), not reported STEADY
+            self._committed = None
+            return self.WAIT, self.endpoints(alive)
+        members = tuple(sorted(alive))[: self.np_max]
+        eps = [alive[k] for k in members]
+        if self._committed is None:
+            self._committed = members
+            return self.RESCALE, eps          # first commit = initial launch
+        if members != self._committed:
+            self._committed = members
+            return self.RESCALE, eps
+        return self.STEADY, eps
+
+
 class ElasticManager:
-    """Controller-side staleness watcher (ref `manager.py:126` liveness role;
-    np ranges / scale-up have no TPU-slice analog and are not pretended)."""
+    """Controller-side staleness watcher (ref `manager.py:126` liveness
+    role) — single-pod fault detection; multi-node membership lives in
+    :class:`NodeRegistry` + :class:`ElasticJobManager`."""
 
     def __init__(self, heartbeat_dir, world_size, timeout=30.0,
                  grace_period=60.0):

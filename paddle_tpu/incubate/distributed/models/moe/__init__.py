@@ -1,3 +1,5 @@
 """paddle.incubate.distributed.models.moe (ref moe_layer.py / gate/*.py)."""
 from paddle_tpu.incubate.moe import (  # noqa: F401
     MoELayer, BaseGate, NaiveGate, GShardGate, SwitchGate)
+from paddle_tpu.incubate.distributed.models.moe.grad_clip import (  # noqa: F401
+    ClipGradForMOEByGlobalNorm)

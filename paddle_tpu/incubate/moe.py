@@ -272,6 +272,7 @@ class MoELayer(Layer):
                     mesh, P("ep", *([None] * (arr.ndim - 1)))))
             prm = Parameter(arr)
             prm.name = f"moe_expert_param_{i}"
+            prm.is_expert = True      # consumed by ClipGradForMOEByGlobalNorm
             self.add_parameter(f"moe_expert_param_{i}", prm)
             self._stacked.append(prm)
         self.l_aux = None

@@ -285,3 +285,63 @@ class TestScatterDispatch:
                        gate=LegacyGate(D, 4), capacity_factor=2.0)
         out = moe(paddle.to_tensor(_x()))
         assert np.isfinite(np.asarray(out._data)).all()
+
+
+class TestMoEGradClip:
+    """ClipGradForMOEByGlobalNorm (ref `moe/grad_clip.py:22`): expert and
+    regular grads combine into ONE global norm; expert params are found via
+    the `is_expert` mark the MoE layer sets on its stacked parameters."""
+
+    def test_combined_norm_matches_manual(self):
+        import numpy as np
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        from paddle_tpu.core.tensor import Tensor, Parameter
+        from paddle_tpu.incubate.distributed.models.moe import (
+            ClipGradForMOEByGlobalNorm)
+
+        rng = np.random.RandomState(0)
+        p_reg = Parameter(jnp.asarray(rng.randn(4, 4).astype(np.float32)))
+        p_exp = Parameter(jnp.asarray(rng.randn(2, 4).astype(np.float32)))
+        p_exp.is_expert = True
+        g_reg = Tensor(jnp.asarray(rng.randn(4, 4).astype(np.float32) * 3),
+                       _internal=True)
+        g_exp = Tensor(jnp.asarray(rng.randn(2, 4).astype(np.float32) * 3),
+                       _internal=True)
+        clip = ClipGradForMOEByGlobalNorm(clip_norm=1.0)
+        out = clip([(p_reg, g_reg), (p_exp, g_exp)])
+        gn = float(np.sqrt((np.asarray(g_reg._data) ** 2).sum()
+                           + (np.asarray(g_exp._data) ** 2).sum()))
+        scale = 1.0 / max(gn, 1.0)
+        np.testing.assert_allclose(np.asarray(out[0][1]._data),
+                                   np.asarray(g_reg._data) * scale,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out[1][1]._data),
+                                   np.asarray(g_exp._data) * scale,
+                                   rtol=1e-6)
+        # clipped global norm == clip_norm
+        cn = float(np.sqrt((np.asarray(out[0][1]._data) ** 2).sum()
+                           + (np.asarray(out[1][1]._data) ** 2).sum()))
+        assert abs(cn - 1.0) < 1e-5
+
+    def test_moe_layer_marks_expert_params(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate.moe import MoELayer, NaiveGate
+
+        paddle.seed(0)
+        d = 8
+
+        class Expert(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(d, d)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        moe = MoELayer(d_model=d, experts=[Expert() for _ in range(4)],
+                       gate="naive")
+        marks = [getattr(p, "is_expert", False) for p in moe.parameters()]
+        assert any(marks), "no expert-marked params"
+        assert not all(marks), "gate params must not be expert-marked"
