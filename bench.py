@@ -158,7 +158,17 @@ def _host_collate(batch):
 
 def bench_dataloader():
     """Data-pipeline rung (SURVEY §7 hard-part #4): multi-worker DataLoader
-    throughput over the native shared-memory transport vs in-process."""
+    throughput over the native shared-memory transport vs in-process.
+
+    Two modes: the raw PUMP (workers only produce) and OVERLAP (the real
+    training shape: each batch is followed by a device step + sync
+    readback, so workers can decode while the chip runs). Measured r4 on
+    this host: workers lose BOTH modes (pump 59 vs 34, overlap 440 vs 382
+    imgs/s) — with one core, even the device wait is not free time, because
+    the tunnel round-trip itself needs host CPU that the decoding workers
+    steal. Hence the DataLoader's single-core auto-fallback (round-3
+    verdict weak #6) applies to every path on this host; the multi-worker
+    pipeline is for real TPU VMs with proper host cores."""
     import paddle_tpu as paddle
     from paddle_tpu.io import DataLoader
     from paddle_tpu.vision.datasets import FakeData
@@ -177,7 +187,12 @@ def bench_dataloader():
     ds = FakeData(size=512, image_shape=(3, 256, 256), transform=aug)
     host_collate = _host_collate
 
+    from paddle_tpu.framework.flags import set_flags
+
     def pump(num_workers, use_shared_memory):
+        # force workers even on a 1-core host: this rung MEASURES the raw
+        # pump so the auto-fallback must not silently re-route it
+        set_flags({"FLAGS_dataloader_auto_fallback": False})
         dl = DataLoader(ds, batch_size=64, num_workers=num_workers,
                         use_shared_memory=use_shared_memory, drop_last=True,
                         collate_fn=host_collate)
@@ -189,9 +204,51 @@ def bench_dataloader():
         dt = time.perf_counter() - t0
         return (n * 64) / dt
 
+    # overlap rung uses a lighter decode (the pump rung's 256px aug costs
+    # ~600 ms/batch — nothing could hide that); per-sample cost here is
+    # sized below one device-step + tunnel round-trip
+    aug_small = T.Compose([
+        _chw_to_hwc_u8,
+        T.RandomResizedCrop(28),
+        T.RandomHorizontalFlip(),
+        _hwc_u8_to_chw,
+    ])
+    ds_small = FakeData(size=2048, image_shape=(3, 32, 32),
+                        transform=aug_small)
+
+    def overlap(num_workers):
+        """Epoch with a device step + sync readback per batch — the shape
+        real training has. Workers decode the next batches while the chip
+        (and the tunnel round-trip) runs; in-process decode serializes
+        behind the readback."""
+        import jax
+        import jax.numpy as jnp
+        set_flags({"FLAGS_dataloader_auto_fallback": False})
+        a = jnp.ones((4096, 4096), jnp.bfloat16)
+        step = jax.jit(lambda a: ((a @ a) * (1.0 / 4096)).astype(
+            jnp.float32).sum())
+        float(step(a))  # compile outside the timed region
+        dl = DataLoader(ds_small, batch_size=64, num_workers=num_workers,
+                        use_shared_memory=num_workers > 0, drop_last=True,
+                        collate_fn=host_collate)
+        it = iter(dl)
+        # amortize worker SPAWN (each child imports the framework, seconds
+        # on this host) outside the timed region: drain 8 batches first
+        for _ in range(8):
+            next(it)
+        n, t0 = 0, time.perf_counter()
+        for batch in it:
+            float(step(a))          # sync: loss-logging training loop
+            n += 1
+        dt = time.perf_counter() - t0
+        return (n * 64) / dt
+
     inproc = pump(0, False)
     shm = pump(4, True)
-    return inproc, shm
+    ov_in = overlap(0)
+    ov_shm = overlap(4)
+    set_flags({"FLAGS_dataloader_auto_fallback": True})
+    return inproc, shm, ov_in, ov_shm
 
 
 def _retry(fn, attempts=3):
@@ -237,11 +294,15 @@ def main():
     except Exception as e:
         print(f"# bert rung failed: {type(e).__name__}: {e}", file=sys.stderr)
     try:
-        inproc, shm = _retry(bench_dataloader)
+        inproc, shm, ov_in, ov_shm = _retry(bench_dataloader)
         import os
-        print(f"# dataloader imgs/sec in-process={inproc:.0f} "
-              f"shm-4workers={shm:.0f} (host_cores={os.cpu_count()}; "
-              "the worker pipeline only wins with >1 core)", file=sys.stderr)
+        print(f"# dataloader overlap(train-shaped): in-process={ov_in:.0f} "
+              f"shm-4workers={ov_shm:.0f} imgs/sec; raw pump: "
+              f"in-process={inproc:.0f} shm-4workers={shm:.0f} "
+              f"(host_cores={os.cpu_count()}; on this 1-core tunnel host "
+              "ALL worker modes lose — the DataLoader auto-falls back "
+              "in-process by default, so no user path ships these numbers)",
+              file=sys.stderr)
     except Exception as e:
         print(f"# dataloader rung failed: {type(e).__name__}: {e}",
               file=sys.stderr)

@@ -112,6 +112,14 @@ define_flag("moe_dispatch", "auto",
             "MoE token dispatch path: auto | scatter (index scatter/gather, "
             "O(N*K*D) movement — the global_scatter analog) | einsum "
             "(one-hot [N,E,C] einsum, O(N*E*C*D) FLOPs; fine at tiny scale)")
+define_flag("dataloader_auto_fallback", True,
+            "drop multi-worker DataLoader to the in-process path on "
+            "single-core hosts, where the worker pipeline measurably LOSES "
+            "in BOTH pump and train-shaped overlap modes (r4 bench: pump "
+            "59 vs 34, overlap 440 vs 382 imgs/s — the tunnel client "
+            "itself needs host CPU). Set False only to force workers for "
+            "measurement, or on multi-core hosts where decode "
+            "parallelism is real")
 define_flag("dataloader_mp_method", "spawn",
             "multiprocessing start method for DataLoader workers: spawn "
             "(default — fork is unsafe under the multithreaded JAX runtime) "
