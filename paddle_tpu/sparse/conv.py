@@ -59,8 +59,8 @@ def _build_rulebook(idx, spatial, ksize, stride, padding, dilation, subm):
         pairs = []
         for (a, b, c) in offsets:
             # input site contributes to output at out = in - (k*dil - pad);
-            # with the reference's subm convention pad = (k-1)//2 keeps the
-            # pattern centered
+            # with the subm convention pad = dil*(k-1)//2 the kernel centers
+            # on the site and the pattern is preserved
             od = coords[:, 1] + pd - a * dd
             oh = coords[:, 2] + ph - b * dh
             ow = coords[:, 3] + pw - c * dw
@@ -113,7 +113,12 @@ def _sparse_conv3d(x, weight, bias, stride, padding, dilation, subm):
         if stride != (1, 1, 1):
             raise ValueError("SubmConv3D requires stride 1 "
                              "(ref conv.py:270 submanifold semantics)")
-        padding = tuple((ksize[i] - 1) // 2 for i in range(3))
+        if any(k % 2 == 0 for k in ksize):
+            raise ValueError(
+                f"SubmConv3D requires ODD kernel sizes (got {ksize}): even "
+                "kernels cannot center on the input sites, so the "
+                "pattern-preserving contract has no consistent padding")
+        padding = tuple(dilation[i] * (ksize[i] - 1) // 2 for i in range(3))
     shape = x._dense_shape                     # [N, D, H, W, C]
     idx = np.asarray(x._indices._data)
     out_idx, out_sp, pairs = _build_rulebook(

@@ -746,3 +746,52 @@ class TestPipelineMemory:
         assert t_pp < t_serial * (bound + 0.35), (
             f"pp temp {t_pp} vs serial {t_serial} "
             f"(ratio {t_pp / t_serial:.2f}, analytic bound {bound:.2f})")
+
+
+class TestHeteroEvalMode:
+    """eval() through the hetero engine: BN switches to running stats
+    (collected during training ticks) and the pipelined eval forward
+    matches the serial eval forward."""
+
+    def test_eval_forward_parity_after_training(self):
+        # f32 carrier suffices here: the CNN is 3 BN layers deep, far from
+        # the ResNet-50 chaos that needs the f64 strict oracle
+        rng = np.random.RandomState(3)
+        X = rng.randn(8, 3, 8, 8).astype(np.float32)
+        Y = rng.randint(0, 4, 8).astype(np.int64)
+        Xe = rng.randn(8, 3, 8, 8).astype(np.float32)
+        loss_fn = nn.CrossEntropyLoss()
+
+        def build():
+            paddle.seed(42)
+            return [ConvStage(3, 8), ConvStage(8, 16, stride=2),
+                    ConvStage(16, 16), PoolHead(16, 4)]
+
+        def run(num_stages, seg):
+            from paddle_tpu.ops.manipulation import split
+            model = PipelineLayer(build(), num_stages=num_stages,
+                                  seg_method=seg)
+            model._pp_micro = 2
+            opt = paddle.optimizer.Momentum(learning_rate=1e-3,
+                                            parameters=model.parameters())
+            xt = paddle.Tensor(X, _internal=True)
+            yt = paddle.Tensor(Y, _internal=True)
+            if num_stages == 1:
+                # serial oracle micro-batches like the engine does (BN
+                # batch stats are per-micro in both)
+                for mx, my in zip(split(xt, 2, axis=0),
+                                  split(yt, 2, axis=0)):
+                    (loss_fn(model(mx), my) / 2).backward()
+            else:
+                loss_fn(model(xt), yt).backward()
+            opt.step()
+            opt.clear_grad()
+            model.eval()
+            out = model(paddle.Tensor(Xe, _internal=True))
+            return np.asarray(out._data)
+
+        set_mesh(None)
+        ref = run(1, "uniform")
+        auto_mesh(dp=4, pp=2)
+        got = run(2, "param")
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)

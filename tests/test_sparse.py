@@ -253,7 +253,7 @@ class TestSparseConv3D:
         return x, idx, vals, shape
 
     def _dense_oracle(self, idx, shape, ksize, stride, padding, subm,
-                      out_idx):
+                      out_idx, dilation=(1, 1, 1)):
         """dense conv on the scattered input, sampled at the sparse output
         sites; returns fn(vals_flat, w) -> out_vals for jax.grad."""
         import jax
@@ -265,6 +265,7 @@ class TestSparseConv3D:
             out = jax.lax.conv_general_dilated(
                 dense, w, window_strides=stride,
                 padding=[(p, p) for p in padding],
+                rhs_dilation=dilation,
                 dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
             return out[tuple(out_idx[i] for i in range(4))]
 
@@ -379,3 +380,28 @@ class TestSparseConv3D:
             out = lay(out)
         np.testing.assert_array_equal(np.asarray(out.indices()._data), idx)
         assert out.shape[-1] == 2
+
+    def test_subm_conv3d_dilation2_vs_dense(self):
+        import jax.numpy as jnp
+        import paddle_tpu.sparse as sparse
+
+        x, idx, vals, shape = self._rand_sparse(seed=11)
+        conv = sparse.nn.SubmConv3D(self.C, 4, 3, dilation=2,
+                                    bias_attr=False)
+        out = conv(x)
+        np.testing.assert_array_equal(np.asarray(out.indices()._data), idx)
+        w = np.asarray(conv.weight._data)
+        oracle = self._dense_oracle(idx, shape, (3, 3, 3), (1, 1, 1),
+                                    (2, 2, 2), True, idx,
+                                    dilation=(2, 2, 2))
+        ref = oracle(jnp.asarray(vals), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(out.values()._data),
+                                   np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_subm_conv3d_even_kernel_raises(self):
+        import pytest as _pytest
+        import paddle_tpu.sparse as sparse
+        x, _, _, _ = self._rand_sparse(seed=13)
+        conv = sparse.nn.SubmConv3D(self.C, 2, 2)
+        with _pytest.raises(ValueError, match="ODD kernel"):
+            conv(x)
