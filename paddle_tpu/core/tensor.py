@@ -325,6 +325,13 @@ class Tensor:
         return float(self._data)
 
     def __index__(self):
+        if isinstance(self._data, jax.core.Tracer):
+            # `range(t)` / `x[t]` on a traced scalar: signal the dy2static
+            # retry (the converter lowers for-over-range to a carried while)
+            # instead of surfacing jax's ConcretizationTypeError
+            from paddle_tpu.jit.dy2static import (
+                DataDependentControlFlowError, _HINT)
+            raise DataDependentControlFlowError(_HINT)
         return int(self._data)
 
     def __hash__(self):

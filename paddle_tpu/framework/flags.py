@@ -134,3 +134,13 @@ define_flag("tpu_flash_impl", "auto",
             "custom vjp, also the fallback for non-tileable shapes)")
 define_flag("autotune_verbose", False,
             "log kernel autotune decisions with measured timings")
+define_flag("dy2static_max_trip_count", 0,
+            "when > 0, TRACED loops produced by dy2static conversion "
+            "(data-dependent while / for-over-range) lower to a bounded "
+            "lax.scan of this many steps with an active mask — making them "
+            "REVERSE-DIFFERENTIABLE (the TPU analog of the reference's "
+            "WhileGradOp forward replay, operators/controlflow/"
+            "while_op.cc:348) at the cost of always running the bound "
+            "(a traced loop whose true trip count exceeds it is TRUNCATED — "
+            "choose a real upper bound; concrete loops are never capped). "
+            "0 = unbounded lax.while, forward-only (loud error under grad)")

@@ -13,7 +13,8 @@ hooks and threaded as explicit state inputs/outputs of one compiled, donated XLA
 program. Steady state = one executable replay, the same shape as InterpreterCore's
 instruction replay (`new_executor/interpretercore.cc:211`) but compiled.
 """
-from paddle_tpu.jit.static_function import to_static, StaticFunction, not_to_static  # noqa: F401
+from paddle_tpu.jit.static_function import (  # noqa: F401
+    to_static, StaticFunction, MultiStepFunction, not_to_static)
 from paddle_tpu.jit.save_load import save, load, TranslatedLayer  # noqa: F401
 from paddle_tpu.jit.static_function import ignore_module  # noqa: F401
 from paddle_tpu.jit.dy2static import (  # noqa: F401

@@ -195,7 +195,16 @@ def ifelse(pred, true_fn, false_fn, operands=()):
                             mk(false_fn, "f"),
                             list(op_arrays) + list(param_arrays))
 
-    out = apply(prim, pred, *tensors, *lparams, op_name="cond")
+    try:
+        out = apply(prim, pred, *tensors, *lparams, op_name="cond")
+    except TypeError as e:
+        if "pytree structure" not in str(e):
+            raise
+        raise DataDependentControlFlowError(
+            "the branches of a traced conditional produce different value "
+            "structures — typically a variable (or a `return`) exists in one "
+            "path only. Bind the same variables (or return a value on every "
+            "path, e.g. an explicit final return). " + _HINT) from e
     if not isinstance(out, (tuple, list)):
         out = (out,)
     (ti, tp), (fi, fp) = probe["t"], probe["f"]
@@ -207,8 +216,46 @@ def ifelse(pred, true_fn, false_fn, operands=()):
     return _join_tensors(ti, list(out), tp)
 
 
+def _discover_extra_reads(body_fn, t_idx, tensors, passthrough):
+    """Grad-requiring Tensors the loop body reads via CLOSURE (hook probe,
+    mirroring `fleet/recompute._probe_extras`): under the bounded-scan
+    lowering they must become explicit vjp inputs or their gradients
+    silently vanish — jax.vjp differentiates positional args only."""
+    from paddle_tpu.core import tensor as tensor_mod
+    known = {id(t) for t in tensors}
+    extras: dict[int, Tensor] = {}
+    written: dict[int, tuple] = {}
+
+    def read_hook(t):
+        if id(t) not in known and id(t) not in extras:
+            extras[id(t)] = t
+
+    def write_hook(t):
+        if id(t) not in written:
+            written[id(t)] = (t, t._data)
+
+    def run(arrs):
+        outs = body_fn(*_join(t_idx, list(arrs), passthrough))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        return [o._data if isinstance(o, Tensor) else o for o in outs]
+
+    prev = tensor_mod.set_capture_hooks(read_hook, write_hook)
+    try:
+        with no_grad():
+            jax.eval_shape(run, [t._data for t in tensors])
+    except Exception:
+        pass                # discovery is best-effort; execution re-raises
+    finally:
+        tensor_mod.set_capture_hooks(*prev)
+        for t, old in written.values():
+            t._data = old
+    return [t for t in extras.values()
+            if not t.stop_gradient and jnp.issubdtype(t.dtype, jnp.inexact)]
+
+
 def whileloop(cond_fn, body_fn, loop_vars, maximum_trip_count=None,
-              var_names=None):
+              var_names=None, bound_traced_only=False):
     """``lax.while_loop`` with Python fallback (ref convert_while_loop).
 
     With ``maximum_trip_count=N`` the loop lowers to a ``lax.scan`` over N
@@ -228,7 +275,11 @@ def whileloop(cond_fn, body_fn, loop_vars, maximum_trip_count=None,
             if not isinstance(loop_vars, tuple):
                 loop_vars = (loop_vars,)
             trips += 1
-            if maximum_trip_count is not None and trips >= maximum_trip_count:
+            if maximum_trip_count is not None and trips >= maximum_trip_count \
+                    and not bound_traced_only:
+                # explicit API cap semantics; under FLAGS_dy2static_max_trip_
+                # count the bound exists only to make TRACED loops scannable
+                # and must not truncate concrete iteration
                 break
             ok = _concrete_bool(cond_fn(*loop_vars))
         return loop_vars
@@ -271,25 +322,34 @@ def whileloop(cond_fn, body_fn, loop_vars, maximum_trip_count=None,
 
     if maximum_trip_count is not None:
         n_steps = int(maximum_trip_count)
+        # closure-read grad-requiring tensors must be EXPLICIT vjp inputs:
+        # jax.vjp differentiates only positional args, so a weight read via
+        # closure inside the scanned body would silently get zero gradient
+        # (same class of bug as ifelse's _layer_params, round-3 finding)
+        extras = _discover_extra_reads(body_fn, t_idx, tensors, passthrough)
+        n_car = len(tensors)
 
         def prim(*arrays):
+            car, ext = arrays[:n_car], arrays[n_car:]
+
             def step(carry, _):
                 arrs, active = carry
                 act = jnp.logical_and(
                     active, _cond_arr(_join(t_idx, list(arrs), passthrough)))
                 o_idx, o_arrays, o_pass = _run_branch(
-                    body_fn, t_idx, passthrough, list(arrs))
+                    body_fn, t_idx, passthrough, list(arrs),
+                    layer_params=extras, param_arrays=ext)
                 _check_body_out(o_idx, o_pass)
                 new = tuple(
                     jnp.where(act.reshape((1,) * a.ndim), na.astype(a.dtype), a)
                     for a, na in zip(arrs, o_arrays))
                 return (new, act), None
 
-            (out, _), _ = jax.lax.scan(step, (arrays, jnp.asarray(True)),
+            (out, _), _ = jax.lax.scan(step, (tuple(car), jnp.asarray(True)),
                                        None, length=n_steps)
             return out
 
-        out = apply(prim, *tensors, op_name="while_loop_bounded")
+        out = apply(prim, *tensors, *extras, op_name="while_loop_bounded")
         if not isinstance(out, (tuple, list)):
             out = (out,)
         return _join_tensors(t_idx, list(out), passthrough)
@@ -343,6 +403,65 @@ def _call_jst(attr, *args):
 
 def _set_true(name):
     return _assign(name, _call_jst("true_"))
+
+
+class _ForToWhileRewriter(ast.NodeTransformer):
+    """``for <name> in range(...)`` -> counter-carried ``while`` (the
+    reference's ForToWhileTransformer,
+    `jit/dy2static/break_continue_transformer.py:36` +
+    `loop_transformer.py:517`): a range bound by a traced tensor becomes a
+    loop-carried tensor counter. The counter is advanced at the TOP of the
+    body (before any user statement), so a ``continue`` — rewritten later by
+    _EscapeRewriter into guard flags that skip the REST of the body — can
+    never skip the increment. Runs before _EscapeRewriter so break/continue/
+    return inside the generated while get the normal escape treatment, and
+    before _ControlFlowTransformer so the while converts normally.
+
+    Only ``range`` iterables convert: any other iterable (tensors, lists,
+    enumerate/zip) has a concrete length under tracing (shapes are static)
+    and executes as a plain Python loop during capture."""
+
+    def __init__(self):
+        self.counter = 0
+
+    def visit_For(self, node):
+        self.generic_visit(node)        # inner loops first
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3
+                and not any(isinstance(a, ast.Starred) for a in it.args)):
+            return node
+        self.counter += 1
+        n = self.counter
+        i_v, stop_v, step_v = (f"_pt_for_i_{n}", f"_pt_for_stop_{n}",
+                               f"_pt_for_step_{n}")
+        init = ast.Assign(
+            targets=[ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Store())
+                                     for v in (i_v, stop_v, step_v)],
+                               ctx=ast.Store())],
+            value=_call_jst("range3", *it.args))
+        take = _assign(node.target.id, ast.Name(id=i_v, ctx=ast.Load()))
+        inc = _assign(i_v, ast.BinOp(
+            left=ast.Name(id=i_v, ctx=ast.Load()), op=ast.Add(),
+            right=ast.Name(id=step_v, ctx=ast.Load())))
+        new_while = ast.While(
+            test=_call_jst("range_cont",
+                           *[ast.Name(id=v, ctx=ast.Load())
+                             for v in (i_v, stop_v, step_v)]),
+            body=[take, inc] + node.body, orelse=[])
+        # pre-bind the target: a traced while carries every body-assigned
+        # name, and lax.while needs carried slots bound before the loop
+        # (divergence from Python only for an empty range, where the target
+        # would stay unbound — same as the reference's converted form)
+        pre = _assign(node.target.id, ast.Name(id=i_v, ctx=ast.Load()))
+        stmts = [init, pre, new_while]
+        for s in stmts:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return stmts
 
 
 class _EscapeRewriter(ast.NodeTransformer):
@@ -507,13 +626,30 @@ def _plumb_returns(fdef):
                 return out
         return out
 
+    # definite-return analysis (pre-rewrite): when the function can fall off
+    # the end (implicit None) AND the return flag ends up traced, a joined
+    # tensor must NOT be silently returned for the dynamically-not-returned
+    # path — final_return raises instead (r4 advisor finding). Conservative:
+    # returns reached only from inside loops don't count as definite.
+    def _definitely_returns(stmts):
+        for st in stmts:
+            if isinstance(st, (ast.Return, ast.Raise)):
+                return True
+            if isinstance(st, ast.If) and st.orelse and \
+                    _definitely_returns(st.body) and \
+                    _definitely_returns(st.orelse):
+                return True
+        return False
+
+    always_returns = _definitely_returns(fdef.body)
     body = rewrite_block(fdef.body)
     inits = [_assign("_pt_ret_flag", _call_jst("false_")),
              _assign("_pt_ret_val", ast.Constant(None))]
     tail = ast.Return(value=_call_jst(
         "final_return",
         ast.Name(id="_pt_ret_flag", ctx=ast.Load()),
-        ast.Name(id="_pt_ret_val", ctx=ast.Load())))
+        ast.Name(id="_pt_ret_val", ctx=ast.Load()),
+        ast.Constant(always_returns)))
     for s in inits + [tail]:
         ast.copy_location(s, fdef.body[0])
     fdef.body = inits + body + [tail]
@@ -673,6 +809,9 @@ def _fndef(name, names, body):
                            type_comment=None, type_params=[])
 
 
+_CONVERT_SEQ = 0
+
+
 def convert_to_static(fn):
     """AST-convert ``fn``'s if/while statements; preserves the original
     closure cells and globals (ref `program_translator.py:283`)."""
@@ -685,6 +824,7 @@ def convert_to_static(fn):
     fdef = tree.body[0]
     # drop decorators — we are already below them
     fdef.decorator_list = []
+    _ForToWhileRewriter().visit(fdef)
     esc = _EscapeRewriter()
     esc.visit(fdef)
     if esc.flag_names:
@@ -718,7 +858,14 @@ def convert_to_static(fn):
         maker.body[0] = fdef
         tree = ast.Module(body=[maker], type_ignores=[])
         ast.fix_missing_locations(tree)
-    code = compile(tree, filename=f"<dy2static {fn.__name__}>", mode="exec")
+    # unique per-conversion filename: lookup()'s enclosing-frame walk scopes
+    # name resolution to frames of THIS conversion unit by filename — two
+    # converted functions sharing a name (e.g. Layer.forward) must not leak
+    # locals into each other
+    global _CONVERT_SEQ
+    _CONVERT_SEQ += 1
+    code = compile(tree, filename=f"<dy2static {fn.__name__}#{_CONVERT_SEQ}>",
+                   mode="exec")
     glb = dict(fn.__globals__)
     glb["_pt_jst"] = _JST
     ns = {}
@@ -738,10 +885,27 @@ class _JSTNamespace:
 
     @staticmethod
     def lookup(loc, glb, name):
-        """locals -> globals -> builtins -> UNDEF (transform-time loads
-        cannot know where a name resolves)."""
+        """locals -> enclosing converted frames -> globals -> builtins ->
+        UNDEF (transform-time loads cannot know where a name resolves).
+
+        The enclosing-frame walk emulates lexical scoping for generated
+        nested functions: a name read ONLY inside a converted inner branch
+        has no syntactic reference in the generated enclosing body fn, so
+        no closure cell forms — but the defining frame (same ``<dy2static
+        …>`` filename) is live on the stack whenever the branch runs."""
         if name in loc:
             return loc[name]
+        import sys
+        caller = sys._getframe(1)
+        fname = caller.f_code.co_filename
+        fr, depth = caller.f_back, 0
+        while fr is not None and depth < 64:
+            if fr.f_code.co_filename == fname and name in fr.f_locals:
+                v = fr.f_locals[name]
+                if v is not UNDEF:
+                    return v
+            fr = fr.f_back
+            depth += 1
         if name in glb:
             return glb[name]
         b = glb.get("__builtins__", {})
@@ -763,7 +927,49 @@ class _JSTNamespace:
         # iteration — any premature USE raises via _Undef. Only a TRACED
         # loop needs every carried slot bound (lax.while has a fixed carry
         # structure), checked inside whileloop once tracedness is known.
-        return whileloop(cfn, bfn, loop_vars, var_names=names)
+        from paddle_tpu.framework.flags import flag_value
+        max_trips = flag_value("dy2static_max_trip_count") or None
+        return whileloop(cfn, bfn, loop_vars, var_names=names,
+                         maximum_trip_count=max_trips,
+                         bound_traced_only=True)
+
+    # --- for-over-range lowering (see _ForToWhileRewriter) ---
+
+    @staticmethod
+    def range3(*args):
+        """Normalize range(...) args to (start, stop, step). If any is a
+        Tensor the triple tensorizes (uniform dtype) so the counter can be
+        loop-carried through lax.while; all-concrete args stay Python ints
+        and the loop runs natively during capture."""
+        if len(args) == 1:
+            start, stop, step = 0, args[0], 1
+        elif len(args) == 2:
+            start, stop, step = args[0], args[1], 1
+        else:
+            start, stop, step = args
+        vals = [start, stop, step]
+        if not any(isinstance(v, Tensor) for v in vals):
+            if step == 0:
+                raise ValueError("range() arg 3 must not be zero")
+            return int(start), int(stop), int(step)
+        dtype = next(v._data.dtype for v in vals if isinstance(v, Tensor))
+        if not jnp.issubdtype(dtype, jnp.integer):
+            dtype = jnp.int32
+        out = []
+        for v in vals:
+            a = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            out.append(Tensor(a.astype(dtype), _internal=True))
+        return tuple(out)
+
+    @classmethod
+    def range_cont(cls, i, stop, step):
+        """Direction-aware range continuation test: ``i < stop`` for
+        positive step, ``i > stop`` for negative (tensor-aware)."""
+        if not isinstance(i, Tensor):
+            return i < stop if step > 0 else i > stop
+        i_, s_, st_ = i._data, stop._data, step._data
+        c = jnp.where(st_ > 0, i_ < s_, i_ > s_)
+        return Tensor(c, _internal=True)
 
     # --- break/continue/return flag plumbing (see _EscapeRewriter) ---
 
@@ -820,18 +1026,23 @@ class _JSTNamespace:
         return bool(np.asarray(b))
 
     @staticmethod
-    def final_return(flag, val):
+    def final_return(flag, val, always_returns=True):
         """The single synthesized return point once any loop contains
-        ``return``. A concrete flag keeps exact Python semantics; a traced
-        flag means the VALUE was already joined through ifelse/whileloop
-        (or those raised their kind-mismatch error), so val is it."""
+        ``return``. A concrete flag keeps exact Python semantics. A traced
+        flag is only safe when static analysis proved every dynamic path
+        returns a value (``always_returns``) — then the joined val IS the
+        answer; otherwise the dynamically-fall-through path would get a
+        joined tensor where Python gives None, so raise (r4 advisor)."""
         f = flag._data if isinstance(flag, Tensor) else jnp.asarray(flag)
         if isinstance(f, jax.core.Tracer):
-            if val is None:
+            if val is None or not always_returns:
                 raise DataDependentControlFlowError(
                     "whether this function returns a value depends on a "
-                    "traced condition, and no value was joined for the "
-                    "not-returned path. " + _HINT)
+                    "traced condition (it can dynamically fall through "
+                    "without returning, which Python answers with None but "
+                    "a traced join cannot represent). Add an explicit "
+                    "return at the end of the function so every path "
+                    "returns a value. " + _HINT)
             return val
         return val if bool(np.asarray(f)) else None
 

@@ -158,11 +158,12 @@ def _sig_of(args, kwargs):
 
 class _Compiled:
     __slots__ = ("jitted", "state_tensors", "out_spec", "out_rebuild",
-                 "n_out_tensors", "out_stop_grads", "grad_mask")
+                 "n_out_tensors", "out_stop_grads", "grad_mask", "pure")
 
     def __init__(self, jitted, state_tensors, out_spec, out_rebuild,
-                 n_out_tensors, out_stop_grads, grad_mask):
+                 n_out_tensors, out_stop_grads, grad_mask, pure=None):
         self.jitted = jitted
+        self.pure = pure
         self.state_tensors = state_tensors
         self.out_spec = out_spec
         self.out_rebuild = out_rebuild
@@ -371,9 +372,135 @@ class StaticFunction:
         donate = (0, 1) if self._donate else ()
         jitted = jax.jit(pure, donate_argnums=donate)
         compiled = _Compiled(jitted, state_tensors, out_spec, out_rebuild,
-                             len(out_tensors), out_stop_grads, grad_mask)
+                             len(out_tensors), out_stop_grads, grad_mask,
+                             pure=pure)
         self._cache.setdefault(key, []).append(compiled)
         return compiled
+
+    def multi_steps(self, k: int) -> "MultiStepFunction":
+        """k steps per dispatch: `lax.scan` over the captured step.
+
+        Amortizes the fixed per-dispatch cost (measured 5-10 ms/call through
+        the TPU runtime, docs/PERF.md) across k steps: the returned callable
+        takes the SAME arguments as the step function but with an extra
+        leading axis of size k (one slice per step), runs all k steps inside
+        ONE compiled, donated XLA program, and returns outputs stacked along
+        a leading k axis (so losses can be logged sparsely without breaking
+        the async chain).
+
+        This is the step-granularity completion of what the reference's
+        one-op `run_program` capture does at op granularity
+        (ref `python/paddle/jit/dy2static/program_translator.py:399`):
+        there, per-op dispatch is amortized into one program; here, the
+        per-program dispatch is amortized into one k-step program.
+
+        Constraint: the step must leave `.grad` presence the way it found it
+        (e.g. a full train step ending in `clear_grad()`). A step that turns
+        absent grads into present ones (bare grad-accumulation micro-step)
+        changes the scan carry structure and raises at trace time.
+        """
+        return MultiStepFunction(self, k)
+
+
+class MultiStepFunction:
+    """See StaticFunction.multi_steps. Shares the per-step capture cache with
+    the parent StaticFunction; holds its own cache of k-step executables."""
+
+    def __init__(self, static_fn: StaticFunction, k: int):
+        if int(k) < 1:
+            raise ValueError(f"multi_steps k must be >= 1, got {k}")
+        self._sf = static_fn
+        self._k = int(k)
+        self._cache: dict[Any, Any] = {}
+        functools.update_wrapper(self, static_fn._fn)
+
+    @property
+    def steps_per_call(self):
+        return self._k
+
+    def __call__(self, *args, **kwargs):
+        k = self._k
+        arg_tensors, arg_spec, rebuild = _tree_flatten_tensors((args, kwargs))
+        for t in arg_tensors:
+            if not t._data.shape or t._data.shape[0] != k:
+                raise ValueError(
+                    f"multi_steps({k}): every tensor argument needs a leading "
+                    f"axis of size {k} (one slice per step); got shape "
+                    f"{tuple(t._data.shape)}")
+        # per-step probe tensors: slice step 0 (shape/dtype carrier only)
+        step_tensors = [Tensor(t._data[0], stop_gradient=t.stop_gradient,
+                               _internal=True) for t in arg_tensors]
+        step_args, step_kwargs = rebuild(arg_spec, step_tensors, lambda t: t)
+        sig = _sig_of(step_args, step_kwargs)
+
+        compiled, jitted_k = None, None
+        for cand, jk in self._cache.get(sig, ()):
+            if cand.mask_matches():
+                compiled, jitted_k = cand, jk
+                break
+        if compiled is None:
+            compiled, jitted_k = self._build(sig, step_args, step_kwargs)
+
+        state_in = []
+        for t in compiled.state_tensors:
+            d = t._data
+            if getattr(d.sharding, "memory_kind", None) == "pinned_host" \
+                    and hasattr(t, "_offload_device"):
+                d = jax.device_put(d, t._offload_device)
+            state_in.append(d)
+        grads_full = [t._grad._data if m else None
+                      for t, m in zip(compiled.state_tensors,
+                                      compiled.grad_mask)]
+        stacked = [t._data for t in arg_tensors]
+        outs_stacked, new_state, new_grads = jitted_k(state_in, grads_full,
+                                                      stacked)
+        for t, arr in zip(compiled.state_tensors, new_state):
+            if hasattr(t, "_offload_host"):
+                arr = jax.device_put(arr, t._offload_host)
+            t._data = arr
+        for t, g in zip(compiled.state_tensors, new_grads):
+            t._grad = None if g is None else Tensor(g, stop_gradient=True,
+                                                    _internal=True)
+        wrapped = [Tensor(a, stop_gradient=compiled.out_stop_grads[i],
+                          _internal=True)
+                   for i, a in enumerate(outs_stacked)]
+        return compiled.out_rebuild(compiled.out_spec, wrapped, lambda t: t)
+
+    def _build(self, sig, step_args, step_kwargs):
+        sf = self._sf
+        compiled = None
+        for cand in sf._cache.get(sig, ()):
+            if cand.mask_matches() and cand.pure is not None:
+                compiled = cand
+                break
+        if compiled is None:
+            compiled = sf._capture(sig, step_args, step_kwargs)
+        pure, mask = compiled.pure, compiled.grad_mask
+
+        def pure_k(state_arrays, grads_full, stacked_args):
+            def body(carry, args_t):
+                state, gfull = carry
+                gin = [g for g, m in zip(gfull, mask) if m]
+                outs, new_state, new_grads = pure(state, gin, list(args_t))
+                return (new_state, new_grads), outs
+
+            try:
+                (state, gfull), outs = jax.lax.scan(
+                    body, (state_arrays, grads_full), stacked_args)
+            except (TypeError, ValueError) as e:
+                raise TypeError(
+                    "multi_steps: the step changes which tensors carry a "
+                    ".grad between entry and exit (scan carry structure "
+                    "mismatch). Use multi_steps only on full train steps "
+                    "that end in clear_grad(); run grad-accumulation "
+                    "micro-steps through the plain to_static path. "
+                    f"Underlying error: {e}") from e
+            return outs, state, gfull
+
+        donate = (0, 1) if sf._donate else ()
+        jitted_k = jax.jit(pure_k, donate_argnums=donate)
+        self._cache.setdefault(sig, []).append((compiled, jitted_k))
+        return compiled, jitted_k
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None,

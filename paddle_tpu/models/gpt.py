@@ -49,6 +49,13 @@ class GPTConfig:
                                      # parallel attention when sp > 1 (beyond
                                      # the reference, SURVEY §5.7)
     recompute: bool = False          # rematerialize each block (jax.checkpoint)
+    recompute_granularity: str = "full"  # "full" | "mlp" | "mlp_up" (ref GPT
+                                     # impls' recompute_granularity). "mlp"
+                                     # remats ln_2+MLP; "mlp_up" only the
+                                     # up-proj+gelu. Memory savers both —
+                                     # measured speed LOSSES on the
+                                     # bandwidth-bound single-chip step
+                                     # (docs/PERF.md r5), so default "full"
     fused_ce: bool = True            # chunked lm-head+CE, no [N,V] logits in HBM
 
 
@@ -119,6 +126,10 @@ class GPTMLP(nn.Layer):
 class GPTBlock(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
+        if cfg.recompute_granularity not in ("full", "mlp", "mlp_up"):
+            raise ValueError(
+                f"recompute_granularity={cfg.recompute_granularity!r}: "
+                "expected 'full', 'mlp', or 'mlp_up'")
         self.cfg = cfg
         self.ln_1 = nn.LayerNorm(cfg.hidden_size)
         self.attn = GPTAttention(cfg)
@@ -131,7 +142,22 @@ class GPTBlock(nn.Layer):
         else:
             a, cache = self.attn(self.ln_1(x), cache)
             x = x + a
-        x = x + self.mlp(self.ln_2(x))
+        gran = self.cfg.recompute_granularity
+        if (gran in ("mlp", "mlp_up") and self.training
+                and cache is None and not self.cfg.recompute):
+            from paddle_tpu.distributed.fleet.recompute import recompute
+            if gran == "mlp":
+                x = x + recompute(lambda t: self.mlp(self.ln_2(t)), x)
+            else:
+                # remat only up-proj+gelu: bwd re-runs ONE matmul instead of
+                # reloading the [N, 4H] intermediate from HBM
+                m = self.mlp
+                g = recompute(
+                    lambda t: F.gelu(m.fc_in(t), approximate=True),
+                    self.ln_2(x))
+                x = x + m.drop(m.fc_out(g))
+        else:
+            x = x + self.mlp(self.ln_2(x))
         x = _sp_constrain(x, self.cfg)
         return x if cache is None else (x, cache)
 
