@@ -526,14 +526,20 @@ class PipelineLayer(Layer):
         if any(len(sl) == 0 for sl in stage_slices):
             raise NotImplementedError(
                 f"segment bounds {segs} produce an empty pipeline stage")
-        owner = {}
+        # cross-stage tying is supported for PARAMETERS (grad hook below);
+        # a shared layer carrying BUFFERS (BN running stats) would update
+        # each stage row independently with no reconciliation — stats would
+        # silently diverge from serial, so it stays a loud error
+        seen_stage = {}
         for s, sl in enumerate(stage_slices):
             for layer, _ in sl:
-                if owner.setdefault(id(layer), s) != s:
+                first = seen_stage.setdefault(id(layer), s)
+                if first != s and list(layer.buffers()):
                     raise NotImplementedError(
-                        "a SharedLayerDesc layer appears in two different "
-                        "stages — weight tying across heterogeneous stages "
-                        "is only supported by the homogeneous engine")
+                        "a SharedLayerDesc layer with BUFFERS (e.g. BN "
+                        "running stats) appears in two pipeline stages — "
+                        "cross-stage tying reconciles parameter grads, but "
+                        "per-stage buffer updates have no single owner")
         param_objs, buf_objs, pmetas, bmetas = [], [], [], []
         for sl in stage_slices:
             ps, bs, seen = [], [], set()
@@ -548,44 +554,89 @@ class PipelineLayer(Layer):
                         bs.append(b)
             param_objs.append(ps)
             buf_objs.append(bs)
-            pm = ph.leaf_metas([p._data for p in ps])
-            bm = ph.leaf_metas([b._data for b in bs])
-            ph._check_packable(pm, "stage parameters",
-                               concrete=[p._data for p in ps])
-            ph._check_packable(bm, "stage buffers",
-                               concrete=[b._data for b in bs])
-            pmetas.append(pm)
-            bmetas.append(bm)
-        plen = max(1, max(ph.packed_len(m) for m in pmetas))
-        blen = max(1, max(ph.packed_len(m) for m in bmetas))
-        packed_p = jnp.stack([ph.pack_leaves([p._data for p in ps], plen)
-                              for ps in param_objs])
-        packed_b = jnp.stack([ph.pack_leaves([b._data for b in bs], blen)
-                              for bs in buf_objs])
-        packed_p = jax.device_put(
-            packed_p, NamedSharding(mesh, PartitionSpec("pp", None)))
-        packed_b = jax.device_put(
-            packed_b, NamedSharding(mesh, PartitionSpec("pp", None)))
-        prm = Parameter(packed_p)
-        prm.name = "pp_hetero_params"
-        self.add_parameter("pp_hetero_params", prm)
-        bufs = Tensor(packed_b, _internal=True)
-        bufs.stop_gradient = True
-        self.register_buffer("pp_hetero_bufs", bufs)
-        self._ph_params = prm
-        self._ph_bufs = bufs
+            pmetas.append(ph.leaf_metas([p._data for p in ps]))
+            bmetas.append(ph.leaf_metas([b._data for b in bs]))
+        plens = ph.merge_lengths([ph.bucket_sizes(m) for m in pmetas])
+        blens = ph.merge_lengths([ph.bucket_sizes(m) for m in bmetas])
+        packed_p = {k: [] for k in plens}
+        packed_b = {k: [] for k in blens}
+        for s in range(n_stages):
+            row_p = ph.pack_buckets([p._data for p in param_objs[s]],
+                                    pmetas[s], plens)
+            row_b = ph.pack_buckets([b._data for b in buf_objs[s]],
+                                    bmetas[s], blens)
+            for k in plens:
+                packed_p[k].append(row_p[k])
+            for k in blens:
+                packed_b[k].append(row_b[k])
+        spec = NamedSharding(mesh, PartitionSpec("pp", None))
+        # a SharedLayerDesc layer in two stages = cross-stage weight tying
+        # (ref `pp_layers.py:381-431` shared-comm groups): its param leaves
+        # occupy slots in BOTH stage rows. The copies start equal (packed
+        # from one object); a grad hook sums the slot grads and broadcasts
+        # the total to every copy — with identical values, grads, and
+        # (flat, zero-init) optimizer state, the copies stay bit-synced
+        # without any extra communication, the SPMD analog of the
+        # reference's allreduce over the shared-weight comm group.
+        locs = {}
+        for s, ps in enumerate(param_objs):
+            for li, p in enumerate(ps):
+                locs.setdefault(id(p), []).append((s, li))
+        tie_groups = {}                      # bucket key -> [ [(s,off,n)..] ]
+        p_layouts = [ph.bucket_layout(m) for m in pmetas]
+        for pid, where in locs.items():
+            if len(where) < 2:
+                continue
+            slots = []
+            for s, li in where:
+                k, off = p_layouts[s][li]
+                n = ph._nelems(pmetas[s][li][0])
+                slots.append((s, off, n))
+            tie_groups.setdefault(k, []).append(slots)
+        self._ph_params, self._ph_bufs = {}, {}
+        for k in sorted(plens):
+            prm = Parameter(jax.device_put(jnp.stack(packed_p[k]), spec))
+            prm.name = f"pp_hetero_params_{k}"
+            self.add_parameter(f"pp_hetero_params_{k}", prm)
+            if k in tie_groups:
+                prm.register_hook(self._make_tie_hook(tie_groups[k]))
+            self._ph_params[k] = prm
+        for k in sorted(blens):
+            buf = Tensor(jax.device_put(jnp.stack(packed_b[k]), spec),
+                         _internal=True)
+            buf.stop_gradient = True
+            self.register_buffer(f"pp_hetero_bufs_{k}", buf)
+            self._ph_bufs[k] = buf
+        self._ph_param_keys = sorted(plens)
+        self._ph_buf_keys = sorted(blens)
+        self._ph_tie_groups = tie_groups
         self._ph_stage_slices = stage_slices
         self._ph_param_objs = param_objs
         self._ph_buf_objs = buf_objs
         self._ph_pmetas, self._ph_bmetas = pmetas, bmetas
-        self._ph_plen, self._ph_blen = plen, blen
+        self._ph_plens, self._ph_blens = plens, blens
         # stage layers stay UNREGISTERED: the packed param/buffer replace them
         self._layers_list = LayerList([])
         self._pp_hetero = True
         self._pp_mode = True
 
-    def _hetero_stage_fn(self, s, in_meta, act_len):
-        """fn(p_flat, b_flat, x_flat[, key]) -> (y_flat[act_len], b_flat')"""
+    @staticmethod
+    def _make_tie_hook(groups):
+        def hook(g):
+            arr = g._data
+            for slots in groups:
+                tot = None
+                for s, off, n in slots:
+                    piece = arr[s, off:off + n]
+                    tot = piece if tot is None else tot + piece
+                for s, off, n in slots:
+                    arr = arr.at[s, off:off + n].set(tot)
+            return Tensor(arr, stop_gradient=True, _internal=True)
+        return hook
+
+    def _hetero_stage_fn(self, s, in_meta, act_lens):
+        """fn(p_buckets, b_buckets, x_buckets[, key]) ->
+        (y_buckets[act_lens], b_buckets')"""
         from paddle_tpu.core import tensor as tensor_mod
         from paddle_tpu.distributed.fleet import pipeline_hetero as ph
         from paddle_tpu.distributed.fleet.pipeline import (
@@ -593,13 +644,12 @@ class PipelineLayer(Layer):
         players = self._ph_stage_slices[s]
         pobjs, bobjs = self._ph_param_objs[s], self._ph_buf_objs[s]
         pmetas, bmetas = self._ph_pmetas[s], self._ph_bmetas[s]
-        blen = self._ph_blen
-        n_in = ph.packed_len([in_meta])
+        blens = self._ph_blens
 
-        def fn(p_flat, b_flat, x_flat, key=None):
-            pvals = ph.unpack_leaves(p_flat, pmetas)
-            bvals = ph.unpack_leaves(b_flat, bmetas)
-            xin = ph.unpack_leaves(x_flat[:n_in], [in_meta])[0]
+        def fn(p_buckets, b_buckets, x_buckets, key=None):
+            pvals = ph.unpack_buckets(p_buckets, pmetas)
+            bvals = ph.unpack_buckets(b_buckets, bmetas)
+            xin = ph.unpack_buckets(x_buckets, [in_meta])[0]
             saved_p = [(t._data, t._grad_node, t._out_slot) for t in pobjs]
             saved_b = [t._data for t in bobjs]
             prev_hooks = tensor_mod.set_capture_hooks(None, None)
@@ -620,8 +670,9 @@ class PipelineLayer(Layer):
                         out = (ffunc(layer, out) if ffunc is not None
                                else layer(out))
                     new_bufs = [t._data for t in bobjs]  # BN wrote updates
-                    y = ph.pack_leaves([out._data], act_len)
-                    nb = ph.pack_leaves(new_bufs, blen)
+                    y = ph.pack_buckets([out._data],
+                                        ph.leaf_metas([out._data]), act_lens)
+                    nb = ph.pack_buckets(new_bufs, bmetas, blens)
             finally:
                 _IN_HETERO_STAGE = prev_stage
                 tensor_mod.set_capture_hooks(*prev_hooks)
@@ -675,8 +726,6 @@ class PipelineLayer(Layer):
             tensor_mod.set_capture_hooks(*prev_hooks)
             for t, d in saved_b:
                 t._data = d
-        from paddle_tpu.distributed.fleet import pipeline_hetero as ph
-        ph._check_packable(metas, "stage boundary activations")
         return metas
 
     def _run_hetero_pipeline(self, x):
@@ -707,43 +756,60 @@ class PipelineLayer(Layer):
         cache = getattr(self, "_ph_prim_cache", None)
         if cache is None:
             cache = self._ph_prim_cache = {}
+        pkeys, bkeys = self._ph_param_keys, self._ph_buf_keys
+        n_pk, n_bk = len(pkeys), len(bkeys)
         jitted = cache.get(cache_key)
         if jitted is None:
             metas = self._hetero_boundary_metas(x, mb)
-            act_len = max(ph.packed_len([m]) for m in metas)
+            act_lens = ph.merge_lengths(
+                [ph.bucket_sizes([m]) for m in metas])
+            # introspection (and the bf16-boundary test): which dtypes
+            # actually cross stage boundaries / sit in the packed params
+            self._ph_act_lens = act_lens
             out_meta = metas[-1]
-            out_len = ph.packed_len([out_meta])
-            stage_fns = [self._hetero_stage_fn(s, metas[s], act_len)
+            out_sizes = ph.bucket_sizes([out_meta])
+            stage_fns = [self._hetero_stage_fn(s, metas[s], act_lens)
                          for s in range(n_stages)]
 
-            def prim(packed_p, packed_b, xa, *kd):
+            def prim(*arrays):
+                packed_p = dict(zip(pkeys, arrays[:n_pk]))
+                packed_b = dict(zip(bkeys, arrays[n_pk:n_pk + n_bk]))
+                xa = arrays[n_pk + n_bk]
+                kd = arrays[n_pk + n_bk + 1:]
                 xm = xa.reshape((n_micro, mb) + xa.shape[1:])
-                xm_flat = jnp.stack(
-                    [ph.pack_leaves([xm[m]], act_len)
-                     for m in range(n_micro)])
+                rows = [ph.pack_buckets([xm[m]], ph.leaf_metas([xm[m]]),
+                                        act_lens) for m in range(n_micro)]
+                xm_flat = {k: jnp.stack([r[k] for r in rows])
+                           for k in act_lens}
                 base_key = (jax.random.wrap_key_data(kd[0]) if kd else None)
                 outs, new_b = ph.spmd_pipeline_hetero(
                     stage_fns, n_stages, n_micro, packed_p, packed_b,
-                    xm_flat, out_len, mesh, rng_key=base_key)
-                res = [ph.unpack_leaves(outs[m], [out_meta])[0]
+                    xm_flat, out_sizes, mesh, rng_key=base_key)
+                res = [ph.unpack_buckets(
+                    {k: outs[k][m] for k in outs}, [out_meta])[0]
                        for m in range(n_micro)]
-                return jnp.concatenate(res, axis=0), new_b
+                return (jnp.concatenate(res, axis=0),
+                        *[new_b[k] for k in bkeys])
 
             jitted = jax.jit(prim)
             cache[cache_key] = jitted
-        args = [self._ph_params, self._ph_bufs, x]
+        args = ([self._ph_params[k] for k in pkeys]
+                + [self._ph_bufs[k] for k in bkeys] + [x])
         if use_rng:
             kd = jax.random.key_data(self._pp_generator.next_key())
             args.append(Tensor(kd, _internal=True))
-        out, new_b = apply(jitted, *args, op_name="spmd_pipeline_hetero")
+        out, *new_bs = apply(jitted, *args, op_name="spmd_pipeline_hetero")
+        new_b = dict(zip(bkeys, new_bs))
         with no_grad():
-            self._ph_bufs._write(new_b._data)
+            for k in bkeys:
+                self._ph_bufs[k]._write(new_b[k]._data)
             # refresh the original layer buffer objects so introspection /
             # a later sequential run sees the updated running stats
             for s, (bl, bm) in enumerate(zip(self._ph_buf_objs,
                                              self._ph_bmetas)):
                 if bl:
-                    vals = ph.unpack_leaves(new_b._data[s], bm)
+                    vals = ph.unpack_buckets(
+                        {k: new_b[k]._data[s] for k in bkeys}, bm)
                     for t, v in zip(bl, vals):
                         t._data = v
         return out

@@ -52,11 +52,19 @@ def shard_optimizer_states(optimizer, mesh=None, axis="dp"):
 
 
 def shard_parameters(model, mesh=None, axis="dp"):
-    """Stage-3: shard the parameter arrays themselves."""
+    """Stage-3: shard the parameter arrays themselves. Parameters that
+    already carry a named mesh sharding (a pipeline's 'pp'-stacked stage
+    params, an mpu layer's 'mp' shard) are left in place — stage3 composes
+    with model parallelism by sharding the REMAINING (replicated) params
+    over the data axis, not by fighting placements the model chose."""
     mesh = mesh or get_mesh()
     if mesh is None:
         return model
     for p in model.parameters():
+        sh = getattr(p._data, "sharding", None)
+        if isinstance(sh, NamedSharding) and any(
+                s is not None for s in sh.spec):
+            continue
         spec = _shard_spec_for(tuple(p._data.shape), mesh, axis)
         _place(p, NamedSharding(mesh, spec))
     return model

@@ -204,3 +204,61 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     cl.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
                    "metrics": metrics or []})
     return cl
+
+
+class VisualDL(Callback):
+    """Scalar-metrics logging callback (ref `hapi/callbacks.py:880`
+    VisualDL). The reference writes VisualDL event files; this build keeps
+    the callback contract (same tags ``train/<metric>`` per train step,
+    ``eval/<metric>`` per epoch, rank-0-only writes) but logs to plain
+    JSON-lines files under ``log_dir`` — readable by anything, no
+    visualdl dependency. One line per scalar:
+    ``{"tag": "train/loss", "step": 12, "value": 0.53}``."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self.epoch = 0
+        self.train_step = 0
+        self._fh = None
+
+    def _is_write(self):
+        from paddle_tpu.distributed import get_rank
+        return get_rank() == 0
+
+    def _writer(self):
+        if self._fh is None:
+            import os
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(os.path.join(self.log_dir, "scalars.jsonl"),
+                            "a", buffering=1)
+        return self._fh
+
+    def _updates(self, logs, mode, step):
+        if not self._is_write():
+            return
+        import json
+        fh = self._writer()
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)) and v:
+                v = v[0]
+            if not isinstance(v, numbers.Number) or k in ("step",
+                                                          "batch_size"):
+                continue
+            fh.write(json.dumps({"tag": f"{mode}/{k}", "step": int(step),
+                                 "value": float(v)}) + "\n")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        self.train_step += 1
+        self._updates(logs, "train", self.train_step)
+
+    def on_eval_end(self, logs=None):
+        self._updates(logs, "eval", self.epoch)
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

@@ -22,4 +22,5 @@ def softmax_mask_fuse_upper_triangle(x):
 from paddle_tpu.incubate import asp  # noqa: F401
 from paddle_tpu.incubate import autograd  # noqa: F401
 from paddle_tpu.incubate import optimizer  # noqa: F401
-from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage  # noqa: F401
+from paddle_tpu.incubate.optimizer import (  # noqa: F401
+    DistributedFusedLamb, LookAhead, ModelAverage)

@@ -65,7 +65,10 @@ extern "C" {
 // dtype codes match serve.py's _DTYPES table
 // (0=f32 1=f64 2=i32 3=i64 4=u8 5=bool 6=f16 7=bf16 8=i8 ...).
 
-void* PD_RemotePredictorCreate(const char* host, int port) {
+// token: the 32-byte sha256 connection digest (serve.py auth_token);
+// sent in the connection hello — a wrong digest gets the socket dropped.
+void* PD_RemotePredictorCreate(const char* host, int port,
+                               const unsigned char* token) {
   auto* c = new Client();
   c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (c->fd < 0) {
@@ -78,6 +81,18 @@ void* PD_RemotePredictorCreate(const char* host, int port) {
   if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
       ::connect(c->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
           0) {
+    ::close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  unsigned char hello[4 + 32];
+  std::memcpy(hello, &kMagic, 4);
+  if (token) {
+    std::memcpy(hello + 4, token, 32);
+  } else {
+    std::memset(hello + 4, 0, 32);
+  }
+  if (!send_all(c->fd, hello, sizeof(hello))) {
     ::close(c->fd);
     delete c;
     return nullptr;

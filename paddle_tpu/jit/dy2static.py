@@ -244,8 +244,15 @@ def _discover_extra_reads(body_fn, t_idx, tensors, passthrough):
     try:
         with no_grad():
             jax.eval_shape(run, [t._data for t in tensors])
-    except Exception:
-        pass                # discovery is best-effort; execution re-raises
+    except Exception as e:
+        # a silent pass here would bake closure-read weights as jit
+        # constants and return ZERO gradients for them — the exact bug this
+        # probe exists to prevent. The probe replays the same jnp ops the
+        # lowering will trace, so a probe failure is a real problem.
+        raise DataDependentControlFlowError(
+            "the bounded-loop lowering could not probe the loop body for "
+            "closure-read tensors (gradients to them would silently "
+            f"vanish). Probe error: {type(e).__name__}: {e}") from e
     finally:
         tensor_mod.set_capture_hooks(*prev)
         for t, old in written.values():
@@ -898,12 +905,17 @@ class _JSTNamespace:
         import sys
         caller = sys._getframe(1)
         fname = caller.f_code.co_filename
+        # "<dy2static {fn_name}#{seq}>" -> the unit's root function name;
+        # the walk STOPS after that frame so a recursive call cannot
+        # resolve names from an OUTER invocation's locals (stale values)
+        root_name = fname[len("<dy2static "):].rsplit("#", 1)[0]
         fr, depth = caller.f_back, 0
         while fr is not None and depth < 64:
-            if fr.f_code.co_filename == fname and name in fr.f_locals:
-                v = fr.f_locals[name]
-                if v is not UNDEF:
-                    return v
+            if fr.f_code.co_filename == fname:
+                if name in fr.f_locals and fr.f_locals[name] is not UNDEF:
+                    return fr.f_locals[name]
+                if fr.f_code.co_name == root_name:
+                    break               # left this invocation's extent
             fr = fr.f_back
             depth += 1
         if name in glb:

@@ -30,7 +30,10 @@ class HookRemoveHelper:
 
 
 class Layer:
-    def __init__(self, name_scope=None, dtype="float32"):
+    def __init__(self, name_scope=None, dtype=None):
+        # dtype None = the GLOBAL default (paddle.set_default_dtype), resolved
+        # at create_parameter time (ref layers.py Layer: uses
+        # paddle.get_default_dtype() unless the layer pins one)
         self.training = True
         self._dtype = dtype
         self._parameters: dict[str, Parameter] = collections.OrderedDict()

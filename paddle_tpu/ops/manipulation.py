@@ -18,7 +18,28 @@ def _ints(v):
         v = v.tolist()
     if isinstance(v, (int, np.integer)):
         return int(v)
-    return [int(x._data) if isinstance(x, Tensor) else int(x) for x in v]
+    out = []
+    for x in v:
+        if isinstance(x, Tensor):
+            x = x._data
+        try:
+            out.append(int(x))
+        except Exception as e:  # noqa: BLE001 — dim kinds sorted by name
+            name = type(e).__name__
+            if name == "ConcretizationTypeError":
+                # a TRACED dim (data-dependent shape): must stay loud — it
+                # is the dy2static retry signal / a real user error, and
+                # jnp.reshape could not consume the raw tracer anyway
+                raise
+            if isinstance(e, TypeError) or \
+                    name == "InconclusiveDimensionOperation":
+                # a SYMBOLIC dimension (jax.export shape polymorphism:
+                # e.g. a dynamic batch from `x.shape[0]` under jit.save's
+                # symbolic export) — jnp.reshape consumes it natively
+                out.append(x)
+            else:
+                raise
+    return out
 
 
 def reshape(x, shape, name=None):

@@ -110,14 +110,27 @@ def _sparse_conv3d(x, weight, bias, stride, padding, dilation, subm):
     stride, padding, dilation = (_triple(stride), _triple(padding),
                                  _triple(dilation))
     if subm:
+        # DIVERGENCE from the reference (documented, r4 advisor): the
+        # reference's ResetSubmKernelSizeAndStrides SILENTLY forces
+        # stride=1, allows even kernels, and pads k/2 without accounting
+        # for dilation (`phi/kernels/sparse/gpu/conv_kernel.cu` /
+        # `sparse/nn/layer/conv.py:270`). Here stride!=1 and even kernels
+        # RAISE (silent resets hide bugs; even kernels cannot center on
+        # input sites), and padding is dilation-aware so dilated subm
+        # convs actually preserve the sparsity pattern. Ported models that
+        # relied on the silent reset must drop the stride argument.
         if stride != (1, 1, 1):
-            raise ValueError("SubmConv3D requires stride 1 "
-                             "(ref conv.py:270 submanifold semantics)")
+            raise ValueError(
+                "SubmConv3D requires stride 1 (submanifold semantics; the "
+                "reference silently RESETS stride to 1 — this build raises "
+                "instead: pass stride=1 explicitly)")
         if any(k % 2 == 0 for k in ksize):
             raise ValueError(
                 f"SubmConv3D requires ODD kernel sizes (got {ksize}): even "
                 "kernels cannot center on the input sites, so the "
-                "pattern-preserving contract has no consistent padding")
+                "pattern-preserving contract has no consistent padding "
+                "(the reference allows them with k/2 padding, shifting the "
+                "receptive field half a voxel)")
         padding = tuple(dilation[i] * (ksize[i] - 1) // 2 for i in range(3))
     shape = x._dense_shape                     # [N, D, H, W, C]
     idx = np.asarray(x._indices._data)

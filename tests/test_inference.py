@@ -114,7 +114,7 @@ class TestServeOutOfProcess:
 
         proc, port = self._start_server(prefix)
         try:
-            cli = RemotePredictor(port=port)
+            cli = RemotePredictor(port=port, model_prefix=prefix)
             assert cli.ping()
             assert cli.run([x])
             out = cli.get_output_handle(
@@ -156,14 +156,17 @@ class TestServeOutOfProcess:
             os.path.join(str(tmp_path / "build"), "pd_c_client.so"))
         cdll.PD_RemotePredictorCreate.restype = ctypes.c_void_p
         cdll.PD_RemotePredictorCreate.argtypes = [ctypes.c_char_p,
-                                                  ctypes.c_int]
+                                                  ctypes.c_int,
+                                                  ctypes.c_char_p]
         cdll.PD_RemotePredictorRun.restype = ctypes.c_int
         cdll.PD_GetOutputData.restype = ctypes.c_void_p
         cdll.PD_GetOutputNbytes.restype = ctypes.c_int64
 
         proc, port = self._start_server(prefix)
         try:
-            h = cdll.PD_RemotePredictorCreate(b"127.0.0.1", port)
+            from paddle_tpu.inference.serve import auth_token
+            h = cdll.PD_RemotePredictorCreate(b"127.0.0.1", port,
+                                              auth_token(prefix))
             assert h, "C client failed to connect"
             h = ctypes.c_void_p(h)
             assert cdll.PD_RemotePredictorPing(h) == 1
@@ -183,6 +186,76 @@ class TestServeOutOfProcess:
             np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
             cdll.PD_RemotePredictorShutdownServer(h)
             cdll.PD_RemotePredictorDelete(h)
+            proc.wait(timeout=20)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+
+class TestServeHardening:
+    """r4 verdict weak #5 + advisor finding: unauthenticated connections
+    (incl. SHUTDOWN) are dropped before any op is read, and a connection
+    whose request failed mid-body is closed instead of desyncing."""
+
+    _start_server = TestServeOutOfProcess._start_server
+
+    def test_unauthenticated_shutdown_rejected(self, tmp_path):
+        import socket
+        import struct
+        from paddle_tpu.inference.serve import (
+            MAGIC, OP_SHUTDOWN, RemotePredictor)
+        _, prefix = _save_model(tmp_path)
+        proc, port = self._start_server(prefix)
+        try:
+            # wrong digest + SHUTDOWN: server must drop the conn and live on
+            raw = socket.create_connection(("127.0.0.1", port), timeout=10)
+            raw.sendall(struct.pack("<I", MAGIC) + b"\x00" * 32)
+            raw.sendall(struct.pack("<III", MAGIC, OP_SHUTDOWN, 0))
+            raw.settimeout(5)
+            try:
+                assert raw.recv(12) == b""  # dropped, no response
+            except ConnectionResetError:
+                pass                        # abrupt close also = dropped
+            raw.close()
+            assert proc.poll() is None, "server died from unauthed shutdown"
+            cli = RemotePredictor(port=port, model_prefix=prefix)
+            assert cli.ping()               # still serving authed clients
+            cli.shutdown_server()
+            cli.close()
+            proc.wait(timeout=20)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+    def test_failed_run_closes_connection(self, tmp_path):
+        """A RUN whose body errors mid-parse gets an error response and a
+        CLOSED connection (stream position is unknowable); a fresh
+        connection works."""
+        import struct
+        from paddle_tpu.inference.serve import MAGIC, OP_RUN, RemotePredictor
+        _, prefix = _save_model(tmp_path)
+        proc, port = self._start_server(prefix)
+        try:
+            cli = RemotePredictor(port=port, model_prefix=prefix)
+            # hand-craft a corrupt array: dims say 2x8 f32 (64 bytes) but
+            # nbytes declares 4 — reshape fails server-side mid-request
+            bad = (struct.pack("<III", MAGIC, OP_RUN, 1)
+                   + struct.pack("<BB", 0, 2) + struct.pack("<2I", 2, 8)
+                   + struct.pack("<Q", 4) + b"\x00" * 4)
+            cli._sock.sendall(bad)
+            from paddle_tpu.inference.serve import _recv_exact
+            magic, status, n = struct.unpack(
+                "<III", _recv_exact(cli._sock, 12))
+            assert magic == MAGIC and status == 1    # error reported
+            _recv_exact(cli._sock, n)
+            # connection now closed by the server: next read sees EOF
+            cli._sock.settimeout(5)
+            assert cli._sock.recv(1) == b""
+            cli.close()
+            cli2 = RemotePredictor(port=port, model_prefix=prefix)
+            assert cli2.ping()
+            cli2.shutdown_server()
+            cli2.close()
             proc.wait(timeout=20)
         finally:
             if proc.poll() is None:

@@ -3,6 +3,7 @@ import os
 import time
 
 import numpy as np
+import jax.numpy as jnp
 import pytest
 
 import paddle_tpu as paddle
@@ -350,3 +351,257 @@ class TestInt8Execution:
         out = fwd(x)
         rel = float((out - ref).abs().max() / ref.abs().max())
         assert rel < 0.08, rel
+
+
+class TestVisualDLCallback:
+    """r4 VERDICT missing #5: the metrics-logging callback (ref
+    `hapi/callbacks.py:880` VisualDL) — same tag/step contract, JSON-lines
+    backend (no visualdl dependency)."""
+
+    def test_scalars_logged(self, tmp_path):
+        import json
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=1e-2,
+                                            parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=paddle.metric.Accuracy())
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 8).astype(np.float32)
+        Y = rng.randint(0, 4, (32, 1)).astype(np.int64)
+        ds = [(X[i], Y[i]) for i in range(32)]
+        logdir = str(tmp_path / "vdl")
+        cb = paddle.callbacks.VisualDL(log_dir=logdir)
+        model.fit(ds, epochs=2, batch_size=8, verbose=0, callbacks=[cb])
+        model.evaluate(ds, batch_size=8, verbose=0, callbacks=[cb])
+        lines = [json.loads(ln) for ln in
+                 open(f"{logdir}/scalars.jsonl", encoding="utf-8")]
+        tags = {ln["tag"] for ln in lines}
+        assert "train/loss" in tags, tags
+        train_steps = [ln["step"] for ln in lines
+                       if ln["tag"] == "train/loss"]
+        assert train_steps == sorted(train_steps) and len(train_steps) >= 8
+        assert all(np.isfinite(ln["value"]) for ln in lines)
+
+
+class TestDistributedFusedLamb:
+    """r4 VERDICT missing #4 (ref
+    `incubate/optimizer/distributed_fused_lamb.py:82`): LAMB parity vs an
+    independent numpy oracle incl. the built-in global-norm clip, plus the
+    gradient-accumulation interplay (update fires every k-th step with the
+    mean grad)."""
+
+    def _numpy_lamb(self, params, grads, steps, lr, wd, b1, b2, eps,
+                    max_norm):
+        ps = [p.astype(np.float64).copy() for p in params]
+        ms = [np.zeros_like(p) for p in ps]
+        vs = [np.zeros_like(p) for p in ps]
+        for t in range(1, steps + 1):
+            gs = [g.astype(np.float64) for g in grads[t - 1]]
+            if max_norm > 0:
+                norm = np.sqrt(sum((g ** 2).sum() for g in gs))
+                scale = min(1.0, max_norm / max(norm, 1e-12))
+                gs = [g * scale for g in gs]
+            for i in range(len(ps)):
+                ms[i] = b1 * ms[i] + (1 - b1) * gs[i]
+                vs[i] = b2 * vs[i] + (1 - b2) * gs[i] ** 2
+                mhat = ms[i] / (1 - b1 ** t)
+                vhat = vs[i] / (1 - b2 ** t)
+                r = mhat / (np.sqrt(vhat) + eps) + wd * ps[i]
+                wn, rn = np.linalg.norm(ps[i]), np.linalg.norm(r)
+                trust = wn / rn if (wn > 0 and rn > 0) else 1.0
+                ps[i] = ps[i] - lr * trust * r
+        return ps
+
+    def test_parity_with_global_clip(self):
+        from paddle_tpu.incubate import DistributedFusedLamb
+        paddle.seed(0)
+        rng = np.random.RandomState(1)
+        w0 = rng.randn(6, 4).astype(np.float32)
+        b0 = rng.randn(4).astype(np.float32)
+        lin = nn.Linear(6, 4)
+        lin.weight._write(jnp.asarray(w0))
+        lin.bias._write(jnp.asarray(b0))
+        opt = DistributedFusedLamb(
+            learning_rate=1e-2, lamb_weight_decay=0.01,
+            parameters=lin.parameters(), max_global_grad_norm=0.5)
+        xs = [rng.randn(8, 6).astype(np.float32) for _ in range(3)]
+        grads = []
+        for x in xs:
+            out = lin(paddle.Tensor(x, _internal=True))
+            loss = (out ** 2).mean()
+            loss.backward()
+            grads.append([np.asarray(lin.weight.grad._data).copy(),
+                          np.asarray(lin.bias.grad._data).copy()])
+            opt.step()
+            opt.clear_grad()
+        want = self._numpy_lamb([w0, b0], grads, 3, 1e-2, 0.01, 0.9, 0.999,
+                                1e-6, 0.5)
+        np.testing.assert_allclose(np.asarray(lin.weight._data), want[0],
+                                   rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(np.asarray(lin.bias._data), want[1],
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_exclude_from_weight_decay(self):
+        from paddle_tpu.incubate import DistributedFusedLamb
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+        opt = DistributedFusedLamb(
+            learning_rate=1e-2, lamb_weight_decay=0.5,
+            parameters=lin.parameters(),
+            exclude_from_weight_decay_fn=lambda p: p.ndim == 1)  # biases
+        x = paddle.ones([2, 4])
+        (lin(x).sum()).backward()
+        b_before = np.asarray(lin.bias._data).copy()
+        g_b = np.asarray(lin.bias.grad._data).copy()
+        opt.step()
+        # bias updated WITHOUT decay: reproduce step-1 lamb by hand
+        mhat = g_b
+        vhat = g_b ** 2
+        r = mhat / (np.sqrt(vhat) + 1e-6)
+        wn, rn = np.linalg.norm(b_before), np.linalg.norm(r)
+        trust = wn / rn if (wn > 0 and rn > 0) else 1.0
+        want = b_before - 1e-2 * trust * r
+        np.testing.assert_allclose(np.asarray(lin.bias._data), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gradient_accumulation_matches_mean_grad(self):
+        """k step() calls with grads g1..gk must equal ONE update with
+        mean(g) (the reference's acc_step/stop_update semantics)."""
+        from paddle_tpu.incubate import DistributedFusedLamb
+
+        def build():
+            paddle.seed(3)
+            lin = nn.Linear(5, 3)
+            return lin
+
+        rng = np.random.RandomState(2)
+        xs = [rng.randn(4, 5).astype(np.float32) for _ in range(2)]
+
+        # path A: gradient_accumulation_steps=2, backward per micro-batch
+        lin_a = build()
+        opt_a = DistributedFusedLamb(learning_rate=1e-2,
+                                     parameters=lin_a.parameters(),
+                                     gradient_accumulation_steps=2)
+        for x in xs:
+            (lin_a(paddle.Tensor(x, _internal=True)) ** 2).mean().backward()
+            opt_a.step()
+            opt_a.clear_grad()
+
+        # path B: plain (k=1) on the averaged grads: backward on both
+        # micro-batches (grads ACCUMULATE on .grad), then scale by 1/2
+        lin_b = build()
+        opt_b = DistributedFusedLamb(learning_rate=1e-2,
+                                     parameters=lin_b.parameters())
+        for x in xs:
+            ((lin_b(paddle.Tensor(x, _internal=True)) ** 2).mean()
+             / 2).backward()
+        opt_b.step()
+        opt_b.clear_grad()
+
+        for pa, pb in zip(lin_a.parameters(), lin_b.parameters()):
+            np.testing.assert_allclose(np.asarray(pa._data),
+                                       np.asarray(pb._data),
+                                       rtol=1e-5, atol=1e-6)
+
+
+class TestInt8Conv:
+    """r4 VERDICT next #5: int8 conv EXECUTION (int8 x int8 -> int32
+    conv_general_dilated with per-out-channel dequant), exactness vs an
+    integer simulation, and a PTQ'd conv net deployed through the
+    Predictor."""
+
+    def test_int8_conv_matches_integer_simulation_exactly(self):
+        from paddle_tpu.quantization import convert_to_int8, int8_conv2d
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32)
+        b = rng.randn(4).astype(np.float32)
+        qw, ws = convert_to_int8(w, per_channel=True, axis=0)
+
+        out = int8_conv2d(paddle.Tensor(x, _internal=True), qw, ws,
+                          bias=paddle.Tensor(b, _internal=True),
+                          stride=1, padding=1)
+
+        # independent integer simulation (numpy, int32 accumulation)
+        s_x = max(np.abs(x).max(), 1e-8) / 127.0
+        xq = np.clip(np.round(x / s_x), -127, 127).astype(np.int32)
+        xp = np.pad(xq, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        N, C, H, W = x.shape
+        O = w.shape[0]
+        acc = np.zeros((N, O, H, W), np.int64)
+        for i in range(3):
+            for j in range(3):
+                patch = xp[:, :, i:i + H, j:j + W]
+                acc += np.einsum("nchw,oc->nohw", patch,
+                                 qw[:, :, i, j].astype(np.int64))
+        want = acc.astype(np.float32) * (s_x * ws / 127.0).reshape(
+            1, -1, 1, 1) + b.reshape(1, -1, 1, 1)
+        np.testing.assert_allclose(np.asarray(out._data), want,
+                                   rtol=1e-6, atol=1e-5)
+
+    def test_int8_conv_close_to_fp32(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.quantization import Int8Conv2D
+        paddle.seed(0)
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        qconv = Int8Conv2D.from_float(conv)
+        rng = np.random.RandomState(1)
+        x = paddle.Tensor(rng.randn(2, 3, 16, 16).astype(np.float32),
+                          _internal=True)
+        ref = np.asarray(conv(x)._data)
+        got = np.asarray(qconv(x)._data)
+        assert got.shape == ref.shape
+        denom = np.abs(ref).max()
+        assert np.abs(got - ref).max() / denom < 0.05, (
+            np.abs(got - ref).max() / denom)
+
+    def test_ptq_lenet_through_predictor(self, tmp_path):
+        """PTQ -> convert convs+linears to int8 -> jit.save -> Predictor:
+        the quantized conv model serves end to end (ref mkdnn_quantizer's
+        int8 deploy path)."""
+        from paddle_tpu.inference import Config, create_predictor
+        from paddle_tpu.quantization import (
+            PTQ, convert_convs_to_int8, convert_linears_to_int8)
+
+        paddle.seed(0)
+
+        class LeNetish(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.c1 = nn.Conv2D(1, 6, 3, padding=1)
+                self.c2 = nn.Conv2D(6, 16, 3, stride=2, padding=1)
+                self.fc = nn.Linear(16 * 14 * 14, 10)
+
+            def forward(self, x):
+                h = paddle.nn.functional.relu(self.c1(x))
+                h = paddle.nn.functional.relu(self.c2(h))
+                return self.fc(h.reshape([h.shape[0], -1]))
+
+        net = LeNetish()
+        rng = np.random.RandomState(2)
+        calib = paddle.Tensor(rng.rand(4, 1, 28, 28).astype(np.float32),
+                              _internal=True)
+        ptq = PTQ()
+        q = ptq.quantize(net)
+        q(calib)                       # observe
+        deploy = ptq.convert(q)
+        deploy = convert_convs_to_int8(deploy)
+        deploy = convert_linears_to_int8(deploy)
+        ref = np.asarray(deploy(calib)._data)
+
+        import paddle_tpu.static as static
+        prefix = str(tmp_path / "lenet_int8")
+        deploy.eval()
+        paddle.jit.save(deploy, prefix, input_spec=[
+            static.InputSpec([None, 1, 28, 28], "float32", "x")])
+        pred = create_predictor(Config(prefix))
+        pred.run([np.asarray(calib._data)])
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-4)
+        fp32 = np.asarray(net(calib)._data)
+        assert np.abs(np.asarray(out) - fp32).max() / \
+            max(np.abs(fp32).max(), 1e-6) < 0.15
