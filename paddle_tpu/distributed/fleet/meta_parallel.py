@@ -600,6 +600,10 @@ class PipelineLayer(Layer):
             self.add_parameter(f"pp_hetero_params_{k}", prm)
             if k in tie_groups:
                 prm.register_hook(self._make_tie_hook(tie_groups[k]))
+                # every slot after a group's first is a grad DUPLICATE:
+                # global-norm clip must not re-count it (nn/clip.py)
+                prm._tied_dup_slots = [slot for slots in tie_groups[k]
+                                       for slot in slots[1:]]
             self._ph_params[k] = prm
         for k in sorted(blens):
             buf = Tensor(jax.device_put(jnp.stack(packed_b[k]), spec),

@@ -178,11 +178,15 @@ class DistributedFusedLamb(Optimizer):
         if self._max_global_grad_norm <= 0:
             return None
         sq = jnp.zeros((), jnp.float32)
-        for _, g in params_grads:
+        for p, g in params_grads:
             if g is None:
                 continue
             ga = g._read().astype(jnp.float32)
-            sq = sq + jnp.sum(ga * ga)
+            s = jnp.sum(ga * ga)
+            for row, off, n in getattr(p, "_tied_dup_slots", ()):
+                dup = ga[row, off:off + n]
+                s = s - jnp.sum(dup * dup)
+            sq = sq + s
         norm = jnp.sqrt(sq)
         if not self._is_grad_scaled_by_nranks:
             from paddle_tpu.distributed import get_world_size
@@ -191,6 +195,14 @@ class DistributedFusedLamb(Optimizer):
         return jnp.minimum(1.0, limit / jnp.maximum(norm, 1e-12))
 
     def step(self):
+        # LAMB's trust ratio needs whole-parameter norms, so SelectedRows
+        # (sparse embedding) grads densify up front — the reference's fused
+        # kernel likewise only consumes flat dense grads
+        from paddle_tpu.core.selected_rows import SelectedRows
+        for p in self._all_params():
+            if isinstance(p._grad, SelectedRows):
+                p._grad = Tensor(p._grad.to_dense().astype(p._data.dtype),
+                                 stop_gradient=True, _internal=True)
         self._acc_count += 1
         if self._acc_count < self._acc_steps:
             # accumulate and hold (ref stop_update): params untouched

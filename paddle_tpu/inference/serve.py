@@ -192,6 +192,14 @@ class RemotePredictor:
 
     def __init__(self, host="127.0.0.1", port=None, timeout=60.0,
                  model_prefix=None, token=None):
+        if token is None and model_prefix is None and \
+                not os.environ.get("PADDLE_SERVE_TOKEN"):
+            raise ValueError(
+                "RemotePredictor cannot derive the auth secret: pass "
+                "model_prefix= (the server derives its token from its "
+                "model prefix), an explicit 32-byte token=, or set "
+                "PADDLE_SERVE_TOKEN on both sides — otherwise the server "
+                "silently drops the connection")
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._outs = []
         tok = token if token is not None else auth_token(str(model_prefix))

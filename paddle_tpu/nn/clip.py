@@ -51,18 +51,30 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
         self.group_name = group_name
 
-    def _global_norm(self, grads):
-        sq = [jnp.sum(g._data.astype(jnp.float32) ** 2) for g in grads]
+    def _global_norm(self, grads, params=None):
+        sq = []
+        for i, g in enumerate(grads):
+            s = jnp.sum(g._data.astype(jnp.float32) ** 2)
+            p = params[i] if params is not None else None
+            # packed pipeline params with cross-stage TIED slots carry the
+            # SUMMED grad in every copy (so updates stay identical); the
+            # duplicates must not re-count in the global norm or clipping
+            # diverges from the serial model (which holds the param once)
+            for row, off, n in getattr(p, "_tied_dup_slots", ()):
+                dup = g._data[row, off:off + n].astype(jnp.float32)
+                s = s - jnp.sum(dup * dup)
+            sq.append(s)
         return jnp.sqrt(sum(sq))
 
     def _dygraph_clip(self, params_grads):
         # params with need_clip=False stay out of the norm sum too (ref
         # _dygraph_clip filters before computing the norm)
-        grads = [g for p, g in params_grads
+        pairs = [(p, g) for p, g in params_grads
                  if g is not None and getattr(p, "need_clip", True)]
-        if not grads:
+        if not pairs:
             return params_grads
-        global_norm = self._global_norm(grads)
+        global_norm = self._global_norm([g for _, g in pairs],
+                                        params=[p for p, _ in pairs])
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
         out = []
         for p, g in params_grads:

@@ -918,6 +918,36 @@ class TestHeteroTiedBf16GPT:
         assert pl._ph_tie_groups, "shared embed not detected as tied"
         np.testing.assert_allclose(serial, dist, rtol=4e-2, atol=2e-2)
 
+    def test_tied_parity_under_global_norm_clip(self):
+        """ClipGradByGlobalNorm with ACTIVE clipping: the tied slots carry
+        the summed grad in BOTH stage rows, and the duplicate must not
+        re-count in the global norm (else the clip scale — and therefore
+        every loss after step 1 — diverges from serial)."""
+        import paddle_tpu.nn as nn_
+
+        def train(num_stages):
+            model = self._build(num_stages, 2)
+            opt = paddle.optimizer.Adam(
+                learning_rate=5e-3, parameters=model.parameters(),
+                grad_clip=nn_.ClipGradByGlobalNorm(0.05))  # always active
+            losses = []
+            for ids in self._batches():
+                x = paddle.Tensor(ids[:, :-1].astype(np.int32),
+                                  _internal=True)
+                y = paddle.Tensor(ids[:, 1:], _internal=True)
+                _, loss = model(x, labels=y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+            return losses
+
+        set_mesh(None)
+        serial = train(2)                   # sequential fallback
+        auto_mesh(dp=4, pp=2)
+        dist = train(2)
+        np.testing.assert_allclose(serial, dist, rtol=4e-2, atol=2e-2)
+
     def test_tied_slots_stay_synced(self):
         """After backward the tie hook gives every shared slot the SUMMED
         grad, and after optimizer steps the copies remain bit-identical
