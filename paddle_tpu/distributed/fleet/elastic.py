@@ -290,7 +290,13 @@ class TcpRegistryServer:
             for line in f:
                 try:
                     req = json.loads(line)
-                except ValueError:
+                except ValueError as e:
+                    # authed but unparsable: reply per protocol, then drop
+                    # (stream position after a bad line is unknowable)
+                    f.write((json.dumps({"ok": False,
+                                         "error": f"bad json: {e}"})
+                             + "\n").encode())
+                    f.flush()
                     return
                 op = req.get("op")
                 now = time.time()
@@ -305,9 +311,17 @@ class TcpRegistryServer:
                             self._nodes.pop(str(req["node_id"]), None)
                             resp = {"ok": True}
                         elif op == "list":
+                            # prune expired leases (node-id churn across
+                            # elastic restarts must not grow the dict
+                            # unboundedly)
+                            dead = [k for k, (_, ts, ttl)
+                                    in self._nodes.items()
+                                    if now - ts > ttl]
+                            for k in dead:
+                                del self._nodes[k]
                             resp = {"ok": True, "nodes": {
                                 k: ep for k, (ep, ts, ttl)
-                                in self._nodes.items() if now - ts <= ttl}}
+                                in self._nodes.items()}}
                         else:
                             resp = {"ok": False, "error": f"bad op {op!r}"}
                 except (KeyError, TypeError, ValueError) as e:
@@ -374,7 +388,11 @@ class TcpNodeRegistry:
     def leave(self):
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=self._interval + 1.0)
+            # join must outlast a renew blocked inside _call (connect/read
+            # timeout 10s), or an in-flight put lands AFTER the del below
+            # and resurrects the lease for a full TTL (cf. the file
+            # backend's identical guard)
+            self._thread.join(timeout=12.0)
         try:
             self._call({"op": "del", "node_id": self.node_id})
         except (OSError, ValueError):

@@ -476,6 +476,7 @@ class TestResNet50Pipeline:
             losses.append(float(loss))
         return losses, pl
 
+    @pytest.mark.slow
     def test_resnet50_pp2_exact_parity_f64_carrier(self):
         """Strict correctness: with an f64 packing carrier the pipelined
         forward agrees with the serial run to 1e-6 (f32 leaves ~1e-3 of
@@ -522,6 +523,7 @@ class TestResNet50Pipeline:
             ph.CARRIER_DTYPE = prev
         np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-6)
 
+    @pytest.mark.slow
     def test_resnet50_pp2_loss_and_grad_parity_f64(self):
         """One TRAINING step (fwd+bwd, micro=2) in f64: pipelined loss
         matches the micro-batched serial run to 1e-6 and the packed

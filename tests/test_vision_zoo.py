@@ -28,7 +28,9 @@ def _train_step(model, size=64):
 
 
 @pytest.mark.parametrize("ctor", [
-    M.densenet121, M.shufflenet_v2_x0_5, M.mobilenet_v3_small,
+    pytest.param(M.densenet121, marks=pytest.mark.slow),
+    M.shufflenet_v2_x0_5,
+    pytest.param(M.mobilenet_v3_small, marks=pytest.mark.slow),
 ], ids=["densenet121", "shufflenet_v2", "mobilenet_v3"])
 def test_zoo_forward_backward(ctor):
     model = ctor(num_classes=10)
@@ -36,6 +38,7 @@ def test_zoo_forward_backward(ctor):
     assert out.shape == [2, 10]
 
 
+@pytest.mark.slow
 def test_googlenet_aux_heads():
     model = M.googlenet(num_classes=10)
     model.eval()
@@ -44,6 +47,7 @@ def test_googlenet_aux_heads():
     assert out.shape == [1, 10] and aux1.shape == [1, 10] and aux2.shape == [1, 10]
 
 
+@pytest.mark.slow
 def test_inception_v3_forward():
     model = M.inception_v3(num_classes=7)
     model.eval()
