@@ -107,6 +107,40 @@ def bench_gpt2():
     return tokens_per_sec, mfu, dt, (init_loss, loss), n_params, ksteps
 
 
+def bench_gpt2_long():
+    """Long-context rung (SURVEY long-context first-class): GPT-2s at seq
+    4096 on ONE chip via the O(S)-memory flash path. r5 sweep: b2/s4096
+    84.5k tok/s (b4 regresses to 64.8k — spill), b1/s8192 44.9k."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    batch, seq = 2, 4096
+    cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, max_position_embeddings=seq,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
+    dt, loss, _ = _timed_steps_k(
+        train_step, ids[:, :-1].astype(np.int32),
+        ids[:, 1:].astype(np.int64), ksteps=8, iters=2)
+    return batch * seq / dt, dt, loss
+
+
 def bench_resnet50():
     """Batch 256 measured optimal on the chip (r5 sweep, imgs/s with the
     k-step loop: b64 1466, b128 1787, b256 1964, b512 1877)."""
@@ -315,6 +349,13 @@ def main():
           f"loss={loss:.3f} step={dt*1e3:.1f}ms mfu={mfu:.3f} "
           f"steps_per_call={ksteps} platform={platform}",
           file=sys.stderr)
+    try:
+        tps_l, dt_l, loss_l = _retry(bench_gpt2_long)
+        print(f"# gpt2s_long seq=4096 tok/s/chip={tps_l:.1f} "
+              f"step={dt_l*1e3:.1f}ms loss={loss_l:.3f}", file=sys.stderr)
+    except Exception as e:
+        print(f"# gpt2s_long rung failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     try:
         ips, dt_r, loss_r = _retry(bench_resnet50)
         print(f"# resnet50 imgs/sec/chip={ips:.1f} step={dt_r*1e3:.1f}ms "

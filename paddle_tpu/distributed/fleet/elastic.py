@@ -236,7 +236,8 @@ class TcpRegistryServer:
 
     def __init__(self, host="127.0.0.1", port=0):
         import socket
-        self._nodes = {}
+        self._nodes = {}          # node_id -> (endpoint, ts, ttl, nonce)
+        self._tombstones = {}     # (node_id, nonce) -> del timestamp
         self._lock = threading.Lock()
         self._token = _elastic_token()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -303,24 +304,43 @@ class TcpRegistryServer:
                 try:
                     with self._lock:
                         if op == "put":
-                            self._nodes[str(req["node_id"])] = (
-                                req["endpoint"], now,
-                                float(req.get("ttl", 30)))
-                            resp = {"ok": True}
+                            nid = str(req["node_id"])
+                            nonce = str(req.get("nonce", ""))
+                            # a put whose SESSION was already deleted is a
+                            # late in-flight renewal racing leave() — drop
+                            # it (sequencing, not timing, closes the lease-
+                            # resurrection race); a REJOIN uses a fresh
+                            # nonce and registers normally
+                            if (nid, nonce) in self._tombstones:
+                                resp = {"ok": True, "stale": True}
+                            else:
+                                self._nodes[nid] = (
+                                    req["endpoint"], now,
+                                    float(req.get("ttl", 30)), nonce)
+                                resp = {"ok": True}
                         elif op == "del":
-                            self._nodes.pop(str(req["node_id"]), None)
+                            nid = str(req["node_id"])
+                            nonce = str(req.get("nonce", ""))
+                            self._tombstones[(nid, nonce)] = now
+                            cur = self._nodes.get(nid)
+                            if cur is None or cur[3] == nonce or not nonce:
+                                self._nodes.pop(nid, None)
                             resp = {"ok": True}
                         elif op == "list":
-                            # prune expired leases (node-id churn across
-                            # elastic restarts must not grow the dict
-                            # unboundedly)
-                            dead = [k for k, (_, ts, ttl)
+                            # prune expired leases + old tombstones
+                            # (node-id churn across elastic restarts must
+                            # not grow the dicts unboundedly)
+                            dead = [k for k, (_, ts, ttl, _n)
                                     in self._nodes.items()
                                     if now - ts > ttl]
                             for k in dead:
                                 del self._nodes[k]
+                            for k in [k for k, ts in
+                                      self._tombstones.items()
+                                      if now - ts > 120.0]:
+                                del self._tombstones[k]
                             resp = {"ok": True, "nodes": {
-                                k: ep for k, (ep, ts, ttl)
+                                k: ep for k, (ep, ts, ttl, _n)
                                 in self._nodes.items()}}
                         else:
                             resp = {"ok": False, "error": f"bad op {op!r}"}
@@ -354,6 +374,7 @@ class TcpNodeRegistry:
         self._stop = threading.Event()
         self._thread = None
         self._last_view: dict = {}
+        self._nonce = os.urandom(8).hex()   # session id: dedupes vs rejoin
 
     def _call(self, req):
         import json
@@ -370,13 +391,15 @@ class TcpNodeRegistry:
 
     def register(self):
         self._call({"op": "put", "node_id": self.node_id,
-                    "endpoint": self.endpoint, "ttl": self.ttl})
+                    "endpoint": self.endpoint, "ttl": self.ttl,
+                    "nonce": self._nonce})
 
         def renew():
             while not self._stop.wait(self._interval):
                 try:
                     self._call({"op": "put", "node_id": self.node_id,
-                                "endpoint": self.endpoint, "ttl": self.ttl})
+                                "endpoint": self.endpoint, "ttl": self.ttl,
+                                "nonce": self._nonce})
                 except (OSError, ValueError):
                     pass
 
@@ -388,13 +411,15 @@ class TcpNodeRegistry:
     def leave(self):
         self._stop.set()
         if self._thread is not None:
-            # join must outlast a renew blocked inside _call (connect/read
-            # timeout 10s), or an in-flight put lands AFTER the del below
-            # and resurrects the lease for a full TTL (cf. the file
-            # backend's identical guard)
-            self._thread.join(timeout=12.0)
+            self._thread.join(timeout=self._interval + 1.0)
         try:
-            self._call({"op": "del", "node_id": self.node_id})
+            # the del TOMBSTONES this session's nonce server-side, so even
+            # a renewal still in flight (socket timeouts can hold one for
+            # tens of seconds) cannot resurrect the lease — sequencing,
+            # not join-timing, closes the race; a rejoining registry uses
+            # a fresh nonce and is unaffected
+            self._call({"op": "del", "node_id": self.node_id,
+                        "nonce": self._nonce})
         except (OSError, ValueError):
             pass
 

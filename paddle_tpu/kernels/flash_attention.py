@@ -170,6 +170,19 @@ def _qblocks(S):
     return min(256, S) if S <= 4096 else 1024
 
 
+# bwd may use a different q-block than fwd: each bwd block pays a padded
+# dk/dv accumulation over the FULL K length, so fewer/larger blocks trade
+# upper-triangular logit FLOPs for less accumulator traffic. Swept r5
+# (GPT-2s B16/S1024 whole step): bwd 512 -> 149.2 ms, 128 -> 152.7 ms vs
+# 130.5 ms at the shared 256 — the split LOSES both ways; 256 is a sharp
+# joint optimum. None = same as fwd (kept as an experiment hook).
+_QBLOCKS_BWD = None
+
+
+def _qblocks_bwd(S):
+    return _QBLOCKS_BWD if _QBLOCKS_BWD else _qblocks(S)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _xla_flash(q, k, v, causal, scale):
     out, _ = _xla_flash_fwd(q, k, v, causal, scale)
@@ -236,7 +249,7 @@ def _xla_flash_bwd(causal, scale, res, do):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     s = scale if scale is not None else 1.0 / math.sqrt(D)
-    bq = _qblocks(Sq)
+    bq = _qblocks_bwd(Sq)
     dqs = []
     dk = jnp.zeros((B, H, Sk, D), jnp.float32)
     dv = jnp.zeros((B, H, Sk, D), jnp.float32)

@@ -378,6 +378,33 @@ class TestTcpElasticRegistry:
         finally:
             srv.stop()
 
+    def test_late_renewal_cannot_resurrect_left_node(self):
+        """The leave() race: a put from the departed SESSION arriving after
+        the del is tombstoned; a fresh session (rejoin) registers fine."""
+        from paddle_tpu.distributed.fleet.elastic import (
+            TcpNodeRegistry, TcpRegistryServer)
+        srv = TcpRegistryServer().start()
+        try:
+            addr = f"127.0.0.1:{srv.port}"
+            r = TcpNodeRegistry(addr, "a", "10.0.0.1:1", ttl=30,
+                                heartbeat_interval=60)
+            r.register()
+            r.leave()
+            # simulate the in-flight renewal landing late (same nonce)
+            resp = r._call({"op": "put", "node_id": "a",
+                            "endpoint": "10.0.0.1:1", "ttl": 30,
+                            "nonce": r._nonce})
+            assert resp.get("stale"), resp
+            assert "a" not in r.alive_nodes()
+            # rejoin with a NEW session works
+            r2 = TcpNodeRegistry(addr, "a", "10.0.0.1:1", ttl=30,
+                                 heartbeat_interval=60)
+            r2.register()
+            assert "a" in r2.alive_nodes()
+            r2.leave()
+        finally:
+            srv.stop()
+
     def test_unauthed_connection_rejected(self):
         import json
         import socket
