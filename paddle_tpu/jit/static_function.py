@@ -398,6 +398,13 @@ class StaticFunction:
         (e.g. a full train step ending in `clear_grad()`). A step that turns
         absent grads into present ones (bare grad-accumulation micro-step)
         changes the scan carry structure and raises at trace time.
+
+        Scheduler granularity: host-side Python that runs BETWEEN steps
+        (``lr_scheduler.step()``, logging, callbacks) now runs between
+        k-step CALLS — the learning rate is constant within one call and
+        updates take effect on the next (state tensors, incl. the lr
+        tensor, are re-read per call). Pick k well below the scheduler's
+        time scale (e.g. k=32 under a 1000-step warmup).
         """
         return MultiStepFunction(self, k)
 

@@ -261,3 +261,34 @@ class TestMultiSteps:
         stepk(paddle.to_tensor(xs), paddle.to_tensor(ys))
         loss = step(paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0]))
         assert np.isfinite(float(loss))
+
+    def test_lr_update_between_calls_reaches_compiled_steps(self):
+        """The lr tensor is step state: a scheduler step BETWEEN multi_steps
+        calls must change the next call's updates (constant within a call —
+        see the multi_steps docstring)."""
+        paddle.seed(0)
+        lin = nn.Linear(4, 1)
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.01,
+                                              step_size=1, gamma=0.1)
+        opt = paddle.optimizer.SGD(learning_rate=sched,
+                                   parameters=lin.parameters())
+
+        @paddle.jit.to_static
+        def step(x):
+            loss = (lin(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        stepk = step.multi_steps(2)
+        x = paddle.ones([2, 2, 4])   # [k, batch, in]
+        w0 = np.asarray(lin.weight.numpy()).copy()
+        stepk(x)
+        w1 = np.asarray(lin.weight.numpy()).copy()
+        d1 = np.abs(w1 - w0).max()
+        sched.step()                 # lr 0.01 -> 0.001 between calls
+        stepk(x)
+        w2 = np.asarray(lin.weight.numpy())
+        d2 = np.abs(w2 - w1).max()
+        assert d2 < d1 * 0.6, (d1, d2)   # much smaller updates after decay

@@ -130,3 +130,67 @@ def test_graft_entry_dryrun():
     sys.path.insert(0, "/root/repo")
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
+
+
+class TestFastGenerate:
+    """fast_generate: single-program decode (static KV cache + lax.scan;
+    the XLA answer to the reference's fused decoding kernels,
+    `fused_multi_transformer_op.cu`) — greedy output must EXACTLY match
+    the eager cached `generate` loop."""
+
+    def _model(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        paddle.seed(7)
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_heads=2, intermediate_size=64,
+                        max_position_embeddings=64, hidden_dropout=0.0,
+                        attention_dropout=0.0)
+        return GPTForCausalLM(cfg)
+
+    def test_greedy_matches_generate(self):
+        m = self._model()
+        ids = paddle.Tensor(np.random.RandomState(0).randint(
+            0, 97, (2, 8)).astype(np.int32), _internal=True)
+        slow = np.asarray(m.generate(ids, max_new_tokens=12).numpy())
+        fast = np.asarray(m.fast_generate(ids, max_new_tokens=12).numpy())
+        np.testing.assert_array_equal(slow, fast)
+
+    def test_sampling_deterministic_per_seed_and_shapes(self):
+        m = self._model()
+        ids = paddle.Tensor(np.random.RandomState(1).randint(
+            0, 97, (3, 5)).astype(np.int32), _internal=True)
+        a = np.asarray(m.fast_generate(ids, max_new_tokens=6,
+                                       temperature=0.8, top_k=5,
+                                       seed=3).numpy())
+        b = np.asarray(m.fast_generate(ids, max_new_tokens=6,
+                                       temperature=0.8, top_k=5,
+                                       seed=3).numpy())
+        c = np.asarray(m.fast_generate(ids, max_new_tokens=6,
+                                       temperature=0.8, top_k=5,
+                                       seed=4).numpy())
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == (3, 11)
+        assert not np.array_equal(a, c)        # different seed, diff draw
+        assert (a[:, :5] == np.asarray(ids.numpy())).all()
+
+    def test_single_new_token(self):
+        m = self._model()
+        ids = paddle.Tensor(np.random.RandomState(2).randint(
+            0, 97, (2, 4)).astype(np.int32), _internal=True)
+        out = np.asarray(m.fast_generate(ids, max_new_tokens=1).numpy())
+        ref = np.asarray(m.generate(ids, max_new_tokens=1).numpy())
+        np.testing.assert_array_equal(out, ref)
+
+    def test_executable_reused_and_weight_updates_respected(self):
+        m = self._model()
+        ids = paddle.Tensor(np.random.RandomState(3).randint(
+            0, 97, (2, 6)).astype(np.int32), _internal=True)
+        m.fast_generate(ids, max_new_tokens=4)
+        assert len(m._fast_decode_cache) == 1
+        # perturb a weight: same executable, new params -> output changes
+        w = m.gpt.wte.weight
+        w._write(w._data + 0.5)
+        out2 = np.asarray(m.fast_generate(ids, max_new_tokens=4).numpy())
+        ref2 = np.asarray(m.generate(ids, max_new_tokens=4).numpy())
+        np.testing.assert_array_equal(out2, ref2)
+        assert len(m._fast_decode_cache) == 1   # no recompile
