@@ -88,7 +88,12 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(x)                       # [B, S, 3H] (mp-sharded)
         qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)
-        if cache is not None:
+        if cache == "init":
+            # prime an empty cache WITHOUT a zero-length tensor: [B, 0, ...]
+            # device arrays crash/hang some backends (the axon TPU tunnel's
+            # terminal died on one), and concat-with-empty is a no-op anyway
+            cache = (k, v)
+        elif cache is not None:
             pk, pv = cache
             k = paddle.concat([pk, k], axis=1)
             v = paddle.concat([pv, v], axis=1)
@@ -179,12 +184,15 @@ class GPTModel(nn.Layer):
     def forward(self, input_ids, position_ids=None, caches=None):
         S = input_ids.shape[1]
         if position_ids is None:
-            past = 0 if caches is None else caches[0][0].shape[1]
+            past = (0 if caches is None or caches == "init"
+                    else caches[0][0].shape[1])
             position_ids = paddle.arange(past, past + S, dtype="int64")
             position_ids = position_ids.unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         x = _sp_constrain(x, self.cfg)
+        if caches == "init":
+            caches = ["init"] * len(self.h)
         new_caches = [] if caches is not None else None
         use_remat = self.cfg.recompute and self.training and caches is None
         for i, block in enumerate(self.h):
@@ -249,12 +257,7 @@ class GPTForCausalLM(nn.Layer):
         cur = x
         for _ in range(max_new_tokens):
             if caches is None:
-                h, caches = self.gpt(cur, caches=[
-                    (paddle.zeros([x.shape[0], 0, self.cfg.num_heads,
-                                   self.cfg.hidden_size // self.cfg.num_heads]),
-                     paddle.zeros([x.shape[0], 0, self.cfg.num_heads,
-                                   self.cfg.hidden_size // self.cfg.num_heads]))
-                    for _ in range(self.cfg.num_layers)])
+                h, caches = self.gpt(cur, caches="init")
             else:
                 h, caches = self.gpt(cur, caches=caches)
             logits = paddle.matmul(h[:, -1], self.gpt.wte.weight,
