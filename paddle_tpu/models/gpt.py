@@ -60,6 +60,12 @@ class GPTConfig:
     fused_ce: bool = True            # chunked lm-head+CE, no [N,V] logits in HBM
 
 
+# cache-priming sentinel: generate()'s first step passes this instead of
+# zero-length [B, 0, H, Dh] tensors (zero-size device buffers crash/hang
+# some PJRT transports); attention returns fresh K/V as the cache
+INIT_CACHE = "init"
+
+
 def _sp_constrain(x, cfg):
     """[B, S, H] activations: batch over dp, sequence over sp."""
     if not cfg.seq_parallel or get_mesh() is None:
@@ -88,7 +94,7 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(x)                       # [B, S, 3H] (mp-sharded)
         qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)
-        if cache == "init":
+        if cache == INIT_CACHE:
             # prime an empty cache WITHOUT a zero-length tensor: [B, 0, ...]
             # device arrays crash/hang some backends (the axon TPU tunnel's
             # terminal died on one), and concat-with-empty is a no-op anyway
@@ -184,15 +190,15 @@ class GPTModel(nn.Layer):
     def forward(self, input_ids, position_ids=None, caches=None):
         S = input_ids.shape[1]
         if position_ids is None:
-            past = (0 if caches is None or caches == "init"
+            past = (0 if caches is None or caches == INIT_CACHE
                     else caches[0][0].shape[1])
             position_ids = paddle.arange(past, past + S, dtype="int64")
             position_ids = position_ids.unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         x = _sp_constrain(x, self.cfg)
-        if caches == "init":
-            caches = ["init"] * len(self.h)
+        if caches == INIT_CACHE:
+            caches = [INIT_CACHE] * len(self.h)
         new_caches = [] if caches is not None else None
         use_remat = self.cfg.recompute and self.training and caches is None
         for i, block in enumerate(self.h):
@@ -257,7 +263,7 @@ class GPTForCausalLM(nn.Layer):
         cur = x
         for _ in range(max_new_tokens):
             if caches is None:
-                h, caches = self.gpt(cur, caches="init")
+                h, caches = self.gpt(cur, caches=INIT_CACHE)
             else:
                 h, caches = self.gpt(cur, caches=caches)
             logits = paddle.matmul(h[:, -1], self.gpt.wte.weight,
