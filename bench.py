@@ -206,6 +206,31 @@ def bench_bert():
     return batch / dt, dt, loss
 
 
+def bench_decode():
+    """Autoregressive decode rung: GPT-2s fast_generate (single compiled
+    program: static KV cache + lax.scan; see models/gpt.py). B=8 prompts
+    of 128, 64 new tokens, greedy."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    B, S0, N = 8, 128, 64
+    cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, max_position_embeddings=256,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = paddle.Tensor(rng.randint(0, cfg.vocab_size, (B, S0))
+                        .astype(np.int32), _internal=True)
+    out = model.fast_generate(ids, max_new_tokens=N)     # compile
+    np.asarray(out.numpy())
+    t0 = time.perf_counter()
+    out = model.fast_generate(ids, max_new_tokens=N)
+    np.asarray(out.numpy())
+    dt = time.perf_counter() - t0
+    return B * N / dt, dt / N
+
+
 def _chw_to_hwc_u8(img):
     # CHW float [0,1] -> HWC uint8 [0,255]: the jitter family operates on
     # image-range uint8 like real decoded inputs. Module-level: spawn
@@ -355,6 +380,13 @@ def main():
               f"step={dt_l*1e3:.1f}ms loss={loss_l:.3f}", file=sys.stderr)
     except Exception as e:
         print(f"# gpt2s_long rung failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        dps, ms_tok = _retry(bench_decode)
+        print(f"# gpt2s_decode fast_generate: {dps:.0f} tok/s "
+              f"({ms_tok*1e3:.2f} ms/token at B=8)", file=sys.stderr)
+    except Exception as e:
+        print(f"# decode rung failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     try:
         ips, dt_r, loss_r = _retry(bench_resnet50)
