@@ -194,3 +194,24 @@ class TestFastGenerate:
         ref2 = np.asarray(m.generate(ids, max_new_tokens=4).numpy())
         np.testing.assert_array_equal(out2, ref2)
         assert len(m._fast_decode_cache) == 1   # no recompile
+
+    def test_bf16_model_decodes(self):
+        """Native-bf16 weights (set_default_dtype path): bf16 KV cache,
+        f32 softmax/logits — matches the eager loop greedily."""
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        paddle.seed(9)
+        prev = paddle.get_default_dtype()
+        paddle.set_default_dtype("bfloat16")
+        try:
+            cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                            num_heads=2, intermediate_size=64,
+                            max_position_embeddings=32, hidden_dropout=0.0,
+                            attention_dropout=0.0)
+            m = GPTForCausalLM(cfg)
+        finally:
+            paddle.set_default_dtype(prev)
+        ids = paddle.Tensor(np.random.RandomState(4).randint(
+            0, 64, (2, 6)).astype(np.int32), _internal=True)
+        fast = np.asarray(m.fast_generate(ids, max_new_tokens=8).numpy())
+        slow = np.asarray(m.generate(ids, max_new_tokens=8).numpy())
+        np.testing.assert_array_equal(fast, slow)
