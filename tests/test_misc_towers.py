@@ -605,3 +605,18 @@ class TestInt8Conv:
         fp32 = np.asarray(net(calib)._data)
         assert np.abs(np.asarray(out) - fp32).max() / \
             max(np.abs(fp32).max(), 1e-6) < 0.15
+
+    def test_int8_conv_nhwc(self):
+        """data_format='NHWC' conv converts and matches its fp32 source
+        (the review found from_float dropped the layout; now threaded)."""
+        from paddle_tpu.quantization import Int8Conv2D
+        paddle.seed(1)
+        conv = nn.Conv2D(3, 8, 3, padding=1, data_format="NHWC")
+        qconv = Int8Conv2D.from_float(conv)
+        rng = np.random.RandomState(5)
+        x = paddle.Tensor(rng.randn(2, 10, 10, 3).astype(np.float32),
+                          _internal=True)
+        ref = np.asarray(conv(x)._data)
+        got = np.asarray(qconv(x)._data)
+        assert got.shape == ref.shape == (2, 10, 10, 8)
+        assert np.abs(got - ref).max() / np.abs(ref).max() < 0.05
