@@ -255,7 +255,10 @@ class GPTForCausalLM(nn.Layer):
 
     @paddle.no_grad()
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0):
-        """Greedy/sampled decode with KV caches (inference path)."""
+        """Greedy/sampled decode with KV caches — EAGER loop (one dispatch
+        per token, growing cache shapes). Debug/reference path; production
+        decode should use :meth:`fast_generate` (single compiled program,
+        identical greedy output)."""
         self.eval()
         x = input_ids
         caches = None
