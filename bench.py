@@ -17,13 +17,55 @@ multi-tensor optimizer, whole-step capture with buffer donation, no remat
 """
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 V5E_BF16_PEAK = 197e12
+PRIMARY_METRIC = "gpt2s_train_tokens_per_sec_per_chip"
+
+
+def _init_backend():
+    """Backend bootstrap that cannot kill the bench (BENCH_r05 root cause:
+    a wedged TPU tunnel raised out of jax.default_backend() and the round
+    shipped rc=1 with no artifact). Order: try the configured backend; on
+    any PJRT init error re-init on CPU in-process; if even that fails the
+    caller re-execs a clean CPU child. Returns (platform|None, error|None) —
+    a non-None error with a non-None platform means 'running on the CPU
+    fallback, original backend was dead'."""
+    import jax
+    try:
+        return jax.default_backend(), None
+    except Exception as e:  # noqa: BLE001 — jax.errors.JaxRuntimeError etc.
+        err = f"{type(e).__name__}: {e}"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        return jax.default_backend(), err
+    except Exception as e2:  # noqa: BLE001
+        return None, f"{err}; cpu re-init failed: {type(e2).__name__}: {e2}"
+
+
+def _reexec_cpu_child(backend_error):
+    """Last resort: this interpreter's jax is wedged beyond re-init — run the
+    same bench invocation in a fresh CPU-pinned child and forward its output."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PTPU_BENCH_CHILD"] = "1"   # no recursive re-exec
+    env["PTPU_BENCH_BACKEND_ERROR"] = backend_error
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env=env)
+    return proc.returncode
+
+
+def _emit(payload):
+    """The ONE structured line the driver parses — every exit path goes
+    through here, so a failed round still leaves a parseable artifact."""
+    print(json.dumps(payload))
 
 
 def _timed_steps_k(train_step, x_np, y_np, ksteps, iters, warmup=2):
@@ -344,6 +386,45 @@ def bench_dataloader():
     return inproc, shm, ov_in, ov_shm
 
 
+def bench_smoke():
+    """CI-sized emission check (`bench.py --smoke`): ONE tiny train step on
+    whatever backend is up (CPU included), returning step time + the metric
+    registry snapshot. Exercised by tests/test_observability.py so a bench
+    emission regression fails tier-1 instead of surfacing at round end."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import metrics
+
+    paddle.seed(0)
+    batch, seq = 2, 8
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                    intermediate_size=64, max_position_embeddings=seq,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        _, loss = model(x, labels=y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq + 1))
+    x = paddle.to_tensor(ids[:, :-1].astype(np.int32))
+    y = paddle.to_tensor(ids[:, 1:].astype(np.int64))
+    loss0 = float(train_step(x, y))        # compile + step 1
+    t0 = time.perf_counter()
+    loss1 = float(train_step(x, y))        # cached step
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss0) and np.isfinite(loss1), (loss0, loss1)
+    snap = metrics.snapshot()
+    return dt, batch * seq / dt, snap
+
+
 def _retry(fn, attempts=3):
     """The dev-tunnel backend occasionally drops a remote_compile connection
     (HTTP 500 / closed body) — transient, so each rung retries."""
@@ -358,18 +439,68 @@ def _retry(fn, attempts=3):
     raise last
 
 
-def main():
-    import jax
-    platform = jax.default_backend()
+def main(argv=None):
+    ap = argparse.ArgumentParser("bench")
+    ap.add_argument("--smoke", action="store_true",
+                    help="1 tiny CPU-OK train step + metrics snapshot; "
+                         "always exits 0 with a parseable JSON line")
+    args = ap.parse_args(argv)
 
-    tps, mfu, dt, (init_loss, loss), n_params, ksteps = _retry(bench_gpt2)
+    platform, backend_error = _init_backend()
+    # a CPU child inherits the parent's original failure for the artifact
+    backend_error = backend_error or \
+        os.environ.get("PTPU_BENCH_BACKEND_ERROR") or None
+    if platform is None:
+        if not os.environ.get("PTPU_BENCH_CHILD"):
+            sys.exit(_reexec_cpu_child(backend_error))
+        # keep the metric name the caller is parsing for, even in total failure
+        _emit({"metric": "smoke_step_time_seconds" if args.smoke
+               else PRIMARY_METRIC,
+               "value": 0.0, "unit": "s" if args.smoke else "tokens/s",
+               "ok": False, "backend_error": backend_error})
+        return
+
+    if args.smoke:
+        try:
+            dt, tps, snap = bench_smoke()
+            _emit({"metric": "smoke_step_time_seconds", "value": round(dt, 6),
+                   "unit": "s", "ok": True, "platform": platform,
+                   "backend_error": backend_error,
+                   "tokens_per_sec": round(tps, 1),
+                   "compile_count": snap["counters"].get(
+                       "jit.compile_count", 0),
+                   "cache_hits": snap["counters"].get("jit.cache_hit", 0),
+                   "cache_misses": snap["counters"].get("jit.cache_miss", 0),
+                   "metrics": snap})
+        except Exception as e:  # noqa: BLE001 — smoke must emit, not raise
+            _emit({"metric": "smoke_step_time_seconds", "value": 0.0,
+                   "unit": "s", "ok": False, "platform": platform,
+                   "backend_error": backend_error or
+                   f"{type(e).__name__}: {e}"})
+        return
+
+    try:
+        tps, mfu, dt, (init_loss, loss), n_params, ksteps = _retry(bench_gpt2)
+    except Exception as e:  # noqa: BLE001 — a dead rung still emits JSON
+        _emit({"metric": PRIMARY_METRIC, "value": 0.0, "unit": "tokens/s",
+               "ok": False, "platform": platform,
+               "backend_error": backend_error or f"{type(e).__name__}: {e}"})
+        return
     target_mfu = 0.8 * 0.45
-    print(json.dumps({
-        "metric": "gpt2s_train_tokens_per_sec_per_chip",
+    from paddle_tpu.observability import metrics as _reg
+    snap = _reg.snapshot()
+    _emit({
+        "metric": PRIMARY_METRIC,
         "value": round(tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / target_mfu, 3),
-    }))
+        "ok": True,
+        "platform": platform,
+        "backend_error": backend_error,
+        "compile_count": snap["counters"].get("jit.compile_count", 0),
+        "cache_hits": snap["counters"].get("jit.cache_hit", 0),
+        "cache_misses": snap["counters"].get("jit.cache_miss", 0),
+    })
     print(f"# gpt2s n_params={n_params/1e6:.1f}M init_loss={init_loss:.3f} "
           f"loss={loss:.3f} step={dt*1e3:.1f}ms mfu={mfu:.3f} "
           f"steps_per_call={ksteps} platform={platform}",
@@ -403,7 +534,6 @@ def main():
         print(f"# bert rung failed: {type(e).__name__}: {e}", file=sys.stderr)
     try:
         inproc, shm, ov_in, ov_shm = _retry(bench_dataloader)
-        import os
         print(f"# dataloader overlap(train-shaped): in-process={ov_in:.0f} "
               f"shm-4workers={ov_shm:.0f} imgs/sec; raw pump: "
               f"in-process={inproc:.0f} shm-4workers={shm:.0f} "

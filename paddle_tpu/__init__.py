@@ -48,6 +48,7 @@ from paddle_tpu import vision  # noqa: F401
 from paddle_tpu import metric  # noqa: F401
 from paddle_tpu import distributed  # noqa: F401
 from paddle_tpu import distribution  # noqa: F401
+from paddle_tpu import observability  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401
 from paddle_tpu.hapi.model import Model  # noqa: F401

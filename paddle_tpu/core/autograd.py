@@ -125,7 +125,10 @@ def _needs_grad(t) -> bool:
 
 def _x64_off_scope():
     if jax.config.jax_enable_x64:
-        return jax.enable_x64(False)
+        # jax.enable_x64(False) was removed upstream; the experimental
+        # context manager is the surviving spelling of a scoped x64-off
+        from jax.experimental import disable_x64
+        return disable_x64()
     import contextlib
     return contextlib.nullcontext()
 

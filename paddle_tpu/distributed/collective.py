@@ -19,7 +19,29 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.observability import metrics
 from paddle_tpu.ops.common import ensure_tensor
+
+
+def _payload_nbytes(data) -> int:
+    """Payload size from shape/dtype — works for concrete arrays AND tracers
+    (aval shapes), so in-graph collectives are accounted at trace time."""
+    try:
+        return int(np.prod(data.shape)) * jnp.dtype(data.dtype).itemsize
+    except Exception:  # noqa: BLE001 — accounting must never break the op
+        return 0
+
+
+def _note_collective(op: str, mode: str, *datas):
+    """Per-primitive accounting: call count + payload bytes, labeled by
+    execution mode. ``in_graph`` counts are trace-time insertions (once per
+    compiled program); ``eager``/``local`` count real calls. The byte figure
+    is the local payload the primitive moves/produces per participant — the
+    EQuARX-style unit for reasoning about comm cost (docs/OBSERVABILITY.md)."""
+    metrics.counter("collective.calls", op=op, mode=mode).inc()
+    nb = sum(_payload_nbytes(d) for d in datas)
+    if nb:
+        metrics.counter("collective.bytes", op=op, mode=mode).inc(nb)
 
 
 class ReduceOp:
@@ -133,9 +155,56 @@ def _multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+# multi-process allgather transport selection: process_allgather compiles a
+# cross-process XLA program, which 0.4.x-era CPU jaxlib cannot do
+# ("Multiprocess computations aren't implemented on the CPU backend") — once
+# it fails, every later call goes straight to the KV transport
+_AG_KV_ONLY = [False]
+# allgather sequence counter: all ranks issue eager collectives in the same
+# program order, so the counter stays in lockstep (same scheme as _p2p_seq)
+_ag_seq = [0]
+
+
+def _kv_allgather(arr):
+    """process_allgather over the coordination-service KV store: each rank
+    publishes its array bytes under a sequenced key, then blocking-reads each
+    peer's — the TCPStore-analog correctness path for backends that cannot
+    compile multiprocess programs. O(P·data) through the coordinator, so it
+    is a fallback, not the fast path."""
+    from paddle_tpu.distributed.parallel import get_rank
+    client = _kv_client()
+    np_arr = np.ascontiguousarray(np.asarray(arr))
+    seq = _ag_seq[0]
+    _ag_seq[0] += 1
+    me = get_rank()
+    client.key_value_set_bytes(f"ptpu_ag/{seq}/{me}", np_arr.tobytes())
+    parts = []
+    for r in range(jax.process_count()):
+        if r == me:
+            parts.append(np_arr)
+            continue
+        raw = client.blocking_key_value_get_bytes(f"ptpu_ag/{seq}/{r}",
+                                                  60_000)
+        parts.append(np.frombuffer(bytes(raw), dtype=np_arr.dtype)
+                     .reshape(np_arr.shape))
+    try:
+        # peers have all read by the barrier: own key is safe to delete, so
+        # a long eager loop doesn't grow the coordination service unboundedly
+        client.wait_at_barrier(f"ptpu_ag_done/{seq}", 60_000)
+        client.key_value_delete(f"ptpu_ag/{seq}/{me}")
+    except Exception:  # noqa: BLE001 — cleanup is best-effort
+        pass
+    return np.stack(parts)
+
+
 def _proc_allgather(arr):
-    from jax.experimental import multihost_utils
-    return multihost_utils.process_allgather(arr)
+    if not _AG_KV_ONLY[0]:
+        from jax.experimental import multihost_utils
+        try:
+            return multihost_utils.process_allgather(arr)
+        except Exception:  # noqa: BLE001 — backend can't run multiprocess XLA
+            _AG_KV_ONLY[0] = True
+    return _kv_allgather(arr)
 
 
 # ------------------------------------------------------------------ collectives
@@ -183,12 +252,15 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     t = ensure_tensor(tensor)
     axis = _axis(group)
     if _in_trace(t) and axis is not None:
+        _note_collective("all_reduce", "in_graph", t._data)
         red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
                ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean,
                # no pprod primitive: gather + local product
                ReduceOp.PROD: lambda a, ax: jnp.prod(
                    jax.lax.all_gather(a, ax), axis=0)}[op]
         return _inplace_apply(tensor, t, lambda a: red(a, axis), "all_reduce")
+    _note_collective("all_reduce", "eager" if _multiprocess() else "local",
+                     t._data)
     if _multiprocess():
         stacked = _proc_allgather(t._data)
         fn = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max, ReduceOp.MIN: jnp.min,
@@ -201,7 +273,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     t = ensure_tensor(tensor)
     ax = _axis(group)
-    if _in_trace(t) and ax is not None:
+    in_graph = _in_trace(t) and ax is not None
+    _note_collective("all_gather", "in_graph" if in_graph else
+                     ("eager" if _multiprocess() else "local"), t._data)
+    if in_graph:
         from paddle_tpu.core.autograd import apply
         res = apply(lambda a: jax.lax.all_gather(a, ax), t, op_name="all_gather")
         n = res.shape[0]
@@ -242,10 +317,13 @@ def broadcast(tensor, src, group=None, sync_op=True):
     t = ensure_tensor(tensor)
     ax = _axis(group)
     if _in_trace(t) and ax is not None:
+        _note_collective("broadcast", "in_graph", t._data)
         # in-SPMD broadcast from src: select src's shard via all_gather + index
         return _inplace_apply(tensor, t,
                               lambda a: jax.lax.all_gather(a, ax)[src],
                               "broadcast")
+    _note_collective("broadcast", "eager" if _multiprocess() else "local",
+                     t._data)
     if _multiprocess():
         stacked = _proc_allgather(t._data)
         tensor._write(jnp.asarray(stacked[src]))
@@ -254,6 +332,8 @@ def broadcast(tensor, src, group=None, sync_op=True):
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     from paddle_tpu.distributed.parallel import get_rank
+    _note_collective("scatter", "eager" if _multiprocess() else "local",
+                     ensure_tensor(tensor)._data)
     if not _multiprocess():
         if tensor_list:
             tensor._write(ensure_tensor(tensor_list[0])._data)
@@ -285,12 +365,19 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
                        else tensor_list)
     ax = _axis(group)
     if _in_trace(t0) and ax is not None:
+        _note_collective("reduce_scatter", "in_graph",
+                         *[ensure_tensor(x)._data for x in tensor_list])
         from paddle_tpu.core.autograd import apply
         stacked = [ensure_tensor(x) for x in tensor_list]
         res = apply(lambda *arrs: jax.lax.psum_scatter(
             jnp.concatenate(arrs, axis=0), ax, tiled=True), *stacked,
             op_name="reduce_scatter")
         return _rebind(tensor, res)
+    _note_collective("reduce_scatter",
+                     "eager" if _multiprocess() else "local",
+                     *([ensure_tensor(x)._data for x in tensor_list]
+                       if isinstance(tensor_list, (list, tuple))
+                       else [t0._data]))
     if _multiprocess():
         from paddle_tpu.distributed.parallel import get_rank
         local = jnp.stack([ensure_tensor(x)._data for x in tensor_list])
@@ -306,6 +393,10 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
         out_tensor_list = []
     ts = [ensure_tensor(x) for x in in_tensor_list]
     ax = _axis(group)
+    _note_collective("alltoall",
+                     "in_graph" if (ts and _in_trace(ts[0]) and ax is not None)
+                     else ("eager" if _multiprocess() else "local"),
+                     *[t._data for t in ts])
     if ts and _in_trace(ts[0]) and ax is not None:
         # in-graph: rank r's output[j] = rank j's input[r] (lax.all_to_all on
         # the stacked chunk axis — the global_scatter/gather building block)
@@ -354,6 +445,9 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     t = ensure_tensor(in_tensor)
     ax = _axis(group)
+    _note_collective("alltoall_single",
+                     "in_graph" if (_in_trace(t) and ax is not None)
+                     else ("eager" if _multiprocess() else "local"), t._data)
     if _in_trace(t) and ax is not None:
         from paddle_tpu.core.autograd import apply
         n = group.nranks
@@ -423,6 +517,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
             "paddle_tpu.distributed.fleet.pipeline.spmd_pipeline")
     if not _multiprocess():
         raise RuntimeError("send() with world_size 1 has no peer")
+    _note_collective("send", "eager", t._data)
     from paddle_tpu.distributed.parallel import get_rank
     arr = np.ascontiguousarray(np.asarray(t._data))
     n, key = _p2p_peek_key(get_rank(), dst)
@@ -441,6 +536,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
             "paddle_tpu.distributed.fleet.pipeline.spmd_pipeline")
     if not _multiprocess():
         raise RuntimeError("recv() with world_size 1 has no peer")
+    _note_collective("recv", "eager", t._data)
     from paddle_tpu.distributed.parallel import get_rank
     n, key = _p2p_peek_key(src, get_rank())
     client = _kv_client()

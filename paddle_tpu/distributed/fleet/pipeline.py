@@ -21,10 +21,35 @@ Loss semantics match the reference's accumulate-then-step contract (GPipe ==
 from __future__ import annotations
 
 import contextlib
+import time
 
 import jax
 import jax.numpy as jnp
+
+from paddle_tpu.framework.jax_compat import shard_map as _shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.observability import metrics
+
+
+def note_pipeline_dispatch(engine, n_stages, n_micro, n_ticks, t0, dt):
+    """Per-call pipeline schedule accounting, shared by both engines.
+
+    The GPipe schedule lives inside ONE XLA program, so per-tick host timers
+    cannot exist; what the host observes is the dispatch of the whole
+    `n_micro + s_total - 1`-tick schedule. `tick_seconds` divides that wall
+    time evenly over the ticks — the per-(stage, microbatch) figure the
+    reference reads off its per-micro p2p timeline. Dispatch is async under
+    jax: on a first call the figure includes compile; steady-state calls that
+    are not immediately consumed may under-report device time (p50 vs max in
+    the histogram separates the two regimes)."""
+    metrics.counter("pipeline.calls", engine=engine).inc()
+    metrics.counter("pipeline.microbatches", engine=engine).inc(n_micro)
+    metrics.gauge("pipeline.stages", engine=engine).set(n_stages)
+    metrics.histogram("pipeline.dispatch_seconds", engine=engine).observe(dt)
+    metrics.histogram("pipeline.tick_seconds", engine=engine).observe(
+        dt / max(n_ticks, 1))
+    metrics.add_span(f"pipeline.dispatch:{engine}", t0, dt, cat="pipeline")
 
 
 class _GuardGenerator:
@@ -246,7 +271,7 @@ def spmd_pipeline_interleaved(stage_fn, n_stages, n_chunks, n_micro,
         # typed keys are rewrapped inside per_rank
         extra = (jax.random.key_data(rng_key),)
         extra_specs = (P(),)
-    f = jax.shard_map(
+    f = _shard_map(
         per_rank, mesh=mesh,
         in_specs=(tuple(P("pp") for _ in stacked_params), P()) + extra_specs,
         out_specs=P(), axis_names={"pp"},
@@ -256,5 +281,9 @@ def spmd_pipeline_interleaved(stage_fn, n_stages, n_chunks, n_micro,
         # (ValueError: axes in vma should be Manual). The ring/ulysses
         # shard_maps, which constrain nothing, run with check_vma=True.
         check_vma=False)
+    t0 = time.perf_counter()
     outs = f(tuple(stacked_params), xm, *extra)
+    note_pipeline_dispatch("spmd", n_stages, n_micro,
+                           n_micro + s_total - 1, t0,
+                           time.perf_counter() - t0)
     return outs.reshape((B,) + outs.shape[2:])

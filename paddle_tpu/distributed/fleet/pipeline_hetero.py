@@ -53,13 +53,18 @@ surprise.
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from paddle_tpu.framework.jax_compat import shard_map as _shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from paddle_tpu.distributed.fleet.pipeline import (
-    functional_rng, stage_rng_key, template_rng_guard)
+    functional_rng, note_pipeline_dispatch, stage_rng_key,
+    template_rng_guard)
 
 
 # Optional float-leaf promotion (None = native dtypes, exact per-dtype
@@ -230,7 +235,7 @@ def spmd_pipeline_hetero(stage_fns, n_stages, n_micro, packed_params,
     if rng_key is not None:
         extra = (jax.random.key_data(rng_key),)
         extra_specs = (P(),)
-    f = jax.shard_map(
+    f = _shard_map(
         per_rank, mesh=mesh,
         in_specs=(tmap(lambda _: P("pp", None), packed_params),
                   tmap(lambda _: P("pp", None), packed_bufs),
@@ -241,7 +246,12 @@ def spmd_pipeline_hetero(stage_fns, n_stages, n_micro, packed_params,
         # see fleet/pipeline.py: stage bodies may run with_sharding_constraint
         # on AUTO axes, which the vma checker rejects inside manual regions
         check_vma=False)
-    return f(packed_params, packed_bufs, xm_flat, *extra)
+    t0 = time.perf_counter()
+    out = f(packed_params, packed_bufs, xm_flat, *extra)
+    note_pipeline_dispatch("hetero", n_stages, n_micro,
+                           n_micro + n_stages - 1, t0,
+                           time.perf_counter() - t0)
+    return out
 
 
 def hetero_serial_reference(stage_fns, n_stages, n_micro, packed_params,

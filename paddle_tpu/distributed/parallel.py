@@ -69,7 +69,8 @@ def init_parallel_env():
     # NOTE: jax.process_count() would initialise the XLA backend, after which
     # jax.distributed.initialize refuses to run — consult the distributed
     # client state instead
-    already_joined = jax.distributed.is_initialized()
+    from paddle_tpu.framework.jax_compat import distributed_is_initialized
+    already_joined = distributed_is_initialized()
     if env.world_size > 1 and not already_joined:
         coordinator = os.environ.get("PADDLE_MASTER") or (
             env.trainer_endpoints[0] if env.trainer_endpoints else None)
@@ -112,4 +113,17 @@ def barrier(group=None):
         jnp.zeros(()).block_until_ready()
         return
     from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    try:
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    except Exception:  # noqa: BLE001 — backend can't run multiprocess XLA
+        # coordination-service barrier: same rendezvous, no compiled program
+        # (0.4.x CPU jaxlib cannot compile cross-process computations); the
+        # id advances in lockstep because every rank calls barrier() in the
+        # same program order
+        from paddle_tpu.distributed.collective import _kv_client
+        _barrier_seq[0] += 1
+        _kv_client().wait_at_barrier(f"ptpu_barrier/{_barrier_seq[0]}",
+                                     60_000)
+
+
+_barrier_seq = [0]

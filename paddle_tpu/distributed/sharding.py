@@ -71,14 +71,22 @@ def shard_parameters(model, mesh=None, axis="dp"):
 
 
 def _host_device_shardings(shape, mesh, axis):
-    """(host, device) sharding pair for one state array."""
+    """(host, device) sharding pair for one state array. On backends with no
+    distinct host tier (CPU: only ``unpinned_host``) the host sharding IS the
+    device sharding — offload degrades to a no-op instead of a PJRT error,
+    so the CPU dryrun/gate can still check stage-3 numerics."""
+    from paddle_tpu.framework.jax_compat import host_memory_kind
     if mesh is not None:
+        kind = host_memory_kind(mesh.devices.flat)
         spec = _shard_spec_for(shape, mesh, axis)
-        return (NamedSharding(mesh, spec, memory_kind="pinned_host"),
-                NamedSharding(mesh, spec))
+        host = (NamedSharding(mesh, spec, memory_kind=kind) if kind
+                else NamedSharding(mesh, spec))
+        return host, NamedSharding(mesh, spec)
     dev = jax.devices()[0]
-    return (jax.sharding.SingleDeviceSharding(dev, memory_kind="pinned_host"),
-            jax.sharding.SingleDeviceSharding(dev))
+    kind = host_memory_kind([dev])
+    host = (jax.sharding.SingleDeviceSharding(dev, memory_kind=kind) if kind
+            else jax.sharding.SingleDeviceSharding(dev))
+    return host, jax.sharding.SingleDeviceSharding(dev)
 
 
 def _flag_offload(t, mesh, axis):

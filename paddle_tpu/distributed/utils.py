@@ -60,17 +60,17 @@ def global_scatter(x, local_count, global_count, group=None, use_calc_stream=Tru
         # from expert-major; with one rank both collapse to expert order
         return Tensor(x._data, _internal=True)
 
-    from jax.experimental import multihost_utils
+    from paddle_tpu.distributed.collective import _proc_allgather
     # variable-size exchange via the allgather emulation path (correctness):
     # everyone shares rows + counts, each rank slices out its inbox
-    all_counts = multihost_utils.process_allgather(
+    all_counts = _proc_allgather(
         jnp.asarray(lc))                       # [world, n_expert*world]
-    n_rows = np.asarray(multihost_utils.process_allgather(
+    n_rows = np.asarray(_proc_allgather(
         jnp.asarray([x.shape[0]], np.int64))).reshape(-1)
     pad = int(n_rows.max())
     xp = jnp.zeros((pad,) + tuple(x.shape[1:]), x._data.dtype)
     xp = xp.at[:x.shape[0]].set(x._data)
-    all_rows = np.asarray(multihost_utils.process_allgather(xp))
+    all_rows = np.asarray(_proc_allgather(xp))
     me = _rank()
     counts_np = np.asarray(all_counts)
     # reference contract check: my global_count must be the transpose view of
@@ -113,14 +113,14 @@ def global_gather(x, local_count, global_count, group=None, use_calc_stream=True
     if world == 1:
         return Tensor(x._data, _internal=True)
 
-    from jax.experimental import multihost_utils
-    n_rows = np.asarray(multihost_utils.process_allgather(
+    from paddle_tpu.distributed.collective import _proc_allgather
+    n_rows = np.asarray(_proc_allgather(
         jnp.asarray([x.shape[0]], np.int64))).reshape(-1)
     pad = int(n_rows.max())
     xp = jnp.zeros((pad,) + tuple(x.shape[1:]), x._data.dtype)
     xp = xp.at[:x.shape[0]].set(x._data)
-    all_rows = np.asarray(multihost_utils.process_allgather(xp))
-    all_gc = np.asarray(multihost_utils.process_allgather(jnp.asarray(gc)))
+    all_rows = np.asarray(_proc_allgather(xp))
+    all_gc = np.asarray(_proc_allgather(jnp.asarray(gc)))
     me = _rank()
     # On each holder rank, rows sit in (src-rank, expert) order; to reclaim my
     # rows IN MY SEND ORDER (expert-major across dest ranks) walk my

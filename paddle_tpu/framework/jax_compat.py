@@ -1,0 +1,86 @@
+"""Version-compat shims over jax API drift.
+
+The repo targets the modern `jax.shard_map` surface (keyword `axis_names`,
+`check_vma`); older jaxlib builds (e.g. 0.4.x) only ship
+`jax.experimental.shard_map.shard_map` with the `auto`/`check_rep` spelling.
+One adapter keeps every call site on the modern vocabulary, whichever jax
+the host has — the environment-proofing lesson of round 5 applied to the
+library itself.
+"""
+from __future__ import annotations
+
+import jax
+
+# Native jax.shard_map implies a jaxlib whose SPMD partitioner fully supports
+# PARTIAL-manual regions (some mesh axes manual, the rest auto). The 0.4.x
+# fallback does not: with a nonempty `auto` set the partitioner lowers
+# ppermute/axis_index to an un-partitionable PartitionId (clean UNIMPLEMENTED)
+# and CHECK-fails on all_to_all, ABORTING the whole process. The shim below
+# therefore refuses partial-manual on old jaxlib with a clean error instead
+# of letting XLA take the process down; fully-manual shard_maps work on both.
+NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+
+
+def host_memory_kind(devices=None):
+    """``"pinned_host"`` where the backend has a distinct host memory tier
+    (TPU/GPU), else None. CPU backends report their ONLY memory as
+    ``unpinned_host``, so host offload has nothing to offload to — callers
+    getting None keep state in default memory (offload degrades to a no-op,
+    numerics unchanged)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    try:
+        kinds = {m.kind for d in devs for m in d.addressable_memories()}
+    except Exception:  # noqa: BLE001 — no memory introspection: fail CLOSED
+        # (None → offload no-op, numerics unchanged); assuming a host tier
+        # here would recreate the PJRT invalid-memory-kind crash on backends
+        # that don't have one
+        return None
+    return "pinned_host" if "pinned_host" in kinds else None
+
+
+def distributed_is_initialized() -> bool:
+    """`jax.distributed.is_initialized()` where it exists; on older jax the
+    same fact read off the distributed client state. Must never initialize
+    the XLA backend (jax.process_count() would, after which
+    jax.distributed.initialize refuses to run)."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except Exception:  # noqa: BLE001 — internals moved: assume fresh process
+        return False
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, axis_names=None,
+              check_vma=True):
+    """`jax.shard_map` when present, else the experimental equivalent.
+
+    ``axis_names`` (modern: the MANUAL axes) maps onto the experimental
+    ``auto`` set (its complement over the mesh axes); ``check_vma`` maps onto
+    ``check_rep``.
+    """
+    if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma,
+                             **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    # Size-1 auto axes are degenerate (nothing to partition) and work fine;
+    # a REAL auto axis (size > 1) makes this a partial-manual region, which
+    # the 0.4.x partitioner cannot lower (see NATIVE_SHARD_MAP above).
+    if any(mesh.shape[a] > 1 for a in auto):
+        raise NotImplementedError(
+            f"shard_map over manual axes {set(axis_names)} of mesh axes "
+            f"{set(mesh.axis_names)} needs a partial-manual region; this "
+            "jaxlib's experimental shard_map cannot partition those "
+            "(PartitionId UNIMPLEMENTED / all_to_all process abort) — "
+            "requires the native jax.shard_map runtime")
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma, auto=auto)

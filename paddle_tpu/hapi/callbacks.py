@@ -213,7 +213,12 @@ class VisualDL(Callback):
     ``eval/<metric>`` per epoch, rank-0-only writes) but logs to plain
     JSON-lines files under ``log_dir`` — readable by anything, no
     visualdl dependency. One line per scalar:
-    ``{"tag": "train/loss", "step": 12, "value": 0.53}``."""
+    ``{"tag": "train/loss", "step": 12, "value": 0.53}``.
+
+    Also reads the process metrics registry (paddle_tpu.observability): at
+    each epoch end the counters/gauges land as ``metrics/<name>`` scalars,
+    so compile counts, cache hit rates, collective bytes and dataloader
+    latency ride the same scalar stream as the losses."""
 
     def __init__(self, log_dir):
         super().__init__()
@@ -221,6 +226,7 @@ class VisualDL(Callback):
         self.epoch = 0
         self.train_step = 0
         self._fh = None
+        self._last_registry_step = None
 
     def _is_write(self):
         from paddle_tpu.distributed import get_rank
@@ -248,6 +254,31 @@ class VisualDL(Callback):
             fh.write(json.dumps({"tag": f"{mode}/{k}", "step": int(step),
                                  "value": float(v)}) + "\n")
 
+    def _emit_registry(self, step):
+        """Registry counters + gauges as ``metrics/<name>`` scalar lines
+        (histograms land as their mean) — rank-0-only like every write.
+        At most once per step: the final epoch's emit and on_train_end land
+        on the same step, and duplicating every line there would break
+        consumers keying on unique (tag, step)."""
+        if not self._is_write() or step == self._last_registry_step:
+            return
+        self._last_registry_step = step
+        import json
+        from paddle_tpu.observability import metrics
+        snap = metrics.snapshot()
+        fh = self._writer()
+        for name, v in snap.get("counters", {}).items():
+            fh.write(json.dumps({"tag": f"metrics/{name}", "step": int(step),
+                                 "value": float(v)}) + "\n")
+        for name, v in snap.get("gauges", {}).items():
+            fh.write(json.dumps({"tag": f"metrics/{name}", "step": int(step),
+                                 "value": float(v)}) + "\n")
+        for name, h in snap.get("histograms", {}).items():
+            if h.get("count"):
+                fh.write(json.dumps(
+                    {"tag": f"metrics/{name}.mean", "step": int(step),
+                     "value": float(h["mean"])}) + "\n")
+
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
 
@@ -255,10 +286,14 @@ class VisualDL(Callback):
         self.train_step += 1
         self._updates(logs, "train", self.train_step)
 
+    def on_epoch_end(self, epoch, logs=None):
+        self._emit_registry(self.train_step)
+
     def on_eval_end(self, logs=None):
         self._updates(logs, "eval", self.epoch)
 
     def on_train_end(self, logs=None):
+        self._emit_registry(self.train_step)
         if self._fh is not None:
             self._fh.close()
             self._fh = None

@@ -31,15 +31,19 @@ from __future__ import annotations
 import argparse
 import hashlib
 import hmac
+import json
 import os
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
+from paddle_tpu.observability import metrics
+
 MAGIC = 0x50445250
-OP_RUN, OP_PING, OP_SHUTDOWN = 1, 2, 3
+OP_RUN, OP_PING, OP_SHUTDOWN, OP_STATS = 1, 2, 3, 4
 
 
 def auth_token(model_prefix: str) -> bytes:
@@ -152,12 +156,22 @@ class InferenceServer:
                 if op == OP_PING:
                     conn.sendall(struct.pack("<III", MAGIC, 0, 0))
                     continue
+                if op == OP_STATS:
+                    # stats endpoint: the process metrics snapshot as one
+                    # uint8 JSON array — same array framing as every other
+                    # response, so any wire client can read it
+                    conn.sendall(struct.pack("<III", MAGIC, 0, 1))
+                    send_arrays(conn, [stats_payload()])
+                    continue
                 if op == OP_SHUTDOWN:
                     conn.sendall(struct.pack("<III", MAGIC, 0, 0))
                     self._stop.set()
                     return
+                t0 = time.perf_counter()
                 try:
                     arrays = recv_arrays(conn, n)
+                    metrics.counter("serve.request_bytes").inc(
+                        sum(a.nbytes for a in arrays))
                     with self._lock:
                         self._predictor.run(arrays)
                         outs = [self._predictor.get_output_handle(nm)
@@ -165,7 +179,14 @@ class InferenceServer:
                                 for nm in self._predictor.get_output_names()]
                     conn.sendall(struct.pack("<III", MAGIC, 0, len(outs)))
                     send_arrays(conn, outs)
+                    metrics.counter("serve.requests").inc()
+                    metrics.counter("serve.response_bytes").inc(
+                        sum(a.nbytes for a in outs))
+                    dt = time.perf_counter() - t0
+                    metrics.histogram("serve.request_seconds").observe(dt)
+                    metrics.add_span("serve.request", t0, dt, cat="serve")
                 except Exception as e:  # noqa: BLE001 — wire back to client
+                    metrics.counter("serve.errors").inc()
                     self._send_err(conn, f"{type(e).__name__}: {e}")
                     # the request body may be partially unconsumed (e.g. a
                     # reshape error mid-recv_arrays): the stream position is
@@ -180,6 +201,14 @@ class InferenceServer:
     def _send_err(conn, msg):
         raw = msg.encode()
         conn.sendall(struct.pack("<III", MAGIC, 1, len(raw)) + raw)
+
+
+def stats_payload() -> np.ndarray:
+    """The serve stats response body: the process metrics snapshot (request
+    counts, latency histogram, and every other subsystem's metrics — one
+    process, one registry) serialized as a uint8 JSON array."""
+    raw = json.dumps(metrics.snapshot()).encode()
+    return np.frombuffer(raw, dtype=np.uint8).copy()
 
 
 class RemotePredictor:
@@ -210,6 +239,17 @@ class RemotePredictor:
         magic, status, _ = struct.unpack(
             "<III", _recv_exact(self._sock, 12))
         return magic == MAGIC and status == 0
+
+    def stats(self) -> dict:
+        """Fetch the server's metrics snapshot (request latency/throughput
+        counters plus everything else its registry holds)."""
+        self._sock.sendall(struct.pack("<III", MAGIC, OP_STATS, 0))
+        magic, status, n = struct.unpack(
+            "<III", _recv_exact(self._sock, 12))
+        if magic != MAGIC or status != 0:
+            raise ConnectionError("bad stats response")
+        (payload,) = recv_arrays(self._sock, n)
+        return json.loads(payload.tobytes().decode())
 
     def run(self, inputs):
         self._sock.sendall(struct.pack("<III", MAGIC, OP_RUN, len(inputs)))

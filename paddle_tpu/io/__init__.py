@@ -20,6 +20,12 @@ import time
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.observability import metrics as _metrics
+
+# batch-fetch telemetry (docs/OBSERVABILITY.md): fetch latency is the stall a
+# training loop would see per next(loader) — the pipeline-health number
+_M_BATCHES = _metrics.counter("dataloader.batches")
+_M_FETCH_S = _metrics.histogram("dataloader.fetch_seconds")
 
 
 class Dataset:
@@ -380,11 +386,20 @@ class DataLoader:
 
     def __iter__(self):
         if self._iterable_mode:
-            yield from self._iter_iterable()
+            inner = self._iter_iterable()
         elif self._effective_workers() > 0:
-            yield from self._iter_multiprocess()
+            inner = self._iter_multiprocess()
         else:
-            yield from self._iter_single()
+            inner = self._iter_single()
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(inner)
+            except StopIteration:
+                return
+            _M_FETCH_S.observe(time.perf_counter() - t0)
+            _M_BATCHES.inc()
+            yield batch
 
     def _iter_single(self):
         if self.batch_sampler is None:

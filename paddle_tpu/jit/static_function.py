@@ -15,6 +15,7 @@ Capture protocol:
 from __future__ import annotations
 
 import functools
+import time
 import weakref
 from typing import Any
 
@@ -25,6 +26,25 @@ import jax.numpy as jnp
 from paddle_tpu.core import tensor as tensor_mod
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.framework.flags import flag_value
+from paddle_tpu.observability import metrics
+
+# ProgramCache telemetry (docs/OBSERVABILITY.md): a hit is a signature that
+# resolved to an existing compiled variant; a miss triggers _capture
+_M_CACHE_HIT = metrics.counter("jit.cache_hit")
+_M_CACHE_MISS = metrics.counter("jit.cache_miss")
+_M_COMPILES = metrics.counter("jit.compile_count")
+_M_COMPILE_S = metrics.histogram("jit.compile_seconds")
+_M_DONATED = metrics.counter("jit.donated_bytes")
+_M_DISPATCH_S = metrics.histogram("jit.dispatch_seconds")
+
+
+def _array_nbytes(arrays) -> int:
+    n = 0
+    for a in arrays:
+        nb = getattr(a, "nbytes", None)
+        if nb is not None:
+            n += int(nb)
+    return n
 
 _IGNORED_MODULES: set = set()
 
@@ -214,7 +234,10 @@ class StaticFunction:
                 compiled = cand
                 break
         if compiled is None:
+            _M_CACHE_MISS.inc()
             compiled = self._capture(key, args, kwargs)
+        else:
+            _M_CACHE_HIT.inc()
         arg_tensors, _, _ = _tree_flatten_tensors((args, kwargs))
         # host-offloaded state (distributed/sharding.offload_optimizer_states):
         # fetch to device memory for the step, push the new value home after —
@@ -229,7 +252,11 @@ class StaticFunction:
         grad_in = [t._grad._data for t, m in zip(compiled.state_tensors,
                                                  compiled.grad_mask) if m]
         arg_in = [t._data for t in arg_tensors]
+        if self._donate:
+            _M_DONATED.inc(_array_nbytes(state_in) + _array_nbytes(grad_in))
+        _t0 = time.perf_counter()
         outs = compiled.jitted(state_in, grad_in, arg_in)
+        _M_DISPATCH_S.observe(time.perf_counter() - _t0)
         out_arrays, new_state, new_grads = outs
         for t, arr in zip(compiled.state_tensors, new_state):
             if hasattr(t, "_offload_host"):
@@ -257,6 +284,7 @@ class StaticFunction:
             # the converted fn instead of re-probing the original
             _converted = True
         fn = self._fn if not _converted else self._fn_dy2static
+        _t0 = time.perf_counter()
         cap = _CaptureSet(tensor_mod.current_stamp())
         arg_tensors, _, _ = _tree_flatten_tensors((args, kwargs))
         arg_ids = {id(t) for t in arg_tensors}
@@ -375,6 +403,13 @@ class StaticFunction:
                              len(out_tensors), out_stop_grads, grad_mask,
                              pure=pure)
         self._cache.setdefault(key, []).append(compiled)
+        # capture wall time covers the abstract probe + pure-fn construction;
+        # XLA's own compile lands inside the first dispatch (jit.dispatch_
+        # seconds max vs p50 separates compile from steady-state)
+        _M_COMPILES.inc()
+        _M_COMPILE_S.observe(time.perf_counter() - _t0)
+        metrics.add_span(f"jit.capture:{getattr(self._fn, '__name__', '?')}",
+                         _t0, time.perf_counter() - _t0, cat="compile")
         return compiled
 
     def multi_steps(self, k: int) -> "MultiStepFunction":
@@ -446,7 +481,10 @@ class MultiStepFunction:
                 compiled, jitted_k = cand, jk
                 break
         if compiled is None:
+            _M_CACHE_MISS.inc()
             compiled, jitted_k = self._build(sig, step_args, step_kwargs)
+        else:
+            _M_CACHE_HIT.inc()
 
         state_in = []
         for t in compiled.state_tensors:
@@ -459,8 +497,14 @@ class MultiStepFunction:
                       for t, m in zip(compiled.state_tensors,
                                       compiled.grad_mask)]
         stacked = [t._data for t in arg_tensors]
+        if self._sf._donate:
+            _M_DONATED.inc(_array_nbytes(state_in) +
+                           _array_nbytes(g for g in grads_full
+                                         if g is not None))
+        _t0 = time.perf_counter()
         outs_stacked, new_state, new_grads = jitted_k(state_in, grads_full,
                                                       stacked)
+        _M_DISPATCH_S.observe(time.perf_counter() - _t0)
         for t, arr in zip(compiled.state_tensors, new_state):
             if hasattr(t, "_offload_host"):
                 arr = jax.device_put(arr, t._offload_host)

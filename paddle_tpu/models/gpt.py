@@ -15,6 +15,7 @@ PaddleNLP GPT-345M hybrid-parallel config (BASELINE.md item 5). Design:
 from __future__ import annotations
 
 import math
+import time
 from dataclasses import dataclass
 
 import jax
@@ -31,6 +32,7 @@ from paddle_tpu.distributed.fleet.meta_parallel import (
 from paddle_tpu.distributed.mesh import get_mesh
 from paddle_tpu.framework.param_attr import ParamAttr
 from paddle_tpu.nn import initializer as I
+from paddle_tpu.observability import metrics
 
 
 @dataclass
@@ -336,6 +338,7 @@ class GPTForCausalLM(nn.Layer):
             # without bound
             cache.pop(next(iter(cache)))
         jitted = cache.get(sig)
+        compiled_now = jitted is None
         if jitted is None:
             scale = 1.0 / (dh ** 0.5)
 
@@ -464,10 +467,30 @@ class GPTForCausalLM(nn.Layer):
 
             jitted = jax.jit(run)
             cache[sig] = jitted
+            metrics.counter("generate.compile_count").inc()
 
         key = jax.random.PRNGKey(seed)
+        # decode telemetry: the program is monolithic (prefill + scan in one
+        # executable), so the host-visible split is the compile call vs the
+        # steady call; block_until_ready makes the steady figure real device
+        # time (callers consume the tokens immediately anyway).
+        # ms/token ≈ decode_seconds / N once N amortizes the prefill.
+        t0 = time.perf_counter()
         toks = jitted(params, input_ids._data,
                       jax.random.key_data(key))
+        jax.block_until_ready(toks)
+        dt = time.perf_counter() - t0
+        metrics.counter("generate.calls").inc()
+        metrics.counter("generate.tokens").inc(B * N)
+        if compiled_now:
+            # first execution of this signature: XLA compile dominates
+            metrics.histogram("generate.compile_seconds").observe(dt)
+            metrics.add_span("generate.compile", t0, dt, cat="compile")
+        else:
+            metrics.histogram("generate.decode_seconds").observe(dt)
+            metrics.gauge("generate.tokens_per_s").set(B * N / dt if dt > 0
+                                                       else 0.0)
+            metrics.add_span("generate.decode", t0, dt, cat="generate")
         return paddle.concat(
             [input_ids, paddle.Tensor(toks, _internal=True)], axis=1)
 

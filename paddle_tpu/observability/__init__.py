@@ -1,0 +1,302 @@
+"""Process-wide runtime telemetry: the metrics registry every layer reports to.
+
+The reference ships per-subsystem introspection (profiler CUPTI tables, the
+`flops` API, DataLoader worker logs); this build centralizes it: one
+thread-safe, zero-dependency registry of counters / gauges / histograms that
+the hot layers (jit capture, collectives, pipeline engines, DataLoader,
+inference serving, decode) write into, and that `paddle.profiler`, the hapi
+VisualDL callback, `bench.py`, and the serve stats endpoint all read from.
+
+Design:
+- **Counter** — monotonically increasing float/int (`inc`).
+- **Gauge** — last-write-wins scalar (`set`).
+- **Histogram** — count/sum/min/max plus a bounded reservoir of recent
+  observations for p50/p99; `time()` returns a context manager that
+  observes the elapsed seconds AND records a span for Chrome-trace export.
+- Metrics are keyed by ``(name, sorted(labels))``; the flat snapshot key is
+  ``name{k=v,...}`` (Prometheus-style).
+- ``snapshot()`` → plain dict (JSON-ready); ``to_json()`` serializes it;
+  ``chrome_trace()`` / ``export_chrome_trace(path)`` emit the recorded spans
+  in Chrome ``traceEvents`` format (load with `chrome://tracing`, Perfetto,
+  or `paddle.profiler.load_profiler_result`).
+
+Everything here is stdlib-only ON PURPOSE: instrumented modules import this
+at module scope, so it must never create an import cycle or pull in jax.
+
+Semantics note for in-graph instrumentation: counters incremented inside a
+jax trace (e.g. `collective.bytes` for the lax.psum path) count **trace-time
+insertions**, not device executions — one per compiled program, not one per
+step. Eager-path counters count real calls. `docs/OBSERVABILITY.md` carries
+the full metric inventory.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "metrics",
+    "counter", "gauge", "histogram", "timer", "snapshot", "reset",
+    "chrome_trace", "export_chrome_trace",
+]
+
+# perf_counter origin for span timestamps — one epoch per process so spans
+# from every subsystem land on a shared timeline
+_EPOCH = time.perf_counter()
+
+_RESERVOIR = 512       # recent observations kept per histogram (percentiles)
+_MAX_SPANS = 20000     # bounded span ring: old spans drop, process never grows
+
+
+def _labelkey(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _flatname(name: str, labelkey: tuple) -> str:
+    if not labelkey:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in labelkey)
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    def reset(self):
+        with self._lock:
+            self._value = 0
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    def dec(self, n=1):
+        with self._lock:
+            self._value -= n
+
+    def reset(self):
+        with self._lock:
+            self._value = 0
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """count/sum/min/max + bounded reservoir of the most recent observations
+    (enough for p50/p99 on step-time-scale series without unbounded memory)."""
+
+    __slots__ = ("_lock", "count", "total", "min", "max", "_recent")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._recent = collections.deque(maxlen=_RESERVOIR)
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self._recent.append(v)
+
+    def reset(self):
+        with self._lock:
+            self.count = 0
+            self.total = 0.0
+            self.min = self.max = None
+            self._recent.clear()
+
+    def percentile(self, q):
+        with self._lock:
+            vals = sorted(self._recent)
+        if not vals:
+            return None
+        idx = min(len(vals) - 1, max(0, int(round(q / 100.0 * (len(vals) - 1)))))
+        return vals[idx]
+
+    def summary(self):
+        with self._lock:
+            vals = sorted(self._recent)
+            count, total, mn, mx = self.count, self.total, self.min, self.max
+        out = {"count": count, "total": total, "min": mn, "max": mx,
+               "mean": (total / count) if count else None}
+        if vals:
+            out["p50"] = vals[int(round(0.50 * (len(vals) - 1)))]
+            out["p99"] = vals[int(round(0.99 * (len(vals) - 1)))]
+        else:
+            out["p50"] = out["p99"] = None
+        return out
+
+
+class _Timer:
+    """Context manager: observes elapsed seconds into a histogram and records
+    a span on the registry's Chrome-trace timeline."""
+
+    __slots__ = ("_reg", "_hist", "_name", "_t0")
+
+    def __init__(self, reg, hist, name):
+        self._reg = reg
+        self._hist = hist
+        self._name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *a):
+        dt = time.perf_counter() - self._t0
+        self._hist.observe(dt)
+        self._reg.add_span(self._name, self._t0, dt)
+        return False
+
+
+class MetricsRegistry:
+    """Process-wide metric store. Creation is locked; each metric carries its
+    own lock, so hot-path updates never contend on the registry lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._histograms: dict = {}
+        self._spans = collections.deque(maxlen=_MAX_SPANS)
+        self._span_lock = threading.Lock()
+
+    # -------------------------------------------------------------- creation
+
+    def _get(self, store, name, labels, factory):
+        key = (name, _labelkey(labels))
+        m = store.get(key)
+        if m is None:
+            with self._lock:
+                m = store.get(key)
+                if m is None:
+                    m = store[key] = factory()
+        return m
+
+    def counter(self, name, **labels) -> Counter:
+        return self._get(self._counters, name, labels, Counter)
+
+    def gauge(self, name, **labels) -> Gauge:
+        return self._get(self._gauges, name, labels, Gauge)
+
+    def histogram(self, name, **labels) -> Histogram:
+        return self._get(self._histograms, name, labels, Histogram)
+
+    def timer(self, name, **labels) -> _Timer:
+        return _Timer(self, self.histogram(name, **labels),
+                      _flatname(name, _labelkey(labels)))
+
+    # ----------------------------------------------------------------- spans
+
+    def add_span(self, name, t0_perf, dur_s, cat="host"):
+        """Record one completed host-side range for Chrome-trace export.
+        ``t0_perf`` is a time.perf_counter() value; timestamps are stored in
+        microseconds relative to the process epoch."""
+        with self._span_lock:
+            self._spans.append((name, cat, (t0_perf - _EPOCH) * 1e6,
+                                dur_s * 1e6, threading.get_ident()))
+
+    # --------------------------------------------------------------- exports
+
+    def snapshot(self) -> dict:
+        """Flat JSON-ready dict of everything the process has recorded."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._histograms)
+        return {
+            "counters": {_flatname(n, lk): c.value
+                         for (n, lk), c in counters.items()},
+            "gauges": {_flatname(n, lk): g.value
+                       for (n, lk), g in gauges.items()},
+            "histograms": {_flatname(n, lk): h.summary()
+                           for (n, lk), h in hists.items()},
+        }
+
+    def to_json(self, indent=None) -> str:
+        return json.dumps(self.snapshot(), indent=indent)
+
+    def chrome_trace(self) -> dict:
+        """Spans in Chrome ``traceEvents`` format plus the metric snapshot
+        under the top-level ``metrics`` key (round-trips through
+        `paddle.profiler.load_profiler_result`)."""
+        with self._span_lock:
+            spans = list(self._spans)
+        events = [{"name": name, "cat": cat, "ph": "X", "pid": os.getpid(),
+                   "tid": tid, "ts": round(ts, 3), "dur": round(dur, 3)}
+                  for name, cat, ts, dur, tid in spans]
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "metrics": self.snapshot()}
+
+    def export_chrome_trace(self, path) -> str:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+    def reset(self):
+        """Zero every metric IN PLACE and drop the spans (tests / bench rung
+        isolation). Metrics are zeroed rather than dropped because the
+        instrumented modules cache their handles at import time — dropping
+        entries would orphan those handles and silently lose their counts."""
+        with self._lock:
+            stores = (list(self._counters.values()),
+                      list(self._gauges.values()),
+                      list(self._histograms.values()))
+        for store in stores:
+            for m in store:
+                m.reset()
+        with self._span_lock:
+            self._spans.clear()
+
+
+# the process-wide default registry every instrumented layer reports to
+metrics = MetricsRegistry()
+
+# module-level conveniences bound to the default registry
+counter = metrics.counter
+gauge = metrics.gauge
+histogram = metrics.histogram
+timer = metrics.timer
+snapshot = metrics.snapshot
+reset = metrics.reset
+chrome_trace = metrics.chrome_trace
+export_chrome_trace = metrics.export_chrome_trace

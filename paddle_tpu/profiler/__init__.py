@@ -9,10 +9,13 @@ from __future__ import annotations
 
 import contextlib
 import enum
+import json
 import os
 import time
 
 import jax
+
+from paddle_tpu.observability import metrics as _metrics
 
 
 class ProfilerTarget(enum.Enum):
@@ -100,7 +103,11 @@ class RecordEvent:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
         if self._t0 is not None:
-            _record_host_event(self.name, time.perf_counter() - self._t0)
+            dt = time.perf_counter() - self._t0
+            _record_host_event(self.name, dt)
+            # every host range also lands on the registry's span ring, so
+            # Profiler.export(path) / observability.chrome_trace() see it
+            _metrics.add_span(self.name, self._t0, dt, cat="host")
             self._t0 = None
 
 
@@ -123,6 +130,7 @@ class Profiler:
         self._logdir = None
         self._step_times = []
         self._last_step_time = None
+        self._metrics_base = {}
 
     def __enter__(self):
         self.start()
@@ -135,6 +143,10 @@ class Profiler:
         global _collecting
         _collecting = True
         _host_events.clear()
+        # counter baseline: summary() reports the registry DELTA over the
+        # profiled region, so compile counts / cache hits / collective bytes
+        # from warmup don't pollute the table
+        self._metrics_base = _metrics.snapshot().get("counters", {})
         self._last_step_time = time.perf_counter()
         if self._timer_only:
             return
@@ -173,8 +185,25 @@ class Profiler:
         return (f"step_time: {dt * 1000:.2f} ms, ips: {ips:.2f} {unit}/s")
 
     def export(self, path=None, format=None):
-        """Trace already lands in the logdir (TensorBoard/XPlane format)."""
-        return self._logdir
+        """With no arguments: the device trace already landed in the logdir
+        (TensorBoard/XPlane format) — return it. With a ``path``: write the
+        HOST-side trace as one Chrome-trace JSON file (RecordEvent ranges,
+        jit capture / pipeline / decode spans off the observability ring,
+        metric snapshot, host-event aggregates, step times) — the file
+        `load_profiler_result` reads back."""
+        if path is None:
+            return self._logdir
+        data = _metrics.chrome_trace()
+        data["hostEvents"] = {
+            name: {"count": cnt, "total": total, "max": mx}
+            for name, (cnt, total, mx) in _host_events.items()}
+        data["stepTimes"] = [t for t, _ in self._step_times]
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(data, f)
+        return path
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
@@ -197,10 +226,62 @@ def profile(*args, **kwargs):
         p.stop()
 
 
-def load_profiler_result(path):
-    raise NotImplementedError(
-        "TPU traces are XPlane directories; open them with TensorBoard's "
-        "profile plugin")
+class ProfilerResult:
+    """Parsed host-trace export (`Profiler.export(path)` /
+    `observability.export_chrome_trace`): Chrome ``traceEvents`` plus the
+    metric snapshot and host-event aggregates that rode along."""
+
+    def __init__(self, data: dict):
+        self._data = data
+
+    @property
+    def trace_events(self) -> list:
+        return self._data.get("traceEvents", [])
+
+    @property
+    def metrics(self) -> dict:
+        return self._data.get("metrics", {})
+
+    @property
+    def host_events(self) -> dict:
+        return self._data.get("hostEvents", {})
+
+    @property
+    def step_times(self) -> list:
+        return self._data.get("stepTimes", [])
+
+    def events(self, name=None) -> list:
+        if name is None:
+            return self.trace_events
+        return [e for e in self.trace_events if e.get("name") == name]
+
+    def durations(self, name) -> list:
+        """Durations (seconds) of every span with ``name``."""
+        return [e["dur"] / 1e6 for e in self.events(name) if "dur" in e]
+
+    def save(self, path):
+        with open(path, "w") as f:
+            json.dump(self._data, f)
+        return path
+
+
+def load_profiler_result(path) -> ProfilerResult:
+    """Load a host-trace JSON export back into a queryable result.
+
+    Device traces remain XPlane DIRECTORIES for TensorBoard's profile
+    plugin; this reads the single-file host trace `Profiler.export(path)`
+    writes (Chrome-trace schema + ``metrics``/``hostEvents`` extensions)."""
+    if os.path.isdir(path):
+        raise ValueError(
+            f"{path} is an XPlane trace directory — open it with "
+            "TensorBoard's profile plugin; load_profiler_result reads the "
+            "host-trace JSON file written by Profiler.export(path)")
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "traceEvents" not in data:
+        raise ValueError(
+            f"{path} is not a host-trace export (no traceEvents key)")
+    return ProfilerResult(data)
 
 
 def _fmt_time(seconds):
@@ -213,14 +294,27 @@ def _fmt_time(seconds):
 
 class SummaryTable:
     """Aggregated host-event statistics (ref `profiler_statistic.py`'s event
-    summary tables): one row per RecordEvent name."""
+    summary tables): one row per RecordEvent name, followed by the process
+    metric registry — counter DELTAS over the profiled region plus histogram
+    summaries — so one summary() covers the whole stack (compiles, cache
+    hits, collective bytes, dataloader latency, decode tokens/s)."""
 
-    def __init__(self, events, step_times):
+    def __init__(self, events, step_times, metrics_snapshot=None,
+                 counter_base=None):
         self.rows = sorted(
             ((name, cnt, total, total / cnt, mx)
              for name, (cnt, total, mx) in events.items()),
             key=lambda r: -r[2])
         self.step_times = [t for t, _ in step_times]
+        snap = metrics_snapshot or {}
+        base = counter_base or {}
+        self.counter_deltas = {
+            name: val - base.get(name, 0)
+            for name, val in snap.get("counters", {}).items()
+            if val - base.get(name, 0)}
+        self.gauges = dict(snap.get("gauges", {}))
+        self.histograms = {name: h for name, h in
+                           snap.get("histograms", {}).items() if h["count"]}
 
     def __str__(self):
         lines = []
@@ -238,6 +332,22 @@ class SummaryTable:
                     f"{name.ljust(name_w)}  {cnt:>7}  "
                     f"{_fmt_time(total):>10}  {_fmt_time(avg):>10}  "
                     f"{_fmt_time(mx):>10}")
+        if self.counter_deltas:
+            lines.append("-- counters (delta over profiled region) --")
+            for name in sorted(self.counter_deltas):
+                lines.append(f"{name}: +{self.counter_deltas[name]}")
+        if self.gauges or self.histograms:
+            # gauges/histograms cannot be baselined the way counters can
+            # (min/max/percentiles don't subtract) — label them honestly
+            lines.append("-- gauges/histograms (process lifetime) --")
+            for name in sorted(self.gauges):
+                lines.append(f"{name}: {self.gauges[name]}")
+            for name in sorted(self.histograms):
+                h = self.histograms[name]
+                lines.append(
+                    f"{name}: n={h['count']} mean={_fmt_time(h['mean'])} "
+                    f"p50={_fmt_time(h['p50'])} p99={_fmt_time(h['p99'])} "
+                    f"max={_fmt_time(h['max'])}")
         return "\n".join(lines) or "(no host events recorded)"
 
 
@@ -245,7 +355,9 @@ def _profiler_summary(self, sorted_by=None, op_detail=False, thread_sep=False,
                       time_unit="ms", views=None):
     """Print + return the host-event statistics table
     (ref `paddle.profiler.Profiler.summary`)."""
-    table = SummaryTable(dict(_host_events), self._step_times)
+    table = SummaryTable(dict(_host_events), self._step_times,
+                         metrics_snapshot=_metrics.snapshot(),
+                         counter_base=getattr(self, "_metrics_base", {}))
     print(table)
     return table
 

@@ -116,8 +116,13 @@ class TestShardingStages:
         np.testing.assert_allclose(serial, dist, rtol=RTOL)
         offl = opt._offloaded_states
         assert offl, "no state was registered for offload"
-        resident = [t._data.sharding.memory_kind for t in offl]
-        assert all(k == "pinned_host" for k in resident), resident
+        # residence is only checkable where the backend HAS a host tier;
+        # CPU's sole memory is unpinned_host and offload is a no-op there
+        from paddle_tpu.framework.jax_compat import host_memory_kind
+        want = host_memory_kind()
+        if want is not None:
+            resident = [t._data.sharding.memory_kind for t in offl]
+            assert all(k == want for k in resident), resident
 
     def test_group_sharded_save_then_load_under_other_mesh(self, tmp_path):
         """save_group_sharded_model (ref `group_sharded.py:222`) merges the
@@ -171,8 +176,13 @@ class TestShardingStages:
             opt.step()
             opt.clear_grad()
         assert np.isfinite(float(loss))
-        kinds = [t._data.sharding.memory_kind for t in opt._offloaded_states]
-        assert kinds and all(k == "pinned_host" for k in kinds), kinds
+        assert opt._offloaded_states
+        from paddle_tpu.framework.jax_compat import host_memory_kind
+        want = host_memory_kind()
+        if want is not None:  # CPU has no host tier; offload is a no-op there
+            kinds = [t._data.sharding.memory_kind
+                     for t in opt._offloaded_states]
+            assert all(k == want for k in kinds), kinds
 
 
 def _gpt_cfg(**kw):

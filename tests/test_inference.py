@@ -121,6 +121,11 @@ class TestServeOutOfProcess:
                 cli.get_output_names()[0]).copy_to_cpu()
             np.testing.assert_allclose(out, np.asarray(ref),
                                        rtol=1e-5, atol=1e-6)
+            # stats endpoint: the server's metrics registry over the wire
+            stats = cli.stats()
+            assert stats["counters"]["serve.requests"] == 1
+            assert stats["counters"]["serve.request_bytes"] == x.nbytes
+            assert stats["histograms"]["serve.request_seconds"]["count"] == 1
             cli.shutdown_server()
             cli.close()
             proc.wait(timeout=20)
