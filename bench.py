@@ -273,6 +273,53 @@ def bench_decode():
     return B * N / dt, dt / N
 
 
+def bench_engine_decode():
+    """Serving rung: N concurrent prompts through the batched decode engine
+    (paged KV cache + continuous batching, inference/engine.py) vs the same
+    N prompts as SEQUENTIAL fast_generate calls — the before/after of this
+    repo's serving story. Greedy, so both paths produce identical tokens;
+    the engine's win is batching the per-token device dispatch across all
+    live sequences."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    NREQ, S0, N = 8, 128, 64
+    cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, max_position_embeddings=256,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, S0).astype(np.int32)
+               for _ in range(NREQ)]
+
+    # -- sequential baseline: one fast_generate(B=1) per request
+    ids0 = paddle.Tensor(prompts[0][None], _internal=True)
+    model.fast_generate(ids0, max_new_tokens=N)          # compile B=1 program
+    t0 = time.perf_counter()
+    for p in prompts:
+        out = model.fast_generate(
+            paddle.Tensor(p[None], _internal=True), max_new_tokens=N)
+        np.asarray(out.numpy())
+    seq_tps = NREQ * N / (time.perf_counter() - t0)
+
+    # -- engine: all N requests in flight on one fixed-shape step
+    eng = DecodeEngine(model, EngineConfig(
+        page_size=16, max_slots=NREQ, max_seq_len=S0 + N))
+    eng.warmup(prompt_lens=[S0])                         # compile excluded
+    t0 = time.perf_counter()
+    reqs = [eng.submit(p, max_new_tokens=N) for p in prompts]
+    eng.run_until_idle()
+    eng_tps = NREQ * N / (time.perf_counter() - t0)
+    # keep the rung honest: the engine output must match the baseline
+    ref = np.asarray(model.fast_generate(
+        paddle.Tensor(prompts[0][None], _internal=True),
+        max_new_tokens=N).numpy())[0]
+    assert np.array_equal(reqs[0].result(timeout=60), ref)
+    return eng_tps, seq_tps
+
+
 def _chw_to_hwc_u8(img):
     # CHW float [0,1] -> HWC uint8 [0,255]: the jitter family operates on
     # image-range uint8 like real decoded inputs. Module-level: spawn
@@ -421,6 +468,17 @@ def bench_smoke():
     loss1 = float(train_step(x, y))        # cached step
     dt = time.perf_counter() - t0
     assert np.isfinite(loss0) and np.isfinite(loss1), (loss0, loss1)
+
+    # one batched-engine decode on the same tiny model: keeps the decode
+    # engine (paged KV cache + bucketed prefill, inference/engine.py)
+    # import- and execution-clean under tier-1
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    eng = DecodeEngine(model, EngineConfig(page_size=2, max_slots=2,
+                                           min_bucket=4))
+    req = eng.submit(ids[0, :4].astype(np.int32), max_new_tokens=2)
+    eng.run_until_idle(max_steps=8)
+    assert req.result(timeout=30).shape == (6,)
+
     snap = metrics.snapshot()
     return dt, batch * seq / dt, snap
 
@@ -518,6 +576,14 @@ def main(argv=None):
               f"({ms_tok*1e3:.2f} ms/token at B=8)", file=sys.stderr)
     except Exception as e:
         print(f"# decode rung failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+    try:
+        eng_tps, seq_tps = _retry(bench_engine_decode)
+        print(f"# gpt2s_engine_decode 8x(128+64): engine={eng_tps:.0f} tok/s "
+              f"sequential_fast_generate={seq_tps:.0f} tok/s "
+              f"({eng_tps / seq_tps:.2f}x)", file=sys.stderr)
+    except Exception as e:
+        print(f"# engine decode rung failed: {type(e).__name__}: {e}",
               file=sys.stderr)
     try:
         ips, dt_r, loss_r = _retry(bench_resnet50)

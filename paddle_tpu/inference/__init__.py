@@ -15,9 +15,13 @@ Counterpart of Paddle Inference's `AnalysisPredictor`
 """
 from __future__ import annotations
 
+from collections import OrderedDict
+
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from paddle_tpu.observability import metrics
 
 __all__ = ["Config", "Predictor", "create_predictor"]
 
@@ -39,6 +43,18 @@ class Config:
         self._glog_info = False
         self._options = {}
         self._mesh = None
+        self._exe_cache_capacity = 32
+
+    def set_executable_cache_capacity(self, n: int):
+        """Cap the per-signature executable cache (the ProgramCache analog):
+        beyond ``n`` entries the least-recently-used executable is dropped
+        (counted as `program_cache.evictions`). A serving loop fed raw,
+        unbucketed shapes otherwise compiles AND RETAINS one executable per
+        distinct shape forever."""
+        if int(n) < 1:
+            raise ValueError(f"capacity must be >= 1, got {n}")
+        self._exe_cache_capacity = int(n)
+        return self
 
     def set_model(self, prog_file, params_file=None):
         self.__init__(prog_file, params_file)
@@ -168,7 +184,7 @@ class Predictor:
                 placed[k] = jax.device_put(
                     v, NamedSharding(self._mesh, spec))
             self._params = placed
-        self._compiled = {}
+        self._compiled = OrderedDict()    # LRU: oldest-used first
 
     # ---------------------------------------------------------------- handles
 
@@ -194,6 +210,12 @@ class Predictor:
             exe = jax.jit(lambda params, *xs: call(params, *xs)) \
                 .lower(self._params, *arrs).compile()
             self._compiled[key] = exe
+            cap = getattr(self._config, "_exe_cache_capacity", 32)
+            while len(self._compiled) > cap:
+                self._compiled.popitem(last=False)
+                metrics.counter("program_cache.evictions").inc()
+        else:
+            self._compiled.move_to_end(key)
         return exe
 
     def run(self, inputs=None):

@@ -1,0 +1,455 @@
+"""Batched decode engine: paged KV cache + bucketed prefill + continuous
+batching.
+
+`GPTForCausalLM.fast_generate` decodes ONE request per compiled program with
+a dense per-request cache; a serving process needs to decode MANY requests
+of different lengths concurrently without recompiling. This engine is the
+host-side scheduler the MPMD pipeline work (arxiv 2412.14374) argues for —
+Python owns admission/retirement, the device runs fixed-shape steps:
+
+- **Paged KV cache** (arxiv 2604.15464): one fixed pool of token pages
+  (`kernels/paged_attention.py`) shared by all slots; a host-side allocator
+  hands pages to sequences at admission and reclaims them at retirement.
+- **Fixed-shape decode step**: every step runs `models.gpt.decode_step` on
+  all `max_slots` slots — active or not — in ONE device call. Slot churn
+  only changes the *contents* of the page table / active mask, never a
+  shape, so after warmup there are ZERO recompiles (continuous batching;
+  guarded by tests/test_no_retrace.py).
+- **Bucketed prefill**: prompts are padded to the next power-of-two bucket,
+  so prefill compiles O(log max_seq_len) programs instead of one per
+  prompt length. Programs are AOT-compiled (`jit.lower().compile()`), so a
+  shape drift RAISES instead of silently recompiling.
+
+All compiled programs take the weights as inputs — `refresh_params` swaps
+them without recompiling. The engine is greedy-only by design: batched
+sampling needs per-slot PRNG threading, which rides on top of this layout
+(docs/SERVING.md).
+
+Thread model: `submit()` is safe from any thread; `step()` /
+`run_until_idle()` / `serve_loop()` must run on ONE driver thread (the
+serve process dedicates a thread; tests/bench call them inline).
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels.paged_attention import TRASH_PAGE
+from paddle_tpu.observability import metrics
+
+__all__ = ["EngineConfig", "PageAllocator", "GenerateRequest", "DecodeEngine"]
+
+
+@dataclass
+class EngineConfig:
+    """Scheduler knobs (docs/SERVING.md).
+
+    page_size    : tokens per KV page (16 keeps page waste < 1 page/seq
+                   while the page table stays small)
+    max_slots    : decode batch width B — every step computes all B slots
+    max_seq_len  : per-sequence capacity (prompt + generated), rounded up
+                   to whole pages; defaults to the model's position table
+    num_pages    : total pool size; default fits max_slots full sequences
+                   plus the reserved trash page
+    min_bucket   : smallest prefill bucket (pow-2 padding starts here)
+    eos_id       : optional token id that retires a slot early
+    donate       : donate cache buffers into the step program (defaults to
+                   on for real accelerators, off on CPU where PJRT ignores
+                   donation and warns)
+    """
+    page_size: int = 16
+    max_slots: int = 8
+    max_seq_len: int | None = None
+    num_pages: int | None = None
+    min_bucket: int = 16
+    eos_id: int | None = None
+    donate: bool | None = None
+
+
+class PageAllocator:
+    """Host-side free-list over the page pool. Page 0 (TRASH_PAGE) is never
+    handed out — it is the spill target for masked writes."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(f"need >= 2 pages (1 is reserved), got {num_pages}")
+        self.num_pages = num_pages
+        self._free = deque(range(1, num_pages))
+        self._g_in_use = metrics.gauge("engine.pages_in_use")
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """n pages or None (caller keeps the request queued — admission
+        control is 'wait', never 'partially allocate')."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.popleft() for _ in range(n)]
+        self._g_in_use.set(self.num_pages - 1 - len(self._free))
+        return pages
+
+    def free(self, pages: list[int]):
+        for p in pages:
+            if not (0 < p < self.num_pages):
+                raise ValueError(f"freeing bogus page {p}")
+        self._free.extend(pages)
+        self._g_in_use.set(self.num_pages - 1 - len(self._free))
+
+
+class GenerateRequest:
+    """One queued/running generation. `result()` blocks until the sequence
+    retires and returns prompt + generated ids (fast_generate's contract)."""
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int):
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.generated: list[int] = []
+        self.submit_t = time.perf_counter()
+        self._done = threading.Event()
+        self._error: str | None = None
+
+    def _finish(self, error: str | None = None):
+        self._error = error
+        self._done.set()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation still running")
+        if self._error is not None:
+            raise RuntimeError(self._error)
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, self.prompt.dtype)])
+
+
+class DecodeEngine:
+    """Continuous-batching decode over a paged KV cache for one GPT model.
+
+    >>> eng = DecodeEngine(model)                    # snapshots the weights
+    >>> reqs = [eng.submit(ids, max_new_tokens=32) for ids in prompts]
+    >>> eng.run_until_idle()
+    >>> outs = [r.result() for r in reqs]
+    """
+
+    def __init__(self, model, engine_config: EngineConfig | None = None):
+        ecfg = engine_config or EngineConfig()
+        self.cfg = model.cfg
+        self.ecfg = ecfg
+        state = model.state_dict()
+        self._params = {k: t._data for k, t in state.items()}
+        self._cdtype = self._params["gpt.wte.weight"].dtype
+        nh = self.cfg.num_heads
+        self._nh, self._dh = nh, self.cfg.hidden_size // nh
+        self._nl = self.cfg.num_layers
+
+        ps = ecfg.page_size
+        max_seq = ecfg.max_seq_len or self.cfg.max_position_embeddings
+        max_seq = min(max_seq, self.cfg.max_position_embeddings)
+        self.max_seq_len = max_seq
+        self.pages_per_slot = -(-max_seq // ps)           # ceil
+        num_pages = ecfg.num_pages or \
+            1 + ecfg.max_slots * self.pages_per_slot
+        self.allocator = PageAllocator(num_pages)
+        if ecfg.donate is None:
+            self._donate = jax.default_backend() != "cpu"
+        else:
+            self._donate = bool(ecfg.donate)
+
+        B, maxp = ecfg.max_slots, self.pages_per_slot
+        self._kc = jnp.zeros((self._nl, num_pages, ps, nh, self._dh),
+                             self._cdtype)
+        self._vc = jnp.zeros_like(self._kc)
+        # host-side mirrors of the per-slot device state (uploaded per step)
+        self._page_table = np.full((B, maxp), TRASH_PAGE, np.int32)
+        self._lengths = np.zeros(B, np.int32)
+        self._tokens = np.zeros(B, np.int32)
+        self._active = np.zeros(B, bool)
+        self._slot_req: list[GenerateRequest | None] = [None] * B
+        self._slot_pages: list[list[int]] = [[] for _ in range(B)]
+
+        self._queue: deque[GenerateRequest] = deque()
+        self._qlock = threading.Lock()
+        self._work = threading.Condition(self._qlock)
+        self._programs: dict = {}     # the engine's ProgramCache analog
+        self._dead: str | None = None  # set by abort(); submits then fail fast
+
+        self._m_hit = metrics.counter("engine.cache_hit")
+        self._m_miss = metrics.counter("engine.cache_miss")
+        self._m_compiles = metrics.counter("engine.compile_count")
+        self._m_steps = metrics.counter("engine.steps")
+        self._m_tokens = metrics.counter("engine.tokens")
+        self._m_requests = metrics.counter("engine.requests")
+        self._g_occupancy = metrics.gauge("engine.batch_occupancy")
+        self._g_queue = metrics.gauge("engine.queue_depth")
+        self._g_tps = metrics.gauge("engine.tokens_per_s")
+        self._h_wait = metrics.histogram("engine.queue_wait_seconds")
+        self._h_step = metrics.histogram("engine.step_seconds")
+        self._h_prefill = metrics.histogram("engine.prefill_seconds")
+
+    # ------------------------------------------------------------- programs
+
+    def _compiled(self, key, build):
+        """AOT program cache: compile once per key; later shape drift raises
+        inside the executable instead of silently retracing."""
+        exe = self._programs.get(key)
+        if exe is None:
+            self._m_miss.inc()
+            t0 = time.perf_counter()
+            exe = self._programs[key] = build()
+            self._m_compiles.inc()
+            metrics.histogram("engine.compile_seconds").observe(
+                time.perf_counter() - t0)
+            metrics.add_span(f"engine.compile:{key[0]}", t0,
+                             time.perf_counter() - t0, cat="compile")
+        else:
+            self._m_hit.inc()
+        return exe
+
+    def _decode_exe(self):
+        from paddle_tpu.models import gpt as gpt_mod
+        cfg = self.cfg
+
+        def step_fn(params, kc, vc, tokens, page_table, lengths, active):
+            cache = dict(k_pages=kc, v_pages=vc, page_table=page_table,
+                         lengths=lengths)
+            logits, cache = gpt_mod.decode_step(params, tokens, cache,
+                                                active, cfg=cfg)
+            nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+            nxt = jnp.where(active, nxt, tokens)
+            return nxt, cache["k_pages"], cache["v_pages"], cache["lengths"]
+
+        def build():
+            donate = (1, 2) if self._donate else ()
+            return jax.jit(step_fn, donate_argnums=donate).lower(
+                self._params, self._kc, self._vc,
+                jnp.asarray(self._tokens), jnp.asarray(self._page_table),
+                jnp.asarray(self._lengths), jnp.asarray(self._active),
+            ).compile()
+
+        return self._compiled(("decode",), build)
+
+    def _prefill_exe(self, bucket: int):
+        from paddle_tpu.models import gpt as gpt_mod
+        cfg = self.cfg
+
+        def prefill_fn(params, kc, vc, ids, length, pt_row):
+            logits, kc, vc = gpt_mod.prefill_step(
+                params, ids, length, pt_row, kc, vc, cfg=cfg)
+            tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)
+            return tok, kc, vc
+
+        def build():
+            donate = (1, 2) if self._donate else ()
+            return jax.jit(prefill_fn, donate_argnums=donate).lower(
+                self._params, self._kc, self._vc,
+                jnp.zeros(bucket, jnp.int32), jnp.int32(0),
+                jnp.asarray(self._page_table[0]),
+            ).compile()
+
+        return self._compiled(("prefill", bucket), build)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Next power-of-two >= prompt_len (floor min_bucket, capped at the
+        position table so wpe[:bucket] stays in range)."""
+        b = max(self.ecfg.min_bucket, 1 << max(0, prompt_len - 1).bit_length())
+        return min(b, self.cfg.max_position_embeddings)
+
+    def warmup(self, prompt_lens=(1,)):
+        """Compile the decode step + the prefill buckets covering
+        ``prompt_lens``. Optional — programs also compile lazily on first
+        use — but lets servers front-load compiles before traffic."""
+        self._decode_exe()
+        for s in prompt_lens:
+            self._prefill_exe(self.bucket_for(int(s)))
+
+    def refresh_params(self, model):
+        """Swap in current weights; programs take params as inputs, so this
+        never recompiles."""
+        self._params = {k: t._data for k, t in model.state_dict().items()}
+
+    # ------------------------------------------------------------ admission
+
+    def submit(self, prompt_ids, max_new_tokens=32) -> GenerateRequest:
+        """Queue one prompt (1-D or [1, S] int array). Thread-safe."""
+        ids = np.asarray(
+            prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids)
+        ids = np.ascontiguousarray(ids).reshape(-1).astype(np.int32)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        n = int(max_new_tokens)
+        if n < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {n}")
+        if ids.size + n > self.max_seq_len:
+            raise ValueError(
+                f"prompt {ids.size} + max_new_tokens {n} exceeds engine "
+                f"max_seq_len={self.max_seq_len}")
+        req = GenerateRequest(ids, n)
+        with self._work:
+            if self._dead is not None:
+                raise RuntimeError(f"engine stopped: {self._dead}")
+            self._queue.append(req)
+            self._g_queue.set(len(self._queue))
+            self._work.notify()
+        self._m_requests.inc()
+        return req
+
+    def _free_slots(self):
+        return [i for i in range(self.ecfg.max_slots) if not self._active[i]]
+
+    def _admit(self):
+        """Drain the queue into free slots while pages allow: assign slot,
+        allocate pages, run the bucketed prefill, seed the first token."""
+        while True:
+            slots = self._free_slots()
+            if not slots:
+                return
+            with self._qlock:
+                if not self._queue:
+                    self._g_queue.set(0)
+                    return
+                req = self._queue[0]
+                need = -(-(req.prompt.size + req.max_new_tokens)
+                         // self.ecfg.page_size)
+                pages = self.allocator.alloc(need)
+                if pages is None:
+                    if not self._active.any():
+                        # nothing will ever retire to free pages: the pool
+                        # itself is too small for this request
+                        self._queue.popleft()
+                        self._g_queue.set(len(self._queue))
+                        req._finish(error=f"request needs {need} pages, pool "
+                                    f"has {self.allocator.num_pages - 1}")
+                        continue
+                    return                 # wait for a retirement
+                self._queue.popleft()
+                self._g_queue.set(len(self._queue))
+            self._h_wait.observe(time.perf_counter() - req.submit_t)
+            self._place(req, slots[0], pages)
+
+    def _place(self, req: GenerateRequest, slot: int, pages: list[int]):
+        s0 = req.prompt.size
+        bucket = self.bucket_for(s0)
+        row = np.full(self.pages_per_slot, TRASH_PAGE, np.int32)
+        row[:len(pages)] = pages
+        ids = np.zeros(bucket, np.int32)
+        ids[:s0] = req.prompt
+        t0 = time.perf_counter()
+        exe = self._prefill_exe(bucket)
+        tok, self._kc, self._vc = exe(
+            self._params, self._kc, self._vc, jnp.asarray(ids),
+            jnp.int32(s0), jnp.asarray(row))
+        self._h_prefill.observe(time.perf_counter() - t0)
+        first = int(tok)
+        self._page_table[slot] = row
+        self._lengths[slot] = s0
+        self._tokens[slot] = first
+        self._active[slot] = True
+        self._slot_req[slot] = req
+        self._slot_pages[slot] = pages
+        req.generated.append(first)
+        self._m_tokens.inc()
+        if req.max_new_tokens == 1 or first == self.ecfg.eos_id:
+            self._retire(slot)
+
+    def _retire(self, slot: int, error: str | None = None):
+        req = self._slot_req[slot]
+        self.allocator.free(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self._slot_req[slot] = None
+        self._active[slot] = False
+        self._page_table[slot] = TRASH_PAGE
+        self._lengths[slot] = 0
+        if req is not None:
+            req._finish(error)
+
+    # ----------------------------------------------------------------- step
+
+    def step(self) -> bool:
+        """Admit waiting requests, run ONE batched decode step, harvest
+        tokens, retire finished slots. Returns False when fully idle."""
+        self._admit()
+        n_active = int(self._active.sum())
+        self._g_occupancy.set(n_active)
+        if n_active == 0:
+            with self._qlock:
+                return bool(self._queue)
+        exe = self._decode_exe()
+        t0 = time.perf_counter()
+        toks, self._kc, self._vc, lengths = exe(
+            self._params, self._kc, self._vc, jnp.asarray(self._tokens),
+            jnp.asarray(self._page_table), jnp.asarray(self._lengths),
+            jnp.asarray(self._active))
+        toks_np = np.asarray(toks)
+        dt = time.perf_counter() - t0
+        self._h_step.observe(dt)
+        self._m_steps.inc()
+        self._m_tokens.inc(n_active)
+        self._g_tps.set(n_active / dt if dt > 0 else 0.0)
+        metrics.add_span("engine.step", t0, dt, cat="engine")
+        self._lengths = np.array(lengths)      # copy: jax views are read-only
+        for slot in np.flatnonzero(self._active):
+            req = self._slot_req[slot]
+            tok = int(toks_np[slot])
+            self._tokens[slot] = tok
+            req.generated.append(tok)
+            if len(req.generated) >= req.max_new_tokens \
+                    or tok == self.ecfg.eos_id:
+                self._retire(slot)
+        return True
+
+    def run_until_idle(self, max_steps: int | None = None):
+        """Drive step() until queue and slots drain (tests/bench)."""
+        n = 0
+        while self.step():
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                raise RuntimeError(
+                    f"engine still busy after {max_steps} steps")
+
+    # ---------------------------------------------------------- serve loop
+
+    def abort(self, reason: str):
+        """Fail every queued and in-flight request with ``reason``, reclaim
+        their pages, and refuse future submits. Blocked `result()` callers
+        get the error immediately instead of hanging to their timeout."""
+        with self._qlock:
+            self._dead = reason
+            queued = list(self._queue)
+            self._queue.clear()
+            self._g_queue.set(0)
+        for req in queued:
+            req._finish(reason)
+        for slot in np.flatnonzero(self._active):
+            self._retire(slot, error=reason)
+        self._g_occupancy.set(0)
+
+    def serve_loop(self, stop_event: threading.Event, idle_wait=0.05):
+        """Drain loop for a dedicated engine thread (inference/serve.py):
+        steps while there is work, parks on the submit condition when idle.
+        On exit — clean shutdown OR a step raising (device OOM, AOT shape
+        error) — every outstanding request is aborted so no connection
+        thread is left blocking on a future nobody will fulfil."""
+        try:
+            while not stop_event.is_set():
+                if self.step():
+                    continue
+                with self._work:
+                    if not self._queue:
+                        self._work.wait(idle_wait)
+        except Exception as e:  # noqa: BLE001 — surface to every waiter
+            metrics.counter("engine.loop_errors").inc()
+            self.abort(f"engine loop died: {type(e).__name__}: {e}")
+            raise
+        self.abort("engine stopped (server shutdown)")

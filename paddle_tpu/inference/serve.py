@@ -14,11 +14,19 @@ Run:  python -m paddle_tpu.inference.serve --model /path/prefix --port 0
 
 Wire protocol (little-endian):
   hello   : u32 magic | 32-byte sha256 auth digest (once per connection)
-  request : u32 magic 'PRPD' | u32 op (1=run 2=ping 3=shutdown) |
-            u32 n_arrays | arrays...
+  request : u32 magic 'PRPD' | u32 op (1=run 2=ping 3=shutdown 4=stats
+            5=generate) | u32 n_arrays | arrays...
   array   : u8 dtype | u8 ndim | u32 dims[ndim] | u64 nbytes | bytes
   response: u32 magic | u32 status (0 ok else error) |
             ok: u32 n_arrays | arrays...   err: u32 len | utf8 message
+
+GENERATE (op 5, docs/SERVING.md): two request arrays — int32 prompt ids
+(1-D) and int32 [1] max_new_tokens. The request lands in the decode
+engine's scheduler queue (`inference/engine.py`); the engine thread batches
+it with whatever else is in flight (continuous batching over the paged KV
+cache) and the response is one int32 array of prompt + generated ids.
+Requires the server to be started with an engine attached
+(`--gpt-config`, or `InferenceServer(..., engine=...)`).
 
 Auth mirrors `distributed/rpc.py` (the r3 hardening this server lacked —
 r4 advisor + verdict weak #5: anyone who could reach the port could
@@ -43,7 +51,7 @@ import numpy as np
 from paddle_tpu.observability import metrics
 
 MAGIC = 0x50445250
-OP_RUN, OP_PING, OP_SHUTDOWN, OP_STATS = 1, 2, 3, 4
+OP_RUN, OP_PING, OP_SHUTDOWN, OP_STATS, OP_GENERATE = 1, 2, 3, 4, 5
 
 
 def auth_token(model_prefix: str) -> bytes:
@@ -100,13 +108,37 @@ def recv_arrays(sock, n):
 
 
 class InferenceServer:
-    """Owns one in-process Predictor; serves run() over TCP."""
+    """Owns one in-process Predictor and/or decode engine; serves run() and
+    generate() over TCP.
 
-    def __init__(self, model_prefix, host="127.0.0.1", port=0, config=None):
-        from paddle_tpu.inference import Config, Predictor
-        if config is None:
-            config = Config(model_prefix)
-        self._predictor = Predictor(config)
+    ``engine`` is a `paddle_tpu.inference.engine.DecodeEngine`; when
+    attached, a dedicated thread drains its scheduler queue so GENERATE
+    requests from any number of connections batch onto the same fixed-shape
+    decode step. Auth: the token derives from ``auth_name`` if given, else
+    ``model_prefix`` (the existing convention). An engine-only server has
+    no model prefix, so it REQUIRES an explicit ``auth_name`` (clients pass
+    the same string as their ``model_prefix``) or ``PADDLE_SERVE_TOKEN`` —
+    a fixed well-known default would let anyone who can reach the port
+    compute the digest and SHUTDOWN the server, the exact hole the hello
+    digest exists to close."""
+
+    def __init__(self, model_prefix, host="127.0.0.1", port=0, config=None,
+                 engine=None, auth_name=None):
+        if model_prefix is None and engine is None:
+            raise ValueError("need a model_prefix, an engine, or both")
+        basis = auth_name if auth_name is not None else model_prefix
+        if basis is None and not os.environ.get("PADDLE_SERVE_TOKEN"):
+            raise ValueError(
+                "engine-only server cannot derive an auth secret: pass "
+                "auth_name= (clients use the same string as model_prefix=) "
+                "or set PADDLE_SERVE_TOKEN on both sides")
+        self._predictor = None
+        if model_prefix is not None:
+            from paddle_tpu.inference import Config, Predictor
+            if config is None:
+                config = Config(model_prefix)
+            self._predictor = Predictor(config)
+        self._engine = engine
         self._lock = threading.Lock()      # one chip, serialized runs
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -114,7 +146,12 @@ class InferenceServer:
         self._sock.listen(8)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
-        self._token = auth_token(str(model_prefix))
+        self._token = auth_token(str(basis))
+        self._engine_thread = None
+        if engine is not None:
+            self._engine_thread = threading.Thread(
+                target=engine.serve_loop, args=(self._stop,), daemon=True)
+            self._engine_thread.start()
 
     def serve_forever(self):
         while not self._stop.is_set():
@@ -172,9 +209,17 @@ class InferenceServer:
                     arrays = recv_arrays(conn, n)
                     metrics.counter("serve.request_bytes").inc(
                         sum(a.nbytes for a in arrays))
-                    with self._lock:
-                        self._predictor.run(arrays)
-                        outs = [self._predictor.get_output_handle(nm)
+                    if op == OP_GENERATE:
+                        outs = [self._generate(arrays)]
+                    else:
+                        if self._predictor is None:
+                            raise RuntimeError(
+                                "engine-only server: no model artifact "
+                                "loaded, only GENERATE/PING/STATS served")
+                        with self._lock:
+                            self._predictor.run(arrays)
+                            outs = [
+                                self._predictor.get_output_handle(nm)
                                 .copy_to_cpu()
                                 for nm in self._predictor.get_output_names()]
                     conn.sendall(struct.pack("<III", MAGIC, 0, len(outs)))
@@ -196,6 +241,23 @@ class InferenceServer:
                     return
         finally:
             conn.close()
+
+    def _generate(self, arrays):
+        """GENERATE op body: enqueue into the engine's scheduler and block
+        this connection thread on the request future — the engine thread
+        does the actual batched decoding."""
+        if self._engine is None:
+            raise RuntimeError("no decode engine attached "
+                               "(start with --gpt-config or engine=)")
+        if len(arrays) != 2:
+            raise ValueError(
+                f"GENERATE wants [prompt_ids, max_new_tokens], got "
+                f"{len(arrays)} arrays")
+        ids, mnt = arrays
+        req = self._engine.submit(ids, int(np.asarray(mnt).reshape(-1)[0]))
+        out = req.result(timeout=600.0)
+        metrics.counter("serve.generate_requests").inc()
+        return np.ascontiguousarray(out, np.int32)
 
     @staticmethod
     def _send_err(conn, msg):
@@ -251,6 +313,24 @@ class RemotePredictor:
         (payload,) = recv_arrays(self._sock, n)
         return json.loads(payload.tobytes().decode())
 
+    def generate(self, prompt_ids, max_new_tokens=32):
+        """Batched server-side decode: ship the prompt, get prompt +
+        generated ids back. Concurrent generate() calls from any number of
+        clients share the server engine's decode batch."""
+        ids = np.ascontiguousarray(np.asarray(prompt_ids).reshape(-1),
+                                   np.int32)
+        self._sock.sendall(struct.pack("<III", MAGIC, OP_GENERATE, 2))
+        send_arrays(self._sock, [ids, np.asarray([max_new_tokens], np.int32)])
+        magic, status, n = struct.unpack(
+            "<III", _recv_exact(self._sock, 12))
+        if magic != MAGIC:
+            raise ConnectionError("bad magic in response")
+        if status != 0:
+            raise RuntimeError(
+                _recv_exact(self._sock, n).decode(errors="replace"))
+        (out,) = recv_arrays(self._sock, n)
+        return out
+
     def run(self, inputs):
         self._sock.sendall(struct.pack("<III", MAGIC, OP_RUN, len(inputs)))
         send_arrays(self._sock, inputs)
@@ -296,12 +376,36 @@ def main(argv=None):
         import jax
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     ap = argparse.ArgumentParser("paddle_tpu.inference.serve")
-    ap.add_argument("--model", required=True,
-                    help="jit.save prefix of the deployed model")
+    ap.add_argument("--model", default=None,
+                    help="jit.save prefix of the deployed model (RUN op)")
+    ap.add_argument("--gpt-config", default=None,
+                    help="JSON file of GPTConfig fields (plus optional "
+                         "'weights': paddle.save state-dict path, and "
+                         "'engine': EngineConfig fields) — attaches a "
+                         "batched decode engine serving the GENERATE op")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     args = ap.parse_args(argv)
-    srv = InferenceServer(args.model, args.host, args.port)
+    if args.model is None and args.gpt_config is None:
+        ap.error("need --model and/or --gpt-config")
+    engine = None
+    if args.gpt_config is not None:
+        import paddle_tpu as paddle
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        with open(args.gpt_config) as f:
+            spec = json.load(f)
+        weights = spec.pop("weights", None)
+        ecfg = EngineConfig(**spec.pop("engine", {}))
+        model = GPTForCausalLM(GPTConfig(**spec))
+        if weights:
+            model.set_state_dict(paddle.load(weights))
+        engine = DecodeEngine(model, ecfg)
+    # engine-only auth basis = the config path (deployment-specific, same
+    # trust model as the model prefix); clients pass it as model_prefix=
+    srv = InferenceServer(args.model, args.host, args.port, engine=engine,
+                          auth_name=args.gpt_config if args.model is None
+                          else None)
     print(f"LISTENING {srv.port}", flush=True)
     srv.serve_forever()
 

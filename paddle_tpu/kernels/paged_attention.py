@@ -1,0 +1,133 @@
+"""Paged KV-cache attention — the serving-side cache layout (arxiv 2604.15464).
+
+Dense decode caches ([B, L, nh, dh] per layer, one slab per sequence) waste
+HBM on short sequences and force one compiled program per (B, L) shape. The
+paged layout stores tokens in fixed-size PAGES:
+
+    k_pages, v_pages : [num_layers, num_pages, page_size, num_heads, head_dim]
+
+and each sequence owns an ordered list of page indices (the host-side page
+table, padded to ``pages_per_slot``). Token position ``t`` of a sequence
+lives at ``(page_table[t // page_size], t % page_size)``. Pages are
+allocated/freed by the engine's host-side allocator as sequences join and
+retire, so B live sequences of wildly different lengths share one fixed-shape
+pool — the decode program never changes shape and never recompiles.
+
+This module is the JAX-native REFERENCE path: reads are a gather of each
+sequence's pages into a [B, Lmax] window followed by masked f32-softmax
+attention — exactly the math `GPTForCausalLM.fast_generate` uses on its dense
+cache, so paged decode is token-identical to it (tested). The functions are
+shaped so a Pallas kernel (double-buffered page DMA, one grid cell per
+(sequence, head)) can replace `paged_attention` without touching callers:
+everything it needs — pages, page table, lengths — is an explicit argument.
+
+Page 0 is RESERVED as the trash page: writes for inactive slots and
+prompt-padding positions are routed there instead of being predicated out
+(XLA scatters need valid indices; a dedicated spill target keeps the write
+unconditional and the program shape static). Allocators must never hand out
+page 0.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# the reserved spill target for masked writes — never allocated to a sequence
+TRASH_PAGE = 0
+
+__all__ = ["TRASH_PAGE", "gather_kv", "paged_attention", "token_page_coords",
+           "prompt_page_coords", "write_token_kv", "write_prompt_kv"]
+
+
+def gather_kv(pages, page_table):
+    """Materialize one layer's paged K (or V) into per-sequence windows.
+
+    pages      : [num_pages, page_size, nh, dh]
+    page_table : [B, pages_per_slot] int32 page indices
+    returns    : [B, pages_per_slot * page_size, nh, dh]
+    """
+    _, ps, nh, dh = pages.shape
+    b, maxp = page_table.shape
+    return pages[page_table].reshape(b, maxp * ps, nh, dh)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, pos):
+    """One decode step of attention over paged K/V for B sequences.
+
+    q          : [B, nh, dh] query for the CURRENT token of each sequence
+    k_pages    : [num_pages, page_size, nh, dh] (one layer)
+    v_pages    : [num_pages, page_size, nh, dh]
+    page_table : [B, pages_per_slot] int32
+    pos        : [B] int32 — position of the current token (already written
+                 to the cache); attends over positions 0..pos inclusive
+    returns    : [B, nh, dh] in q.dtype
+
+    Same numerics as the dense path (f32 scores, -1e30 mask, f32 softmax):
+    token-identical output is the contract, not an approximation.
+    """
+    dh = q.shape[-1]
+    scale = 1.0 / (dh ** 0.5)
+    k = gather_kv(k_pages, page_table)              # [B, Lmax, nh, dh]
+    v = gather_kv(v_pages, page_table)
+    lmax = k.shape[1]
+    sc = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32) * scale,
+                    k.astype(jnp.float32))
+    mask = jnp.arange(lmax)[None, :] <= pos[:, None]         # [B, Lmax]
+    sc = jnp.where(mask[:, None, :], sc, -1e30)
+    pr = jax.nn.softmax(sc, axis=-1)
+    att = jnp.einsum("bhl,blhd->bhd", pr, v.astype(jnp.float32))
+    return att.astype(q.dtype)
+
+
+def token_page_coords(page_table, pos, active, page_size):
+    """(page, offset) for writing token ``pos`` of each of B sequences.
+
+    page_table : [B, pages_per_slot] int32; pos : [B] int32; active : [B]
+    bool — inactive slots are routed to TRASH_PAGE. Returns ([B], [B]).
+    """
+    maxp = page_table.shape[1]
+    idx = jnp.clip(pos // page_size, 0, maxp - 1)
+    page = jnp.take_along_axis(page_table, idx[:, None], axis=1)[:, 0]
+    page = jnp.where(active, page, TRASH_PAGE)
+    return page, pos % page_size
+
+
+def prompt_page_coords(page_table, length, seq_len, page_size):
+    """(page, offset) for writing positions 0..seq_len-1 of ONE sequence.
+
+    page_table : [pages_per_slot] int32; length : scalar int32 true prompt
+    length (positions >= length — bucket padding — go to TRASH_PAGE).
+    Returns ([seq_len], [seq_len]).
+    """
+    maxp = page_table.shape[0]
+    t = jnp.arange(seq_len)
+    idx = jnp.clip(t // page_size, 0, maxp - 1)
+    page = jnp.where(t < length, page_table[idx], TRASH_PAGE)
+    return page, t % page_size
+
+
+def write_token_kv(k_pages, v_pages, k, v, page_table, pos, active):
+    """Scatter one new K/V token per sequence into its page.
+
+    k, v       : [B, nh, dh] — the current token's key/value (one layer)
+    page_table : [B, pages_per_slot] int32
+    pos        : [B] int32 token position being written
+    active     : [B] bool — inactive slots write to TRASH_PAGE
+    returns    : (k_pages, v_pages) updated
+    """
+    page, off = token_page_coords(page_table, pos, active, k_pages.shape[1])
+    return k_pages.at[page, off].set(k), v_pages.at[page, off].set(v)
+
+
+def write_prompt_kv(k_pages, v_pages, k, v, page_table, length):
+    """Scatter a whole prompt's K/V (one sequence, one layer) into its pages.
+
+    k, v       : [S, nh, dh] — S is the PADDED bucket length; positions
+                 >= length (prompt padding) go to TRASH_PAGE
+    page_table : [pages_per_slot] int32
+    length     : scalar int32, true prompt length
+    returns    : (k_pages, v_pages) updated
+    """
+    page, off = prompt_page_coords(page_table, length, k.shape[0],
+                                   k_pages.shape[1])
+    return k_pages.at[page, off].set(k), v_pages.at[page, off].set(v)

@@ -68,6 +68,168 @@ class GPTConfig:
 INIT_CACHE = "init"
 
 
+# --------------------------------------------------------------------------
+# Pure decode math over the state_dict weight layout. `fast_generate`, the
+# paged `decode_step`/`prefill_step` (inference/engine.py), and the sampled
+# `generate` path all run THESE functions, so their numerics agree by
+# construction — token-identical output across cache layouts is the
+# contract the parity tests enforce.
+
+def _pget(p, layer, suffix):
+    return p[f"gpt.h.{layer}.{suffix}"]
+
+
+def _ln_ref(x, w, b):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) / jnp.sqrt(var + 1e-5)
+    return (y * w + b).astype(x.dtype)
+
+
+def _block_stack(p, x, nl, nh, dh, attend):
+    """All nl transformer blocks over x ([..., H], H = nh*dh). ``attend(i, q,
+    k, v)`` gets [..., nh, dh] q/k/v for layer i and returns the attention
+    context in x.dtype with q's shape — the ONLY thing that differs between
+    the dense-cache and paged-cache decode paths."""
+    lead = x.shape[:-1]
+    for i in range(nl):
+        hpre = _ln_ref(x, _pget(p, i, "ln_1.weight"), _pget(p, i, "ln_1.bias"))
+        qkv = hpre @ _pget(p, i, "attn.qkv_proj.weight") + \
+            _pget(p, i, "attn.qkv_proj.bias")
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        att = attend(i, q.reshape(*lead, nh, dh), k.reshape(*lead, nh, dh),
+                     v.reshape(*lead, nh, dh))
+        att = att.reshape(*lead, nh * dh)
+        att = att @ _pget(p, i, "attn.out_proj.weight") + \
+            _pget(p, i, "attn.out_proj.bias")
+        x = x + att
+        hpre = _ln_ref(x, _pget(p, i, "ln_2.weight"), _pget(p, i, "ln_2.bias"))
+        m = hpre @ _pget(p, i, "mlp.fc_in.weight") + \
+            _pget(p, i, "mlp.fc_in.bias")
+        m = jax.nn.gelu(m, approximate=True)
+        m = m @ _pget(p, i, "mlp.fc_out.weight") + \
+            _pget(p, i, "mlp.fc_out.bias")
+        x = x + m
+    return x
+
+
+def _final_logits(p, x):
+    x = _ln_ref(x, p["gpt.ln_f.weight"], p["gpt.ln_f.bias"])
+    return (x @ p["gpt.wte.weight"].T).astype(jnp.float32)
+
+
+def _causal_attend(scale, cmask, dtype):
+    """Prefill attention over the prompt itself (dense f32 softmax)."""
+    def attend(i, q, k, v):
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                        k.astype(jnp.float32))
+        sc = jnp.where(cmask[None, None], sc, -1e30)
+        pr = jax.nn.softmax(sc, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", pr,
+                          v.astype(jnp.float32)).astype(dtype)
+    return attend
+
+
+def _make_sampler(temperature, top_k):
+    """Greedy / temperature / top-k sampling on [B, V] f32 logits with a
+    threaded PRNG key. Temperature scales BEFORE the top-k mask (so the
+    kth-logit cutoff is applied on the tempered distribution), and the key
+    splits once per sampled token — both `generate` and `fast_generate`
+    thread keys identically, so a shared seed reproduces the same tokens
+    on either path."""
+    def sample(logits, key):
+        if temperature != 1.0:
+            logits = logits / temperature
+        if top_k:
+            vals, _ = jax.lax.top_k(logits, top_k)
+            kth = vals[:, -1][:, None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        if top_k or temperature != 1.0:
+            key, sub = jax.random.split(key)
+            return jax.random.categorical(sub, logits, axis=-1), key
+        return jnp.argmax(logits, axis=-1), key
+    return sample
+
+
+def decode_step(params, ids, cache, slot_mask, *, cfg):
+    """One fixed-shape batched decode step over a PAGED KV cache.
+
+    The serving engine's inner loop (inference/engine.py): B slots advance
+    one token in one device call. Nothing here depends on which slots are
+    live — ``slot_mask`` only routes dead slots' cache writes to the trash
+    page and freezes their lengths — so slots can join/retire between steps
+    with zero recompiles (continuous batching).
+
+    params    : state_dict arrays (the `fast_generate` weight layout)
+    ids       : [B] int32 — current token per slot
+    cache     : dict with
+                  k_pages/v_pages : [nl, num_pages, page_size, nh, dh]
+                  page_table      : [B, pages_per_slot] int32
+                  lengths         : [B] int32 tokens already cached
+    slot_mask : [B] bool — active slots
+    returns   : (logits [B, V] f32, new cache with lengths advanced)
+    """
+    from paddle_tpu.kernels import paged_attention as pa
+    nl, nh = cfg.num_layers, cfg.num_heads
+    dh = cfg.hidden_size // nh
+    kc, vc = cache["k_pages"], cache["v_pages"]
+    page_table, lengths = cache["page_table"], cache["lengths"]
+    ps = kc.shape[2]
+    # write position = current length; clamp only to keep gathers in range
+    # for retired slots sitting at capacity
+    pos = jnp.clip(lengths, 0, params["gpt.wpe.weight"].shape[0] - 1)
+    x = params["gpt.wte.weight"][ids] + params["gpt.wpe.weight"][pos]
+
+    def attend(i, q, k, v):
+        nonlocal kc, vc
+        page, off = pa.token_page_coords(page_table, pos, slot_mask, ps)
+        kc = kc.at[i, page, off].set(k)
+        vc = vc.at[i, page, off].set(v)
+        return pa.paged_attention(q, kc[i], vc[i], page_table, pos)
+
+    x = _block_stack(params, x, nl, nh, dh, attend)
+    logits = _final_logits(params, x)
+    new_cache = dict(k_pages=kc, v_pages=vc, page_table=page_table,
+                     lengths=jnp.where(slot_mask, lengths + 1, lengths))
+    return logits, new_cache
+
+
+def prefill_step(params, ids, length, page_table, k_pages, v_pages, *, cfg):
+    """Bucketed single-sequence prefill into the paged cache.
+
+    ids is PADDED to its bucket length S (a small power-of-two set, so
+    prefill compiles O(buckets) programs); ``length`` is the true prompt
+    length. One dense causal pass computes the prompt's K/V, scatters
+    positions < length into the slot's pages (padding lands on the trash
+    page), and returns the last REAL token's logits so the engine can
+    sample the first generated token.
+
+    returns : (logits [V] f32, k_pages, v_pages)
+    """
+    from paddle_tpu.kernels import paged_attention as pa
+    nl, nh = cfg.num_layers, cfg.num_heads
+    dh = cfg.hidden_size // nh
+    scale = 1.0 / (dh ** 0.5)
+    ps = k_pages.shape[2]
+    s = ids.shape[0]
+    x = params["gpt.wte.weight"][ids][None] + \
+        params["gpt.wpe.weight"][None, :s]               # [1, S, H]
+    cmask = jnp.tril(jnp.ones((s, s), bool))
+    causal = _causal_attend(scale, cmask, x.dtype)
+
+    def attend(i, q, k, v):
+        nonlocal k_pages, v_pages
+        page, off = pa.prompt_page_coords(page_table, length, s, ps)
+        k_pages = k_pages.at[i, page, off].set(k[0])
+        v_pages = v_pages.at[i, page, off].set(v[0])
+        return causal(i, q, k, v)
+
+    x = _block_stack(params, x, nl, nh, dh, attend)
+    last = x[0, jnp.clip(length - 1, 0, s - 1)]
+    return _final_logits(params, last), k_pages, v_pages
+
+
 def _sp_constrain(x, cfg):
     """[B, S, H] activations: batch over dp, sequence over sp."""
     if not cfg.seq_parallel or get_mesh() is None:
@@ -256,16 +418,27 @@ class GPTForCausalLM(nn.Layer):
         return logits, loss
 
     @paddle.no_grad()
-    def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0):
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=0, seed=0):
         """Greedy/sampled decode with KV caches — EAGER loop (one dispatch
         per token, growing cache shapes). Debug/reference path; production
         decode should use :meth:`fast_generate` (single compiled program,
-        identical greedy output)."""
+        identical output).
+
+        Sampling runs the SAME sampler as `fast_generate` (temperature
+        before the top-k mask, one key split per token from
+        ``PRNGKey(seed)``), so a shared seed reproduces identical tokens on
+        both paths — parity-tested in tests/test_models.py. The old
+        `paddle.multinomial` draw was nondeterministic w.r.t. this seed and
+        masked AFTER softmax, which silently disagreed with the compiled
+        path."""
         self.eval()
         x = input_ids
         caches = None
         out_ids = [x]
         cur = x
+        sample = _make_sampler(float(temperature), int(top_k))
+        key = jax.random.PRNGKey(seed)
         for _ in range(max_new_tokens):
             if caches is None:
                 h, caches = self.gpt(cur, caches=INIT_CACHE)
@@ -273,18 +446,9 @@ class GPTForCausalLM(nn.Layer):
                 h, caches = self.gpt(cur, caches=caches)
             logits = paddle.matmul(h[:, -1], self.gpt.wte.weight,
                                    transpose_y=True)
-            if temperature != 1.0:
-                logits = logits / temperature
-            if top_k:
-                vals, _ = logits.topk(top_k, axis=-1)
-                kth = vals[:, -1:]
-                logits = paddle.where(logits < kth,
-                                      paddle.full_like(logits, -1e30), logits)
-            if top_k or temperature != 1.0:
-                probs = F.softmax(logits, axis=-1)
-                nxt = paddle.multinomial(probs, 1)
-            else:
-                nxt = logits.argmax(axis=-1, keepdim=True)
+            nxt_arr, key = sample(logits._data.astype(jnp.float32), key)
+            nxt = paddle.Tensor(nxt_arr[:, None].astype(x._data.dtype),
+                                _internal=True)
             out_ids.append(nxt)
             cur = nxt
         return paddle.concat(out_ids, axis=1)
@@ -341,29 +505,7 @@ class GPTForCausalLM(nn.Layer):
         compiled_now = jitted is None
         if jitted is None:
             scale = 1.0 / (dh ** 0.5)
-
-            def pget(p, layer, suffix):
-                return p[f"gpt.h.{layer}.{suffix}"]
-
-            def ln(x, w, b):
-                x32 = x.astype(jnp.float32)
-                mu = jnp.mean(x32, axis=-1, keepdims=True)
-                var = jnp.var(x32, axis=-1, keepdims=True)
-                y = (x32 - mu) / jnp.sqrt(var + 1e-5)
-                return (y * w + b).astype(x.dtype)
-
-            def sample(logits, key):
-                # logits [B, V] f32; returns (tokens [B], new key)
-                if temperature != 1.0:
-                    logits = logits / temperature
-                if top_k:
-                    vals, _ = jax.lax.top_k(logits, top_k)
-                    kth = vals[:, -1][:, None]
-                    logits = jnp.where(logits < kth, -1e30, logits)
-                if top_k or temperature != 1.0:
-                    key, sub = jax.random.split(key)
-                    return jax.random.categorical(sub, logits, axis=-1), key
-                return jnp.argmax(logits, axis=-1), key
+            sample = _make_sampler(float(temperature), int(top_k))
 
             def run(p, ids, key_data):
                 key = jax.random.wrap_key_data(key_data)
@@ -376,40 +518,18 @@ class GPTForCausalLM(nn.Layer):
                 x = p["gpt.wte.weight"][ids] + \
                     p["gpt.wpe.weight"][None, :S0]          # [B, S0, H]
                 cmask = jnp.tril(jnp.ones((S0, S0), bool))
-                for i in range(nl):
-                    hpre = ln(x, pget(p, i, "ln_1.weight"),
-                              pget(p, i, "ln_1.bias"))
-                    qkv = hpre @ pget(p, i, "attn.qkv_proj.weight") + \
-                        pget(p, i, "attn.qkv_proj.bias")
-                    q, k, v = jnp.split(qkv, 3, axis=-1)
-                    q = q.reshape(B, S0, nh, dh)
-                    k = k.reshape(B, S0, nh, dh)
-                    v = v.reshape(B, S0, nh, dh)
+                causal = _causal_attend(scale, cmask, x.dtype)
+
+                def attend_prefill(i, q, k, v):
+                    nonlocal kc, vc
                     kc = jax.lax.dynamic_update_slice(
                         kc, k[None], (i, 0, 0, 0, 0))
                     vc = jax.lax.dynamic_update_slice(
                         vc, v[None], (i, 0, 0, 0, 0))
-                    sc = jnp.einsum("bqhd,bkhd->bhqk",
-                                    q.astype(jnp.float32) * scale,
-                                    k.astype(jnp.float32))
-                    sc = jnp.where(cmask[None, None], sc, -1e30)
-                    pr = jax.nn.softmax(sc, axis=-1)
-                    att = jnp.einsum("bhqk,bkhd->bqhd", pr,
-                                     v.astype(jnp.float32)).astype(x.dtype)
-                    att = att.reshape(B, S0, nh * dh)
-                    att = att @ pget(p, i, "attn.out_proj.weight") + \
-                        pget(p, i, "attn.out_proj.bias")
-                    x = x + att
-                    hpre = ln(x, pget(p, i, "ln_2.weight"),
-                              pget(p, i, "ln_2.bias"))
-                    m = hpre @ pget(p, i, "mlp.fc_in.weight") + \
-                        pget(p, i, "mlp.fc_in.bias")
-                    m = jax.nn.gelu(m, approximate=True)
-                    m = m @ pget(p, i, "mlp.fc_out.weight") + \
-                        pget(p, i, "mlp.fc_out.bias")
-                    x = x + m
-                xf = ln(x[:, -1], p["gpt.ln_f.weight"], p["gpt.ln_f.bias"])
-                logits0 = (xf @ p["gpt.wte.weight"].T).astype(jnp.float32)
+                    return causal(i, q, k, v)
+
+                x = _block_stack(p, x, nl, nh, dh, attend_prefill)
+                logits0 = _final_logits(p, x[:, -1])
                 first, key = sample(logits0, key)
                 first = first.astype(ids.dtype)
 
@@ -419,15 +539,9 @@ class GPTForCausalLM(nn.Layer):
                     pos = S0 + t
                     x = p["gpt.wte.weight"][tok] + \
                         p["gpt.wpe.weight"][pos][None, :]    # [B, H]
-                    for i in range(nl):
-                        hpre = ln(x, pget(p, i, "ln_1.weight"),
-                                  pget(p, i, "ln_1.bias"))
-                        qkv = hpre @ pget(p, i, "attn.qkv_proj.weight") + \
-                            pget(p, i, "attn.qkv_proj.bias")
-                        q, k, v = jnp.split(qkv, 3, axis=-1)
-                        q = q.reshape(B, nh, dh)
-                        k = k.reshape(B, nh, dh)
-                        v = v.reshape(B, nh, dh)
+
+                    def attend(i, q, k, v):
+                        nonlocal kc, vc
                         kc = jax.lax.dynamic_update_slice(
                             kc, k[None, :, None], (i, 0, pos, 0, 0))
                         vc = jax.lax.dynamic_update_slice(
@@ -438,23 +552,12 @@ class GPTForCausalLM(nn.Layer):
                         mask = jnp.arange(L) <= pos
                         sc = jnp.where(mask[None, None], sc, -1e30)
                         pr = jax.nn.softmax(sc, axis=-1)
-                        att = jnp.einsum(
+                        return jnp.einsum(
                             "bhl,blhd->bhd", pr,
-                            vc[i].astype(jnp.float32)).astype(x.dtype)
-                        att = att.reshape(B, nh * dh)
-                        att = att @ pget(p, i, "attn.out_proj.weight") + \
-                            pget(p, i, "attn.out_proj.bias")
-                        x = x + att
-                        hpre = ln(x, pget(p, i, "ln_2.weight"),
-                                  pget(p, i, "ln_2.bias"))
-                        m = hpre @ pget(p, i, "mlp.fc_in.weight") + \
-                            pget(p, i, "mlp.fc_in.bias")
-                        m = jax.nn.gelu(m, approximate=True)
-                        m = m @ pget(p, i, "mlp.fc_out.weight") + \
-                            pget(p, i, "mlp.fc_out.bias")
-                        x = x + m
-                    x = ln(x, p["gpt.ln_f.weight"], p["gpt.ln_f.bias"])
-                    logits = (x @ p["gpt.wte.weight"].T).astype(jnp.float32)
+                            vc[i].astype(jnp.float32)).astype(q.dtype)
+
+                    x = _block_stack(p, x, nl, nh, dh, attend)
+                    logits = _final_logits(p, x)
                     nxt, key = sample(logits, key)
                     nxt = nxt.astype(tok.dtype)
                     return (kc, vc, nxt, key), nxt

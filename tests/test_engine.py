@@ -1,0 +1,308 @@
+"""Batched decode engine: paged KV cache correctness, continuous batching,
+page accounting, and the serve GENERATE wire op.
+
+The load-bearing contract: paged-cache decode is TOKEN-IDENTICAL to dense
+`fast_generate` (same math, different cache layout), for B=1 and B>1,
+including sequences that cross page boundaries.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import metrics
+
+
+def _tiny_model(seed=7, vocab=97, max_pos=64):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=32, num_layers=2,
+                    num_heads=2, intermediate_size=64,
+                    max_position_embeddings=max_pos, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def _fast_ref(model, prompt, n):
+    ids = paddle.Tensor(np.asarray(prompt)[None].astype(np.int32),
+                        _internal=True)
+    return np.asarray(model.fast_generate(ids, max_new_tokens=n).numpy())[0]
+
+
+class TestPagedAttentionKernel:
+    """kernels/paged_attention.py against a dense reference."""
+
+    def test_gather_matches_dense_layout(self):
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import paged_attention as pa
+        rng = np.random.RandomState(0)
+        ps, nh, dh = 4, 2, 8
+        # a 13-token sequence scattered over pages [3, 1, 4, 2]
+        toks = rng.randn(13, nh, dh).astype(np.float32)
+        pages = np.zeros((6, ps, nh, dh), np.float32)
+        table = np.array([3, 1, 4, 2], np.int32)
+        for t in range(13):
+            pages[table[t // ps], t % ps] = toks[t]
+        got = pa.gather_kv(jnp.asarray(pages), jnp.asarray(table[None]))
+        np.testing.assert_array_equal(np.asarray(got)[0, :13], toks)
+
+    def test_paged_attention_matches_dense_softmax(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import paged_attention as pa
+        rng = np.random.RandomState(1)
+        ps, nh, dh, L = 4, 2, 8, 11
+        q = rng.randn(1, nh, dh).astype(np.float32)
+        ks = rng.randn(L, nh, dh).astype(np.float32)
+        vs = rng.randn(L, nh, dh).astype(np.float32)
+        kp = np.zeros((5, ps, nh, dh), np.float32)
+        vp = np.zeros_like(kp)
+        table = np.array([2, 4, 1], np.int32)
+        for t in range(L):
+            kp[table[t // ps], t % ps] = ks[t]
+            vp[table[t // ps], t % ps] = vs[t]
+        got = pa.paged_attention(jnp.asarray(q), jnp.asarray(kp),
+                                 jnp.asarray(vp), jnp.asarray(table[None]),
+                                 jnp.asarray([L - 1], np.int32))
+        # dense reference: plain f32 softmax attention over the L tokens
+        sc = np.einsum("hd,lhd->hl", q[0] / np.sqrt(dh), ks)
+        pr = np.asarray(jax.nn.softmax(jnp.asarray(sc), axis=-1))
+        want = np.einsum("hl,lhd->hd", pr, vs)
+        np.testing.assert_allclose(np.asarray(got)[0], want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_trash_page_routing(self):
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import paged_attention as pa
+        kp = jnp.zeros((3, 2, 1, 4))
+        vp = jnp.zeros_like(kp)
+        k = jnp.ones((1, 1, 4))
+        table = jnp.asarray([[1, 2]], jnp.int32)
+        # inactive slot: the write must land on TRASH_PAGE, not page 1
+        kp2, _ = pa.write_token_kv(kp, vp, k, k, table,
+                                   jnp.asarray([0], jnp.int32),
+                                   jnp.asarray([False]))
+        assert np.asarray(kp2)[pa.TRASH_PAGE].sum() == 4
+        assert np.asarray(kp2)[1:].sum() == 0
+
+
+class TestEngineParity:
+    """Paged decode == dense fast_generate, token for token."""
+
+    def test_b1_crosses_page_boundary(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        # page_size 4, prompt 5, 12 new tokens: the sequence spans pages
+        # 0..4 and the prompt itself straddles a page edge
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=1,
+                                           min_bucket=8))
+        prompt = np.random.RandomState(0).randint(0, 97, 5).astype(np.int32)
+        req = eng.submit(prompt, max_new_tokens=12)
+        eng.run_until_idle(max_steps=50)
+        np.testing.assert_array_equal(req.result(timeout=30),
+                                      _fast_ref(m, prompt, 12))
+
+    def test_batch_gt1_mixed_lengths(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=4,
+                                           min_bucket=8))
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(0, 97, s).astype(np.int32)
+                   for s in (3, 7, 9, 16)]
+        reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        eng.run_until_idle(max_steps=100)
+        for p, r in zip(prompts, reqs):
+            np.testing.assert_array_equal(r.result(timeout=30),
+                                          _fast_ref(m, p, 8))
+
+    def test_single_token_request(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8))
+        prompt = np.random.RandomState(2).randint(0, 97, 6).astype(np.int32)
+        req = eng.submit(prompt, max_new_tokens=1)
+        eng.run_until_idle(max_steps=10)
+        np.testing.assert_array_equal(req.result(timeout=30),
+                                      _fast_ref(m, prompt, 1))
+
+
+class TestContinuousBatching:
+    def test_more_requests_than_slots(self):
+        """7 requests over 2 slots: later requests are admitted as earlier
+        ones retire, mid-flight, and every output still matches the dense
+        reference."""
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8))
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 97, 3 + i).astype(np.int32)
+                   for i in range(7)]
+        # staggered max_new so retirements interleave with admissions
+        ns = [5, 9, 3, 7, 4, 8, 6]
+        reqs = [eng.submit(p, max_new_tokens=n)
+                for p, n in zip(prompts, ns)]
+        eng.run_until_idle(max_steps=300)
+        for p, n, r in zip(prompts, ns, reqs):
+            np.testing.assert_array_equal(r.result(timeout=30),
+                                          _fast_ref(m, p, n))
+
+    def test_late_submit_joins_running_batch(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8))
+        rng = np.random.RandomState(4)
+        p1 = rng.randint(0, 97, 4).astype(np.int32)
+        p2 = rng.randint(0, 97, 6).astype(np.int32)
+        r1 = eng.submit(p1, max_new_tokens=10)
+        for _ in range(3):
+            eng.step()                       # r1 alone for a few tokens
+        r2 = eng.submit(p2, max_new_tokens=5)   # joins mid-decode
+        eng.run_until_idle(max_steps=100)
+        np.testing.assert_array_equal(r1.result(timeout=30),
+                                      _fast_ref(m, p1, 10))
+        np.testing.assert_array_equal(r2.result(timeout=30),
+                                      _fast_ref(m, p2, 5))
+
+    def test_pages_reclaimed_and_occupancy_gauge(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8))
+        total = eng.allocator.free_pages
+        rng = np.random.RandomState(5)
+        reqs = [eng.submit(rng.randint(0, 97, 5).astype(np.int32), 4)
+                for _ in range(3)]
+        eng.run_until_idle(max_steps=100)
+        for r in reqs:
+            assert r.done
+        assert eng.allocator.free_pages == total     # all pages returned
+        assert metrics.gauge("engine.pages_in_use").value == 0
+        assert metrics.histogram("engine.queue_wait_seconds").count >= 3
+
+    def test_pool_too_small_request_errors(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        # 4 usable pages of 4 tokens = 16-token capacity
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=1,
+                                           min_bucket=8, num_pages=5,
+                                           max_seq_len=40))
+        req = eng.submit(np.arange(20, dtype=np.int32), max_new_tokens=10)
+        eng.run_until_idle(max_steps=10)
+        with pytest.raises(RuntimeError, match="pages"):
+            req.result(timeout=5)
+
+    def test_submit_validates_capacity(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=1))
+        with pytest.raises(ValueError, match="max_seq_len"):
+            eng.submit(np.arange(60, dtype=np.int32), max_new_tokens=30)
+
+    def test_eos_retires_early(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        prompt = np.random.RandomState(6).randint(0, 97, 4).astype(np.int32)
+        ref = _fast_ref(m, prompt, 12)
+        eos = int(ref[len(prompt) + 2])      # the 3rd generated token
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=1,
+                                           min_bucket=8, eos_id=eos))
+        req = eng.submit(prompt, max_new_tokens=12)
+        eng.run_until_idle(max_steps=50)
+        out = req.result(timeout=30)
+        assert out[-1] == eos
+        np.testing.assert_array_equal(out, ref[:len(out)])
+
+
+class TestAbort:
+    def test_abort_fails_queued_and_inflight_then_refuses_submits(self):
+        """serve_loop's exit path: every outstanding request errors out
+        immediately (no client hangs to its timeout), pages are reclaimed,
+        and later submits fail fast instead of queueing onto a dead
+        engine."""
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=1,
+                                           min_bucket=8))
+        rng = np.random.RandomState(8)
+        inflight = eng.submit(rng.randint(0, 97, 4).astype(np.int32), 10)
+        queued = eng.submit(rng.randint(0, 97, 4).astype(np.int32), 10)
+        eng.step()                              # inflight occupies the slot
+        eng.abort("device fell over")
+        for req in (inflight, queued):
+            with pytest.raises(RuntimeError, match="device fell over"):
+                req.result(timeout=5)
+        assert eng.allocator.free_pages == eng.allocator.num_pages - 1
+        with pytest.raises(RuntimeError, match="engine stopped"):
+            eng.submit(rng.randint(0, 97, 4).astype(np.int32), 2)
+
+
+class TestServeGenerate:
+    """GENERATE wire op: scheduler-queue admission over TCP, batched with
+    other connections' requests."""
+
+    def _server(self, model, **ekw):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        from paddle_tpu.inference.serve import InferenceServer
+        eng = DecodeEngine(model, EngineConfig(
+            page_size=4, max_slots=2, min_bucket=8, **ekw))
+        srv = InferenceServer(None, engine=eng, auth_name="engine")
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        return srv
+
+    def test_concurrent_clients_match_fast_generate(self):
+        from paddle_tpu.inference.serve import RemotePredictor
+        m = _tiny_model()
+        srv = self._server(m)
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(0, 97, 4 + i).astype(np.int32)
+                   for i in range(3)]
+        outs = [None] * 3
+
+        def client(i):
+            cli = RemotePredictor(port=srv.port, model_prefix="engine")
+            outs[i] = cli.generate(prompts[i], max_new_tokens=6)
+            cli.close()
+
+        ths = [threading.Thread(target=client, args=(i,)) for i in range(3)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+        for p, o in zip(prompts, outs):
+            assert o is not None, "client thread died"
+            np.testing.assert_array_equal(o, _fast_ref(m, p, 6))
+        cli = RemotePredictor(port=srv.port, model_prefix="engine")
+        stats = cli.stats()
+        assert stats["counters"]["serve.generate_requests"] >= 3
+        cli.shutdown_server()
+        cli.close()
+
+    def test_engine_only_server_requires_auth_basis(self, monkeypatch):
+        """No model prefix and no auth_name would mean a well-known default
+        digest — anyone reaching the port could SHUTDOWN. Must refuse to
+        start (unless PADDLE_SERVE_TOKEN provides the secret)."""
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        from paddle_tpu.inference.serve import InferenceServer
+        monkeypatch.delenv("PADDLE_SERVE_TOKEN", raising=False)
+        eng = DecodeEngine(_tiny_model(), EngineConfig(page_size=4,
+                                                       max_slots=1))
+        with pytest.raises(ValueError, match="auth"):
+            InferenceServer(None, engine=eng)
+
+    def test_run_op_rejected_on_engine_only_server(self):
+        from paddle_tpu.inference.serve import RemotePredictor
+        m = _tiny_model()
+        srv = self._server(m)
+        cli = RemotePredictor(port=srv.port, model_prefix="engine")
+        with pytest.raises(RuntimeError, match="engine-only"):
+            cli.run([np.zeros((1, 4), np.float32)])
+        cli.close()
+        cli2 = RemotePredictor(port=srv.port, model_prefix="engine")
+        cli2.shutdown_server()
+        cli2.close()

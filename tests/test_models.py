@@ -96,6 +96,39 @@ def test_gpt_generate():
     assert out.shape == [1, 8]
 
 
+def test_generate_sampling_parity_with_fast_generate():
+    """The eager `generate` and compiled `fast_generate` run the SAME
+    sampler (temperature before the top-k mask, one PRNG split per token
+    from PRNGKey(seed)): identical tokens under a shared seed. The old
+    paddle.multinomial draw ignored `seed` entirely and masked after
+    softmax."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(5)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+                    intermediate_size=64, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    ids = paddle.Tensor(np.random.RandomState(0).randint(
+        0, 97, (2, 6)).astype(np.int32), _internal=True)
+    for kw in ({"temperature": 0.8, "top_k": 5},
+               {"temperature": 1.3, "top_k": 0},
+               {"temperature": 1.0, "top_k": 3}):
+        slow = np.asarray(m.generate(ids, max_new_tokens=8, seed=3,
+                                     **kw).numpy())
+        fast = np.asarray(m.fast_generate(ids, max_new_tokens=8, seed=3,
+                                          **kw).numpy())
+        np.testing.assert_array_equal(slow, fast)
+        # deterministic under the seed, and a different seed differs
+        again = np.asarray(m.generate(ids, max_new_tokens=8, seed=3,
+                                      **kw).numpy())
+        np.testing.assert_array_equal(slow, again)
+    other = np.asarray(m.generate(ids, max_new_tokens=8, seed=4,
+                                  temperature=0.8, top_k=5).numpy())
+    sampled = np.asarray(m.generate(ids, max_new_tokens=8, seed=3,
+                                    temperature=0.8, top_k=5).numpy())
+    assert not np.array_equal(sampled, other)
+
+
 def test_bert_classification():
     from paddle_tpu.models.bert import BertConfig, BertForSequenceClassification
     # dropout off: a 4-step loss-decrease assertion is noise under real

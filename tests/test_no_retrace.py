@@ -1,0 +1,83 @@
+"""Shape-dynamism tripwire for the decode engine.
+
+Continuous batching only pays off if slot churn (sequences joining,
+retiring, different active sets, different prompt lengths within a bucket)
+NEVER changes a program shape. These tests warm the engine up, then push it
+through every churn pattern and assert the registry's compile counters are
+frozen — a regression that sneaks a host value into a traced shape fails
+here instead of as a silent 100x serving slowdown.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import metrics
+
+
+def _tiny_model():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                    intermediate_size=64, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def _compile_counters():
+    snap = metrics.snapshot()["counters"]
+    return (snap.get("engine.compile_count", 0),
+            snap.get("jit.compile_count", 0),
+            snap.get("generate.compile_count", 0))
+
+
+def test_slot_churn_zero_recompiles():
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    m = _tiny_model()
+    eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=3,
+                                       min_bucket=8))
+    rng = np.random.RandomState(0)
+
+    # ---- warmup: compile the decode step + the one prefill bucket the
+    # traffic below uses (prompt lengths 3..8 all pad to bucket 8)
+    eng.warmup(prompt_lens=[8])
+    r = eng.submit(rng.randint(0, 64, 5).astype(np.int32), 3)
+    eng.run_until_idle(max_steps=20)
+    assert r.done
+    frozen = _compile_counters()
+
+    # ---- churn: different slot counts, different active sets, staggered
+    # retirement, late joins — every shape the engine sees is warm
+    reqs = [eng.submit(rng.randint(0, 64, 3 + i).astype(np.int32), 2 + i)
+            for i in range(3)]                       # fills all 3 slots
+    for _ in range(2):
+        eng.step()
+    late = eng.submit(rng.randint(0, 64, 8).astype(np.int32), 4)
+    eng.run_until_idle(max_steps=100)
+    for req in reqs + [late]:
+        assert req.done
+
+    assert _compile_counters() == frozen, (
+        "decode engine recompiled after warmup: slot churn must be "
+        "shape-invariant")
+
+
+def test_new_bucket_compiles_exactly_once():
+    """A prompt length outside the warm bucket set compiles ONE new prefill
+    program; re-using that bucket afterwards is free."""
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    m = _tiny_model()
+    eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                       min_bucket=8))
+    rng = np.random.RandomState(1)
+    eng.submit(rng.randint(0, 64, 4).astype(np.int32), 2)
+    eng.run_until_idle(max_steps=20)             # decode + bucket-8 compiled
+    base = _compile_counters()
+
+    eng.submit(rng.randint(0, 64, 12).astype(np.int32), 2)   # bucket 16
+    eng.run_until_idle(max_steps=20)
+    after_new = _compile_counters()
+    assert after_new[0] == base[0] + 1
+
+    eng.submit(rng.randint(0, 64, 9).astype(np.int32), 2)    # bucket 16 again
+    eng.submit(rng.randint(0, 64, 6).astype(np.int32), 2)    # bucket 8 again
+    eng.run_until_idle(max_steps=40)
+    assert _compile_counters() == after_new
