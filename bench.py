@@ -63,8 +63,11 @@ def _reexec_cpu_child(backend_error):
 
 
 def _emit(payload):
-    """The ONE structured line the driver parses — every exit path goes
-    through here, so a failed round still leaves a parseable artifact."""
+    """One structured JSON line per metric. The PRIMARY metric line is
+    always emitted first (every exit path goes through here, so a failed
+    round still leaves a parseable artifact); the serving rungs
+    (engine_ragged_decode, paged_attention_step) append their own
+    metric-keyed lines after it."""
     print(json.dumps(payload))
 
 
@@ -320,6 +323,72 @@ def bench_engine_decode():
     return eng_tps, seq_tps
 
 
+def bench_engine_ragged():
+    """Ragged-mix serving rung (the shape the Pallas paged kernel's
+    length-aware stop is built for): 8 CONCURRENT prompts whose lengths span
+    1-4 pages decode together through the engine; page-table capacity is 6
+    pages/slot, so the XLA reference pays for 6 pages per slot per step while
+    the ragged kernel touches only each sequence's live pages. Emits its own
+    structured JSON line."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.kernels.autotune import cache_table
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    PS, N = 16, 32
+    lens = [7, 19, 34, 61, 14, 44, 27, 55]           # 1..4 pages of 16
+    cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, max_position_embeddings=128,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, s).astype(np.int32)
+               for s in lens]
+    eng = DecodeEngine(model, EngineConfig(
+        page_size=PS, max_slots=len(prompts), max_seq_len=max(lens) + N))
+    eng.warmup(prompt_lens=sorted(set(lens)))        # compile excluded
+    t0 = time.perf_counter()
+    reqs = [eng.submit(p, max_new_tokens=N) for p in prompts]
+    eng.run_until_idle()
+    tps = len(prompts) * N / (time.perf_counter() - t0)
+    for r in reqs:
+        assert r.done
+    impl = next((v[0] for k, v in cache_table().items() if k[0] == "paged"),
+                "xla")
+    return tps, impl
+
+
+def bench_paged_kernel():
+    """Paged-attention kernel microbench: ONE decode step, xla reference vs
+    the authored Pallas ragged kernel, GPT-2s serving geometry (B=8, 12
+    heads, dh=64, 16-token pages, 16-page slots) over a ragged position mix.
+    Pallas is measured only on real TPU (interpret mode is a parity tool,
+    not a serving path). Emits its own structured JSON line."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels import paged_attention as pa
+    from paddle_tpu.kernels.autotune import _measure
+
+    B, nh, dh, ps, maxp = 8, 12, 64, 16, 16
+    num_pages = 1 + B * maxp
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, nh, dh).astype(np.float32))
+    kp = jnp.asarray(rng.randn(num_pages, ps, nh, dh).astype(np.float32))
+    vp = jnp.asarray(rng.randn(num_pages, ps, nh, dh).astype(np.float32))
+    pt = jnp.asarray(1 + np.arange(B * maxp, dtype=np.int32)
+                     .reshape(B, maxp))
+    pos = jnp.asarray(((np.arange(B) % 4) + 1) * 4 * ps - 1, dtype=jnp.int32)
+
+    times = {}
+    impls = ["xla", "pallas"] if jax.default_backend() == "tpu" else ["xla"]
+    for impl in impls:
+        step = jax.jit(lambda q_, k_, v_, _i=impl: pa._impl_call(
+            _i, q_, k_, v_, pt, pos))
+        times[impl] = _measure(step, (q, kp, vp))
+    return times
+
+
 def _chw_to_hwc_u8(img):
     # CHW float [0,1] -> HWC uint8 [0,255]: the jitter family operates on
     # image-range uint8 like real decoded inputs. Module-level: spawn
@@ -471,13 +540,19 @@ def bench_smoke():
 
     # one batched-engine decode on the same tiny model: keeps the decode
     # engine (paged KV cache + bucketed prefill, inference/engine.py)
-    # import- and execution-clean under tier-1
+    # import- and execution-clean under tier-1, and exercises the
+    # paged-attention dispatch switch (FLAGS_tpu_paged_impl=auto resolves
+    # to the xla path on CPU; the impl counter must show it fired)
     from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
     eng = DecodeEngine(model, EngineConfig(page_size=2, max_slots=2,
                                            min_bucket=4))
     req = eng.submit(ids[0, :4].astype(np.int32), max_new_tokens=2)
     eng.run_until_idle(max_steps=8)
     assert req.result(timeout=30).shape == (6,)
+    impl_counts = {k: v for k, v in metrics.snapshot()["counters"].items()
+                   if k.startswith("paged_attention.impl.")}
+    assert sum(impl_counts.values()) > 0, (
+        "paged-attention dispatch switch did not fire")
 
     snap = metrics.snapshot()
     return dt, batch * seq / dt, snap
@@ -521,9 +596,13 @@ def main(argv=None):
     if args.smoke:
         try:
             dt, tps, snap = bench_smoke()
+            impls = {k.rsplit(".", 1)[-1]: v
+                     for k, v in snap["counters"].items()
+                     if k.startswith("paged_attention.impl.") and v}
             _emit({"metric": "smoke_step_time_seconds", "value": round(dt, 6),
                    "unit": "s", "ok": True, "platform": platform,
                    "backend_error": backend_error,
+                   "paged_impl": max(impls, key=impls.get) if impls else None,
                    "tokens_per_sec": round(tps, 1),
                    "compile_count": snap["counters"].get(
                        "jit.compile_count", 0),
@@ -585,6 +664,27 @@ def main(argv=None):
     except Exception as e:
         print(f"# engine decode rung failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        rag_tps, rag_impl = _retry(bench_engine_ragged)
+        _emit({"metric": "engine_ragged_decode_tokens_per_sec",
+               "value": round(rag_tps, 1), "unit": "tokens/s", "ok": True,
+               "platform": platform, "paged_impl": rag_impl,
+               "mix": "8x lengths 7-61 (1-4 pages of 16), 32 new tokens"})
+    except Exception as e:
+        _emit({"metric": "engine_ragged_decode_tokens_per_sec", "value": 0.0,
+               "unit": "tokens/s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
+    try:
+        times = _retry(bench_paged_kernel)
+        _emit({"metric": "paged_attention_step_seconds",
+               "value": round(min(times.values()), 6), "unit": "s",
+               "ok": True, "platform": platform,
+               "impl_seconds": {k: round(v, 6) for k, v in times.items()},
+               "geometry": "B8 h12 dh64 page16 x16pages, ragged pos"})
+    except Exception as e:
+        _emit({"metric": "paged_attention_step_seconds", "value": 0.0,
+               "unit": "s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
     try:
         ips, dt_r, loss_r = _retry(bench_resnet50)
         print(f"# resnet50 imgs/sec/chip={ips:.1f} step={dt_r*1e3:.1f}ms "

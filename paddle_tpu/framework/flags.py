@@ -132,6 +132,14 @@ define_flag("tpu_flash_impl", "auto",
             "authored (in-repo Pallas fwd+bwd kernels, "
             "kernels/pallas/flash_attention.py) | xla (pure-XLA flash-style "
             "custom vjp, also the fallback for non-tileable shapes)")
+define_flag("tpu_paged_impl", "auto",
+            "paged-attention decode backend (serving engine hot kernel): "
+            "auto (measured per-signature selection on real TPU, xla "
+            "elsewhere — kernels/autotune.py) | xla (gather + masked f32 "
+            "softmax reference, traffic scales with pool capacity) | pallas "
+            "(authored ragged paged-attention kernel, kernels/pallas/"
+            "paged_attention.py — page loop bounded by each sequence's true "
+            "length; interpret mode off-TPU, parity tests only)")
 define_flag("autotune_verbose", False,
             "log kernel autotune decisions with measured timings")
 define_flag("dy2static_max_trip_count", 0,

@@ -1,5 +1,5 @@
 """Batched decode engine: paged KV cache + bucketed prefill + continuous
-batching.
+batching, with a DE-SYNCHRONIZED step loop.
 
 `GPTForCausalLM.fast_generate` decodes ONE request per compiled program with
 a dense per-request cache; a serving process needs to decode MANY requests
@@ -19,6 +19,15 @@ Python owns admission/retirement, the device runs fixed-shape steps:
   so prefill compiles O(log max_seq_len) programs instead of one per
   prompt length. Programs are AOT-compiled (`jit.lower().compile()`), so a
   shape drift RAISES instead of silently recompiling.
+- **De-synchronized hot path**: the per-slot host mirrors (token, length,
+  flags, page-table row) are fused into ONE packed int32 upload per step
+  (`engine.h2d_transfers` counts them — exactly one per step); sampled
+  tokens chain step-to-step ON DEVICE, and their readback is DEFERRED — up
+  to ``EngineConfig.inflight`` steps stay in flight before the host blocks
+  on the oldest step's token ids (`engine.d2h_transfers`; the ONLY blocking
+  readback in the loop). Host admission/retirement bookkeeping runs while
+  the device chews on the just-dispatched step; the `engine.host_ms` /
+  `engine.device_ms` timer pair makes the overlap visible in the snapshot.
 
 All compiled programs take the weights as inputs — `refresh_params` swaps
 them without recompiling. The engine is greedy-only by design: batched
@@ -31,7 +40,6 @@ serve process dedicates a thread; tests/bench call them inline).
 """
 from __future__ import annotations
 
-import math
 import threading
 import time
 from collections import deque
@@ -45,6 +53,11 @@ from paddle_tpu.kernels.paged_attention import TRASH_PAGE
 from paddle_tpu.observability import metrics
 
 __all__ = ["EngineConfig", "PageAllocator", "GenerateRequest", "DecodeEngine"]
+
+# packed slot-state upload layout: [B, _STATE_COLS + pages_per_slot] int32,
+# ONE host->device transfer per step (engine.h2d_transfers)
+_COL_TOKEN, _COL_LENGTH, _COL_FLAGS, _STATE_COLS = 0, 1, 2, 3
+_FLAG_ACTIVE, _FLAG_FRESH = 1, 2
 
 
 @dataclass
@@ -63,6 +76,11 @@ class EngineConfig:
     donate       : donate cache buffers into the step program (defaults to
                    on for real accelerators, off on CPU where PJRT ignores
                    donation and warns)
+    inflight     : decode steps kept in flight before the host blocks on
+                   the oldest step's sampled tokens (deferred readback; 1
+                   restores the synchronous loop). EOS detection lags by up
+                   to this many steps — the surplus tokens are discarded at
+                   harvest, never delivered
     """
     page_size: int = 16
     max_slots: int = 8
@@ -71,6 +89,7 @@ class EngineConfig:
     min_bucket: int = 16
     eos_id: int | None = None
     donate: bool | None = None
+    inflight: int = 2
 
 
 class PageAllocator:
@@ -159,6 +178,7 @@ class DecodeEngine:
         max_seq = min(max_seq, self.cfg.max_position_embeddings)
         self.max_seq_len = max_seq
         self.pages_per_slot = -(-max_seq // ps)           # ceil
+        self.slot_capacity = self.pages_per_slot * ps     # tokens per slot
         num_pages = ecfg.num_pages or \
             1 + ecfg.max_slots * self.pages_per_slot
         self.allocator = PageAllocator(num_pages)
@@ -171,13 +191,22 @@ class DecodeEngine:
         self._kc = jnp.zeros((self._nl, num_pages, ps, nh, self._dh),
                              self._cdtype)
         self._vc = jnp.zeros_like(self._kc)
-        # host-side mirrors of the per-slot device state (uploaded per step)
+        # host-side mirrors of the per-slot state, fused into ONE packed
+        # int32 upload per step; sampled tokens live on device and only the
+        # _tokens column is consulted for freshly admitted slots
         self._page_table = np.full((B, maxp), TRASH_PAGE, np.int32)
         self._lengths = np.zeros(B, np.int32)
         self._tokens = np.zeros(B, np.int32)
-        self._active = np.zeros(B, bool)
+        self._active = np.zeros(B, bool)      # dispatchable this step
+        self._fresh = np.zeros(B, bool)       # admitted since last dispatch
+        self._budget = np.zeros(B, np.int32)  # tokens left to dispatch
         self._slot_req: list[GenerateRequest | None] = [None] * B
         self._slot_pages: list[list[int]] = [[] for _ in range(B)]
+        # device-resident sampled-token chain + deferred-readback fifo of
+        # (device tokens, [(slot, request)] snapshot, dispatch t0)
+        self._tok_dev = jnp.zeros(B, jnp.int32)
+        self._inflight: deque = deque()
+        self._blocked_s = 0.0                 # device-wait within this step
 
         self._queue: deque[GenerateRequest] = deque()
         self._qlock = threading.Lock()
@@ -191,12 +220,17 @@ class DecodeEngine:
         self._m_steps = metrics.counter("engine.steps")
         self._m_tokens = metrics.counter("engine.tokens")
         self._m_requests = metrics.counter("engine.requests")
+        self._m_h2d = metrics.counter("engine.h2d_transfers")
+        self._m_d2h = metrics.counter("engine.d2h_transfers")
         self._g_occupancy = metrics.gauge("engine.batch_occupancy")
         self._g_queue = metrics.gauge("engine.queue_depth")
         self._g_tps = metrics.gauge("engine.tokens_per_s")
+        self._g_inflight = metrics.gauge("engine.steps_in_flight")
         self._h_wait = metrics.histogram("engine.queue_wait_seconds")
         self._h_step = metrics.histogram("engine.step_seconds")
         self._h_prefill = metrics.histogram("engine.prefill_seconds")
+        self._h_host = metrics.histogram("engine.host_ms")
+        self._h_device = metrics.histogram("engine.device_ms")
 
     # ------------------------------------------------------------- programs
 
@@ -219,34 +253,56 @@ class DecodeEngine:
 
     def _decode_exe(self):
         from paddle_tpu.models import gpt as gpt_mod
+        from paddle_tpu.framework.flags import flag_value
         cfg = self.cfg
+        B, maxp = self.ecfg.max_slots, self.pages_per_slot
+        # the paged-attention impl is baked into the traced program, so the
+        # flag is part of the cache key — flipping it compiles a new decode
+        # program instead of being silently ignored (same rule as
+        # tpu_flash_impl in the jit ProgramCache)
+        impl_flag = flag_value("tpu_paged_impl")
 
-        def step_fn(params, kc, vc, tokens, page_table, lengths, active):
-            cache = dict(k_pages=kc, v_pages=vc, page_table=page_table,
-                         lengths=lengths)
-            logits, cache = gpt_mod.decode_step(params, tokens, cache,
+        def step_fn(params, kc, vc, tokens, slot_state):
+            # slot_state: the ONE fused upload — [B, 3 + maxp] int32 of
+            # (fresh token id, length, flags, page-table row); `tokens` is
+            # the previous step's on-device output, overridden only for
+            # slots the host admitted since the last dispatch
+            flags = slot_state[:, _COL_FLAGS]
+            active = (flags & _FLAG_ACTIVE) != 0
+            fresh = (flags & _FLAG_FRESH) != 0
+            toks = jnp.where(fresh, slot_state[:, _COL_TOKEN], tokens)
+            cache = dict(k_pages=kc, v_pages=vc,
+                         page_table=slot_state[:, _STATE_COLS:],
+                         lengths=slot_state[:, _COL_LENGTH])
+            logits, cache = gpt_mod.decode_step(params, toks, cache,
                                                 active, cfg=cfg)
-            nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
-            nxt = jnp.where(active, nxt, tokens)
-            return nxt, cache["k_pages"], cache["v_pages"], cache["lengths"]
+            nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
+            nxt = jnp.where(active, nxt, toks)
+            return nxt, cache["k_pages"], cache["v_pages"]
 
         def build():
             donate = (1, 2) if self._donate else ()
             return jax.jit(step_fn, donate_argnums=donate).lower(
                 self._params, self._kc, self._vc,
-                jnp.asarray(self._tokens), jnp.asarray(self._page_table),
-                jnp.asarray(self._lengths), jnp.asarray(self._active),
+                jnp.zeros(B, jnp.int32),
+                jnp.zeros((B, _STATE_COLS + maxp), jnp.int32),
             ).compile()
 
-        return self._compiled(("decode",), build)
+        return self._compiled(("decode", impl_flag), build)
 
     def _prefill_exe(self, bucket: int):
         from paddle_tpu.models import gpt as gpt_mod
         cfg = self.cfg
+        maxp = self.pages_per_slot
 
-        def prefill_fn(params, kc, vc, ids, length, pt_row):
+        def prefill_fn(params, kc, vc, packed):
+            # packed [bucket + 1 + maxp] int32: ids | true length | page row
+            # — one fused upload per admission
+            ids = packed[:bucket]
+            length = packed[bucket]
+            row = packed[bucket + 1:]
             logits, kc, vc = gpt_mod.prefill_step(
-                params, ids, length, pt_row, kc, vc, cfg=cfg)
+                params, ids, length, row, kc, vc, cfg=cfg)
             tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)
             return tok, kc, vc
 
@@ -254,8 +310,7 @@ class DecodeEngine:
             donate = (1, 2) if self._donate else ()
             return jax.jit(prefill_fn, donate_argnums=donate).lower(
                 self._params, self._kc, self._vc,
-                jnp.zeros(bucket, jnp.int32), jnp.int32(0),
-                jnp.asarray(self._page_table[0]),
+                jnp.zeros(bucket + 1 + maxp, jnp.int32),
             ).compile()
 
         return self._compiled(("prefill", bucket), build)
@@ -306,7 +361,13 @@ class DecodeEngine:
         return req
 
     def _free_slots(self):
-        return [i for i in range(self.ecfg.max_slots) if not self._active[i]]
+        # occupancy, not the dispatch mask: a slot whose budget is spent
+        # stays occupied until its pending tokens are harvested
+        return [i for i in range(self.ecfg.max_slots)
+                if self._slot_req[i] is None]
+
+    def _occupied(self) -> bool:
+        return any(r is not None for r in self._slot_req)
 
     def _admit(self):
         """Drain the queue into free slots while pages allow: assign slot,
@@ -324,7 +385,7 @@ class DecodeEngine:
                          // self.ecfg.page_size)
                 pages = self.allocator.alloc(need)
                 if pages is None:
-                    if not self._active.any():
+                    if not (self._occupied() or self._inflight):
                         # nothing will ever retire to free pages: the pool
                         # itself is too small for this request
                         self._queue.popleft()
@@ -341,21 +402,29 @@ class DecodeEngine:
     def _place(self, req: GenerateRequest, slot: int, pages: list[int]):
         s0 = req.prompt.size
         bucket = self.bucket_for(s0)
-        row = np.full(self.pages_per_slot, TRASH_PAGE, np.int32)
+        maxp = self.pages_per_slot
+        row = np.full(maxp, TRASH_PAGE, np.int32)
         row[:len(pages)] = pages
-        ids = np.zeros(bucket, np.int32)
-        ids[:s0] = req.prompt
+        packed = np.zeros(bucket + 1 + maxp, np.int32)
+        packed[:s0] = req.prompt
+        packed[bucket] = s0
+        packed[bucket + 1:] = row
         t0 = time.perf_counter()
         exe = self._prefill_exe(bucket)
+        self._m_h2d.inc()
         tok, self._kc, self._vc = exe(
-            self._params, self._kc, self._vc, jnp.asarray(ids),
-            jnp.int32(s0), jnp.asarray(row))
+            self._params, self._kc, self._vc, jax.device_put(packed))
+        tb = time.perf_counter()
+        first = int(tok)                     # sampled-token readback
+        self._blocked_s += time.perf_counter() - tb
+        self._m_d2h.inc()
         self._h_prefill.observe(time.perf_counter() - t0)
-        first = int(tok)
         self._page_table[slot] = row
         self._lengths[slot] = s0
         self._tokens[slot] = first
         self._active[slot] = True
+        self._fresh[slot] = True
+        self._budget[slot] = req.max_new_tokens - 1
         self._slot_req[slot] = req
         self._slot_pages[slot] = pages
         req.generated.append(first)
@@ -369,6 +438,8 @@ class DecodeEngine:
         self._slot_pages[slot] = []
         self._slot_req[slot] = None
         self._active[slot] = False
+        self._fresh[slot] = False
+        self._budget[slot] = 0
         self._page_table[slot] = TRASH_PAGE
         self._lengths[slot] = 0
         if req is not None:
@@ -376,41 +447,106 @@ class DecodeEngine:
 
     # ----------------------------------------------------------------- step
 
-    def step(self) -> bool:
-        """Admit waiting requests, run ONE batched decode step, harvest
-        tokens, retire finished slots. Returns False when fully idle."""
-        self._admit()
-        n_active = int(self._active.sum())
-        self._g_occupancy.set(n_active)
-        if n_active == 0:
-            with self._qlock:
-                return bool(self._queue)
+    def _packed_state(self) -> np.ndarray:
+        B, maxp = self.ecfg.max_slots, self.pages_per_slot
+        packed = np.empty((B, _STATE_COLS + maxp), np.int32)
+        packed[:, _COL_TOKEN] = self._tokens
+        packed[:, _COL_LENGTH] = self._lengths
+        packed[:, _COL_FLAGS] = (self._active.astype(np.int32) * _FLAG_ACTIVE
+                                 | self._fresh.astype(np.int32) * _FLAG_FRESH)
+        packed[:, _STATE_COLS:] = self._page_table
+        return packed
+
+    def _dispatch(self):
+        """Enqueue ONE fixed-shape decode step: one fused host->device
+        upload, no readback — tokens stay on device for the next step."""
         exe = self._decode_exe()
+        self._m_h2d.inc()
+        state = jax.device_put(self._packed_state())
         t0 = time.perf_counter()
-        toks, self._kc, self._vc, lengths = exe(
-            self._params, self._kc, self._vc, jnp.asarray(self._tokens),
-            jnp.asarray(self._page_table), jnp.asarray(self._lengths),
-            jnp.asarray(self._active))
-        toks_np = np.asarray(toks)
-        dt = time.perf_counter() - t0
-        self._h_step.observe(dt)
+        self._tok_dev, self._kc, self._vc = exe(
+            self._params, self._kc, self._vc, self._tok_dev, state)
+        snapshot = [(int(i), self._slot_req[i])
+                    for i in np.flatnonzero(self._active)]
+        self._inflight.append((self._tok_dev, snapshot, t0))
+        self._g_inflight.set(len(self._inflight))
+        # host bookkeeping for the step just enqueued: each active slot
+        # advances one position; a slot at its token budget stops being
+        # dispatched but stays occupied until its tokens are harvested
+        self._lengths[self._active] += 1
+        self._budget[self._active] -= 1
+        self._fresh[:] = False
+        self._active &= self._budget > 0
         self._m_steps.inc()
-        self._m_tokens.inc(n_active)
-        self._g_tps.set(n_active / dt if dt > 0 else 0.0)
-        metrics.add_span("engine.step", t0, dt, cat="engine")
-        self._lengths = np.array(lengths)      # copy: jax views are read-only
-        for slot in np.flatnonzero(self._active):
-            req = self._slot_req[slot]
+        metrics.add_span("engine.dispatch", t0,
+                         time.perf_counter() - t0, cat="engine")
+
+    def _harvest_one(self) -> int:
+        """Block on the OLDEST in-flight step's sampled token ids (the only
+        blocking readback in the loop) and deliver them: append to each
+        snapshot request, retire slots that hit max_new_tokens or EOS."""
+        toks_dev, snapshot, t0 = self._inflight.popleft()
+        self._g_inflight.set(len(self._inflight))
+        tb = time.perf_counter()
+        toks_np = np.asarray(toks_dev)
+        self._blocked_s += time.perf_counter() - tb
+        self._m_d2h.inc()
+        n = 0
+        for slot, req in snapshot:
+            if req.done or self._slot_req[slot] is not req:
+                continue        # EOS-retired earlier in the fifo (or abort)
             tok = int(toks_np[slot])
-            self._tokens[slot] = tok
             req.generated.append(tok)
+            n += 1
             if len(req.generated) >= req.max_new_tokens \
                     or tok == self.ecfg.eos_id:
                 self._retire(slot)
-        return True
+        self._m_tokens.inc(n)
+        return n
+
+    def step(self) -> bool:
+        """Admit waiting requests, enqueue ONE batched decode step, harvest
+        steps past the in-flight window. Returns False when fully idle."""
+        t_step = time.perf_counter()
+        self._blocked_s = 0.0
+        self._admit()
+        # capacity tripwire: a token at pos >= slot_capacity would spill to
+        # the trash page on device (kernels/paged_attention.py); the engine
+        # retires the sequence with an error instead of scheduling it
+        for slot in np.flatnonzero(self._active &
+                                   (self._lengths >= self.slot_capacity)):
+            self._retire(int(slot), error=(
+                f"sequence hit slot capacity {self.slot_capacity} "
+                f"(pages_per_slot * page_size); token at position "
+                f"{int(self._lengths[slot])} cannot be cached"))
+        n_active = int(self._active.sum())
+        self._g_occupancy.set(n_active)
+        harvested = 0
+        if n_active:
+            self._dispatch()
+            while len(self._inflight) >= max(1, self.ecfg.inflight):
+                harvested += self._harvest_one()
+        elif self._inflight:
+            # nothing dispatchable: drain the fifo so budget-spent slots
+            # retire (freeing pages/slots for the next admission)
+            harvested += self._harvest_one()
+        else:
+            with self._qlock:
+                return bool(self._queue)
+        dt = time.perf_counter() - t_step
+        self._h_step.observe(dt)
+        self._h_host.observe((dt - self._blocked_s) * 1e3)
+        self._h_device.observe(self._blocked_s * 1e3)
+        if harvested:
+            self._g_tps.set(harvested / dt if dt > 0 else 0.0)
+        metrics.add_span("engine.step", t_step, dt, cat="engine")
+        with self._qlock:
+            queued = bool(self._queue)
+        return queued or bool(self._inflight) or self._occupied()
 
     def run_until_idle(self, max_steps: int | None = None):
-        """Drive step() until queue and slots drain (tests/bench)."""
+        """Drive step() until queue, slots and the in-flight window drain
+        (tests/bench)."""
         n = 0
         while self.step():
             n += 1
@@ -431,8 +567,11 @@ class DecodeEngine:
             self._g_queue.set(0)
         for req in queued:
             req._finish(reason)
-        for slot in np.flatnonzero(self._active):
-            self._retire(slot, error=reason)
+        self._inflight.clear()               # undelivered device tokens
+        self._g_inflight.set(0)
+        for slot in range(self.ecfg.max_slots):
+            if self._slot_req[slot] is not None:
+                self._retire(slot, error=reason)
         self._g_occupancy.set(0)
 
     def serve_loop(self, stop_event: threading.Event, idle_wait=0.05):

@@ -7,7 +7,10 @@ signature is seen, every candidate implementation VIABLE on the current
 backend is compiled and timed (forward + backward, a couple of repetitions,
 best-of), and the winner is cached — exactly the reference's
 measure-once-then-cache policy, keyed the same way its kernel cache keys on
-shapes/dtypes.
+shapes/dtypes. ``FLAGS_tpu_paged_impl=auto`` does the same for the serving
+engine's paged-attention decode step through :func:`paged_winner`, keyed on
+(backend, B, pages_per_slot, page_size, nh, dh, dtype) — forward only, a
+ragged position mix so the measurement sees the length-aware stop.
 
 Backend viability is decided by NAME, never by probing execution: the
 experimental 'axon' tunnel reports platform "tpu" but cannot lower Mosaic,
@@ -144,6 +147,72 @@ def flash_winner(shape_q, shape_k, dtype, causal, tileable, run_impl):
         verbose = False
     if verbose:
         _LOG.warning("autotune flash %s -> %s (%s)", key, winner,
+                     {k_: f"{v_ * 1e3:.2f}ms" for k_, v_ in timings.items()})
+    _CACHE[key] = (winner, timings)
+    return winner
+
+
+def _paged_candidates(backend):
+    """Paged-attention impls viable on this backend (by name, never by
+    execution). Pallas is offered only on real TPU: interpret mode off-TPU
+    is a parity tool, not a serving path, and the axon tunnel cannot lower
+    Mosaic (same rule as _flash_candidates)."""
+    if backend == "tpu":
+        return ["xla", "pallas"]
+    return ["xla"]
+
+
+def paged_winner(b, pages_per_slot, page_size, nh, dh, dtype, run_impl):
+    """Pick (and cache) the fastest paged-attention decode impl for this
+    signature — (backend, B, pages_per_slot, page_size, nh, dh, dtype).
+
+    run_impl(impl, q, k_pages, v_pages, page_table, pos) must execute the
+    named implementation and return [B, nh, dh].
+    """
+    backend = _backend_kind()
+    key = ("paged", backend, int(b), int(pages_per_slot), int(page_size),
+           int(nh), int(dh), str(dtype))
+    hit = _CACHE.get(key)
+    if hit is not None:
+        return hit[0]
+    cands = _paged_candidates(backend)
+    if len(cands) == 1:
+        _CACHE[key] = (cands[0], {})
+        return cands[0]
+
+    import jax
+    import jax.numpy as jnp
+    num_pages = 1 + b * pages_per_slot
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, nh, dh).astype(np.float32)).astype(dtype)
+    kp = jnp.asarray(rng.randn(num_pages, page_size, nh, dh)
+                     .astype(np.float32)).astype(dtype)
+    vp = jnp.asarray(rng.randn(num_pages, page_size, nh, dh)
+                     .astype(np.float32)).astype(dtype)
+    pt = jnp.asarray(1 + np.arange(b * pages_per_slot, dtype=np.int32)
+                     .reshape(b, pages_per_slot))
+    # ragged mix spanning 1..pages_per_slot pages — the serving shape the
+    # pallas kernel's length-aware stop is built for
+    pos = jnp.asarray(((np.arange(b) % pages_per_slot) + 1) * page_size - 1,
+                      dtype=jnp.int32)
+
+    timings = {}
+    for impl in cands:
+        try:
+            step = jax.jit(
+                lambda q_, k_, v_, _i=impl: run_impl(_i, q_, k_, v_, pt, pos))
+            timings[impl] = _measure(step, (q, kp, vp))
+        except Exception as e:           # a candidate failing to compile is
+            _LOG.info("autotune: paged %s failed on %s: %s", impl, backend, e)
+            continue                     # data, not an error (ref behavior)
+    winner = min(timings, key=timings.get) if timings else "xla"
+    from paddle_tpu.framework.flags import flag_value
+    try:
+        verbose = flag_value("autotune_verbose")
+    except Exception:
+        verbose = False
+    if verbose:
+        _LOG.warning("autotune paged %s -> %s (%s)", key, winner,
                      {k_: f"{v_ * 1e3:.2f}ms" for k_, v_ in timings.items()})
     _CACHE[key] = (winner, timings)
     return winner

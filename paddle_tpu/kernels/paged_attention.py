@@ -13,13 +13,24 @@ allocated/freed by the engine's host-side allocator as sequences join and
 retire, so B live sequences of wildly different lengths share one fixed-shape
 pool — the decode program never changes shape and never recompiles.
 
-This module is the JAX-native REFERENCE path: reads are a gather of each
-sequence's pages into a [B, Lmax] window followed by masked f32-softmax
-attention — exactly the math `GPTForCausalLM.fast_generate` uses on its dense
-cache, so paged decode is token-identical to it (tested). The functions are
-shaped so a Pallas kernel (double-buffered page DMA, one grid cell per
-(sequence, head)) can replace `paged_attention` without touching callers:
-everything it needs — pages, page table, lengths — is an explicit argument.
+`paged_attention` is a DISPATCH SWITCH over two implementations with one
+contract (token-identical output, enforced by parity tests):
+
+- **xla** — the JAX-native reference: gather each sequence's pages into a
+  [B, Lmax] window, masked f32-softmax attention. Correct everywhere, but
+  HBM traffic and FLOPs scale with the pool's capacity (`pages_per_slot`),
+  not the live lengths.
+- **pallas** — the authored ragged paged-attention kernel
+  (`kernels/pallas/paged_attention.py`): grid over (sequence, head),
+  double-buffered page DMA, page loop bounded by ``ceil((pos+1)/page_size)``
+  so traffic scales with each sequence's true length.
+
+``FLAGS_tpu_paged_impl`` picks: ``auto`` (measured winner per signature on
+real TPU via `kernels/autotune.py`, xla elsewhere — backend viability is
+decided by NAME, `kernels/pallas/_compat.py`), ``xla``, or ``pallas``
+(interpret mode off-TPU: parity tests only). The chosen implementation is
+counted per program build in ``paged_attention.impl.{xla|pallas}``
+(docs/OBSERVABILITY.md).
 
 Page 0 is RESERVED as the trash page: writes for inactive slots and
 prompt-padding positions are routed there instead of being predicated out
@@ -31,6 +42,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from paddle_tpu.observability import metrics
 
 # the reserved spill target for masked writes — never allocated to a sequence
 TRASH_PAGE = 0
@@ -51,20 +64,8 @@ def gather_kv(pages, page_table):
     return pages[page_table].reshape(b, maxp * ps, nh, dh)
 
 
-def paged_attention(q, k_pages, v_pages, page_table, pos):
-    """One decode step of attention over paged K/V for B sequences.
-
-    q          : [B, nh, dh] query for the CURRENT token of each sequence
-    k_pages    : [num_pages, page_size, nh, dh] (one layer)
-    v_pages    : [num_pages, page_size, nh, dh]
-    page_table : [B, pages_per_slot] int32
-    pos        : [B] int32 — position of the current token (already written
-                 to the cache); attends over positions 0..pos inclusive
-    returns    : [B, nh, dh] in q.dtype
-
-    Same numerics as the dense path (f32 scores, -1e30 mask, f32 softmax):
-    token-identical output is the contract, not an approximation.
-    """
+def _xla_paged_attention(q, k_pages, v_pages, page_table, pos):
+    """The gather + masked f32-softmax reference implementation."""
     dh = q.shape[-1]
     scale = 1.0 / (dh ** 0.5)
     k = gather_kv(k_pages, page_table)              # [B, Lmax, nh, dh]
@@ -79,16 +80,62 @@ def paged_attention(q, k_pages, v_pages, page_table, pos):
     return att.astype(q.dtype)
 
 
+def _impl_call(impl, q, k_pages, v_pages, page_table, pos):
+    """Execute one named implementation (also the autotuner's run_impl)."""
+    if impl == "pallas":
+        from paddle_tpu.kernels.pallas.paged_attention import (
+            paged_attention as pallas_paged)
+        return pallas_paged(q, k_pages, v_pages, page_table, pos)
+    return _xla_paged_attention(q, k_pages, v_pages, page_table, pos)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, pos):
+    """One decode step of attention over paged K/V for B sequences.
+
+    q          : [B, nh, dh] query for the CURRENT token of each sequence
+    k_pages    : [num_pages, page_size, nh, dh] (one layer)
+    v_pages    : [num_pages, page_size, nh, dh]
+    page_table : [B, pages_per_slot] int32
+    pos        : [B] int32 — position of the current token (already written
+                 to the cache); attends over positions 0..pos inclusive
+    returns    : [B, nh, dh] in q.dtype
+
+    Same numerics as the dense path (f32 scores, -1e30 mask, f32 softmax):
+    token-identical output is the contract, not an approximation. Dispatches
+    on ``FLAGS_tpu_paged_impl`` (module docstring); the selection runs at
+    trace time, so the winner string is baked into each compiled program and
+    the ``paged_attention.impl.*`` counters count program builds (once per
+    layer per trace), not steps.
+    """
+    try:
+        from paddle_tpu.framework.flags import flag_value
+        impl = flag_value("tpu_paged_impl")
+    except Exception:          # flags registry unavailable (early import)
+        impl = "xla"
+    if impl == "auto":
+        from paddle_tpu.kernels.autotune import paged_winner
+        impl = paged_winner(q.shape[0], page_table.shape[1],
+                            k_pages.shape[1], q.shape[1], q.shape[2],
+                            q.dtype, _impl_call)
+    metrics.counter(f"paged_attention.impl.{impl}").inc()
+    return _impl_call(impl, q, k_pages, v_pages, page_table, pos)
+
+
 def token_page_coords(page_table, pos, active, page_size):
     """(page, offset) for writing token ``pos`` of each of B sequences.
 
     page_table : [B, pages_per_slot] int32; pos : [B] int32; active : [B]
-    bool — inactive slots are routed to TRASH_PAGE. Returns ([B], [B]).
+    bool — inactive slots are routed to TRASH_PAGE, and so is any position
+    past the slot's capacity (``pos >= pages_per_slot * page_size``): a
+    clamped overflow would silently corrupt the LAST page's KV, which the
+    engine then attends over. Returns ([B], [B]).
     """
     maxp = page_table.shape[1]
-    idx = jnp.clip(pos // page_size, 0, maxp - 1)
-    page = jnp.take_along_axis(page_table, idx[:, None], axis=1)[:, 0]
-    page = jnp.where(active, page, TRASH_PAGE)
+    idx = pos // page_size
+    page = jnp.take_along_axis(page_table,
+                               jnp.clip(idx, 0, maxp - 1)[:, None],
+                               axis=1)[:, 0]
+    page = jnp.where(active & (idx < maxp), page, TRASH_PAGE)
     return page, pos % page_size
 
 
@@ -96,13 +143,15 @@ def prompt_page_coords(page_table, length, seq_len, page_size):
     """(page, offset) for writing positions 0..seq_len-1 of ONE sequence.
 
     page_table : [pages_per_slot] int32; length : scalar int32 true prompt
-    length (positions >= length — bucket padding — go to TRASH_PAGE).
-    Returns ([seq_len], [seq_len]).
+    length (positions >= length — bucket padding — go to TRASH_PAGE, as do
+    positions past the slot's capacity rather than corrupting the last
+    page). Returns ([seq_len], [seq_len]).
     """
     maxp = page_table.shape[0]
     t = jnp.arange(seq_len)
-    idx = jnp.clip(t // page_size, 0, maxp - 1)
-    page = jnp.where(t < length, page_table[idx], TRASH_PAGE)
+    idx = t // page_size
+    page = jnp.where((t < length) & (idx < maxp),
+                     page_table[jnp.clip(idx, 0, maxp - 1)], TRASH_PAGE)
     return page, t % page_size
 
 

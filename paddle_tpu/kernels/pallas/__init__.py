@@ -9,6 +9,10 @@ Kernels:
 - :mod:`flash_attention` — online-softmax attention forward
   (≈ `fused_attention_op.cu` but flash; the reference has NO flash kernel,
   SURVEY §5.7).
+- :mod:`paged_attention` — ragged paged-attention decode step (arxiv
+  2604.15464): grid over (sequence, head), double-buffered page DMA, page
+  loop bounded by each sequence's true length. The serving engine's hot
+  kernel (`FLAGS_tpu_paged_impl`).
 - :mod:`fused_layernorm` — single-pass layernorm fwd + analytic bwd
   (≈ `fused_layernorm` kernels in `phi/kernels/fusion/`).
 - :mod:`rotary` — fused rotary position embedding
@@ -20,3 +24,4 @@ through Mosaic.
 from paddle_tpu.kernels.pallas.flash_attention import flash_attention  # noqa: F401
 from paddle_tpu.kernels.pallas.fused_layernorm import fused_layer_norm  # noqa: F401
 from paddle_tpu.kernels.pallas.rotary import apply_rotary_emb  # noqa: F401
+from paddle_tpu.kernels.pallas import paged_attention as paged_attention  # noqa: F401,PLC0414

@@ -1,0 +1,171 @@
+"""Authored Pallas TPU ragged paged-attention decode kernel (arxiv 2604.15464).
+
+The XLA reference path (`kernels/paged_attention.py`) materializes the FULL
+padded ``[B, pages_per_slot * page_size, nh, dh]`` K and V windows per layer
+per step — HBM traffic and FLOPs scale with the pool's *capacity*, not the
+live sequences' lengths. This kernel is the drop-in the reference module was
+shaped for:
+
+- **grid over (sequence, head)** — one grid cell owns one (b, h) pair and
+  produces its ``[dh]`` context vector;
+- **pages streamed block-by-block** — the K/V pools stay in HBM
+  (``memory_space=ANY``); each cell DMAs one ``[page_size, dh]`` page slice
+  at a time into a double-buffered VMEM scratch (next page's DMA in flight
+  while the current page is on the MXU) and folds it into a running online
+  softmax (max, denom, accumulator);
+- **length-aware stop** — the page loop's trip count is
+  ``ceil((pos[b]+1) / page_size)``, read from the scalar-prefetched ``pos``,
+  so compute AND DMA traffic scale with each sequence's true length instead
+  of ``pages_per_slot``. A 1-token sequence in a 4096-token slot touches one
+  page, not 256.
+
+Numerics match the reference: f32 scores, f32 online softmax, masked tail
+positions excluded — parity with the XLA path is enforced by
+tests/test_paged_pallas.py in interpret mode on CPU; on TPU the kernel
+compiles through Mosaic. Selection between the two lives in
+`kernels/paged_attention.py` (``FLAGS_tpu_paged_impl``), backend viability
+decided by NAME in `kernels/pallas/_compat.py`, measured winners in
+`kernels/autotune.py`.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def pages_needed(pos, page_size):
+    """Trip count of the kernel's page loop for position ``pos`` — the
+    length-aware stop: ``ceil((pos + 1) / page_size)``, NOT pages_per_slot."""
+    return (pos + page_size) // page_size
+
+
+def _decode_kernel(pos_ref, pt_ref, q_ref, k_hbm, v_hbm, o_ref, *rest,
+                   page_size, scale):
+    # one grid cell per (sequence b, head h): q_ref [1, 1, dh] in VMEM,
+    # k_hbm/v_hbm the full [num_pages, page_size, nh, dh] pools in HBM,
+    # pos/page_table scalar-prefetched into SMEM. The visits output exists
+    # only under return_visits (parity tests) — the serving kernel is
+    # single-output.
+    if len(rest) == 4:
+        visits_ref, kbuf, vbuf, sem = rest
+    else:
+        visits_ref, (kbuf, vbuf, sem) = None, rest
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    pos = pos_ref[b]
+    npages = pages_needed(pos, page_size)
+    if visits_ref is not None:
+        visits_ref[0, 0] = npages      # the loop bound, exported for tests
+
+    def dma(slot, j):
+        # page j of sequence b: DMA this head's [page_size, dh] slice of the
+        # page from HBM into the double buffer
+        pg = pt_ref[b, j]
+        return (pltpu.make_async_copy(k_hbm.at[pg, :, h, :], kbuf.at[slot],
+                                      sem.at[0, slot]),
+                pltpu.make_async_copy(v_hbm.at[pg, :, h, :], vbuf.at[slot],
+                                      sem.at[1, slot]))
+
+    kd, vd = dma(0, 0)
+    kd.start()
+    vd.start()
+    q = q_ref[0, 0][None].astype(jnp.float32) * scale          # [1, dh]
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, jnp.int32(2))
+        nslot = jax.lax.rem(j + jnp.int32(1), jnp.int32(2))
+
+        @pl.when(j + jnp.int32(1) < npages)
+        def _():                       # overlap: next page's DMA in flight
+            kn, vn = dma(nslot, j + jnp.int32(1))
+            kn.start()
+            vn.start()
+
+        kw, vw = dma(slot, j)
+        kw.wait()
+        vw.wait()
+        k = kbuf[slot].astype(jnp.float32)                     # [ps, dh]
+        v = vbuf[slot].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [1, ps]
+        kpos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = jnp.where(kpos <= pos, s, NEG_INF)  # tail of the last page
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    dh = q_ref.shape[-1]
+    m0 = jnp.full((1, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((1, 1), jnp.float32)
+    a0 = jnp.zeros((1, dh), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, npages, body, (m0, l0, a0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30))[0].astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, pos, *, interpret=None,
+                    return_visits=False):
+    """One decode step of ragged paged attention. Same contract as the XLA
+    reference `kernels.paged_attention.paged_attention`:
+
+    q          : [B, nh, dh] current-token query
+    k_pages    : [num_pages, page_size, nh, dh] (one layer)
+    v_pages    : [num_pages, page_size, nh, dh]
+    page_table : [B, pages_per_slot] int32
+    pos        : [B] int32 — attends positions 0..pos inclusive
+    returns    : [B, nh, dh] in q.dtype; with ``return_visits=True`` also
+                 the per-(b, h) page-loop trip counts [B, nh] int32 — the
+                 ragged-stop proof the parity tests assert on.
+
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU (CPU
+    parity tests); on TPU the kernel compiles through Mosaic.
+    """
+    if interpret is None:
+        from paddle_tpu.kernels.pallas._compat import default_interpret
+        interpret = default_interpret()
+    b, nh, dh = q.shape
+    ps = k_pages.shape[1]
+    scale = 1.0 / (dh ** 0.5)
+    kern = functools.partial(_decode_kernel, page_size=ps, scale=float(scale))
+    out_specs = [pl.BlockSpec((1, 1, dh), lambda i, j, *_: (i, j, 0))]
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    if return_visits:
+        out_specs.append(pl.BlockSpec((1, 1), lambda i, j, *_: (i, j)))
+        out_shape.append(jax.ShapeDtypeStruct((b, nh), jnp.int32))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nh),
+        in_specs=[
+            pl.BlockSpec((1, 1, dh), lambda i, j, *_: (i, j, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),     # K pool stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),     # V pool stays in HBM
+        ],
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((2, ps, dh), k_pages.dtype),   # K double buffer
+            pltpu.VMEM((2, ps, dh), v_pages.dtype),   # V double buffer
+            pltpu.SemaphoreType.DMA((2, 2)),          # (k/v, buffer slot)
+        ],
+    )
+    outs = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=bool(interpret),
+    )(pos.astype(jnp.int32), page_table.astype(jnp.int32), q, k_pages,
+      v_pages)
+    if return_visits:
+        return outs[0], outs[1]
+    return outs[0]

@@ -218,6 +218,143 @@ class TestContinuousBatching:
         np.testing.assert_array_equal(out, ref[:len(out)])
 
 
+class TestPallasEngineParity:
+    """The whole serving stack on the authored Pallas kernel (interpret mode
+    on CPU): still token-identical to dense fast_generate."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_flag(self):
+        from paddle_tpu.framework.flags import set_flags
+        yield
+        set_flags({"tpu_paged_impl": "auto"})
+
+    def test_engine_on_pallas_matches_fast_generate(self):
+        from paddle_tpu.framework.flags import set_flags
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        set_flags({"tpu_paged_impl": "pallas"})
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8))
+        rng = np.random.RandomState(9)
+        prompts = [rng.randint(0, 97, s).astype(np.int32) for s in (5, 9)]
+        reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        eng.run_until_idle(max_steps=60)
+        set_flags({"tpu_paged_impl": "auto"})  # ref decodes on the default
+        for p, r in zip(prompts, reqs):
+            np.testing.assert_array_equal(r.result(timeout=30),
+                                          _fast_ref(m, p, 8))
+        assert metrics.counter("paged_attention.impl.pallas").value > 0
+
+    def test_flag_flip_compiles_new_decode_program(self):
+        """The impl is baked into the traced program, so the flag is part of
+        the engine's program-cache key: flipping it mid-life compiles a new
+        decode program instead of being silently ignored."""
+        from paddle_tpu.framework.flags import set_flags
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        set_flags({"tpu_paged_impl": "xla"})
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=1,
+                                           min_bucket=8))
+        rng = np.random.RandomState(14)
+        eng.submit(rng.randint(0, 97, 4).astype(np.int32), 3)
+        eng.run_until_idle(max_steps=20)
+        compiles = metrics.counter("engine.compile_count").value
+        pallas_before = metrics.counter("paged_attention.impl.pallas").value
+
+        set_flags({"tpu_paged_impl": "pallas"})
+        req = eng.submit(rng.randint(0, 97, 4).astype(np.int32), 3)
+        eng.run_until_idle(max_steps=20)
+        np.testing.assert_array_equal(req.result(timeout=30)[-3:],
+                                      _fast_ref(m, req.prompt, 3)[-3:])
+        # exactly ONE new program (the pallas decode step), and it fired
+        assert metrics.counter("engine.compile_count").value == compiles + 1
+        assert metrics.counter(
+            "paged_attention.impl.pallas").value > pallas_before
+
+
+class TestDesyncStepLoop:
+    """The de-synchronized hot path: ONE fused host->device upload per step,
+    no blocking readback besides sampled token ids (deferred by the
+    in-flight window), host/device timers populated."""
+
+    def test_one_upload_one_token_readback_per_step(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8, inflight=2))
+        h2d = metrics.counter("engine.h2d_transfers")
+        d2h = metrics.counter("engine.d2h_transfers")
+        steps = metrics.counter("engine.steps")
+        base = (h2d.value, d2h.value, steps.value)
+        rng = np.random.RandomState(10)
+        reqs = [eng.submit(rng.randint(0, 97, 5).astype(np.int32), 6)
+                for _ in range(2)]
+        eng.run_until_idle(max_steps=60)
+        for r in reqs:
+            assert r.done
+        n_steps = steps.value - base[2]
+        n_prefills = 2
+        # exactly one packed slot-state upload per decode step (+ one fused
+        # upload per prefill), and exactly one sampled-token readback per
+        # dispatched step (+ the prefill's first token) — nothing else
+        # crosses the transfer boundary in the loop
+        assert h2d.value - base[0] == n_steps + n_prefills
+        assert d2h.value - base[1] == n_steps + n_prefills
+
+    def test_readback_is_deferred_behind_inflight_window(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=1,
+                                           min_bucket=8, inflight=3))
+        prompt = np.random.RandomState(11).randint(0, 97, 4).astype(np.int32)
+        req = eng.submit(prompt, max_new_tokens=10)
+        eng.step()                    # prefill + dispatch #1
+        eng.step()                    # dispatch #2 — still nothing harvested
+        assert len(eng._inflight) == 2
+        assert len(req.generated) == 1          # only the prefill token yet
+        eng.step()                    # window full: oldest step harvested
+        assert len(eng._inflight) == 2
+        assert len(req.generated) == 2
+        eng.run_until_idle(max_steps=30)
+        np.testing.assert_array_equal(req.result(timeout=30),
+                                      _fast_ref(m, prompt, 10))
+
+    def test_host_device_timer_pair_visible_in_snapshot(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=1,
+                                           min_bucket=8))
+        host = metrics.histogram("engine.host_ms")
+        dev = metrics.histogram("engine.device_ms")
+        base = (host.count, dev.count)
+        req = eng.submit(np.random.RandomState(12).randint(0, 97, 4)
+                         .astype(np.int32), 4)
+        eng.run_until_idle(max_steps=30)
+        assert req.done
+        assert host.count > base[0] and dev.count > base[1]
+        snap = metrics.snapshot()["histograms"]
+        assert "engine.host_ms" in snap and "engine.device_ms" in snap
+
+    def test_capacity_guard_retires_instead_of_corrupting(self):
+        """Regression (overflow satellite): a sequence about to write past
+        pages_per_slot * page_size is retired with an error BEFORE the step
+        is scheduled — the trash-page spill on device is the backstop, not
+        the path."""
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=1,
+                                           min_bucket=8))
+        req = eng.submit(np.random.RandomState(13).randint(0, 97, 4)
+                         .astype(np.int32), 4)
+        eng.step()                              # placed + first decode step
+        eng._lengths[0] = eng.slot_capacity     # simulate runaway length
+        eng.run_until_idle(max_steps=20)
+        with pytest.raises(RuntimeError, match="slot capacity"):
+            req.result(timeout=5)
+        # pages reclaimed, slot reusable
+        assert eng.allocator.free_pages == eng.allocator.num_pages - 1
+
+
 class TestAbort:
     def test_abort_fails_queued_and_inflight_then_refuses_submits(self):
         """serve_loop's exit path: every outstanding request errors out

@@ -81,3 +81,39 @@ def test_new_bucket_compiles_exactly_once():
     eng.submit(rng.randint(0, 64, 6).astype(np.int32), 2)    # bucket 8 again
     eng.run_until_idle(max_steps=40)
     assert _compile_counters() == after_new
+
+
+def test_pallas_path_compiles_once_per_bucket():
+    """FLAGS_tpu_paged_impl=pallas must be exactly as shape-stable as the
+    XLA path: one decode program, one program per prefill bucket, and slot
+    churn after warmup never retraces the Pallas call."""
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    set_flags({"tpu_paged_impl": "pallas"})
+    try:
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8))
+        rng = np.random.RandomState(2)
+        eng.warmup(prompt_lens=[8])
+        r = eng.submit(rng.randint(0, 64, 5).astype(np.int32), 3)
+        eng.run_until_idle(max_steps=30)
+        assert r.done
+        frozen = _compile_counters()
+
+        reqs = [eng.submit(rng.randint(0, 64, 3 + i).astype(np.int32), 2 + i)
+                for i in range(2)]                   # churn both slots
+        eng.step()
+        late = eng.submit(rng.randint(0, 64, 7).astype(np.int32), 3)
+        eng.run_until_idle(max_steps=80)
+        for req in reqs + [late]:
+            assert req.done
+        assert _compile_counters() == frozen, (
+            "pallas paged decode recompiled after warmup")
+
+        eng.submit(rng.randint(0, 64, 12).astype(np.int32), 2)  # bucket 16
+        eng.run_until_idle(max_steps=30)
+        after_new = _compile_counters()
+        assert after_new[0] == frozen[0] + 1         # exactly ONE new program
+    finally:
+        set_flags({"tpu_paged_impl": "auto"})

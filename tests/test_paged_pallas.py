@@ -1,0 +1,220 @@
+"""Ragged paged-attention Pallas kernel: interpret-mode parity with the XLA
+reference on CPU, the length-aware page-loop stop, the dispatch switch
+(`FLAGS_tpu_paged_impl`), the autotune entry, and the overflow-to-trash
+coordinate fix.
+
+The load-bearing contracts:
+- pallas(interpret) == xla reference on every ragged shape (same f32 masked
+  softmax, so the engine's token-identical guarantee survives the kernel
+  swap);
+- the kernel's page-loop trip count is ``ceil((pos+1)/page_size)`` — it
+  scales with each sequence's TRUE length, never with ``pages_per_slot``;
+- positions past a slot's capacity route to TRASH_PAGE instead of silently
+  corrupting the last page.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels import paged_attention as pa
+from paddle_tpu.kernels.pallas import paged_attention as ppa
+from paddle_tpu.observability import metrics
+
+
+def _random_case(rng, b, nh, dh, ps, maxp, num_pages, pos):
+    """Distinct non-trash pages per (slot, page) so any wrong page read
+    shows up as a numeric mismatch, not a coincidence."""
+    q = jnp.asarray(rng.randn(b, nh, dh).astype(np.float32))
+    kp = jnp.asarray(rng.randn(num_pages, ps, nh, dh).astype(np.float32))
+    vp = jnp.asarray(rng.randn(num_pages, ps, nh, dh).astype(np.float32))
+    perm = 1 + rng.permutation(num_pages - 1)[:b * maxp]
+    pt = jnp.asarray(perm.reshape(b, maxp).astype(np.int32))
+    return q, kp, vp, pt, jnp.asarray(np.asarray(pos, np.int32))
+
+
+class TestPallasParity:
+    """pallas(interpret) vs the XLA reference, elementwise."""
+
+    def _check(self, b, nh, dh, ps, maxp, pos, seed=0):
+        rng = np.random.RandomState(seed)
+        num_pages = 1 + b * maxp
+        q, kp, vp, pt, pos = _random_case(rng, b, nh, dh, ps, maxp,
+                                          num_pages, pos)
+        want = pa._xla_paged_attention(q, kp, vp, pt, pos)
+        got, visits = ppa.paged_attention(q, kp, vp, pt, pos,
+                                          interpret=True, return_visits=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+        return np.asarray(visits)
+
+    def test_ragged_length_mix(self):
+        # lengths spanning 1 token .. full capacity across the batch
+        self._check(b=4, nh=2, dh=16, ps=4, maxp=5, pos=[0, 6, 13, 19])
+
+    def test_page_boundary_crossings(self):
+        # pos exactly at the last slot of a page and first of the next
+        self._check(b=4, nh=2, dh=16, ps=4, maxp=4, pos=[3, 4, 7, 8])
+
+    def test_single_token_batch(self):
+        self._check(b=3, nh=2, dh=8, ps=8, maxp=6, pos=[0, 0, 0])
+
+    def test_full_pool_batch(self):
+        # every sequence at capacity: the stop equals pages_per_slot
+        v = self._check(b=3, nh=2, dh=16, ps=4, maxp=3, pos=[11, 11, 11])
+        assert (v == 3).all()
+
+    def test_jit_composes(self):
+        # the engine calls the kernel from inside a jitted decode step
+        rng = np.random.RandomState(3)
+        q, kp, vp, pt, pos = _random_case(rng, 2, 2, 16, 4, 3, 7, [2, 9])
+        f = jax.jit(lambda *a: ppa.paged_attention(*a, interpret=True))
+        np.testing.assert_allclose(
+            np.asarray(f(q, kp, vp, pt, pos)),
+            np.asarray(pa._xla_paged_attention(q, kp, vp, pt, pos)),
+            rtol=1e-5, atol=1e-5)
+
+
+class TestLengthAwareStop:
+    """Compute/DMA scale with pos, not pages_per_slot — the ragged claim."""
+
+    def test_trip_count_tracks_pos_not_capacity(self):
+        rng = np.random.RandomState(1)
+        b, nh, dh, ps, maxp = 4, 2, 16, 4, 16        # 64-token slots
+        pos = [0, 5, 17, 63]
+        q, kp, vp, pt, posj = _random_case(rng, b, nh, dh, ps, maxp,
+                                           1 + b * maxp, pos)
+        _, visits = ppa.paged_attention(q, kp, vp, pt, posj, interpret=True,
+                                        return_visits=True)
+        visits = np.asarray(visits)
+        want = np.array([(p + ps) // ps for p in pos])   # ceil((pos+1)/ps)
+        for h in range(nh):
+            np.testing.assert_array_equal(visits[:, h], want)
+        # a 1-token sequence touches ONE page of its 16-page slot
+        assert visits[0, 0] == 1 and visits[0, 0] < maxp
+
+    def test_pages_needed_formula(self):
+        assert int(ppa.pages_needed(jnp.int32(0), 4)) == 1
+        assert int(ppa.pages_needed(jnp.int32(3), 4)) == 1
+        assert int(ppa.pages_needed(jnp.int32(4), 4)) == 2
+        assert int(ppa.pages_needed(jnp.int32(15), 4)) == 4
+
+
+class TestDispatchSwitch:
+    """FLAGS_tpu_paged_impl routing + the impl observability counter."""
+
+    @pytest.fixture(autouse=True)
+    def _restore_flag(self):
+        from paddle_tpu.framework.flags import set_flags
+        yield
+        set_flags({"tpu_paged_impl": "auto"})
+
+    def _case(self):
+        rng = np.random.RandomState(2)
+        return _random_case(rng, 2, 2, 8, 4, 3, 7, [2, 9])
+
+    def test_explicit_impls_agree(self):
+        from paddle_tpu.framework.flags import set_flags
+        q, kp, vp, pt, pos = self._case()
+        set_flags({"tpu_paged_impl": "xla"})
+        a = pa.paged_attention(q, kp, vp, pt, pos)
+        set_flags({"tpu_paged_impl": "pallas"})
+        b = pa.paged_attention(q, kp, vp, pt, pos)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_impl_counter_counts_dispatches(self):
+        from paddle_tpu.framework.flags import set_flags
+        q, kp, vp, pt, pos = self._case()
+        set_flags({"tpu_paged_impl": "xla"})
+        before = metrics.counter("paged_attention.impl.xla").value
+        pa.paged_attention(q, kp, vp, pt, pos)
+        assert metrics.counter("paged_attention.impl.xla").value == before + 1
+        set_flags({"tpu_paged_impl": "pallas"})
+        before_p = metrics.counter("paged_attention.impl.pallas").value
+        pa.paged_attention(q, kp, vp, pt, pos)
+        assert metrics.counter(
+            "paged_attention.impl.pallas").value == before_p + 1
+
+    def test_auto_pins_xla_off_tpu(self):
+        from paddle_tpu.framework.flags import set_flags
+        from paddle_tpu.kernels import autotune
+        autotune.clear_cache()
+        set_flags({"tpu_paged_impl": "auto"})
+        q, kp, vp, pt, pos = self._case()
+        before = metrics.counter("paged_attention.impl.xla").value
+        pa.paged_attention(q, kp, vp, pt, pos)
+        assert metrics.counter("paged_attention.impl.xla").value == before + 1
+        key = [k for k in autotune.cache_table() if k[0] == "paged"]
+        assert key and autotune.cache_table()[key[0]][0] == "xla"
+        autotune.clear_cache()
+
+
+class TestPagedAutotune:
+    def test_tpu_measures_both_candidates(self, monkeypatch):
+        from paddle_tpu.kernels import autotune
+        autotune.clear_cache()
+        monkeypatch.setattr(autotune, "_backend_kind", lambda: "tpu")
+        measured = []
+
+        def fake_measure(fn, args, warmup=1, reps=3):
+            measured.append(len(measured))
+            return [5.0, 1.0][len(measured) - 1]     # pallas wins
+
+        monkeypatch.setattr(autotune, "_measure", fake_measure)
+        w = autotune.paged_winner(2, 4, 4, 2, 8, jnp.float32,
+                                  lambda impl, *a: a[0])
+        assert w == "pallas"
+        assert len(measured) == 2        # both candidates timed
+        # cached: second lookup measures nothing
+        w2 = autotune.paged_winner(2, 4, 4, 2, 8, jnp.float32,
+                                   lambda *a: (_ for _ in ()).throw(
+                                       AssertionError("must not execute")))
+        assert w2 == "pallas"
+        autotune.clear_cache()
+
+    def test_cpu_pins_xla_without_measuring(self):
+        from paddle_tpu.kernels import autotune
+        autotune.clear_cache()
+        w = autotune.paged_winner(2, 4, 4, 2, 8, jnp.float32,
+                                  lambda *a: (_ for _ in ()).throw(
+                                      AssertionError("must not execute")))
+        assert w == "xla"
+        autotune.clear_cache()
+
+
+class TestOverflowToTrash:
+    """Regression: pos past the slot's capacity used to be CLIPPED into the
+    last page, silently corrupting its KV — it must spill to TRASH_PAGE."""
+
+    def test_token_coords_overflow_routes_to_trash(self):
+        ps, maxp = 4, 2                               # capacity 8 tokens
+        pt = jnp.asarray([[1, 2]], jnp.int32)
+        active = jnp.asarray([True])
+        page, off = pa.token_page_coords(pt, jnp.asarray([8], jnp.int32),
+                                         active, ps)
+        assert int(page[0]) == pa.TRASH_PAGE          # NOT page 2
+        # in-range positions still map normally
+        page, _ = pa.token_page_coords(pt, jnp.asarray([7], jnp.int32),
+                                       active, ps)
+        assert int(page[0]) == 2
+
+    def test_token_write_overflow_leaves_last_page_intact(self):
+        ps, maxp = 2, 2
+        kp = jnp.zeros((4, ps, 1, 4))
+        vp = jnp.zeros_like(kp)
+        k = jnp.ones((1, 1, 4))
+        pt = jnp.asarray([[1, 2]], jnp.int32)
+        kp2, _ = pa.write_token_kv(kp, vp, k, k, pt,
+                                   jnp.asarray([4], jnp.int32),   # capacity!
+                                   jnp.asarray([True]))
+        assert np.asarray(kp2)[pa.TRASH_PAGE].sum() == 4
+        assert np.asarray(kp2)[1:].sum() == 0         # page 2 NOT corrupted
+
+    def test_prompt_coords_overflow_routes_to_trash(self):
+        ps = 2
+        pt = jnp.asarray([1, 2], jnp.int32)           # capacity 4 tokens
+        page, _ = pa.prompt_page_coords(pt, jnp.int32(6), 6, ps)
+        assert np.asarray(page)[:4].tolist() == [1, 1, 2, 2]
+        assert (np.asarray(page)[4:] == pa.TRASH_PAGE).all()
