@@ -251,6 +251,57 @@ def bench_bert():
     return batch / dt, dt, loss
 
 
+def bench_train_step():
+    """Scan-over-layers donated train step rung (paddle_tpu/train).
+
+    Three claims, three measurements:
+    - compile wall is ~O(1) in depth: the 4-layer and 12-layer captures
+      should compile within ~1.5x of each other (the unrolled trace grew
+      ~linearly, ~3x);
+    - steady tok/s of the fused program (scan fwd/bwd + 2 microbatches +
+      AdamW apply, params+opt state donated);
+    - per-replica optimizer-state bytes with vs without ZeRO-1 (equal on a
+      single chip where dp=1; the multichip dryrun rung asserts the ~1/dp
+      drop on a real dp axis).
+    """
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.train import ScanTrainStep
+
+    on_cpu = jax.default_backend() == "cpu"
+    batch, seq = (4, 128) if on_cpu else (16, 1024)
+    hs, nh, im, vocab = (256, 4, 1024, 8192) if on_cpu else \
+        (768, 12, 3072, 50304)
+    rng = np.random.RandomState(0)
+    out = {}
+    for nl in (4, 12):
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=vocab, hidden_size=hs, num_layers=nl,
+                        num_heads=nh, intermediate_size=im,
+                        max_position_embeddings=seq, hidden_dropout=0.0,
+                        attention_dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        step = ScanTrainStep(model, opt, microbatches=2)
+        ids = rng.randint(0, vocab, (batch, seq + 1))
+        x = ids[:, :-1].astype(np.int32)
+        y = ids[:, 1:].astype(np.int64)
+        t0 = time.perf_counter()
+        step.step(x, y)                          # compile + step 1
+        first = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        loss = step.step(x, y)                   # steady
+        steady = time.perf_counter() - t0
+        assert step.compile_count == 1, step.compile_count
+        out[nl] = dict(compile_s=max(first - steady, 1e-9), step_s=steady,
+                       tokens_per_s=batch * seq / steady, loss=loss,
+                       opt_state_bytes=step.opt_state_bytes())
+    ratio = out[12]["compile_s"] / out[4]["compile_s"]
+    return out, ratio
+
+
 def bench_decode():
     """Autoregressive decode rung: GPT-2s fast_generate (single compiled
     program: static KV cache + lax.scan; see models/gpt.py). B=8 prompts
@@ -538,6 +589,23 @@ def bench_smoke():
     dt = time.perf_counter() - t0
     assert np.isfinite(loss0) and np.isfinite(loss1), (loss0, loss1)
 
+    # one scanned microbatched donated train step (paddle_tpu/train): tier-1
+    # exercises the scan-over-layers program shape — stacked [nl, ...]
+    # leaves, grad accumulation over 2 microbatches, fused AdamW apply,
+    # params+opt-state donation
+    from paddle_tpu.train import ScanTrainStep
+    paddle.seed(0)
+    smodel = GPTForCausalLM(cfg)
+    sopt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=smodel.parameters())
+    scan_step = ScanTrainStep(smodel, sopt, microbatches=2)
+    scan_loss = scan_step.step(ids[:, :-1].astype(np.int32),
+                               ids[:, 1:].astype(np.int64))
+    assert np.isfinite(scan_loss), scan_loss
+    assert scan_step.compile_count == 1
+    snap_mb = metrics.snapshot()["counters"].get("train.microbatches", 0)
+    assert snap_mb >= 2, "scan step did not report train.microbatches"
+
     # one batched-engine decode on the same tiny model: keeps the decode
     # engine (paged KV cache + bucketed prefill, inference/engine.py)
     # import- and execution-clean under tier-1, and exercises the
@@ -603,6 +671,9 @@ def main(argv=None):
                    "unit": "s", "ok": True, "platform": platform,
                    "backend_error": backend_error,
                    "paged_impl": max(impls, key=impls.get) if impls else None,
+                   "scan_train_steps": snap["counters"].get("train.steps", 0),
+                   "scan_train_microbatches": snap["counters"].get(
+                       "train.microbatches", 0),
                    "tokens_per_sec": round(tps, 1),
                    "compile_count": snap["counters"].get(
                        "jit.compile_count", 0),
@@ -656,6 +727,27 @@ def main(argv=None):
     except Exception as e:
         print(f"# decode rung failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        tr, ratio = _retry(bench_train_step)
+        _emit({"metric": "train_step_tokens_per_sec",
+               "value": round(tr[12]["tokens_per_s"], 1), "unit": "tokens/s",
+               "ok": True, "platform": platform,
+               "compile_s": {str(nl): round(v["compile_s"], 3)
+                             for nl, v in tr.items()},
+               "compile_ratio_12v4": round(ratio, 3),
+               "step_s": {str(nl): round(v["step_s"], 4)
+                          for nl, v in tr.items()},
+               "opt_state_bytes": tr[12]["opt_state_bytes"],
+               "microbatches": 2})
+        print(f"# train_step scan-over-layers: compile 4L="
+              f"{tr[4]['compile_s']:.2f}s 12L={tr[12]['compile_s']:.2f}s "
+              f"(ratio {ratio:.2f}x, unrolled trace was ~3x), "
+              f"steady 12L tok/s={tr[12]['tokens_per_s']:.0f}",
+              file=sys.stderr)
+    except Exception as e:
+        _emit({"metric": "train_step_tokens_per_sec", "value": 0.0,
+               "unit": "tokens/s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
     try:
         eng_tps, seq_tps = _retry(bench_engine_decode)
         print(f"# gpt2s_engine_decode 8x(128+64): engine={eng_tps:.0f} tok/s "

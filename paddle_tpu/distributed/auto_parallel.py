@@ -36,6 +36,19 @@ class Strategy:
         self.amp = type("amp", (), {"enable": False, "level": "O2",
                                     "dtype": "bfloat16"})()
         self.recompute = type("rc", (), {"enable": False})()
+        # gradient-accumulation microbatching (ref strategy.gradient_merge):
+        # k_steps loader batches fold into ONE optimizer apply inside the
+        # fused scanned step (grads accumulate in f32 on device)
+        self.gradient_merge = type("gm", (), {"enable": False,
+                                              "k_steps": 1})()
+        # ZeRO-1 (ref strategy.sharding stage 1): optimizer state sharded
+        # over the dp axis inside the captured step. enable=False still
+        # AUTO-shards when the mesh has dp > 1; set stage=0 to force off.
+        self.sharding = type("sh", (), {"enable": False, "stage": 1})()
+        # scanned-layer-stack fused train step: "auto" routes GPT models
+        # through paddle_tpu.train.ScanTrainStep (O(1)-in-depth compile,
+        # donated buffers); False always uses the unrolled capture
+        self.fused_scan = "auto"
 
 
 def estimate_step_cost(n_params, dp, mp, n_layers=None, hidden=None,
@@ -126,6 +139,7 @@ class Engine:
         self._mesh = None
         self._train_step = None
         self._eval_step = None
+        self._scan_step = None     # ScanTrainStep when the fused route took
         self._history = []
 
     # ------------------------------------------------------------------ plan
@@ -144,23 +158,98 @@ class Engine:
             self._mesh = auto_mesh(**shape)
         model, loss, opt = self._model, self._loss, self._optimizer
 
-        @paddle.jit.to_static
-        def train_step(x, y):
-            out = model(x)
-            l = loss(out, y)
-            l.backward()
-            opt.step()
-            opt.clear_grad()
-            return l
+        if self._try_scan_capture():
+            # fused scanned train step captured; train_step stays None and
+            # fit() routes through self._scan_step
+            pass
+        else:
+            @paddle.jit.to_static
+            def train_step(x, y):
+                out = model(x)
+                l = loss(out, y)
+                l.backward()
+                opt.step()
+                opt.clear_grad()
+                return l
 
-        @paddle.jit.to_static
-        def eval_step(x, y):
-            out = model(x)
-            return loss(out, y)
+            self._train_step = train_step
 
-        self._train_step = train_step
+        if loss is None and self._scan_step is not None:
+            # fused GPT route without an external loss fn: eval on the
+            # model's OWN causal-LM loss (the objective the step trains)
+            @paddle.jit.to_static
+            def eval_step(x, y):
+                _, l = model(x, labels=y)
+                return l
+        else:
+            @paddle.jit.to_static
+            def eval_step(x, y):
+                out = model(x)
+                return loss(out, y)
+
         self._eval_step = eval_step
         return self
+
+    def _try_scan_capture(self):
+        """Route GPT models through the scan-over-layers donated train step
+        (paddle_tpu.train.ScanTrainStep): O(1)-in-depth compile, gradient
+        merge microbatching, ZeRO-1 over dp. Falls back to the unrolled
+        to_static capture whenever the (model, loss, optimizer) trio is
+        outside the fused step's envelope."""
+        s = self._strategy
+        if getattr(s, "fused_scan", "auto") is False or \
+                self._optimizer is None:
+            return False
+        from paddle_tpu.models.gpt import GPTForCausalLM
+        import paddle_tpu.nn as nn
+        if not isinstance(self._model, GPTForCausalLM):
+            return False
+        # the fused step computes the model's own causal-LM CE (plain mean
+        # token CE); only take the route when the engine loss IS that exact
+        # function — a default-configured CrossEntropyLoss. Any non-default
+        # knob (class weights, reduction, smoothing, custom ignore_index)
+        # would be silently dropped, so those fall back to the unrolled
+        # capture. ignore_index=-100 is inert for valid token ids.
+        if self._loss is not None:
+            l = self._loss
+            if not isinstance(l, nn.CrossEntropyLoss):
+                return False
+            if (l.weight is not None or l.reduction != "mean"
+                    or l.soft_label or l.label_smoothing
+                    or not l.use_softmax or l.axis != -1
+                    or l.ignore_index != -100):
+                return False
+        gm = getattr(s, "gradient_merge", None)
+        k = int(getattr(gm, "k_steps", 1) or 1) if gm is not None and \
+            getattr(gm, "enable", False) else 1
+        sh = getattr(s, "sharding", None)
+        if sh is not None and getattr(sh, "enable", False):
+            zero1 = getattr(sh, "stage", 1) >= 1
+        elif sh is not None and getattr(sh, "stage", 1) == 0:
+            zero1 = False
+        else:
+            zero1 = "auto"
+        try:
+            from paddle_tpu.train import ScanTrainStep, ScanUnsupported
+        except ImportError:
+            return False
+        try:
+            self._scan_step = ScanTrainStep(
+                self._model, self._optimizer, microbatches=k, zero1=zero1,
+                mesh=self._mesh)
+        except ScanUnsupported:
+            return False
+        from paddle_tpu.observability import metrics
+        metrics.counter("train.scan_route").inc()
+        return True
+
+    @property
+    def train_step_kind(self):
+        return "scan" if self._scan_step is not None else "unrolled"
+
+    def _sync_scan(self):
+        if self._scan_step is not None and self._scan_step.dirty:
+            self._scan_step.sync_to_model()
 
     def _place(self, arr):
         a = arr._data if hasattr(arr, "_data") else np.asarray(arr)
@@ -175,27 +264,55 @@ class Engine:
 
     def fit(self, train_data, epochs=1, steps_per_epoch=None, log_freq=10,
             valid_data=None):
-        if self._train_step is None:
+        if self._train_step is None and self._scan_step is None:
             self.prepare()
         history = []
+        # gradient merge: k_steps LOADER batches fold into one optimizer
+        # apply (the reference strategy semantics) — buffer, concatenate,
+        # and let the fused step scan over them as microbatches
+        merge_k = self._scan_step.microbatches if self._scan_step else 1
         for epoch in range(epochs):
             losses = []
+            buf = []
             for step, batch in enumerate(train_data):
                 if steps_per_epoch is not None and step >= steps_per_epoch:
                     break
                 x, y = batch[0], batch[1]
-                l = self._train_step(self._place(x), self._place(y))
-                losses.append(float(l))
+                if self._scan_step is not None:
+                    buf.append((self._place(x), self._place(y)))
+                    if len(buf) == merge_k:
+                        losses.append(self._apply_scan(buf))
+                        buf = []
+                else:
+                    l = self._train_step(self._place(x), self._place(y))
+                    losses.append(float(l))
+            if buf:
+                # partial accumulation group at epoch end
+                losses.append(self._apply_scan(buf))
             entry = {"epoch": epoch, "loss": float(np.mean(losses))}
             if valid_data is not None:
                 entry["val_loss"] = self.evaluate(valid_data)["loss"]
             history.append(entry)
+        self._sync_scan()    # model/optimizer state_dict see trained values
         self._history = history
         return history
+
+    def _apply_scan(self, buf):
+        """One fused step over the buffered (x, y) loader batches. Equal
+        batch sizes scan as microbatches; a ragged group (short final
+        loader batch) runs as ONE microbatch — still a single optimizer
+        apply over all its tokens."""
+        import jax.numpy as jnp
+        xs = jnp.concatenate([x._data for x, _ in buf])
+        ys = jnp.concatenate([y._data for _, y in buf])
+        sizes = {x._data.shape[0] for x, _ in buf}
+        m = len(buf) if len(sizes) == 1 else 1
+        return self._scan_step.step(xs, ys, microbatches=m)
 
     def evaluate(self, eval_data, steps=None):
         if self._eval_step is None:
             self.prepare()
+        self._sync_scan()
         losses = []
         for step, batch in enumerate(eval_data):
             if steps is not None and step >= steps:
@@ -206,6 +323,7 @@ class Engine:
         return {"loss": float(np.mean(losses))}
 
     def predict(self, test_data, steps=None):
+        self._sync_scan()
         outs = []
         for step, batch in enumerate(test_data):
             if steps is not None and step >= steps:
@@ -218,6 +336,7 @@ class Engine:
     # ------------------------------------------------------------------ ckpt
 
     def save(self, path):
+        self._sync_scan()
         from paddle_tpu.distributed.checkpoint import save_sharded
         save_sharded({"model": self._model.state_dict(),
                       "optimizer": self._optimizer.state_dict()
@@ -234,4 +353,6 @@ class Engine:
                       if k.startswith("optimizer/")}
             if opt_sd:
                 self._optimizer.set_state_dict(opt_sd)
+        if self._scan_step is not None:
+            self._scan_step.refresh_from_model()
         return self

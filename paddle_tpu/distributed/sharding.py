@@ -34,6 +34,29 @@ def _place(t: Tensor, sharding):
         t._write(jax.device_put(t._data, sharding))
 
 
+def zero1_partition_spec(shape, mesh, axis="dp", base_spec=None):
+    """ZeRO-1 placement for ONE optimizer-state leaf (arxiv 2004.13336:
+    shard the weight-update/optimizer-state over the data-parallel axis).
+
+    Picks the LARGEST dim that the param's own sharding (``base_spec`` —
+    its mp/sp placement, which moments must mirror) leaves unsharded and
+    that divides by the axis size, and assigns ``axis`` to it, so an
+    mp-sharded weight gets dp x mp - sharded moments. Returns None when no
+    dim qualifies or the axis has size 1 (replicate: nothing to win)."""
+    size = mesh.shape.get(axis, 1) if mesh is not None else 1
+    if size <= 1 or not shape:
+        return None
+    base = list(base_spec) if base_spec is not None else []
+    base = base + [None] * (len(shape) - len(base))
+    cands = [d for d in range(len(shape))
+             if base[d] is None and shape[d] % size == 0 and shape[d] >= size]
+    if not cands:
+        return None
+    d = max(cands, key=lambda i: shape[i])
+    base[d] = axis
+    return PartitionSpec(*base)
+
+
 def shard_optimizer_states(optimizer, mesh=None, axis="dp"):
     """Stage-1/2: lay optimizer accumulators out sharded over the data axis."""
     mesh = mesh or get_mesh()

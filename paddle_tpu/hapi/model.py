@@ -17,6 +17,8 @@ class Model:
         self._loss = None
         self._metrics = []
         self.stop_training = False
+        self._fused_step = None        # ScanTrainStep when the GPT route took
+        self._fused_stale = False      # eager updates happened since capture
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
@@ -36,13 +38,20 @@ class Model:
             return self._loss(outputs, *labels)
         return self._loss(outputs, labels)
 
-    def train_batch(self, inputs, labels=None, update=True):
+    def train_batch(self, inputs, labels=None, update=True, loss_divisor=1):
+        """One eager train step. ``update=False`` leaves the accumulated
+        grads in place (gradient accumulation across calls); pass the
+        accumulation count as ``loss_divisor`` so the effective gradient is
+        the mean over the k batches, like one k-times-larger batch. The
+        reported loss is always the UNdivided per-batch loss."""
+        self._sync_fused()
+        self._fused_stale = True       # eager update: fused state goes stale
         self.network.train()
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
         outputs = self.network(*inputs)
         loss = self._compute_loss(outputs, labels)
-        loss.backward()
+        (loss / float(loss_divisor) if loss_divisor != 1 else loss).backward()
         if update:
             self._optimizer.step()
             self._optimizer.clear_grad()
@@ -53,13 +62,83 @@ class Model:
         return ([float(loss.numpy())], metrics) if metrics else \
             [float(loss.numpy())]
 
+    # ---------------------------------------------- fused scanned GPT route
+
+    def _network_computes_loss(self):
+        """Networks whose forward(input, labels=...) returns (out, loss) —
+        today the GPT causal-LM family — evaluate on their OWN loss when no
+        loss fn was prepared."""
+        try:
+            from paddle_tpu.models.gpt import GPTForCausalLM
+        except ImportError:
+            return False
+        return isinstance(self.network, GPTForCausalLM)
+
+    def _maybe_fused_step(self, k):
+        """A ScanTrainStep when (network, loss, optimizer) fit its envelope:
+        a GPTForCausalLM whose OWN causal-LM loss is the objective (loss
+        fn None), no streaming metrics (they need logits the fused step
+        never materializes). k loader batches concatenate into one donated
+        device program (scan over microbatches, single optimizer apply)."""
+        if self._loss is not None or self._metrics or self._optimizer is None:
+            return None
+        try:
+            from paddle_tpu.models.gpt import GPTForCausalLM
+            from paddle_tpu.train import ScanTrainStep, ScanUnsupported
+        except ImportError:
+            return None
+        if not isinstance(self.network, GPTForCausalLM):
+            return None
+        if self._fused_step is not None:
+            if self._fused_step.microbatches != k:
+                self._sync_fused()
+                self._fused_step = None
+            elif self._fused_stale:
+                self._fused_step.refresh_from_model()
+                self._fused_stale = False
+        if self._fused_step is None:
+            try:
+                self._fused_step = ScanTrainStep(
+                    self.network, self._optimizer, microbatches=k)
+                self._fused_stale = False
+            except ScanUnsupported:
+                return None
+        return self._fused_step
+
+    def _sync_fused(self):
+        if self._fused_step is not None and self._fused_step.dirty:
+            self._fused_step.sync_to_model()
+
+    def _fused_apply(self, fused, buf):
+        """Run one fused step over the buffered loader batches. Equal
+        batch sizes scan as microbatches; a ragged group (drop_last=False
+        short final batch) runs as ONE microbatch — still a single
+        optimizer apply over all its tokens."""
+        arrs = [(np.asarray(ins[0].numpy() if isinstance(ins[0], Tensor)
+                            else ins[0]),
+                 np.asarray(lab.numpy() if isinstance(lab, Tensor)
+                            else lab)) for ins, lab in buf]
+        xs = np.concatenate([a for a, _ in arrs])
+        ys = np.concatenate([b for _, b in arrs])
+        sizes = {a.shape[0] for a, _ in arrs}
+        m = len(buf) if len(sizes) == 1 else 1
+        return fused.step(xs, ys, microbatches=m)
+
     @no_grad()
     def eval_batch(self, inputs, labels=None):
+        self._sync_fused()
         self.network.eval()
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
-        outputs = self.network(*inputs)
-        loss = self._compute_loss(outputs, labels)
+        if self._loss is None and labels is not None and \
+                self._network_computes_loss():
+            lab = labels[0] if isinstance(labels, (list, tuple)) else labels
+            if not isinstance(lab, Tensor):
+                lab = Tensor(np.asarray(lab), _internal=True)
+            outputs, loss = self.network(*inputs, labels=lab)
+        else:
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
         metrics = []
         for m in self._metrics:
             m.update(m.compute(outputs, labels))
@@ -69,6 +148,7 @@ class Model:
 
     @no_grad()
     def predict_batch(self, inputs):
+        self._sync_fused()
         self.network.eval()
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
@@ -98,6 +178,8 @@ class Model:
                                 log_freq=log_freq, save_freq=save_freq,
                                 save_dir=save_dir, verbose=verbose,
                                 metrics=["loss"] + self._metric_names())
+        k = max(1, int(accumulate_grad_batches or 1))
+        fused = self._maybe_fused_step(k) if k >= 1 else None
         cbks.on_begin("train")
         for epoch in range(epochs):
             if self.stop_training:
@@ -106,14 +188,50 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
+            buf, pending, last_loss = [], 0, None
             for step, batch in enumerate(train_loader):
                 if num_iters is not None and step >= num_iters:
                     break
                 cbks.on_batch_begin("train", step, logs)
                 ins, labels = self._split_batch(batch)
-                result = self.train_batch(ins, labels)
-                logs = self._result_to_logs(result, step, batch_size)
+                if fused is not None:
+                    buf.append((ins, labels))
+                    if len(buf) == k:
+                        last_loss = self._fused_apply(fused, buf)
+                        buf = []
+                    # before the first apply there IS no loss yet: omit the
+                    # key rather than poison callbacks with NaN
+                    logs = (self._result_to_logs([last_loss], step,
+                                                 batch_size)
+                            if last_loss is not None
+                            else {"step": step, "batch_size": batch_size})
+                else:
+                    update = k == 1 or (step + 1) % k == 0
+                    pending = 0 if update else pending + 1
+                    result = self.train_batch(ins, labels, update=update,
+                                              loss_divisor=k)
+                    logs = self._result_to_logs(result, step, batch_size)
                 cbks.on_batch_end("train", step, logs)
+            if fused is not None and buf:
+                # leftover partial accumulation group at epoch end
+                last_loss = self._fused_apply(fused, buf)
+                logs["loss"] = last_loss
+            elif pending:
+                # flush generic-path leftover grads: they accumulated as
+                # sum(g_i)/k over only `pending` batches — rescale to the
+                # mean over the partial group (k/pending) so the final
+                # update is not silently undersized
+                scale = float(k) / float(pending)
+                with no_grad():
+                    for p in self._optimizer._parameter_list:
+                        g = p.grad
+                        if g is not None and hasattr(g, "_data"):
+                            g._write(g._data * scale)
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+                pending = 0
+            if fused is not None:
+                self._sync_fused()   # state_dict/parameters see the epoch
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self._run_eval(eval_loader, batch_size)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
@@ -198,6 +316,7 @@ class Model:
 
     def save(self, path, training=True):
         from paddle_tpu.framework import io as fio
+        self._sync_fused()
         fio.save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             fio.save(self._optimizer.state_dict(), path + ".pdopt")
@@ -210,6 +329,12 @@ class Model:
         if not reset_optimizer and self._optimizer is not None and \
                 os.path.exists(opt_path):
             self._optimizer.set_state_dict(fio.load(opt_path))
+        if self._fused_step is not None:
+            # re-pull the loaded state NOW (refresh also clears the dirty
+            # flag — a later _sync_fused must not write pre-load weights
+            # back over the checkpoint we just loaded)
+            self._fused_step.refresh_from_model()
+        self._fused_stale = False
 
     def parameters(self, *args, **kwargs):
         return self.network.parameters()

@@ -237,6 +237,237 @@ def _sp_constrain(x, cfg):
     return _constrain(x, PartitionSpec("dp", "sp", None))
 
 
+# --------------------------------------------------------------------------
+# Scanned layer stack (training hot path).
+#
+# The Layer-based forward above unrolls all `nl` blocks into the traced
+# graph, so XLA compile wall grows linearly with depth — the 8-device CPU
+# dryrun times out before producing a step. Here the block weights live as
+# STACKED [nl, ...] pytree leaves and the forward is ONE `jax.lax.scan`
+# over them: the block body is traced/compiled once regardless of nl, so
+# compile time is O(1) in depth. The `recompute`/`recompute_granularity`
+# knobs map onto scan-level `jax.checkpoint` policies (full-block remat /
+# save-everything-except the tagged MLP intermediates). Converters keep the
+# per-layer state_dict layout as the checkpoint + decode/serving truth.
+
+BLOCK_SUFFIXES = (
+    "ln_1.weight", "ln_1.bias",
+    "attn.qkv_proj.weight", "attn.qkv_proj.bias",
+    "attn.out_proj.weight", "attn.out_proj.bias",
+    "ln_2.weight", "ln_2.bias",
+    "mlp.fc_in.weight", "mlp.fc_in.bias",
+    "mlp.fc_out.weight", "mlp.fc_out.bias",
+)
+
+_BLOCK_PREFIX = "gpt.h."
+
+
+def _leaf_array(v):
+    return v._data if hasattr(v, "_data") else jnp.asarray(v)
+
+
+def stacked_num_layers(params):
+    """Number of per-layer blocks present in a state_dict-layout dict."""
+    idx = [int(k[len(_BLOCK_PREFIX):].split(".", 1)[0]) for k in params
+           if k.startswith(_BLOCK_PREFIX)]
+    if not idx:
+        raise ValueError("no gpt.h.<i>.* leaves: not a GPT state dict")
+    return 1 + max(idx)
+
+
+def stack_gpt_params(params, mesh=None):
+    """state_dict layout {name: array} -> {"blocks": {suffix: [nl, ...]},
+    "top": {name: array}}.
+
+    Per-leaf `mp`/`sp` shardings survive the restack: a layer weight placed
+    as NamedSharding(mesh, spec) comes out as the stacked leaf sharded
+    PartitionSpec(None, *spec) — the layer axis is never split, so each
+    scan slice carries exactly the old per-layer placement and GSPMD
+    inserts the same collectives it did for the unrolled graph."""
+    from jax.sharding import NamedSharding
+    arrs = {k: _leaf_array(v) for k, v in params.items()}
+    nl = stacked_num_layers(arrs)
+    blocks, top = {}, {}
+    for suffix in BLOCK_SUFFIXES:
+        leaves = [arrs[f"{_BLOCK_PREFIX}{i}.{suffix}"] for i in range(nl)]
+        stacked = jnp.stack(leaves)
+        sh = getattr(leaves[0], "sharding", None)
+        if isinstance(sh, NamedSharding) and any(
+                s is not None for s in sh.spec):
+            stacked = jax.device_put(
+                stacked, NamedSharding(mesh or sh.mesh,
+                                       PartitionSpec(None, *sh.spec)))
+        blocks[suffix] = stacked
+    for k, v in arrs.items():
+        if not k.startswith(_BLOCK_PREFIX):
+            top[k] = v
+    return {"blocks": blocks, "top": top}
+
+
+def unstack_gpt_params(stacked):
+    """Inverse of :func:`stack_gpt_params`: back to the per-layer
+    state_dict layout (checkpoints, decode paths, Layer parameters)."""
+    out = dict(stacked["top"])
+    nl = next(iter(stacked["blocks"].values())).shape[0]
+    for suffix, leaf in stacked["blocks"].items():
+        for i in range(nl):
+            out[f"{_BLOCK_PREFIX}{i}.{suffix}"] = leaf[i]
+    return out
+
+
+def _scan_remat_wrapper(cfg):
+    """Map the model's recompute knobs onto a scan-level jax.checkpoint
+    policy applied to the per-layer body:
+
+    - ``recompute=True``            -> full-block remat (save only carries)
+    - ``recompute_granularity="mlp"``    -> recompute ln_2 + the [N, 4H]
+      up-projection in bwd (their activations are tagged and excluded from
+      the saveable set)
+    - ``recompute_granularity="mlp_up"`` -> recompute only up-proj+gelu
+    - otherwise                      -> no remat (XLA keeps all residuals)
+    """
+    from jax.ad_checkpoint import checkpoint as _ckpt
+    if cfg.recompute:
+        return lambda body: _ckpt(body, prevent_cse=False)
+    gran = cfg.recompute_granularity
+    if gran in ("mlp", "mlp_up"):
+        pol = getattr(jax.checkpoint_policies,
+                      "save_anything_except_these_names", None)
+        if pol is None:  # very old jax: degrade to full-block remat
+            return lambda body: _ckpt(body, prevent_cse=False)
+        names = ("mlp_up",) if gran == "mlp_up" else ("mlp_up", "mlp_ln")
+        return lambda body: _ckpt(body, policy=pol(*names),
+                                  prevent_cse=False)
+    return lambda body: body
+
+
+def _fdropout(x, key, p):
+    """upscale_in_train dropout on a raw array (paddle nn.Dropout default)."""
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+
+
+def _scan_attend(cfg):
+    """Training attention for the scan body on [B, S, nh, dh] q/k/v."""
+    if cfg.use_flash:
+        from paddle_tpu.kernels.flash_attention import flash_attention_fn
+        return flash_attention_fn(causal=True)
+    dh = cfg.hidden_size // cfg.num_heads
+    scale = 1.0 / (dh ** 0.5)
+
+    def dense(q, k, v):
+        s = q.shape[1]
+        cmask = jnp.tril(jnp.ones((s, s), bool))
+        return _causal_attend(scale, cmask, q.dtype)(None, q, k, v)
+
+    return dense
+
+
+def scan_blocks(blocks, x, cfg, *, training=False, dropout_keys=None):
+    """All nl transformer blocks over x as ONE lax.scan over the stacked
+    leaves. `dropout_keys` is a [nl, 2] key array (attn-residual, mlp) when
+    training with hidden_dropout > 0, else None."""
+    from jax.ad_checkpoint import checkpoint_name
+    nh = cfg.num_heads
+    dh = cfg.hidden_size // nh
+    mesh = get_mesh()
+    attend = _scan_attend(cfg)
+    p_drop = float(cfg.hidden_dropout) if training else 0.0
+
+    def body(h, per_layer):
+        lp, keys = per_layer if p_drop else (per_layer, None)
+        lead = h.shape[:-1]
+        hn = _ln_ref(h, lp["ln_1.weight"], lp["ln_1.bias"])
+        qkv = hn @ lp["attn.qkv_proj.weight"] + lp["attn.qkv_proj.bias"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        att = attend(q.reshape(*lead, nh, dh), k.reshape(*lead, nh, dh),
+                     v.reshape(*lead, nh, dh))
+        att = att.reshape(*lead, nh * dh)
+        att = att @ lp["attn.out_proj.weight"] + lp["attn.out_proj.bias"]
+        if p_drop:
+            att = _fdropout(att, keys[0], p_drop)
+        h = h + att
+        hn = _ln_ref(h, lp["ln_2.weight"], lp["ln_2.bias"])
+        hn = checkpoint_name(hn, "mlp_ln")
+        up = jax.nn.gelu(hn @ lp["mlp.fc_in.weight"] + lp["mlp.fc_in.bias"],
+                         approximate=True)
+        up = checkpoint_name(up, "mlp_up")
+        m = up @ lp["mlp.fc_out.weight"] + lp["mlp.fc_out.bias"]
+        if p_drop:
+            m = _fdropout(m, keys[1], p_drop)
+        h = h + m
+        if cfg.seq_parallel and mesh is not None:
+            from jax.sharding import NamedSharding
+            h = jax.lax.with_sharding_constraint(
+                h, NamedSharding(mesh, PartitionSpec("dp", "sp", None)))
+        return h, None
+
+    wrapped = _scan_remat_wrapper(cfg)(body) if training else body
+    xs = (blocks, dropout_keys) if p_drop else blocks
+    x, _ = jax.lax.scan(wrapped, x, xs)
+    return x
+
+
+def scan_hidden(stacked, ids, cfg, *, training=False, dropout_key=None):
+    """[B, S] ids -> final-LN hidden states [B, S, H] via the scanned stack."""
+    if training and cfg.attention_dropout:
+        raise NotImplementedError(
+            "scan path has no attention-dropout implementation; use the "
+            "unrolled Layer forward (or set attention_dropout=0)")
+    top, blocks = stacked["top"], stacked["blocks"]
+    s = ids.shape[-1]
+    x = top["gpt.wte.weight"][ids] + top["gpt.wpe.weight"][None, :s]
+    keys = None
+    if training and cfg.hidden_dropout:
+        if dropout_key is None:
+            raise ValueError("hidden_dropout > 0 needs a dropout_key")
+        nl = next(iter(blocks.values())).shape[0]
+        emb_key, lk = jax.random.split(dropout_key)
+        x = _fdropout(x, emb_key, float(cfg.hidden_dropout))
+        keys = jax.random.split(lk, (nl, 2))
+    mesh = get_mesh()
+    if cfg.seq_parallel and mesh is not None:
+        from jax.sharding import NamedSharding
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, PartitionSpec("dp", "sp", None)))
+    x = scan_blocks(blocks, x, cfg, training=training, dropout_keys=keys)
+    return _ln_ref(x, top["gpt.ln_f.weight"], top["gpt.ln_f.bias"])
+
+
+def scan_logits(stacked, ids, cfg, *, training=False, dropout_key=None):
+    """[B, S] ids -> [B, S, V] f32 logits (tied lm head, no fused CE)."""
+    h = scan_hidden(stacked, ids, cfg, training=training,
+                    dropout_key=dropout_key)
+    return (h @ stacked["top"]["gpt.wte.weight"].T).astype(jnp.float32)
+
+
+def scan_loss(stacked, ids, labels, cfg, *, loss_mask=None, training=True,
+              dropout_key=None):
+    """Scalar f32 causal-LM loss over the scanned stack — the same math as
+    GPTForCausalLM.forward(labels=...) (fused LM-head CE when enabled and
+    no mp axis; dense logits + log-softmax CE otherwise)."""
+    h = scan_hidden(stacked, ids, cfg, training=training,
+                    dropout_key=dropout_key)
+    wte = stacked["top"]["gpt.wte.weight"]
+    mesh = get_mesh()
+    use_fused = cfg.fused_ce and (mesh is None or mesh.shape.get("mp", 1) == 1)
+    if use_fused:
+        from paddle_tpu.kernels.fused_ce import fused_linear_cross_entropy
+        n = h.shape[0] * h.shape[1]
+        loss = fused_linear_cross_entropy(h.reshape(n, -1), wte,
+                                          labels.reshape(-1))
+    else:
+        logits = (h @ wte.T).astype(jnp.float32)
+        logp = jax.nn.log_softmax(
+            logits.reshape(-1, logits.shape[-1]), axis=-1)
+        li = labels.reshape(-1).astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, li[:, None], axis=-1)[:, 0]
+    if loss_mask is not None:
+        m = loss_mask.reshape(-1).astype(jnp.float32)
+        return (loss * m).sum() / m.sum()
+    return loss.mean()
+
+
 class GPTAttention(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()

@@ -242,6 +242,12 @@ class Optimizer:
     def _fused_state_names(self):
         return []
 
+    def _functional_state_init(self, name, shape):
+        """Initial value for a fused/functional state leaf that has no
+        per-param accumulator to seed from (zeros for every stock optimizer
+        except Adagrad's initial_accumulator_value)."""
+        return jnp.zeros(shape, jnp.float32)
+
     def _fused_update(self, p32, g32, states, lr, wd, t):
         """states: list of flat f32 arrays (same order as _fused_state_names).
         Returns (new_p32, new_states)."""
@@ -310,8 +316,8 @@ class Optimizer:
                 for (p, _, _), n in zip(pgs, sizes):
                     acc = store.pop(id(p), None)
                     chunks.append(acc._data.reshape(-1).astype(jnp.float32)
-                                  if acc is not None else jnp.zeros((n,),
-                                                                    jnp.float32))
+                                  if acc is not None
+                                  else self._functional_state_init(name, (n,)))
                 t = Tensor(jnp.concatenate(chunks), _internal=True)
                 t.persistable = True
                 states.append(t)
@@ -377,6 +383,45 @@ class Optimizer:
                 t.persistable = True
                 return t
         return None
+
+    # ------------------------------------------------- scanned-step interop
+    # The scan-over-layers donated train step (paddle_tpu/train) runs the
+    # update FUNCTIONALLY: it owns stacked param/moment arrays and applies
+    # `_fused_update` per leaf inside one jitted program. These hooks keep
+    # THIS object the checkpoint truth: the step seeds its state from the
+    # accumulators and writes the post-step slices back before state_dict.
+
+    def functional_update(self):
+        """(state_names, update_fn) for the pure fused update. update_fn
+        (p32, g32, states, lr, wd, t) -> (new_p32, new_states) is
+        elementwise, so it applies to stacked [nl, ...] leaves unchanged."""
+        if not self._FUSABLE:
+            raise ValueError(
+                f"{type(self).__name__} has no pure fused update (per-tensor "
+                "trust ratios etc.); the scanned train step cannot fuse it")
+        return list(self._fused_state_names()), self._fused_update
+
+    def get_state_array(self, name, p):
+        """Current accumulator array for (state name, param) — from the
+        per-param store or a fused flat slice — or None if not yet created."""
+        t = self._accumulators.get(name, {}).get(id(p))
+        if t is None and self._fused_parts:
+            t = self._fused_acc_slice(name, p)
+        return None if t is None else t._data
+
+    def set_state_array(self, name, p, arr):
+        """Adopt `arr` as the accumulator for (name, param). Any fused flat
+        buffers are spilled first so per-param accumulators are the truth."""
+        for key in list(self._fused_parts):
+            self._fused_spill(key)
+        t = Tensor(jnp.asarray(arr), _internal=True)
+        t.persistable = True
+        self._accumulators[name][id(p)] = t
+
+    def set_master_array(self, p, arr):
+        t = Tensor(jnp.asarray(arr, jnp.float32), _internal=True)
+        t.persistable = True
+        self._master_weights[id(p)] = t
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
         """Static-graph-style convenience: backward already run via loss.backward()

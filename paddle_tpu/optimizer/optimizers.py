@@ -240,12 +240,29 @@ def _adagrad_update(p, g, moment, lr, eps, wd):
 
 
 class Adagrad(Optimizer):
+    # elementwise update: rides the fused eager path AND the scanned donated
+    # train step (paddle_tpu/train) via the same pure kernel
+    _FUSABLE = True
+
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
                  weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
                  multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._epsilon = epsilon
         self._init_acc = initial_accumulator_value
+
+    def _fused_state_names(self):
+        return ["moment"]
+
+    def _functional_state_init(self, name, shape):
+        if name == "moment" and self._init_acc:
+            return jnp.full(shape, self._init_acc, jnp.float32)
+        return jnp.zeros(shape, jnp.float32)
+
+    def _fused_update(self, p32, g32, states, lr, wd, t):
+        g = g32 + wd * p32
+        moment = states[0] + g * g
+        return p32 - lr * g / (jnp.sqrt(moment) + self._epsilon), [moment]
 
     def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
         mom = self._accumulator(
@@ -271,10 +288,22 @@ def _adamax_update(p, g, m, inf_norm, lr, beta1, beta2, eps, t, wd):
 
 
 class Adamax(Optimizer):
+    _FUSABLE = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _fused_state_names(self):
+        return ["moment", "inf_norm"]
+
+    def _fused_update(self, p32, g32, states, lr, wd, t):
+        g = g32 + wd * p32
+        m = self._beta1 * states[0] + (1 - self._beta1) * g
+        inf = jnp.maximum(self._beta2 * states[1], jnp.abs(g))
+        new_p = p32 - (lr / (1 - self._beta1 ** t)) * m / (inf + self._epsilon)
+        return new_p, [m, inf]
 
     def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
         m = self._accumulator("moment", p, dtype=jnp.float32)
@@ -304,10 +333,23 @@ def _adadelta_update(p, g, avg_sq, avg_upd, rho, eps, lr, wd):
 
 
 class Adadelta(Optimizer):
+    _FUSABLE = True
+
     def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
                  weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._epsilon, self._rho = epsilon, rho
+
+    def _fused_state_names(self):
+        return ["avg_squared_grad", "avg_squared_update"]
+
+    def _fused_update(self, p32, g32, states, lr, wd, t):
+        g = g32 + wd * p32
+        avg_sq = self._rho * states[0] + (1 - self._rho) * g * g
+        upd = jnp.sqrt(states[1] + self._epsilon) / \
+            jnp.sqrt(avg_sq + self._epsilon) * g
+        avg_upd = self._rho * states[1] + (1 - self._rho) * upd * upd
+        return p32 - lr * upd, [avg_sq, avg_upd]
 
     def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
         sq = self._accumulator("avg_squared_grad", p, dtype=jnp.float32)
@@ -340,12 +382,26 @@ def _rmsprop_update(p, g, mean_sq, mom, mean_g, lr, rho, eps, momentum, wd,
 
 
 class RMSProp(Optimizer):
+    _FUSABLE = True
+
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._rho, self._epsilon = rho, epsilon
         self._momentum, self._centered = momentum, centered
+
+    def _fused_state_names(self):
+        return ["mean_square", "momentum", "mean_grad"]
+
+    def _fused_update(self, p32, g32, states, lr, wd, t):
+        new_p, msq, mom, mg = _rmsprop_update(
+            p32, g32, states[0], states[1], states[2], lr,
+            jnp.asarray(self._rho, jnp.float32),
+            jnp.asarray(self._epsilon, jnp.float32),
+            jnp.asarray(self._momentum, jnp.float32),
+            wd, centered=self._centered)
+        return new_p, [msq, mom, mg]
 
     def _append_optimize_op(self, p, grad, lr, weight_decay, t=None):
         msq = self._accumulator("mean_square", p, dtype=jnp.float32)

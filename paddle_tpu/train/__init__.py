@@ -1,0 +1,10 @@
+"""Training-step capture: the scan-over-layers donated GPT hot path.
+
+See scan_step.py — stacked [nl, ...] params, lax.scan forward/backward,
+gradient-accumulation microbatching, ZeRO-1 sharded optimizer update,
+buffer donation. Engine (distributed/auto_parallel.py) and hapi Model
+route here when the (model, optimizer) pair supports it.
+"""
+from paddle_tpu.train.scan_step import ScanTrainStep, ScanUnsupported
+
+__all__ = ["ScanTrainStep", "ScanUnsupported"]

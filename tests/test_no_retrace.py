@@ -83,6 +83,43 @@ def test_new_bucket_compiles_exactly_once():
     assert _compile_counters() == after_new
 
 
+def test_scan_train_step_compiles_once_and_donates():
+    """The captured scan-over-layers train step (paddle_tpu/train): exactly
+    ONE compile across N steps with changing batch CONTENTS, frozen
+    jit.compile_count, and real buffer donation (the pre-step param and
+    opt-state arrays are deleted, not copied)."""
+    from paddle_tpu.train import ScanTrainStep
+    m = _tiny_model()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    step = ScanTrainStep(m, opt, microbatches=2)
+    rng = np.random.RandomState(3)
+
+    def batch():
+        ids = rng.randint(0, 64, (4, 9))
+        return ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int64)
+
+    x, y = batch()
+    old_param = step._params["blocks"]["mlp.fc_in.weight"]
+    old_moment = step._opt_state["blocks"]["mlp.fc_in.weight"]["moment1"]
+    step.step(x, y)
+    # donation check: the old buffers are DELETED, the step did not copy
+    assert old_param.is_deleted(), "params were copied, not donated"
+    assert old_moment.is_deleted(), "opt state was copied, not donated"
+
+    frozen_jit = metrics.snapshot()["counters"].get("jit.compile_count", 0)
+    for _ in range(4):
+        step.step(*batch())          # new contents, same shapes
+    assert step.compile_count == 1, (
+        f"train step recompiled: {step.compile_count} compiles")
+    assert metrics.snapshot()["counters"].get("jit.compile_count", 0) \
+        == frozen_jit, "jit.compile_count grew on batch-content churn"
+
+    # a different microbatch count is a new program shape: exactly one more
+    step.step(*batch(), microbatches=4)
+    assert step.compile_count == 2
+
+
 def test_pallas_path_compiles_once_per_bucket():
     """FLAGS_tpu_paged_impl=pallas must be exactly as shape-stable as the
     XLA path: one decode program, one program per prefill bucket, and slot
