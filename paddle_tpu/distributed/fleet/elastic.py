@@ -215,9 +215,19 @@ class ElasticManager:
 
 def _elastic_token() -> bytes:
     """Shared-secret digest for registry connections (same contract as
-    `distributed/rpc.py`): set PADDLE_ELASTIC_TOKEN on all hosts."""
+    `distributed/rpc.py`): set the SAME ``PADDLE_ELASTIC_TOKEN`` on every
+    host. There is deliberately no default — the old constant fallback
+    ("pt-elastic") let anyone who could reach the port tamper with
+    membership (r5 advisor), and a per-process random token cannot work
+    for a registry whose whole point is cross-host agreement."""
     import hashlib
-    secret = os.environ.get("PADDLE_ELASTIC_TOKEN") or "pt-elastic"
+    secret = os.environ.get("PADDLE_ELASTIC_TOKEN")
+    if not secret:
+        raise RuntimeError(
+            "PADDLE_ELASTIC_TOKEN is not set: the TCP elastic registry "
+            "refuses to run with a well-known default secret. Export the "
+            "same PADDLE_ELASTIC_TOKEN on the registry host and every "
+            "agent host.")
     return hashlib.sha256(secret.encode()).digest()
 
 

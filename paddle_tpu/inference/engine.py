@@ -51,6 +51,10 @@ import jax.numpy as jnp
 
 from paddle_tpu.kernels.paged_attention import TRASH_PAGE
 from paddle_tpu.observability import metrics
+from paddle_tpu.observability.flight_recorder import (Watchdog,
+                                                      default_deadline,
+                                                      flight)
+from paddle_tpu.observability.tracing import RequestTrace
 
 __all__ = ["EngineConfig", "PageAllocator", "GenerateRequest", "DecodeEngine"]
 
@@ -126,17 +130,26 @@ class PageAllocator:
 
 class GenerateRequest:
     """One queued/running generation. `result()` blocks until the sequence
-    retires and returns prompt + generated ids (fast_generate's contract)."""
+    retires and returns prompt + generated ids (fast_generate's contract).
+    ``trace`` is the request's :class:`RequestTrace` — serve passes one
+    created at wire-accept so TTFT/e2e include the wire wait; a direct
+    `submit()` gets a fresh one."""
 
-    def __init__(self, prompt: np.ndarray, max_new_tokens: int):
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int, trace=None):
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.generated: list[int] = []
         self.submit_t = time.perf_counter()
+        self.trace = trace if trace is not None else RequestTrace()
         self._done = threading.Event()
         self._error: str | None = None
 
+    @property
+    def request_id(self) -> str:
+        return self.trace.request_id
+
     def _finish(self, error: str | None = None):
+        self.trace.mark_done(error)
         self._error = error
         self._done.set()
 
@@ -213,6 +226,8 @@ class DecodeEngine:
         self._work = threading.Condition(self._qlock)
         self._programs: dict = {}     # the engine's ProgramCache analog
         self._dead: str | None = None  # set by abort(); submits then fail fast
+        self.step_seq = 0             # advances once per step(); the
+        #                               watchdog's progress reading
 
         self._m_hit = metrics.counter("engine.cache_hit")
         self._m_miss = metrics.counter("engine.cache_miss")
@@ -240,6 +255,7 @@ class DecodeEngine:
         exe = self._programs.get(key)
         if exe is None:
             self._m_miss.inc()
+            flight.record("engine.compile_start", program=str(key))
             t0 = time.perf_counter()
             exe = self._programs[key] = build()
             self._m_compiles.inc()
@@ -336,8 +352,11 @@ class DecodeEngine:
 
     # ------------------------------------------------------------ admission
 
-    def submit(self, prompt_ids, max_new_tokens=32) -> GenerateRequest:
-        """Queue one prompt (1-D or [1, S] int array). Thread-safe."""
+    def submit(self, prompt_ids, max_new_tokens=32,
+               trace=None) -> GenerateRequest:
+        """Queue one prompt (1-D or [1, S] int array). Thread-safe.
+        ``trace``: a `RequestTrace` created upstream (serve's wire-accept)
+        so the SLO clock starts there; default starts it here."""
         ids = np.asarray(
             prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids)
         ids = np.ascontiguousarray(ids).reshape(-1).astype(np.int32)
@@ -350,10 +369,16 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt {ids.size} + max_new_tokens {n} exceeds engine "
                 f"max_seq_len={self.max_seq_len}")
-        req = GenerateRequest(ids, n)
+        req = GenerateRequest(ids, n, trace=trace)
         with self._work:
             if self._dead is not None:
                 raise RuntimeError(f"engine stopped: {self._dead}")
+            # trace/ring entries only for ACCEPTED submits: a rejected one
+            # must not leave a phantom never-retired request in a watchdog
+            # post-mortem
+            req.trace.mark_submit()
+            flight.record("engine.submit", request_id=req.request_id,
+                          prompt_len=int(ids.size), max_new_tokens=n)
             self._queue.append(req)
             self._g_queue.set(len(self._queue))
             self._work.notify()
@@ -400,6 +425,10 @@ class DecodeEngine:
             self._place(req, slots[0], pages)
 
     def _place(self, req: GenerateRequest, slot: int, pages: list[int]):
+        req.trace.mark_admitted()
+        flight.record("engine.admit", request_id=req.request_id,
+                      slot=slot, pages=len(pages),
+                      prompt_len=int(req.prompt.size))
         s0 = req.prompt.size
         bucket = self.bucket_for(s0)
         maxp = self.pages_per_slot
@@ -428,6 +457,7 @@ class DecodeEngine:
         self._slot_req[slot] = req
         self._slot_pages[slot] = pages
         req.generated.append(first)
+        req.trace.mark_first_token()
         self._m_tokens.inc()
         if req.max_new_tokens == 1 or first == self.ecfg.eos_id:
             self._retire(slot)
@@ -443,6 +473,8 @@ class DecodeEngine:
         self._page_table[slot] = TRASH_PAGE
         self._lengths[slot] = 0
         if req is not None:
+            flight.record("engine.retire", request_id=req.request_id,
+                          slot=slot, tokens=len(req.generated), error=error)
             req._finish(error)
 
     # ----------------------------------------------------------------- step
@@ -497,6 +529,7 @@ class DecodeEngine:
                 continue        # EOS-retired earlier in the fifo (or abort)
             tok = int(toks_np[slot])
             req.generated.append(tok)
+            req.trace.mark_tokens(1)
             n += 1
             if len(req.generated) >= req.max_new_tokens \
                     or tok == self.ecfg.eos_id:
@@ -508,6 +541,7 @@ class DecodeEngine:
         """Admit waiting requests, enqueue ONE batched decode step, harvest
         steps past the in-flight window. Returns False when fully idle."""
         t_step = time.perf_counter()
+        self.step_seq += 1
         self._blocked_s = 0.0
         self._admit()
         # capacity tripwire: a token at pos >= slot_capacity would spill to
@@ -521,6 +555,11 @@ class DecodeEngine:
                 f"{int(self._lengths[slot])} cannot be cached"))
         n_active = int(self._active.sum())
         self._g_occupancy.set(n_active)
+        if n_active or self._inflight:
+            # idle polls stay out of the ring: an hour of idle serve_loop
+            # must not evict the events around the last real work
+            flight.record("engine.step", step_seq=self.step_seq,
+                          occupancy=n_active, inflight=len(self._inflight))
         harvested = 0
         if n_active:
             self._dispatch()
@@ -540,9 +579,7 @@ class DecodeEngine:
         if harvested:
             self._g_tps.set(harvested / dt if dt > 0 else 0.0)
         metrics.add_span("engine.step", t_step, dt, cat="engine")
-        with self._qlock:
-            queued = bool(self._queue)
-        return queued or bool(self._inflight) or self._occupied()
+        return self._has_work()
 
     def run_until_idle(self, max_steps: int | None = None):
         """Drive step() until queue, slots and the in-flight window drain
@@ -553,6 +590,47 @@ class DecodeEngine:
             if max_steps is not None and n >= max_steps:
                 raise RuntimeError(
                     f"engine still busy after {max_steps} steps")
+
+    # ------------------------------------------------------------ watchdog
+
+    def active_traces(self):
+        """Traces of every request the engine still owes an answer —
+        queued, slotted, or awaiting in-flight harvest (these are what a
+        watchdog dump lists as the stalled requests)."""
+        with self._qlock:
+            reqs = list(self._queue)
+        reqs += [r for r in self._slot_req if r is not None]
+        for _, snapshot, _ in list(self._inflight):
+            reqs += [r for _, r in snapshot]
+        seen, traces = set(), []
+        for r in reqs:
+            if id(r) not in seen and not r.done:
+                seen.add(id(r))
+                traces.append(r.trace)
+        return traces
+
+    def _has_work(self) -> bool:
+        with self._qlock:
+            queued = bool(self._queue)
+        return queued or bool(self._inflight) or self._occupied()
+
+    def start_watchdog(self, deadline_s=None, dump_dir=None,
+                       interval_s=None):
+        """Arm a stall watchdog over this engine's step loop: if the engine
+        has work but `step_seq` stops advancing for ``deadline_s``
+        (default ``PADDLE_WATCHDOG_S``, 300 s; <= 0 disables and returns
+        None), the flight-recorder ring + the stalled requests' traces +
+        the metrics snapshot dump to a JSON file (`observability/
+        flight_recorder.py`). `serve_loop` arms one automatically; direct
+        `step()`/`run_until_idle()` drivers opt in by calling this."""
+        deadline = default_deadline() if deadline_s is None \
+            else float(deadline_s)
+        if deadline <= 0:
+            return None
+        return Watchdog("engine", progress=lambda: self.step_seq,
+                        busy=self._has_work, deadline_s=deadline,
+                        dump_dir=dump_dir, traces=self.active_traces,
+                        interval_s=interval_s).start()
 
     # ---------------------------------------------------------- serve loop
 
@@ -579,7 +657,10 @@ class DecodeEngine:
         steps while there is work, parks on the submit condition when idle.
         On exit — clean shutdown OR a step raising (device OOM, AOT shape
         error) — every outstanding request is aborted so no connection
-        thread is left blocking on a future nobody will fulfil."""
+        thread is left blocking on a future nobody will fulfil. A stall
+        watchdog (`start_watchdog`) guards the loop: a step that wedges in
+        the device leaves a flight-recorder dump instead of a silent hang."""
+        watchdog = self.start_watchdog()
         try:
             while not stop_event.is_set():
                 if self.step():
@@ -591,4 +672,7 @@ class DecodeEngine:
             metrics.counter("engine.loop_errors").inc()
             self.abort(f"engine loop died: {type(e).__name__}: {e}")
             raise
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
         self.abort("engine stopped (server shutdown)")

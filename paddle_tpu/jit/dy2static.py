@@ -30,6 +30,7 @@ rebuilding the function with its original cells.
 from __future__ import annotations
 
 import ast
+import functools
 import inspect
 import textwrap
 import types
@@ -261,6 +262,19 @@ def _discover_extra_reads(body_fn, t_idx, tensors, passthrough):
             if not t.stop_gradient and jnp.issubdtype(t.dtype, jnp.inexact)]
 
 
+def _trip_bound_check(still_active, *, bound):
+    """Host-side assert behind the bounded-scan lowering: runs after the
+    scan with the final (active AND cond) state; raising here surfaces as
+    a runtime error on the dispatching thread."""
+    if bool(still_active):
+        raise RuntimeError(
+            f"FLAGS_dy2static_max_trip_count={bound} exceeded: the loop "
+            f"condition is still true after {bound} bounded-scan steps, so "
+            "the traced loop's results are TRUNCATED. Raise the flag above "
+            "the loop's true trip count (or unset it to use the "
+            "non-differentiable lax.while lowering).")
+
+
 def whileloop(cond_fn, body_fn, loop_vars, maximum_trip_count=None,
               var_names=None, bound_traced_only=False):
     """``lax.while_loop`` with Python fallback (ref convert_while_loop).
@@ -352,8 +366,22 @@ def whileloop(cond_fn, body_fn, loop_vars, maximum_trip_count=None,
                     for a, na in zip(arrs, o_arrays))
                 return (new, act), None
 
-            (out, _), _ = jax.lax.scan(step, (tuple(car), jnp.asarray(True)),
-                                       None, length=n_steps)
+            (out, act), _ = jax.lax.scan(step, (tuple(car), jnp.asarray(True)),
+                                         None, length=n_steps)
+            if bound_traced_only:
+                # the bound came from FLAGS_dy2static_max_trip_count — it
+                # exists only to make the traced loop scannable, NOT to cap
+                # iteration. If the loop condition still holds after
+                # n_steps, the results are truncated: fail LOUDLY at run
+                # time (r5 advisor — silent truncation is indistinguishable
+                # from a correct result). debug.callback exceptions surface
+                # through the runtime (XlaRuntimeError wrapping the
+                # message), including under vjp of this scan.
+                still = jnp.logical_and(
+                    act, _cond_arr(_join(t_idx, list(out), passthrough)))
+                jax.debug.callback(
+                    functools.partial(_trip_bound_check, bound=n_steps),
+                    still)
             return out
 
         out = apply(prim, *tensors, *extras, op_name="while_loop_bounded")

@@ -262,6 +262,32 @@ BLOCK_SUFFIXES = (
 _BLOCK_PREFIX = "gpt.h."
 
 
+def analytic_param_count(cfg) -> int:
+    """Parameter count straight from the config (no weights needed):
+    embeddings + per-block (qkv, proj, mlp up/down, 2 LNs) + final LN.
+    Matches `sum(prod(p.shape) for p in model.parameters())` exactly —
+    `tests/test_tracing.py` pins that."""
+    h, i = cfg.hidden_size, cfg.intermediate_size
+    per_block = (3 * h * h + 3 * h       # qkv
+                 + h * h + h             # attn proj
+                 + h * i + i             # mlp up
+                 + i * h + h             # mlp down
+                 + 4 * h)                # ln_1 + ln_2 (scale + bias)
+    return (cfg.vocab_size * h                       # wte (tied lm head)
+            + cfg.max_position_embeddings * h        # wpe
+            + cfg.num_layers * per_block
+            + 2 * h)                                 # final ln
+
+
+def analytic_flops_per_token(cfg, seq_len: int) -> float:
+    """Training FLOPs per token: the standard 6N matmul term (fwd + bwd)
+    plus the attention score/context term 12·nl·h·S (QKᵀ and PV are each
+    2·nl·h·S per token forward, ×3 for fwd+bwd) — the PaLM/Chinchilla
+    accounting the `train.mfu` gauge uses (`train/scan_step.py`)."""
+    return (6.0 * analytic_param_count(cfg)
+            + 12.0 * cfg.num_layers * cfg.hidden_size * seq_len)
+
+
 def _leaf_array(v):
     return v._data if hasattr(v, "_data") else jnp.asarray(v)
 

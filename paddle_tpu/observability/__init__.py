@@ -40,7 +40,7 @@ import time
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "metrics",
     "counter", "gauge", "histogram", "timer", "snapshot", "reset",
-    "chrome_trace", "export_chrome_trace",
+    "chrome_trace", "export_chrome_trace", "to_prometheus",
 ]
 
 # perf_counter origin for span timestamps — one epoch per process so spans
@@ -224,13 +224,15 @@ class MetricsRegistry:
 
     # ----------------------------------------------------------------- spans
 
-    def add_span(self, name, t0_perf, dur_s, cat="host"):
+    def add_span(self, name, t0_perf, dur_s, cat="host", args=None):
         """Record one completed host-side range for Chrome-trace export.
         ``t0_perf`` is a time.perf_counter() value; timestamps are stored in
-        microseconds relative to the process epoch."""
+        microseconds relative to the process epoch. ``args`` (a small dict,
+        e.g. ``{"request_id": "req-7"}``) lands on the Chrome-trace event's
+        ``args`` field so Perfetto can group/filter spans by request."""
         with self._span_lock:
             self._spans.append((name, cat, (t0_perf - _EPOCH) * 1e6,
-                                dur_s * 1e6, threading.get_ident()))
+                                dur_s * 1e6, threading.get_ident(), args))
 
     # --------------------------------------------------------------- exports
 
@@ -258,11 +260,24 @@ class MetricsRegistry:
         `paddle.profiler.load_profiler_result`)."""
         with self._span_lock:
             spans = list(self._spans)
-        events = [{"name": name, "cat": cat, "ph": "X", "pid": os.getpid(),
-                   "tid": tid, "ts": round(ts, 3), "dur": round(dur, 3)}
-                  for name, cat, ts, dur, tid in spans]
+        events = []
+        for name, cat, ts, dur, tid, args in spans:
+            ev = {"name": name, "cat": cat, "ph": "X", "pid": os.getpid(),
+                  "tid": tid, "ts": round(ts, 3), "dur": round(dur, 3)}
+            if args:
+                ev["args"] = dict(args)
+            events.append(ev)
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "metrics": self.snapshot()}
+
+    def to_prometheus(self) -> str:
+        """Zero-dependency Prometheus text exposition (format 0.0.4) of
+        every counter/gauge/histogram — histograms render as summaries
+        (p50/p99 quantiles + _sum/_count). Standard scrapers consume this
+        via the serve PROMETHEUS wire op or the stdlib http exporter
+        (`observability/prometheus.py`)."""
+        from paddle_tpu.observability.prometheus import render
+        return render(self)
 
     def export_chrome_trace(self, path) -> str:
         d = os.path.dirname(path)
@@ -300,3 +315,4 @@ snapshot = metrics.snapshot
 reset = metrics.reset
 chrome_trace = metrics.chrome_trace
 export_chrome_trace = metrics.export_chrome_trace
+to_prometheus = metrics.to_prometheus

@@ -31,6 +31,32 @@ from jax.sharding import NamedSharding, PartitionSpec
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.distributed.mesh import get_mesh
 from paddle_tpu.observability import metrics
+from paddle_tpu.observability.flight_recorder import (Watchdog,
+                                                      default_deadline,
+                                                      flight)
+
+
+# per-chip peak for MFU denominators — bench.py imports THIS constant so
+# its rung MFU and the `train.mfu` gauge can never disagree on the peak
+V5E_BF16_PEAK = 197e12
+
+
+def safe_backend() -> str:
+    """`jax.default_backend()` that cannot raise ("cpu" when the platform
+    plugin is wedged): telemetry reads must never take a hot path down
+    (the BENCH_r05 lesson). The one such probe in the repo — bench.py's
+    `_platform()` delegates here."""
+    try:
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — plugin init errors of any type
+        return "cpu"
+
+
+def peak_flops() -> float:
+    """Per-chip MFU denominator: v5e bf16 peak on TPU, a nominal
+    1 TFLOP/s elsewhere. The ONE peak predicate in the repo — bench.py
+    imports this, so its rung MFU and `train.mfu` cannot disagree."""
+    return V5E_BF16_PEAK if safe_backend() == "tpu" else 1e12
 
 
 class ScanUnsupported(ValueError):
@@ -374,6 +400,11 @@ class ScanTrainStep:
         t = jnp.asarray(self.opt._global_step + 1, jnp.float32)
         self._key, sub = jax.random.split(self._key)
         before = self._cache_size()
+        # dispatch marker BEFORE the jit call: if the step (or its compile)
+        # wedges, the watchdog dump's last ring event shows WHERE — a
+        # post-hoc record would vanish with the hang
+        flight.record("train.dispatch", step=self.opt._global_step + 1,
+                      shape=str(tuple(xs.shape)))
         t0 = time.perf_counter()
         from jax.experimental import disable_x64
         with disable_x64():
@@ -391,6 +422,14 @@ class ScanTrainStep:
             sig = (xs.shape, ys.shape, str(xs.dtype))
             compiled = sig not in self._seen_sigs
             self._seen_sigs.add(sig)
+        tokens = int(np.prod(xd.shape))
+        from paddle_tpu.models.gpt import analytic_flops_per_token
+        flops = analytic_flops_per_token(self.cfg, int(xd.shape[-1])) * tokens
+        # flops covers the whole global batch, so the peak must cover the
+        # whole mesh — a per-chip denominator would read ~device_count too
+        # high and clamp at 1.0 exactly on multichip deployments
+        n_dev = self.mesh.size if self.mesh is not None else 1
+        mfu = min(1.0, flops / (max(dt, 1e-9) * peak_flops() * n_dev))
         if compiled:
             self._compiles += 1
             metrics.counter("train.compile_count").inc()
@@ -399,9 +438,20 @@ class ScanTrainStep:
         else:
             metrics.gauge("train.step_ms").set(dt * 1e3)
             metrics.histogram("train.step_seconds").observe(dt)
+            # goodput + model FLOPs utilization from the ANALYTIC flop
+            # count (models/gpt.py, 6N + attention term) — STEADY steps
+            # only, like step_ms: a compile step's dt would read as a
+            # collapsed mfu and fake the exact alarm the gauge exists to
+            # raise (mfu down while step_ms holds = the batch shrank)
+            metrics.gauge("train.mfu").set(mfu)
+            metrics.gauge("train.goodput_tokens_per_s").set(
+                tokens / max(dt, 1e-9))
         metrics.counter("train.steps").inc()
         metrics.counter("train.microbatches").inc(m)
-        metrics.counter("train.tokens").inc(int(np.prod(xd.shape)))
+        metrics.counter("train.tokens").inc(tokens)
+        flight.record("train.step", step=self.opt._global_step + 1,
+                      loss=lossf, ms=round(dt * 1e3, 3),
+                      mfu=round(mfu, 5), compiled=bool(compiled))
         self.opt._global_step += 1
         self.opt._sync_lr_tensor(self.opt.get_lr())
         self._dirty = True
@@ -412,5 +462,24 @@ class ScanTrainStep:
             return self._jit._cache_size()
         except Exception:  # noqa: BLE001 — jax internals moved
             return -1
+
+    def start_watchdog(self, deadline_s=None, dump_dir=None,
+                       interval_s=None):
+        """Arm a stall watchdog over the train loop: if `step()` stops
+        completing (a wedged device call, a hung collective) for
+        ``deadline_s`` (default ``PADDLE_WATCHDOG_S``; <= 0 disables and
+        returns None), the flight-recorder ring + metrics snapshot dump to
+        a JSON file. The driver owns the lifecycle: call before the loop,
+        `.stop()` after — an armed watchdog treats the loop as always-busy,
+        so don't leave it running across eval/checkpoint pauses longer
+        than the deadline."""
+        deadline = default_deadline() if deadline_s is None \
+            else float(deadline_s)
+        if deadline <= 0:
+            return None
+        return Watchdog("train",
+                        progress=lambda: self.opt._global_step,
+                        deadline_s=deadline, dump_dir=dump_dir,
+                        interval_s=interval_s).start()
 
     __call__ = step

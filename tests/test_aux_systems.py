@@ -346,7 +346,19 @@ class TestTcpElasticRegistry:
     """TcpNodeRegistry / TcpRegistryServer (r4 verdict weak #6): etcd-like
     membership WITHOUT a shared filesystem — same surface as NodeRegistry,
     so ElasticJobManager composes unchanged; connections are shared-secret
-    authed like rpc.py."""
+    authed like rpc.py. Since r6 the secret MUST come from
+    PADDLE_ELASTIC_TOKEN — the old constant fallback was a well-known
+    secret anyone on the network could use (r5 advisor)."""
+
+    @pytest.fixture(autouse=True)
+    def _shared_token(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_ELASTIC_TOKEN", "test-elastic-secret")
+
+    def test_refuses_to_run_without_token(self, monkeypatch):
+        from paddle_tpu.distributed.fleet.elastic import TcpRegistryServer
+        monkeypatch.delenv("PADDLE_ELASTIC_TOKEN", raising=False)
+        with pytest.raises(RuntimeError, match="PADDLE_ELASTIC_TOKEN"):
+            TcpRegistryServer()
 
     def test_join_leave_stale_and_manager(self):
         import time

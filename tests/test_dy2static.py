@@ -556,6 +556,42 @@ class TestForLoopConversion:
         finally:
             set_flags({"FLAGS_dy2static_max_trip_count": 0})
 
+    def test_exceeding_flag_bound_fails_loudly(self):
+        """r5 advisor (medium): a traced loop whose true trip count exceeds
+        FLAGS_dy2static_max_trip_count must RAISE at run time, not silently
+        return the truncated result — truncation is indistinguishable from
+        a correct answer. The in-bound path stays silent and correct."""
+        from paddle_tpu.framework.flags import set_flags
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        def f(x, n):
+            s = x * 0.0
+            i = paddle.to_tensor(0)
+            while i < n:
+                s = s + x
+                i = i + 1
+            return s
+
+        g = convert_to_static(f)
+        set_flags({"FLAGS_dy2static_max_trip_count": 4})
+        try:
+            @paddle.jit.to_static
+            def step(x, n):
+                return g(x, n)
+
+            x = _t(2.0)
+            # within the bound: correct and quiet (TRACED: n is a tensor
+            # input, so the while lowers to the bounded scan)
+            np.testing.assert_allclose(
+                float(step(x, paddle.to_tensor(3))), 6.0, rtol=1e-6)
+            # beyond the bound: the post-scan cond assert fires (surfaced
+            # through jax.debug.callback as a runtime error whose message
+            # names the flag)
+            with pytest.raises(Exception, match="dy2static_max_trip_count"):
+                float(step(x, paddle.to_tensor(9)))
+        finally:
+            set_flags({"FLAGS_dy2static_max_trip_count": 0})
+
     def test_flag_does_not_cap_concrete_loops(self):
         from paddle_tpu.framework.flags import set_flags
         from paddle_tpu.jit.dy2static import convert_to_static

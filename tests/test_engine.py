@@ -420,17 +420,60 @@ class TestServeGenerate:
         cli.shutdown_server()
         cli.close()
 
-    def test_engine_only_server_requires_auth_basis(self, monkeypatch):
-        """No model prefix and no auth_name would mean a well-known default
-        digest — anyone reaching the port could SHUTDOWN. Must refuse to
-        start (unless PADDLE_SERVE_TOKEN provides the secret)."""
+    def test_engine_only_server_generates_random_secret(self, monkeypatch):
+        """No auth_name and no PADDLE_SERVE_TOKEN: the server must mint a
+        RANDOM per-startup secret (r5 advisor — any derivable default digest
+        lets whoever can reach the port SHUTDOWN the server). Clients with
+        the generated secret connect; a guessed well-known one is dropped."""
+        import socket
+        import struct
         from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
-        from paddle_tpu.inference.serve import InferenceServer
+        from paddle_tpu.inference.serve import (
+            MAGIC, InferenceServer, RemotePredictor, auth_token)
         monkeypatch.delenv("PADDLE_SERVE_TOKEN", raising=False)
         eng = DecodeEngine(_tiny_model(), EngineConfig(page_size=4,
                                                        max_slots=1))
-        with pytest.raises(ValueError, match="auth"):
-            InferenceServer(None, engine=eng)
+        srv = InferenceServer(None, engine=eng)
+        assert srv.generated_secret and len(srv.generated_secret) >= 32
+        srv2 = InferenceServer(None, engine=eng)
+        assert srv2.generated_secret != srv.generated_secret
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        # guessed constants fail: connection dropped before any op
+        raw = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        raw.sendall(struct.pack("<I", MAGIC) + auth_token("None"))
+        raw.settimeout(3)
+        try:
+            assert raw.recv(12) == b""
+        except ConnectionResetError:
+            pass
+        raw.close()
+        # the printed secret works
+        cli = RemotePredictor(port=srv.port, secret=srv.generated_secret)
+        assert cli.ping()
+        cli.shutdown_server()
+        cli.close()
+        srv2._sock.close()
+
+    def test_legacy_model_prefix_client_with_env_token(self, monkeypatch):
+        """Back-compat: the old auth let PADDLE_SERVE_TOKEN beat
+        model_prefix on BOTH sides, so a legacy deployment (env set
+        everywhere, clients still passing model_prefix=) must keep
+        connecting — the legacy alias keeps its legacy precedence."""
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        from paddle_tpu.inference.serve import InferenceServer, \
+            RemotePredictor
+        monkeypatch.setenv("PADDLE_SERVE_TOKEN", "legacy-shared-secret")
+        eng = DecodeEngine(_tiny_model(), EngineConfig(page_size=4,
+                                                       max_slots=1))
+        srv = InferenceServer(None, engine=eng)
+        assert srv.generated_secret is None      # env var IS the secret
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        cli = RemotePredictor(port=srv.port, model_prefix="/some/model/path")
+        assert cli.ping()
+        cli.shutdown_server()
+        cli.close()
 
     def test_run_op_rejected_on_engine_only_server(self):
         from paddle_tpu.inference.serve import RemotePredictor

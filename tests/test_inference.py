@@ -106,12 +106,17 @@ class TestServeOutOfProcess:
     talk the wire protocol and must reproduce in-process Predictor outputs."""
 
     def _start_server(self, prefix):
+        """Returns (proc, port, secret): the server now generates a RANDOM
+        auth secret per startup and prints it once as 'TOKEN <hex>' (r5
+        advisor — the old model-path-derived default was guessable);
+        clients authenticate with that printed value."""
         import os
         import subprocess
         import sys
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("XLA_FLAGS", None)
+        env.pop("PADDLE_SERVE_TOKEN", None)   # force the random-token path
         proc = subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.inference.serve",
              "--model", prefix, "--port", "0"],
@@ -123,7 +128,13 @@ class TestServeOutOfProcess:
             err = proc.stderr.read()
             proc.kill()
             raise RuntimeError(f"server failed to start: {line!r} / {err}")
-        return proc, int(line.split()[1])
+        port = int(line.split()[1])
+        tok_line = proc.stdout.readline().strip()
+        if not tok_line.startswith("TOKEN"):
+            proc.kill()
+            raise RuntimeError(f"server printed no startup token: "
+                               f"{tok_line!r}")
+        return proc, port, tok_line.split()[1]
 
     def test_python_client_matches_in_process(self, tmp_path):
         from paddle_tpu.inference import Config, create_predictor
@@ -136,9 +147,9 @@ class TestServeOutOfProcess:
         ref = ref_pred.get_output_handle(
             ref_pred.get_output_names()[0]).copy_to_cpu()
 
-        proc, port = self._start_server(prefix)
+        proc, port, secret = self._start_server(prefix)
         try:
-            cli = RemotePredictor(port=port, model_prefix=prefix)
+            cli = RemotePredictor(port=port, secret=secret)
             assert cli.ping()
             assert cli.run([x])
             out = cli.get_output_handle(
@@ -191,11 +202,11 @@ class TestServeOutOfProcess:
         cdll.PD_GetOutputData.restype = ctypes.c_void_p
         cdll.PD_GetOutputNbytes.restype = ctypes.c_int64
 
-        proc, port = self._start_server(prefix)
+        proc, port, secret = self._start_server(prefix)
         try:
             from paddle_tpu.inference.serve import auth_token
             h = cdll.PD_RemotePredictorCreate(b"127.0.0.1", port,
-                                              auth_token(prefix))
+                                              auth_token(secret))
             assert h, "C client failed to connect"
             h = ctypes.c_void_p(h)
             assert cdll.PD_RemotePredictorPing(h) == 1
@@ -234,7 +245,7 @@ class TestServeHardening:
         from paddle_tpu.inference.serve import (
             MAGIC, OP_SHUTDOWN, RemotePredictor)
         _, prefix = _save_model(tmp_path)
-        proc, port = self._start_server(prefix)
+        proc, port, secret = self._start_server(prefix)
         try:
             # wrong digest + SHUTDOWN: server must drop the conn and live on
             raw = socket.create_connection(("127.0.0.1", port), timeout=10)
@@ -247,7 +258,7 @@ class TestServeHardening:
                 pass                        # abrupt close also = dropped
             raw.close()
             assert proc.poll() is None, "server died from unauthed shutdown"
-            cli = RemotePredictor(port=port, model_prefix=prefix)
+            cli = RemotePredictor(port=port, secret=secret)
             assert cli.ping()               # still serving authed clients
             cli.shutdown_server()
             cli.close()
@@ -263,9 +274,9 @@ class TestServeHardening:
         import struct
         from paddle_tpu.inference.serve import MAGIC, OP_RUN, RemotePredictor
         _, prefix = _save_model(tmp_path)
-        proc, port = self._start_server(prefix)
+        proc, port, secret = self._start_server(prefix)
         try:
-            cli = RemotePredictor(port=port, model_prefix=prefix)
+            cli = RemotePredictor(port=port, secret=secret)
             # hand-craft a corrupt array: dims say 2x8 f32 (64 bytes) but
             # nbytes declares 4 — reshape fails server-side mid-request
             bad = (struct.pack("<III", MAGIC, OP_RUN, 1)
@@ -281,7 +292,7 @@ class TestServeHardening:
             cli._sock.settimeout(5)
             assert cli._sock.recv(1) == b""
             cli.close()
-            cli2 = RemotePredictor(port=port, model_prefix=prefix)
+            cli2 = RemotePredictor(port=port, secret=secret)
             assert cli2.ping()
             cli2.shutdown_server()
             cli2.close()

@@ -343,3 +343,37 @@ def test_bench_smoke_emits_structured_json():
     assert d["compile_count"] >= 1
     assert d["cache_misses"] >= 1 and d["cache_hits"] >= 1
     assert d["metrics"]["counters"]["jit.compile_count"] >= 1
+    # r6: the smoke line pins the SLO layer end-to-end — per-request
+    # ttft/tpot/e2e percentiles from the engine run, a clean watchdog,
+    # and the train.mfu gauge in (0, 1]
+    assert d["watchdog_clean"] is True
+    for k in ("ttft_p50", "ttft_p99", "tpot_p50", "tpot_p99",
+              "e2e_p50", "e2e_p99"):
+        assert d["slo"][k] > 0, (k, d["slo"])
+    assert d["slo"]["ttft_p50"] <= d["slo"]["e2e_p50"]
+    assert 0 < d["train_mfu"] <= 1.0
+    assert d["metrics"]["histograms"]["serve.ttft_seconds"]["count"] >= 3
+
+
+def test_bench_emission_survives_failing_platform_plugin(tmp_path):
+    """r6 satellite (BENCH_r05 gap): a CONFIGURED platform whose plugin
+    fails to initialize must ride `_init_backend`'s configured -> CPU
+    fallback — rc 0, one parseable JSON line with ok=true, platform=cpu,
+    and the original plugin error preserved in backend_error — instead of
+    rc=1 with a raw traceback and no artifact (BENCH_r05.json parsed:null).
+    Complements test_scan_train's dead-backend test, which covers the
+    everything-failed emission path."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "definitely_not_a_backend"
+    env.pop("PTPU_BENCH_CHILD", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=420, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, (proc.stdout, proc.stderr[-2000:])
+    d = json.loads(lines[-1])
+    assert d["metric"] == "smoke_step_time_seconds"
+    assert d["ok"] is True
+    assert d["platform"] == "cpu"
+    assert "definitely_not_a_backend" in (d["backend_error"] or "")
