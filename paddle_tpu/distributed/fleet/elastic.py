@@ -21,6 +21,15 @@ import threading
 import time
 
 
+def _check_registry_member(node_id, endpoint):
+    """Shared observer-mode guard for both registry backends: a registry
+    constructed without node_id/endpoint only watches membership."""
+    if node_id is None or endpoint is None:
+        raise RuntimeError(
+            "observer-mode registry (no node_id/endpoint) cannot "
+            "register or leave — it only watches membership")
+
+
 def start_heartbeat(path, interval=2.0):
     """Touch `path` every `interval` seconds from a daemon thread."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -51,18 +60,25 @@ class NodeRegistry:
     CONSTRAINT (loud, r4 verdict weak #6): this backend only coordinates
     hosts that mount the SAME directory. For clusters without one, use
     :class:`TcpNodeRegistry` against a :class:`TcpRegistryServer` — same
-    surface, no filesystem assumption."""
+    surface, no filesystem assumption.
 
-    def __init__(self, registry_dir, node_id, endpoint, ttl=30.0,
+    OBSERVER MODE: a process that only WATCHES membership (the serving
+    router, a controller) constructs the registry with ``node_id=None`` —
+    ``alive_nodes()`` works, ``register()``/``leave()`` refuse."""
+
+    def __init__(self, registry_dir, node_id=None, endpoint=None, ttl=30.0,
                  heartbeat_interval=2.0):
         self.dir = registry_dir
-        self.node_id = str(node_id)
+        self.node_id = None if node_id is None else str(node_id)
         self.endpoint = endpoint
         self.ttl = ttl
         self._interval = heartbeat_interval
         self._stop = threading.Event()
         self._thread = None
         os.makedirs(registry_dir, exist_ok=True)
+
+    def _check_member(self):
+        _check_registry_member(self.node_id, self.endpoint)
 
     def _path(self, node_id=None):
         return os.path.join(self.dir, f"node_{node_id or self.node_id}.json")
@@ -77,6 +93,7 @@ class NodeRegistry:
 
     def register(self):
         """Publish this node and keep renewing the lease (daemon thread)."""
+        self._check_member()
         self._write()
 
         def renew():
@@ -92,6 +109,7 @@ class NodeRegistry:
         return self
 
     def leave(self):
+        self._check_member()
         self._stop.set()
         if self._thread is not None:
             # join before unlinking: an in-flight _write() could otherwise
@@ -370,14 +388,15 @@ class TcpRegistryServer:
 class TcpNodeRegistry:
     """Drop-in for :class:`NodeRegistry` backed by a
     :class:`TcpRegistryServer` instead of a shared directory — same
-    register()/leave()/alive_nodes() surface, so
-    :class:`ElasticJobManager` works with either backend unchanged."""
+    register()/leave()/alive_nodes() surface (observer mode with
+    ``node_id=None`` included), so :class:`ElasticJobManager` and the
+    serving router work with either backend unchanged."""
 
-    def __init__(self, server_addr, node_id, endpoint, ttl=30.0,
+    def __init__(self, server_addr, node_id=None, endpoint=None, ttl=30.0,
                  heartbeat_interval=2.0):
         host, port = server_addr.rsplit(":", 1)
         self._addr = (host, int(port))
-        self.node_id = str(node_id)
+        self.node_id = None if node_id is None else str(node_id)
         self.endpoint = endpoint
         self.ttl = ttl
         self._interval = heartbeat_interval
@@ -399,7 +418,11 @@ class TcpNodeRegistry:
                 raise ConnectionError("registry closed (bad auth token?)")
             return json.loads(line)
 
+    def _check_member(self):
+        _check_registry_member(self.node_id, self.endpoint)
+
     def register(self):
+        self._check_member()
         self._call({"op": "put", "node_id": self.node_id,
                     "endpoint": self.endpoint, "ttl": self.ttl,
                     "nonce": self._nonce})
@@ -419,6 +442,7 @@ class TcpNodeRegistry:
         return self
 
     def leave(self):
+        self._check_member()
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=self._interval + 1.0)

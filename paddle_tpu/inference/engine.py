@@ -19,6 +19,17 @@ Python owns admission/retirement, the device runs fixed-shape steps:
   so prefill compiles O(log max_seq_len) programs instead of one per
   prompt length. Programs are AOT-compiled (`jit.lower().compile()`), so a
   shape drift RAISES instead of silently recompiling.
+- **Decode-priority chunked prefill** (`EngineConfig.prefill_chunk_tokens`):
+  a long prompt is split into fixed-size chunks, ONE chunk enqueued per
+  step AFTER the decode dispatch, so in-flight decodes keep their token
+  cadence instead of stalling for the whole prefill wall — the first rung
+  of prefill/decode disaggregation (ROADMAP item 1). The chunk program is
+  one AOT shape regardless of prompt length.
+- **Page-granular KV handoff** (`prefill_export` / `import_request` /
+  :class:`KVHandoff`): a request's page-table rows + page contents
+  serialize into a replica-independent blob, so a prefill finished on one
+  replica resumes decode on another token-identically — the transfer
+  primitive full disaggregation rides (docs/SERVING.md).
 - **De-synchronized hot path**: the per-slot host mirrors (token, length,
   flags, page-table row) are fused into ONE packed int32 upload per step
   (`engine.h2d_transfers` counts them — exactly one per step); sampled
@@ -40,6 +51,8 @@ serve process dedicates a thread; tests/bench call them inline).
 """
 from __future__ import annotations
 
+import json
+import struct
 import threading
 import time
 from collections import deque
@@ -56,7 +69,8 @@ from paddle_tpu.observability.flight_recorder import (Watchdog,
                                                       flight)
 from paddle_tpu.observability.tracing import RequestTrace
 
-__all__ = ["EngineConfig", "PageAllocator", "GenerateRequest", "DecodeEngine"]
+__all__ = ["EngineConfig", "PageAllocator", "GenerateRequest", "DecodeEngine",
+           "KVHandoff"]
 
 # packed slot-state upload layout: [B, _STATE_COLS + pages_per_slot] int32,
 # ONE host->device transfer per step (engine.h2d_transfers)
@@ -85,6 +99,13 @@ class EngineConfig:
                    restores the synchronous loop). EOS detection lags by up
                    to this many steps — the surplus tokens are discarded at
                    harvest, never delivered
+    prefill_chunk_tokens : when set, prompts LONGER than this are prefilled
+                   in fixed-size chunks of this many tokens, ONE chunk per
+                   engine step scheduled AFTER the decode dispatch
+                   (decode-priority): running requests keep decoding while
+                   a long prompt fills. None (default) keeps the one-shot
+                   bucketed prefill; prompts <= the chunk size always take
+                   the one-shot path
     """
     page_size: int = 16
     max_slots: int = 8
@@ -94,6 +115,7 @@ class EngineConfig:
     eos_id: int | None = None
     donate: bool | None = None
     inflight: int = 2
+    prefill_chunk_tokens: int | None = None
 
 
 class PageAllocator:
@@ -166,6 +188,70 @@ class GenerateRequest:
             [self.prompt, np.asarray(self.generated, self.prompt.dtype)])
 
 
+@dataclass
+class KVHandoff:
+    """A request's paged KV state, detached from any engine — the
+    page-granular handoff primitive (docs/SERVING.md "KV handoff format").
+
+    `DecodeEngine.prefill_export` produces one (prompt KV pages + the first
+    sampled token); `DecodeEngine.import_request` on ANY engine with the
+    same model geometry resumes decode from it, token-identical to having
+    prefilled locally. Only page IDS change across the transfer — contents
+    move bit-exact — so prefill/decode disaggregation is a page copy, not a
+    tensor-relayout problem.
+
+    ``pack()``/``unpack()`` define the wire blob:
+    ``b"PTKV1\\n" | u32 header_len | JSON header | prompt int32 | k | v``
+    where the header carries page_size, dtype, prompt_len, first_token and
+    the ``[nl, n_pages, page_size, nh, dh]`` pages shape.
+    """
+    prompt: np.ndarray          # [S0] int32
+    first_token: int            # sampled from the prefill's last logits
+    k_pages: np.ndarray         # [nl, n_pages, page_size, nh, dh]
+    v_pages: np.ndarray
+    page_size: int
+    cache_dtype: str            # numpy dtype name of the pool
+
+    MAGIC = b"PTKV1\n"
+
+    def pack(self) -> bytes:
+        head = json.dumps({
+            "page_size": int(self.page_size), "dtype": self.cache_dtype,
+            "first_token": int(self.first_token),
+            "prompt_len": int(self.prompt.size),
+            "pages_shape": [int(d) for d in self.k_pages.shape]}).encode()
+        return b"".join([
+            self.MAGIC, struct.pack("<I", len(head)), head,
+            np.ascontiguousarray(self.prompt, np.int32).tobytes(),
+            np.ascontiguousarray(self.k_pages).tobytes(),
+            np.ascontiguousarray(self.v_pages).tobytes()])
+
+    @classmethod
+    def unpack(cls, buf: bytes) -> "KVHandoff":
+        m = len(cls.MAGIC)
+        if buf[:m] != cls.MAGIC:
+            raise ValueError("not a KV handoff blob (bad magic)")
+        (hlen,) = struct.unpack("<I", buf[m:m + 4])
+        head = json.loads(buf[m + 4:m + 4 + hlen].decode())
+        off = m + 4 + hlen
+        s0 = int(head["prompt_len"])
+        prompt = np.frombuffer(buf, np.int32, count=s0, offset=off).copy()
+        off += 4 * s0
+        if head["dtype"] == "bfloat16":
+            import ml_dtypes
+            dt = np.dtype(ml_dtypes.bfloat16)
+        else:
+            dt = np.dtype(head["dtype"])
+        shape = tuple(head["pages_shape"])
+        n = int(np.prod(shape))
+        k = np.frombuffer(buf, dt, count=n, offset=off).reshape(shape).copy()
+        off += n * dt.itemsize
+        v = np.frombuffer(buf, dt, count=n, offset=off).reshape(shape).copy()
+        return cls(prompt=prompt, first_token=int(head["first_token"]),
+                   k_pages=k, v_pages=v, page_size=int(head["page_size"]),
+                   cache_dtype=head["dtype"])
+
+
 class DecodeEngine:
     """Continuous-batching decode over a paged KV cache for one GPT model.
 
@@ -226,6 +312,15 @@ class DecodeEngine:
         self._work = threading.Condition(self._qlock)
         self._programs: dict = {}     # the engine's ProgramCache analog
         self._dead: str | None = None  # set by abort(); submits then fail fast
+        self._draining = False        # drain(): refuse NEW submits only
+        # chunked-prefill progress: slot -> {"req", "done", "t0"}; slots
+        # here are occupied (slot_req set, pages held) but NOT decode-active
+        self._prefilling: dict[int, dict] = {}
+        if ecfg.prefill_chunk_tokens is not None \
+                and int(ecfg.prefill_chunk_tokens) < 1:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 1, "
+                f"got {ecfg.prefill_chunk_tokens}")
         self.step_seq = 0             # advances once per step(); the
         #                               watchdog's progress reading
 
@@ -237,6 +332,7 @@ class DecodeEngine:
         self._m_requests = metrics.counter("engine.requests")
         self._m_h2d = metrics.counter("engine.h2d_transfers")
         self._m_d2h = metrics.counter("engine.d2h_transfers")
+        self._m_chunks = metrics.counter("engine.prefill_chunks")
         self._g_occupancy = metrics.gauge("engine.batch_occupancy")
         self._g_queue = metrics.gauge("engine.queue_depth")
         self._g_tps = metrics.gauge("engine.tokens_per_s")
@@ -331,6 +427,38 @@ class DecodeEngine:
 
         return self._compiled(("prefill", bucket), build)
 
+    def _prefill_chunk_exe(self):
+        from paddle_tpu.models import gpt as gpt_mod
+        cfg = self.cfg
+        maxp = self.pages_per_slot
+        c = int(self.ecfg.prefill_chunk_tokens)
+
+        def chunk_fn(params, kc, vc, packed):
+            # packed [c + 2 + maxp] int32: chunk ids | start | valid | page
+            # row — one fused upload per chunk, no readback until the final
+            # chunk's sampled token
+            ids = packed[:c]
+            start = packed[c]
+            valid = packed[c + 1]
+            row = packed[c + 2:]
+            logits, kc, vc = gpt_mod.prefill_chunk_step(
+                params, ids, start, valid, row, kc, vc, cfg=cfg)
+            tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)
+            return tok, kc, vc
+
+        def build():
+            donate = (1, 2) if self._donate else ()
+            return jax.jit(chunk_fn, donate_argnums=donate).lower(
+                self._params, self._kc, self._vc,
+                jnp.zeros(c + 2 + maxp, jnp.int32),
+            ).compile()
+
+        return self._compiled(("prefill_chunk", c), build)
+
+    def _use_chunked(self, prompt_len: int) -> bool:
+        c = self.ecfg.prefill_chunk_tokens
+        return c is not None and prompt_len > int(c)
+
     def bucket_for(self, prompt_len: int) -> int:
         """Next power-of-two >= prompt_len (floor min_bucket, capped at the
         position table so wpe[:bucket] stays in range)."""
@@ -338,12 +466,19 @@ class DecodeEngine:
         return min(b, self.cfg.max_position_embeddings)
 
     def warmup(self, prompt_lens=(1,)):
-        """Compile the decode step + the prefill buckets covering
-        ``prompt_lens``. Optional — programs also compile lazily on first
-        use — but lets servers front-load compiles before traffic."""
+        """Compile the decode step + the prefill programs (buckets or the
+        chunk program) covering ``prompt_lens``. Optional — programs also
+        compile lazily on first use — but lets servers front-load compiles
+        before traffic."""
         self._decode_exe()
+        need_chunk = False
         for s in prompt_lens:
-            self._prefill_exe(self.bucket_for(int(s)))
+            if self._use_chunked(int(s)):
+                need_chunk = True
+            else:
+                self._prefill_exe(self.bucket_for(int(s)))
+        if need_chunk:
+            self._prefill_chunk_exe()
 
     def refresh_params(self, model):
         """Swap in current weights; programs take params as inputs, so this
@@ -373,6 +508,9 @@ class DecodeEngine:
         with self._work:
             if self._dead is not None:
                 raise RuntimeError(f"engine stopped: {self._dead}")
+            if self._draining:
+                raise RuntimeError(
+                    "engine draining: not accepting new requests")
             # trace/ring entries only for ACCEPTED submits: a rejected one
             # must not leave a phantom never-retired request in a watchdog
             # post-mortem
@@ -429,41 +567,122 @@ class DecodeEngine:
         flight.record("engine.admit", request_id=req.request_id,
                       slot=slot, pages=len(pages),
                       prompt_len=int(req.prompt.size))
-        s0 = req.prompt.size
-        bucket = self.bucket_for(s0)
         maxp = self.pages_per_slot
         row = np.full(maxp, TRASH_PAGE, np.int32)
         row[:len(pages)] = pages
-        packed = np.zeros(bucket + 1 + maxp, np.int32)
-        packed[:s0] = req.prompt
-        packed[bucket] = s0
-        packed[bucket + 1:] = row
+        self._page_table[slot] = row
+        self._slot_req[slot] = req
+        self._slot_pages[slot] = pages
+        if self._use_chunked(req.prompt.size):
+            # decode-priority chunked prefill: the slot holds its pages but
+            # stays decode-inactive; step() runs ONE chunk per step after
+            # the decode dispatch (`_advance_prefill`) until the prompt is
+            # fully cached, then the slot joins the decode batch
+            self._lengths[slot] = 0
+            self._prefilling[slot] = {"req": req, "done": 0,
+                                      "t0": time.perf_counter()}
+            return
         t0 = time.perf_counter()
-        exe = self._prefill_exe(bucket)
-        self._m_h2d.inc()
-        tok, self._kc, self._vc = exe(
-            self._params, self._kc, self._vc, jax.device_put(packed))
+        first = self._run_prefill(req.prompt, row)
+        self._h_prefill.observe(time.perf_counter() - t0)
+        self._seed_first_token(slot, req, first)
+
+    def _run_prefill(self, ids: np.ndarray, row: np.ndarray) -> int:
+        """Fill ``row``'s pages with the prompt's KV — one-shot bucketed or
+        back-to-back chunks per config — and return the sampled first
+        token. Shared by `_place` and `prefill_export` (which has no slot
+        to interleave around, so its chunks run consecutively)."""
+        s0 = ids.size
+        maxp = self.pages_per_slot
+        if self._use_chunked(s0):
+            c = int(self.ecfg.prefill_chunk_tokens)
+            tok = None
+            for done in range(0, s0, c):
+                tok = self._run_chunk(ids, done, row)
+        else:
+            bucket = self.bucket_for(s0)
+            packed = np.zeros(bucket + 1 + maxp, np.int32)
+            packed[:s0] = ids
+            packed[bucket] = s0
+            packed[bucket + 1:] = row
+            exe = self._prefill_exe(bucket)
+            self._m_h2d.inc()
+            tok, self._kc, self._vc = exe(
+                self._params, self._kc, self._vc, jax.device_put(packed))
         tb = time.perf_counter()
         first = int(tok)                     # sampled-token readback
         self._blocked_s += time.perf_counter() - tb
         self._m_d2h.inc()
-        self._h_prefill.observe(time.perf_counter() - t0)
-        self._page_table[slot] = row
-        self._lengths[slot] = s0
+        return first
+
+    def _run_chunk(self, ids: np.ndarray, done: int, row: np.ndarray):
+        """Pack and enqueue ONE prefill chunk (``ids[done:done+c]`` against
+        page ``row``) — the single owner of the packed chunk layout for
+        both the interleaved (`_advance_prefill`) and back-to-back
+        (`_run_prefill`) paths. Returns the chunk program's on-device
+        sampled token (meaningful only for the final chunk; no readback
+        here)."""
+        c = int(self.ecfg.prefill_chunk_tokens)
+        chunk = ids[done:done + c]
+        packed = np.zeros(c + 2 + self.pages_per_slot, np.int32)
+        packed[:chunk.size] = chunk
+        packed[c] = done
+        packed[c + 1] = chunk.size
+        packed[c + 2:] = row
+        exe = self._prefill_chunk_exe()
+        self._m_h2d.inc()
+        tok, self._kc, self._vc = exe(
+            self._params, self._kc, self._vc, jax.device_put(packed))
+        self._m_chunks.inc()
+        return tok
+
+    def _seed_first_token(self, slot: int, req: GenerateRequest,
+                          first: int):
+        """Prefill finished (or a handoff was imported): activate the slot
+        for decode and deliver the first generated token. Prefill-latency
+        accounting stays with the CALLERS that actually ran a prefill — a
+        KV import must not land a ~0 s observation in the histogram."""
+        self._lengths[slot] = req.prompt.size
         self._tokens[slot] = first
         self._active[slot] = True
         self._fresh[slot] = True
         self._budget[slot] = req.max_new_tokens - 1
-        self._slot_req[slot] = req
-        self._slot_pages[slot] = pages
         req.generated.append(first)
         req.trace.mark_first_token()
         self._m_tokens.inc()
         if req.max_new_tokens == 1 or first == self.ecfg.eos_id:
             self._retire(slot)
 
+    def _advance_prefill(self):
+        """Run ONE prefill chunk for the oldest prefilling slot. Called
+        AFTER the decode dispatch (decode-priority): the chunk queues
+        behind the step already in flight instead of delaying it, and the
+        next decode step queues behind the chunk — the long prompt's
+        prefill wall is spread one chunk per step across the decode
+        cadence. Returns True when a chunk ran (step() then knows this
+        step did work even with zero decode-active slots)."""
+        if not self._prefilling:
+            return False
+        slot = next(iter(self._prefilling))
+        st = self._prefilling[slot]
+        req = st["req"]
+        c = int(self.ecfg.prefill_chunk_tokens)
+        done = st["done"]
+        tok = self._run_chunk(req.prompt, done, self._page_table[slot])
+        st["done"] = min(done + c, req.prompt.size)
+        if st["done"] >= req.prompt.size:
+            del self._prefilling[slot]
+            tb = time.perf_counter()
+            first = int(tok)         # the prefill's ONLY readback: the
+            self._blocked_s += time.perf_counter() - tb  # final chunk's token
+            self._m_d2h.inc()
+            self._h_prefill.observe(time.perf_counter() - st["t0"])
+            self._seed_first_token(slot, req, first)
+        return True
+
     def _retire(self, slot: int, error: str | None = None):
         req = self._slot_req[slot]
+        self._prefilling.pop(slot, None)
         self.allocator.free(self._slot_pages[slot])
         self._slot_pages[slot] = []
         self._slot_req[slot] = None
@@ -538,8 +757,9 @@ class DecodeEngine:
         return n
 
     def step(self) -> bool:
-        """Admit waiting requests, enqueue ONE batched decode step, harvest
-        steps past the in-flight window. Returns False when fully idle."""
+        """Admit waiting requests, enqueue ONE batched decode step plus at
+        most one prefill chunk, harvest steps past the in-flight window.
+        Returns False when fully idle."""
         t_step = time.perf_counter()
         self.step_seq += 1
         self._blocked_s = 0.0
@@ -555,7 +775,7 @@ class DecodeEngine:
                 f"{int(self._lengths[slot])} cannot be cached"))
         n_active = int(self._active.sum())
         self._g_occupancy.set(n_active)
-        if n_active or self._inflight:
+        if n_active or self._inflight or self._prefilling:
             # idle polls stay out of the ring: an hour of idle serve_loop
             # must not evict the events around the last real work
             flight.record("engine.step", step_seq=self.step_seq,
@@ -563,13 +783,18 @@ class DecodeEngine:
         harvested = 0
         if n_active:
             self._dispatch()
+        # decode-priority: the chunk enqueues AFTER the decode step, so the
+        # in-flight decodes' cadence bounds how much a long prompt can add
+        # per step (one chunk), never the whole prefill wall
+        chunked = self._advance_prefill()
+        if n_active:
             while len(self._inflight) >= max(1, self.ecfg.inflight):
                 harvested += self._harvest_one()
         elif self._inflight:
             # nothing dispatchable: drain the fifo so budget-spent slots
             # retire (freeing pages/slots for the next admission)
             harvested += self._harvest_one()
-        else:
+        elif not chunked:
             with self._qlock:
                 return bool(self._queue)
         dt = time.perf_counter() - t_step
@@ -590,6 +815,119 @@ class DecodeEngine:
             if max_steps is not None and n >= max_steps:
                 raise RuntimeError(
                     f"engine still busy after {max_steps} steps")
+
+    # ----------------------------------------------------------- KV handoff
+
+    def prefill_export(self, prompt_ids) -> KVHandoff:
+        """Run this engine's prefill for ``prompt_ids`` and export the
+        result as a detached :class:`KVHandoff` instead of entering decode
+        — the prefill half of prefill/decode disaggregation. Pages are
+        borrowed from the pool for the duration of the call and freed
+        before returning. Driver-thread only (runs device programs)."""
+        ids = np.asarray(
+            prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids)
+        ids = np.ascontiguousarray(ids).reshape(-1).astype(np.int32)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        if ids.size >= self.max_seq_len:
+            raise ValueError(
+                f"prompt {ids.size} leaves no room to decode within "
+                f"max_seq_len={self.max_seq_len}")
+        n_src = -(-ids.size // self.ecfg.page_size)
+        pages = self.allocator.alloc(n_src)
+        if pages is None:
+            raise RuntimeError(
+                f"prefill_export needs {n_src} pages, "
+                f"{self.allocator.free_pages} free")
+        row = np.full(self.pages_per_slot, TRASH_PAGE, np.int32)
+        row[:n_src] = pages
+        try:
+            first = self._run_prefill(ids, row)
+            from paddle_tpu.kernels.paged_attention import export_pages
+            k_blob, v_blob = export_pages(self._kc, self._vc, pages)
+            k_np, v_np = np.asarray(k_blob), np.asarray(v_blob)
+        finally:
+            self.allocator.free(pages)
+        metrics.counter("engine.kv_exports").inc()
+        return KVHandoff(prompt=ids, first_token=first, k_pages=k_np,
+                         v_pages=v_np, page_size=int(self.ecfg.page_size),
+                         cache_dtype=np.dtype(self._cdtype).name)
+
+    def import_request(self, handoff: KVHandoff, max_new_tokens=32,
+                       trace=None) -> GenerateRequest:
+        """Resume decode from a :class:`KVHandoff` exported on ANOTHER
+        engine/replica: allocate a slot + pages here, scatter the imported
+        page contents in, and continue decoding — token-identical to having
+        prefilled locally (the first decode step writes the first token's
+        KV at position S0 exactly as the local flow would). Driver-thread
+        only, and placement is immediate: the handoff path does its own
+        admission control upstream, so a full engine raises instead of
+        queueing. Pass the ORIGINATING request's ``trace`` to keep SLO
+        accounting honest across the transfer — with the default fresh
+        trace, TTFT on this engine measures only the import itself."""
+        if int(handoff.page_size) != int(self.ecfg.page_size):
+            raise ValueError(
+                f"page_size mismatch: handoff {handoff.page_size} vs "
+                f"engine {self.ecfg.page_size}")
+        if handoff.cache_dtype != np.dtype(self._cdtype).name:
+            raise ValueError(
+                f"cache dtype mismatch: handoff {handoff.cache_dtype} vs "
+                f"engine {np.dtype(self._cdtype).name} — a silent cast "
+                f"would break bit-identical decode")
+        nl, n_src, ps, nh, dh = handoff.k_pages.shape
+        if (nl, ps, nh, dh) != (self._nl, self.ecfg.page_size, self._nh,
+                                self._dh):
+            raise ValueError(
+                f"cache geometry mismatch: handoff pages "
+                f"{handoff.k_pages.shape} vs engine [nl={self._nl}, "
+                f"ps={self.ecfg.page_size}, nh={self._nh}, dh={self._dh}]")
+        ids = np.ascontiguousarray(handoff.prompt).reshape(-1)\
+            .astype(np.int32)
+        n = int(max_new_tokens)
+        if n < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {n}")
+        if ids.size + n > self.max_seq_len:
+            raise ValueError(
+                f"prompt {ids.size} + max_new_tokens {n} exceeds engine "
+                f"max_seq_len={self.max_seq_len}")
+        if n_src != -(-ids.size // self.ecfg.page_size):
+            raise ValueError(
+                f"handoff has {n_src} pages for a {ids.size}-token prompt "
+                f"at page_size {self.ecfg.page_size}")
+        req = GenerateRequest(ids, n, trace=trace)
+        with self._work:
+            if self._dead is not None:
+                raise RuntimeError(f"engine stopped: {self._dead}")
+            if self._draining:
+                raise RuntimeError(
+                    "engine draining: not accepting new requests")
+            req.trace.mark_submit()
+        slots = self._free_slots()
+        if not slots:
+            raise RuntimeError("no free slot for KV import")
+        need = -(-(ids.size + n) // self.ecfg.page_size)
+        pages = self.allocator.alloc(need)
+        if pages is None:
+            raise RuntimeError(
+                f"KV import needs {need} pages, "
+                f"{self.allocator.free_pages} free")
+        self._m_requests.inc()
+        slot = slots[0]
+        req.trace.mark_admitted()
+        flight.record("engine.kv_import", request_id=req.request_id,
+                      slot=slot, pages=len(pages), prompt_len=int(ids.size))
+        from paddle_tpu.kernels.paged_attention import import_pages
+        self._kc, self._vc = import_pages(
+            self._kc, self._vc, jnp.asarray(handoff.k_pages),
+            jnp.asarray(handoff.v_pages), pages[:n_src])
+        row = np.full(self.pages_per_slot, TRASH_PAGE, np.int32)
+        row[:len(pages)] = pages
+        self._page_table[slot] = row
+        self._slot_req[slot] = req
+        self._slot_pages[slot] = pages
+        metrics.counter("engine.kv_imports").inc()
+        self._seed_first_token(slot, req, int(handoff.first_token))
+        return req
 
     # ------------------------------------------------------------ watchdog
 
@@ -612,7 +950,8 @@ class DecodeEngine:
     def _has_work(self) -> bool:
         with self._qlock:
             queued = bool(self._queue)
-        return queued or bool(self._inflight) or self._occupied()
+        return queued or bool(self._inflight) or bool(self._prefilling) \
+            or self._occupied()
 
     def start_watchdog(self, deadline_s=None, dump_dir=None,
                        interval_s=None):
@@ -633,6 +972,16 @@ class DecodeEngine:
                         interval_s=interval_s).start()
 
     # ---------------------------------------------------------- serve loop
+
+    def drain(self):
+        """Refuse NEW submits while everything already accepted runs to
+        completion — the first half of graceful shutdown
+        (`InferenceServer.drain`, docs/SERVING.md). Unlike `abort`, nothing
+        in flight is failed; callers poll `_has_work()` / watch their
+        requests to know when the engine has quiesced."""
+        with self._qlock:
+            self._draining = True
+        metrics.counter("engine.drains").inc()
 
     def abort(self, reason: str):
         """Fail every queued and in-flight request with ``reason``, reclaim

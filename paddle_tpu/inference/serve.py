@@ -52,6 +52,7 @@ import hashlib
 import hmac
 import json
 import os
+import random
 import secrets as _secrets
 import socket
 import struct
@@ -79,6 +80,47 @@ def auth_token(secret_name: str | None = None) -> bytes:
     else:
         secret = os.environ.get("PADDLE_SERVE_TOKEN") or ""
     return hashlib.sha256(secret.encode()).digest()
+
+def retrying_connect(host, port, *, timeout=60.0, attempts=5,
+                     base_delay_s=0.05, max_delay_s=2.0, deadline_s=None,
+                     jitter=0.5):
+    """``socket.create_connection`` with exponential backoff + jitter and a
+    hard deadline. A replica restart (rolling deploy, elastic eviction)
+    surfaces as a few hundred ms of ``ConnectionRefusedError`` — retrying
+    with backoff rides it out instead of failing the caller instantly,
+    and the jitter keeps a fleet of reconnecting clients from stampeding
+    the fresh process. ``deadline_s`` caps the WHOLE dance (sleeps are
+    clipped to it), so a hung endpoint can never hold a caller past it.
+    Used by `RemotePredictor` and the serving router
+    (`paddle_tpu/serving/router.py`)."""
+    t_end = None if deadline_s is None else time.monotonic() + deadline_s
+    delay = base_delay_s
+    last = None
+    for i in range(max(1, int(attempts))):
+        if t_end is not None and time.monotonic() >= t_end:
+            break
+        try:
+            to = timeout if t_end is None \
+                else max(0.001, min(timeout, t_end - time.monotonic()))
+            sock = socket.create_connection((host, int(port)), timeout=to)
+            # the deadline bounds the CONNECT dance only; request IO on the
+            # established socket gets the caller's full timeout back
+            sock.settimeout(timeout)
+            return sock
+        except OSError as e:
+            last = e
+        if i == attempts - 1:
+            break
+        sleep = delay * (1.0 + jitter * random.random())
+        if t_end is not None:
+            sleep = min(sleep, max(0.0, t_end - time.monotonic()))
+        time.sleep(sleep)
+        delay = min(delay * 2.0, max_delay_s)
+    raise ConnectionError(
+        f"connect to {host}:{port} failed after {attempts} attempts"
+        + (f" (deadline {deadline_s}s)" if deadline_s is not None else "")
+        + f": {type(last).__name__ if last else 'deadline'}: {last}")
+
 
 _DTYPES = ["float32", "float64", "int32", "int64", "uint8", "bool",
            "float16", "bfloat16", "int8", "int16", "uint16", "uint32",
@@ -174,11 +216,56 @@ class InferenceServer:
         self._stop = threading.Event()
         self._token = auth_token(
             basis if basis is None else str(basis))
+        self._registry = None          # elastic-registry lease (drain leaves)
+        self._draining = False
+        self._drain_thread = None      # set by install_sigterm_drain's handler
         self._engine_thread = None
         if engine is not None:
             self._engine_thread = threading.Thread(
                 target=engine.serve_loop, args=(self._stop,), daemon=True)
             self._engine_thread.start()
+
+    def attach_registry(self, registry):
+        """Hold the elastic-registry lease this replica registered under
+        (`distributed/fleet/elastic.py` NodeRegistry/TcpNodeRegistry);
+        `drain()` deregisters it so the router stops sending traffic before
+        the process exits."""
+        self._registry = registry
+        return self
+
+    def drain(self, deadline_s=30.0):
+        """Graceful shutdown (SIGTERM contract, docs/SERVING.md): refuse
+        new GENERATE submits, let everything in flight finish for up to
+        ``deadline_s``, deregister from the elastic registry, then stop
+        the server (stragglers past the deadline are aborted by the engine
+        thread's shutdown path). Returns True when all in-flight work
+        finished inside the deadline."""
+        metrics.counter("serve.drains").inc()
+        self._draining = True
+        if self._engine is not None:
+            self._engine.drain()
+        clean = True
+        if self._engine is not None:
+            t_end = time.monotonic() + float(deadline_s)
+            while self._engine._has_work():
+                if time.monotonic() >= t_end:
+                    clean = False
+                    break
+                time.sleep(0.01)
+        if self._registry is not None:
+            try:
+                self._registry.leave()
+            except OSError:
+                pass               # registry gone: exiting anyway
+        self._stop.set()
+        if self._engine_thread is not None \
+                and self._engine_thread is not threading.current_thread():
+            # join the engine thread before reporting drained: a process
+            # that exits while the loop's final abort still runs device
+            # calls tears the backend down under it (C++ terminate at
+            # interpreter shutdown)
+            self._engine_thread.join(timeout=30.0)
+        return clean
 
     def serve_forever(self):
         while not self._stop.is_set():
@@ -292,6 +379,12 @@ class InferenceServer:
         this connection thread on the request future — the engine thread
         does the actual batched decoding. ``trace`` is the wire-accept
         `RequestTrace`; the engine carries it to retirement."""
+        if self._draining:
+            # wire-level refusal ahead of the engine's own: a draining
+            # server must not accept work even in the window before
+            # drain() reaches the engine
+            raise RuntimeError(
+                "server draining: not accepting new requests")
         if self._engine is None:
             raise RuntimeError("no decode engine attached "
                                "(start with --gpt-config or engine=)")
@@ -328,10 +421,17 @@ class RemotePredictor:
     explicit 32-byte ``token=`` digest; with neither, the env-var secret
     alone is used (works when PADDLE_SERVE_TOKEN is set on both sides).
     ``model_prefix=`` is the legacy alias for ``secret=`` (servers no
-    longer derive their token from the model path)."""
+    longer derive their token from the model path).
+
+    Connect (and idempotent-op IO) retries with exponential backoff +
+    jitter under a hard deadline (`retrying_connect`): a replica restart
+    used to surface as an instant ``ConnectionRefusedError``; now the
+    client rides out up to ``retry_deadline_s`` of it. ``connect_retries=1``
+    restores the old single-attempt behavior."""
 
     def __init__(self, host="127.0.0.1", port=None, timeout=60.0,
-                 model_prefix=None, token=None, secret=None):
+                 model_prefix=None, token=None, secret=None,
+                 connect_retries=3, retry_deadline_s=10.0):
         if secret is None and model_prefix is not None \
                 and not os.environ.get("PADDLE_SERVE_TOKEN"):
             # legacy alias keeps its LEGACY semantics: the old auth_token
@@ -347,39 +447,74 @@ class RemotePredictor:
                 "or its auth_name=), an explicit 32-byte token=, or set "
                 "PADDLE_SERVE_TOKEN on both sides — otherwise the server "
                 "silently drops the connection")
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._host, self._port = host, port
+        self._timeout = timeout
+        self._retries = max(1, int(connect_retries))
+        self._retry_deadline = retry_deadline_s
         self._outs = []
-        tok = token if token is not None else auth_token(
+        self._token_bytes = token if token is not None else auth_token(
             secret if secret is None else str(secret))
-        self._sock.sendall(struct.pack("<I", MAGIC) + tok)
+        self._sock = None
+        self._connect()
+
+    def _connect(self):
+        self._sock = retrying_connect(
+            self._host, self._port, timeout=self._timeout,
+            attempts=self._retries, deadline_s=self._retry_deadline)
+        self._sock.sendall(struct.pack("<I", MAGIC) + self._token_bytes)
+
+    def _reconnect(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._connect()
+
+    def _idempotent(self, fn):
+        """Run a read-only op; on a broken connection (server restarted
+        between calls) reconnect with backoff and retry ONCE. Only ops
+        with no server-side effect ride this — generate() surfaces IO
+        errors to the caller (the router owns resubmission)."""
+        try:
+            return fn()
+        except (ConnectionError, socket.timeout, OSError):
+            self._reconnect()
+            return fn()
 
     def ping(self):
-        self._sock.sendall(struct.pack("<III", MAGIC, OP_PING, 0))
-        magic, status, _ = struct.unpack(
-            "<III", _recv_exact(self._sock, 12))
-        return magic == MAGIC and status == 0
+        def _do():
+            self._sock.sendall(struct.pack("<III", MAGIC, OP_PING, 0))
+            magic, status, _ = struct.unpack(
+                "<III", _recv_exact(self._sock, 12))
+            return magic == MAGIC and status == 0
+        return self._idempotent(_do)
 
     def stats(self) -> dict:
         """Fetch the server's metrics snapshot (request latency/throughput
         counters plus everything else its registry holds)."""
-        self._sock.sendall(struct.pack("<III", MAGIC, OP_STATS, 0))
-        magic, status, n = struct.unpack(
-            "<III", _recv_exact(self._sock, 12))
-        if magic != MAGIC or status != 0:
-            raise ConnectionError("bad stats response")
-        (payload,) = recv_arrays(self._sock, n)
-        return json.loads(payload.tobytes().decode())
+        def _do():
+            self._sock.sendall(struct.pack("<III", MAGIC, OP_STATS, 0))
+            magic, status, n = struct.unpack(
+                "<III", _recv_exact(self._sock, 12))
+            if magic != MAGIC or status != 0:
+                raise ConnectionError("bad stats response")
+            (payload,) = recv_arrays(self._sock, n)
+            return json.loads(payload.tobytes().decode())
+        return self._idempotent(_do)
 
     def prometheus(self) -> str:
         """The server's metrics in Prometheus text exposition format
         (PROMETHEUS wire op) — relay to a scraper or eyeball directly."""
-        self._sock.sendall(struct.pack("<III", MAGIC, OP_PROMETHEUS, 0))
-        magic, status, n = struct.unpack(
-            "<III", _recv_exact(self._sock, 12))
-        if magic != MAGIC or status != 0:
-            raise ConnectionError("bad prometheus response")
-        (payload,) = recv_arrays(self._sock, n)
-        return payload.tobytes().decode()
+        def _do():
+            self._sock.sendall(
+                struct.pack("<III", MAGIC, OP_PROMETHEUS, 0))
+            magic, status, n = struct.unpack(
+                "<III", _recv_exact(self._sock, 12))
+            if magic != MAGIC or status != 0:
+                raise ConnectionError("bad prometheus response")
+            (payload,) = recv_arrays(self._sock, n)
+            return payload.tobytes().decode()
+        return self._idempotent(_do)
 
     def generate(self, prompt_ids, max_new_tokens=32):
         """Batched server-side decode: ship the prompt, get prompt +
@@ -436,6 +571,25 @@ class RemotePredictor:
         self._sock.close()
 
 
+def install_sigterm_drain(server: InferenceServer, deadline_s=30.0):
+    """SIGTERM -> graceful drain (the pod-eviction / rolling-deploy
+    contract): refuse new submits, finish in-flight requests up to
+    ``deadline_s``, deregister from the elastic registry, exit. The
+    handler returns immediately — the drain runs on a daemon thread so a
+    signal can never wedge the main thread mid-accept. Returns the
+    installed handler (tests invoke it directly)."""
+    import signal
+
+    def _handler(signum, frame):  # noqa: ARG001 — signal handler signature
+        t = threading.Thread(target=server.drain, args=(deadline_s,),
+                             daemon=True, name="pt-serve-drain")
+        server._drain_thread = t
+        t.start()
+
+    signal.signal(signal.SIGTERM, _handler)
+    return _handler
+
+
 def main(argv=None):
     import os
     if os.environ.get("JAX_PLATFORMS"):
@@ -462,6 +616,21 @@ def main(argv=None):
                          "pass it as secret=); default is PADDLE_SERVE_TOKEN "
                          "or a random per-startup token printed once as "
                          "'TOKEN <hex>'")
+    ap.add_argument("--registry-dir", default=None,
+                    help="shared-filesystem elastic registry directory: "
+                         "register this replica for router discovery "
+                         "(distributed/fleet/elastic.py NodeRegistry)")
+    ap.add_argument("--registry-addr", default=None,
+                    help="host:port of a TcpRegistryServer to register "
+                         "with (needs PADDLE_ELASTIC_TOKEN)")
+    ap.add_argument("--replica-id", default=None,
+                    help="registry node id (default replica-<pid>)")
+    ap.add_argument("--advertise", default=None,
+                    help="endpoint to publish in the registry (default "
+                         "<host>:<bound port>)")
+    ap.add_argument("--drain-deadline", type=float, default=30.0,
+                    help="SIGTERM graceful-drain budget in seconds: finish "
+                         "in-flight requests up to this long before exit")
     args = ap.parse_args(argv)
     if args.model is None and args.gpt_config is None:
         ap.error("need --model and/or --gpt-config")
@@ -480,6 +649,19 @@ def main(argv=None):
         engine = DecodeEngine(model, ecfg)
     srv = InferenceServer(args.model, args.host, args.port, engine=engine,
                           auth_name=args.auth_name)
+    if args.registry_dir or args.registry_addr:
+        from paddle_tpu.distributed.fleet.elastic import (NodeRegistry,
+                                                          TcpNodeRegistry)
+        rid = args.replica_id or f"replica-{os.getpid()}"
+        endpoint = args.advertise or f"{args.host}:{srv.port}"
+        if args.registry_dir:
+            registry = NodeRegistry(args.registry_dir, rid, endpoint)
+        else:
+            registry = TcpNodeRegistry(args.registry_addr, rid, endpoint)
+        registry.register()
+        srv.attach_registry(registry)
+        print(f"REGISTERED {rid} {endpoint}", flush=True)
+    install_sigterm_drain(srv, deadline_s=args.drain_deadline)
     print(f"LISTENING {srv.port}", flush=True)
     if srv.generated_secret is not None:
         # printed ONCE at startup; clients pass it as secret= / the C
@@ -491,6 +673,15 @@ def main(argv=None):
                                        port=args.metrics_port)
         print(f"METRICS {exporter.server_address[1]}", flush=True)
     srv.serve_forever()
+    # serve_forever returns as soon as _stop is set — but a SIGTERM drain
+    # (daemon thread) may still be finishing in-flight work, and the
+    # engine thread still runs its shutdown abort. Exiting now would tear
+    # the backend down under a live device call (C++ terminate at
+    # interpreter shutdown) and skip the stragglers' abort path.
+    if srv._drain_thread is not None:
+        srv._drain_thread.join(timeout=args.drain_deadline + 60.0)
+    if srv._engine_thread is not None:
+        srv._engine_thread.join(timeout=60.0)
 
 
 if __name__ == "__main__":

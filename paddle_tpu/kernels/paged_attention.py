@@ -49,7 +49,8 @@ from paddle_tpu.observability import metrics
 TRASH_PAGE = 0
 
 __all__ = ["TRASH_PAGE", "gather_kv", "paged_attention", "token_page_coords",
-           "prompt_page_coords", "write_token_kv", "write_prompt_kv"]
+           "prompt_page_coords", "chunk_page_coords", "write_token_kv",
+           "write_prompt_kv", "export_pages", "import_pages"]
 
 
 def gather_kv(pages, page_table):
@@ -153,6 +154,55 @@ def prompt_page_coords(page_table, length, seq_len, page_size):
     page = jnp.where((t < length) & (idx < maxp),
                      page_table[jnp.clip(idx, 0, maxp - 1)], TRASH_PAGE)
     return page, t % page_size
+
+
+def chunk_page_coords(page_table, start, valid, seq_len, page_size):
+    """(page, offset) for writing a prefill CHUNK — positions
+    ``start .. start+seq_len-1`` of ONE sequence.
+
+    page_table : [pages_per_slot] int32; start : scalar int32 absolute
+    position of the chunk's first token; valid : scalar int32 true token
+    count in this chunk (chunk-padding positions ``i >= valid`` go to
+    TRASH_PAGE, as do positions past the slot's capacity). The ``start=0,
+    valid=length`` case degenerates to :func:`prompt_page_coords`.
+    Returns ([seq_len], [seq_len]).
+    """
+    maxp = page_table.shape[0]
+    t = start + jnp.arange(seq_len)
+    idx = t // page_size
+    page = jnp.where((jnp.arange(seq_len) < valid) & (idx < maxp),
+                     page_table[jnp.clip(idx, 0, maxp - 1)], TRASH_PAGE)
+    return page, t % page_size
+
+
+def export_pages(k_pages, v_pages, page_list):
+    """Gather the listed pages' contents out of the pool — the send side of
+    the page-granular KV handoff (a prefill finished on one replica resumes
+    decode on another; docs/SERVING.md). The page table makes the transfer a
+    page-index gather, never a tensor-relayout.
+
+    k_pages/v_pages : [num_layers, num_pages, page_size, nh, dh]
+    page_list       : [n] int page indices (a sequence's allocation,
+                      in token order)
+    returns         : (k_blob, v_blob) each [num_layers, n, page_size, nh, dh]
+    """
+    idx = jnp.asarray(page_list, jnp.int32)
+    return k_pages[:, idx], v_pages[:, idx]
+
+
+def import_pages(k_pages, v_pages, k_blob, v_blob, page_list):
+    """Scatter exported page contents into a (different) pool at (different)
+    page indices — the receive side of the KV handoff. Only the page IDS
+    change across the transfer; contents land bit-identical, so decode on
+    the importing replica matches decode where the prefill ran.
+
+    k_blob/v_blob : [num_layers, n, page_size, nh, dh] from `export_pages`
+    page_list     : [n] destination page indices in THIS pool
+    returns       : (k_pages, v_pages) updated
+    """
+    idx = jnp.asarray(page_list, jnp.int32)
+    return (k_pages.at[:, idx].set(k_blob.astype(k_pages.dtype)),
+            v_pages.at[:, idx].set(v_blob.astype(v_pages.dtype)))
 
 
 def write_token_kv(k_pages, v_pages, k, v, page_table, pos, active):

@@ -230,6 +230,62 @@ def prefill_step(params, ids, length, page_table, k_pages, v_pages, *, cfg):
     return _final_logits(params, last), k_pages, v_pages
 
 
+def prefill_chunk_step(params, ids, start, valid, page_table, k_pages,
+                       v_pages, *, cfg):
+    """One CHUNK of a decode-priority chunked prefill into the paged cache.
+
+    The engine splits a long prompt into fixed-size chunks interleaved
+    between decode steps (`EngineConfig.prefill_chunk_tokens`), so a long
+    prompt no longer stalls every in-flight decode for its full prefill
+    wall. ``ids`` is ONE chunk padded to the fixed chunk length C (one
+    compiled program per chunk size — AOT like every other engine program);
+    ``start`` is the absolute position of ``ids[0]``; ``valid`` is the true
+    token count in this chunk.
+
+    Writes the chunk's K/V into the slot's pages (padding and overflow land
+    on the trash page), then attends the chunk's queries over ALL cached
+    positions — previous chunks AND the current one — via the paged gather,
+    masked by absolute position (query at position p sees keys 0..p). Same
+    f32 masked-softmax numerics as `decode_step`, so chunked prefill is
+    token-identical to the one-shot `prefill_step` path.
+
+    returns : (logits [V] f32 of the chunk's LAST valid token — only
+               meaningful on the final chunk — , k_pages, v_pages)
+    """
+    from paddle_tpu.kernels import paged_attention as pa
+    nl, nh = cfg.num_layers, cfg.num_heads
+    dh = cfg.hidden_size // nh
+    scale = 1.0 / (dh ** 0.5)
+    ps = k_pages.shape[2]
+    c = ids.shape[0]
+    pos = start + jnp.arange(c)
+    wpe = params["gpt.wpe.weight"]
+    x = params["gpt.wte.weight"][ids][None] + \
+        wpe[jnp.clip(pos, 0, wpe.shape[0] - 1)][None]        # [1, C, H]
+
+    def attend(i, q, k, v):
+        nonlocal k_pages, v_pages
+        page, off = pa.chunk_page_coords(page_table, start, valid, c, ps)
+        k_pages = k_pages.at[i, page, off].set(k[0])
+        v_pages = v_pages.at[i, page, off].set(v[0])
+        kk = pa.gather_kv(k_pages[i], page_table[None])      # [1, Lmax, ...]
+        vv = pa.gather_kv(v_pages[i], page_table[None])
+        lmax = kk.shape[1]
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                        kk.astype(jnp.float32))
+        # absolute-position causality: within-chunk future tokens sit at
+        # positions > start+i and mask out exactly like unwritten pages
+        mask = jnp.arange(lmax)[None, :] <= pos[:, None]     # [C, Lmax]
+        sc = jnp.where(mask[None, None], sc, -1e30)
+        pr = jax.nn.softmax(sc, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", pr,
+                          vv.astype(jnp.float32)).astype(x.dtype)
+
+    x = _block_stack(params, x, nl, nh, dh, attend)
+    last = x[0, jnp.clip(valid - 1, 0, c - 1)]
+    return _final_logits(params, last), k_pages, v_pages
+
+
 def _sp_constrain(x, cfg):
     """[B, S, H] activations: batch over dp, sequence over sp."""
     if not cfg.seq_parallel or get_mesh() is None:

@@ -1,0 +1,29 @@
+"""Multi-replica serving: the subsystem ABOVE one engine process.
+
+`inference/engine.py` + `inference/serve.py` end at one process on one
+mesh; this package is the scale-out story (ROADMAP item 1, "millions of
+users"): a router front door that load-balances GENERATE requests across N
+engine replicas discovered through the elastic registry
+(`distributed/fleet/elastic.py`), with pluggable placement policies and
+bounded resubmission around replica failures. The multi-program
+coordination shape follows the MPMD pipeline-parallelism paper
+(arxiv 2412.14374) — Python owns placement and membership, every replica
+keeps its own fixed-shape device programs — and replica-level scale-out is
+the serving comparison's production path (arxiv 2605.25645).
+
+Run a fleet (docs/SERVING.md "Scaling out"):
+
+    # replicas register themselves
+    python -m paddle_tpu.inference.serve --gpt-config g.json \
+        --registry-dir /mnt/registry --replica-id r0
+    # the router watches the registry and fronts them
+    python -m paddle_tpu.serving.router --registry-dir /mnt/registry
+
+Clients speak the unchanged serve wire protocol to the router
+(`RemotePredictor` works as-is); the router forwards GENERATE to a replica
+picked by policy, resubmits on replica failure, and serves its own
+STATS/PROMETHEUS from the local metrics registry.
+"""
+from paddle_tpu.serving.router import POLICIES, ReplicaState, Router
+
+__all__ = ["Router", "ReplicaState", "POLICIES"]

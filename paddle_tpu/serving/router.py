@@ -1,0 +1,596 @@
+"""Router: a wire-compatible front door over N engine replicas.
+
+One `DecodeEngine` process serves one mesh; production traffic needs a
+fleet. The router speaks the EXISTING serve wire protocol
+(`inference/serve.py` — hello auth, ops GENERATE/STATS/PROMETHEUS/PING/
+SHUTDOWN), so every client that talks to one replica talks to the router
+unchanged. Behind the front door:
+
+- **Membership** comes from the elastic registry
+  (`distributed/fleet/elastic.py`): replicas register
+  ``node_<id>.json``-style leases (file or TCP backend) and renew them on
+  heartbeats; the router polls ``alive_nodes()`` in observer mode — a
+  replica that joins mid-stream starts receiving traffic on the next poll,
+  a replica whose heartbeat expires is routed around. Static fleets (tests,
+  bench) pass ``replicas={id: "host:port"}`` instead.
+- **Placement policies** (``POLICIES``): ``round_robin`` (default),
+  ``least_outstanding`` (fewest router-tracked in-flight requests), and
+  ``slo_aware`` — the poll thread pulls each replica's metrics snapshot
+  over the STATS op and ranks replicas by their ``serve.tpot_seconds`` p99
+  (the decode SLO the tracing layer maintains), outstanding count as the
+  tiebreak; replicas with no observations yet rank optimistically so fresh
+  capacity warms up.
+- **Failure handling**: a replica that refuses/drops a connection, or
+  answers with a drain/shutdown error, is EVICTED from rotation
+  (``evict_cooldown_s`` before the registry may vouch it back in) and the
+  request is resubmitted to another replica under a bounded budget
+  (``max_resubmits``) — a mid-flight replica kill is a retry, not a
+  client-visible error. Application errors (bad request) relay to the
+  client unchanged and are never resubmitted.
+
+Observability (docs/OBSERVABILITY.md): ``router.requests``,
+``router.replica_errors``, ``router.resubmits``, ``router.no_replica``,
+per-replica ``router.replica_requests{replica=..}`` counters and
+``router.outstanding{replica=..}`` gauges, a ``router.request_seconds``
+histogram, and a ``router.forward`` span per routed request tagged with
+the replica id — one Perfetto filter shows which replica served a request.
+
+The router is deliberately stateless about request CONTENT: GENERATE in,
+int32 ids out. The page-granular KV handoff (`inference/engine.py`
+KVHandoff) is the primitive a later prefill-tier router will ride to move
+half-finished requests between replicas.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import secrets as _secrets
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.inference.serve import (MAGIC, OP_GENERATE, OP_PING,
+                                        OP_PROMETHEUS, OP_RUN, OP_SHUTDOWN,
+                                        OP_STATS, _recv_exact, auth_token,
+                                        recv_arrays, retrying_connect,
+                                        send_arrays, stats_payload)
+from paddle_tpu.observability import metrics
+from paddle_tpu.observability.flight_recorder import flight
+from paddle_tpu.observability.tracing import new_request_id
+
+__all__ = ["Router", "ReplicaState", "POLICIES", "ReplicaUnavailable"]
+
+
+class ReplicaUnavailable(ConnectionError):
+    """The replica answered, but with a not-taking-work error (draining,
+    engine stopped) — resubmit elsewhere, same as a dead connection."""
+
+
+class _ReplicaAppError(RuntimeError):
+    """The replica rejected the REQUEST itself: relaying it to another
+    replica would fail identically, so it goes straight back to the
+    client and never burns resubmit budget."""
+
+
+def _classify_wire_error(msg: str) -> Exception:
+    """Split replica wire errors by the exception TYPE the replica raised
+    (the wire message is ``<Type>: <text>``): a ``ValueError`` is request
+    validation (bad prompt/length — identical on every replica, relay it),
+    as is an engine-less replica serving only RUN; everything else —
+    draining, engine stopped/aborted/died, result timeout — means THIS
+    replica can't finish the work, which is exactly what resubmission is
+    for. Defaulting to resubmittable is deliberate: abort reasons are
+    free-form text, and a missed marker must cost a bounded retry, not a
+    client-visible error."""
+    if msg.startswith("ValueError") or "no decode engine attached" in msg:
+        return _ReplicaAppError(msg)
+    return ReplicaUnavailable(msg)
+
+
+# a replica-answered error justifies EVICTION (not just resubmission of
+# this one request) only when it says the replica stopped taking work;
+# other request-scoped failures ("request needs N pages", result timeout)
+# must not let one bad request empty the whole rotation for a cooldown
+_EVICT_MARKERS = ("drain", "engine stopped", "engine loop died")
+
+
+def _should_evict(e: Exception) -> bool:
+    """Connection-level failures (refused/dropped/timed-out sockets) always
+    evict — the replica's wire stack is gone. A `ReplicaUnavailable` the
+    replica ANSWERED with evicts only on an explicit not-taking-work
+    marker; anything else resubmits this request (the `tried` set already
+    keeps it off the same replica) while the replica stays in rotation
+    for everyone else."""
+    if not isinstance(e, ReplicaUnavailable):
+        return True
+    return any(m in str(e) for m in _EVICT_MARKERS)
+
+
+class ReplicaState:
+    """Router-side view of one engine replica."""
+
+    __slots__ = ("replica_id", "endpoint", "outstanding", "errors",
+                 "draining", "evicted_at", "stats", "stats_at", "_g_out")
+
+    def __init__(self, replica_id: str, endpoint: str):
+        self.replica_id = replica_id
+        self.endpoint = endpoint
+        self.outstanding = 0
+        self.errors = 0
+        self.draining = False
+        self.evicted_at = 0.0
+        self.stats = None          # last STATS snapshot (slo_aware policy)
+        self.stats_at = 0.0
+        self._g_out = metrics.gauge("router.outstanding",
+                                    replica=replica_id)
+
+
+def _pick_round_robin(router: "Router", cands: list[ReplicaState]):
+    router._rr += 1
+    return cands[router._rr % len(cands)]
+
+
+def _pick_least_outstanding(router: "Router", cands: list[ReplicaState]):
+    return min(cands, key=lambda r: (r.outstanding, r.replica_id))
+
+
+def _pick_slo_aware(router: "Router", cands: list[ReplicaState]):
+    """Best observed decode SLO wins: rank by the replica's own
+    ``serve.tpot_seconds`` p99 (pulled over STATS by the poll thread),
+    outstanding as the tiebreak. A replica with no observations yet scores
+    0.0 — optimistic, so fresh capacity gets traffic and earns a score."""
+    def score(r: ReplicaState):
+        tpot = None
+        if r.stats:
+            h = r.stats.get("histograms", {}).get("serve.tpot_seconds")
+            if h:
+                tpot = h.get("p99")
+        return (0.0 if tpot is None else float(tpot), r.outstanding,
+                r.replica_id)
+    return min(cands, key=score)
+
+
+POLICIES = {
+    "round_robin": _pick_round_robin,
+    "least_outstanding": _pick_least_outstanding,
+    "slo_aware": _pick_slo_aware,
+}
+
+
+class Router:
+    """Front door process: accepts serve-protocol connections, forwards
+    GENERATE to a policy-picked replica, resubmits around failures.
+
+    >>> router = Router(replicas={"r0": f"127.0.0.1:{p0}",
+    ...                           "r1": f"127.0.0.1:{p1}"},
+    ...                 replica_secret="fleet", auth_name="front")
+    >>> threading.Thread(target=router.serve_forever, daemon=True).start()
+    >>> cli = RemotePredictor(port=router.port, secret="front")
+    >>> out = cli.generate(prompt_ids, max_new_tokens=64)
+
+    ``registry`` is an observer-mode NodeRegistry / TcpNodeRegistry whose
+    ``alive_nodes()`` maps replica id -> "host:port"; ``replicas`` is the
+    static equivalent (both compose — static entries survive registry
+    churn). ``replica_secret`` is the fleet-shared auth secret every
+    replica was started with (its ``--auth-name``); None falls back to
+    ``PADDLE_SERVE_TOKEN`` on both sides. The router's OWN client-facing
+    auth follows the serve rules: ``auth_name`` > ``PADDLE_SERVE_TOKEN`` >
+    a random per-startup token in ``generated_secret``.
+    """
+
+    def __init__(self, registry=None, replicas=None, policy="round_robin",
+                 host="127.0.0.1", port=0, auth_name=None,
+                 replica_secret=None, poll_interval_s=1.0,
+                 stats_interval_s=5.0, max_resubmits=2,
+                 evict_cooldown_s=5.0, connect_deadline_s=5.0,
+                 request_timeout_s=600.0):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown policy {policy!r}; have {sorted(POLICIES)}")
+        if registry is None and not replicas:
+            raise ValueError("need a registry and/or static replicas")
+        self._registry = registry
+        self._policy = policy
+        self._poll_interval = float(poll_interval_s)
+        self._stats_interval = float(stats_interval_s)
+        self._max_resubmits = int(max_resubmits)
+        self._evict_cooldown = float(evict_cooldown_s)
+        self._connect_deadline = float(connect_deadline_s)
+        self._request_timeout = float(request_timeout_s)
+        self._replica_token = auth_token(
+            None if replica_secret is None else str(replica_secret))
+        self._rr = -1
+        self._rlock = threading.Lock()
+        self._replicas: dict[str, ReplicaState] = {}
+        self._static = dict(replicas or {})
+        # fold the registry in SYNCHRONOUSLY before listening: a
+        # registry-only router must not serve its first poll_interval of
+        # requests with an empty rotation
+        alive = dict(self._static)
+        if registry is not None:
+            try:
+                alive.update(registry.alive_nodes())
+            except OSError:
+                pass               # registry not up yet: the poll catches up
+        self._sync_membership(alive)
+
+        self.generated_secret = None
+        if auth_name is not None:
+            basis = auth_name
+        elif os.environ.get("PADDLE_SERVE_TOKEN"):
+            basis = None
+        else:
+            self.generated_secret = _secrets.token_hex(16)
+            basis = self.generated_secret
+        self._token = auth_token(basis if basis is None else str(basis))
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        # the membership poll thread ALWAYS runs: beyond registry
+        # membership it is what re-admits an error-evicted replica after
+        # the cooldown (static fleets included — without it an eviction
+        # would be permanent). slo_aware's STATS pulls live on their OWN
+        # thread: a half-open replica blocking a stats read must never
+        # stall membership sync
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, daemon=True, name="pt-router-poll")
+        self._poll_thread.start()
+        self._stats_thread = None
+        if self._policy == "slo_aware":
+            self._stats_thread = threading.Thread(
+                target=self._stats_loop, daemon=True,
+                name="pt-router-stats")
+            self._stats_thread.start()
+
+    # ----------------------------------------------------------- membership
+
+    def replica_ids(self, healthy_only=False) -> list[str]:
+        with self._rlock:
+            return sorted(r.replica_id for r in self._replicas.values()
+                          if not (healthy_only and r.draining))
+
+    def _sync_membership(self, alive: dict):
+        """Fold one registry view in: new ids join rotation, missing ids
+        (lease expired or deregistered) leave it, and an error-evicted
+        replica the registry still vouches for re-enters after the
+        cooldown (a crashed process keeps a fresh lease until its TTL —
+        eviction-by-error covers that gap)."""
+        now = time.monotonic()
+        with self._rlock:
+            for rid, ep in alive.items():
+                r = self._replicas.get(rid)
+                if r is None:
+                    self._replicas[rid] = ReplicaState(rid, str(ep))
+                    metrics.counter("router.replica_joins").inc()
+                    flight.record("router.join", replica=rid,
+                                  endpoint=str(ep))
+                else:
+                    r.endpoint = str(ep)
+                    if r.draining and \
+                            now - r.evicted_at >= self._evict_cooldown:
+                        r.draining = False
+            for rid in [rid for rid in self._replicas if rid not in alive]:
+                self._replicas.pop(rid)._g_out.set(0)
+                metrics.counter("router.replica_leaves").inc()
+                flight.record("router.leave", replica=rid)
+
+    def _poll_loop(self):
+        while not self._stop.wait(self._poll_interval):
+            alive = dict(self._static)
+            if self._registry is not None:
+                try:
+                    alive.update(self._registry.alive_nodes())
+                except OSError:
+                    continue       # transient registry outage: hold steady
+            self._sync_membership(alive)
+
+    def _stats_loop(self):
+        while not self._stop.wait(self._poll_interval):
+            self._refresh_stats()
+
+    def _refresh_stats(self):
+        """Pull each healthy replica's STATS snapshot (rate-limited per
+        replica) so `slo_aware` ranks on fresh serve.tpot histograms. A
+        failed pull only ages the cached stats — placement failure
+        handling stays with the forward path."""
+        now = time.monotonic()
+        with self._rlock:
+            due = [r for r in self._replicas.values()
+                   if not r.draining
+                   and now - r.stats_at >= self._stats_interval]
+        for r in due:
+            # stats_at advances on FAILURE too: a wedged replica must be
+            # rate-limited like a healthy one, or it would stay "due" and
+            # stall every poll cycle back to back
+            r.stats_at = time.monotonic()
+            try:
+                # short dedicated IO timeout: a STATS pull is a few KB of
+                # telemetry, never worth the full GENERATE request
+                # timeout — a half-open replica must cost this loop
+                # seconds, not minutes
+                snap = self._replica_op(r, OP_STATS,
+                                        timeout=self._connect_deadline + 5.0)
+                import json
+                r.stats = json.loads(snap.tobytes().decode())
+            except (OSError, ConnectionError, ValueError):
+                pass
+
+    # -------------------------------------------------------------- routing
+
+    def _pick(self, tried: set) -> ReplicaState | None:
+        with self._rlock:
+            cands = [r for r in self._replicas.values()
+                     if not r.draining and r.replica_id not in tried]
+            if not cands:
+                return None
+            cands.sort(key=lambda r: r.replica_id)
+            return POLICIES[self._policy](self, cands)
+
+    def _evict(self, r: ReplicaState, reason: str):
+        with self._rlock:
+            r.draining = True
+            r.evicted_at = time.monotonic()
+            r.errors += 1
+        flight.record("router.evict", replica=r.replica_id, reason=reason)
+
+    def _replica_op(self, r: ReplicaState, op: int, arrays=(),
+                    timeout=None):
+        """One request/response exchange with a replica on a fresh authed
+        connection. Returns the response arrays (GENERATE) or single
+        payload array (STATS/PROMETHEUS). A connection per exchange is
+        deliberate: the failure classification (`_classify_wire_error`)
+        needs request/response isolation — a resubmitted request must
+        never read a half-delivered response from a previous exchange —
+        and it keeps the router stateless about replica sockets; a
+        persistent-pool optimization would buy one connect RTT per
+        request at the cost of desync tracking."""
+        host, port = r.endpoint.rsplit(":", 1)
+        sock = retrying_connect(host, int(port),
+                                timeout=timeout if timeout is not None
+                                else self._request_timeout, attempts=2,
+                                deadline_s=self._connect_deadline)
+        try:
+            sock.sendall(struct.pack("<I", MAGIC) + self._replica_token)
+            sock.sendall(struct.pack("<III", MAGIC, op, len(arrays)))
+            if arrays:
+                send_arrays(sock, arrays)
+            magic, status, n = struct.unpack(
+                "<III", _recv_exact(sock, 12))
+            if magic != MAGIC:
+                raise ConnectionError(
+                    f"bad magic from replica {r.replica_id} (auth "
+                    f"mismatch drops the connection — check "
+                    f"replica_secret)")
+            if status != 0:
+                msg = _recv_exact(sock, n).decode(errors="replace")
+                raise _classify_wire_error(msg)
+            outs = recv_arrays(sock, n)
+            return outs if op == OP_GENERATE else outs[0]
+        finally:
+            sock.close()
+
+    def _route_generate(self, arrays) -> list[np.ndarray]:
+        """Forward one GENERATE to a policy-picked replica; on replica
+        failure evict it and resubmit elsewhere, up to ``max_resubmits``
+        times. Raises to the client only when the budget or the healthy
+        set is exhausted (or the request itself is bad)."""
+        rid_req = new_request_id()
+        budget = self._max_resubmits
+        tried: set[str] = set()
+        t0 = time.perf_counter()
+        last_err = None
+        while True:
+            r = self._pick(tried)
+            if r is None:
+                metrics.counter("router.no_replica").inc()
+                raise RuntimeError(
+                    "router: no healthy replica available"
+                    + (f" (last error from {last_err})" if last_err
+                       else ""))
+            with self._rlock:
+                r.outstanding += 1
+                r._g_out.set(r.outstanding)
+            try:
+                outs = self._replica_op(r, OP_GENERATE, arrays)
+            except (ReplicaUnavailable, ConnectionError, socket.timeout,
+                    OSError) as e:
+                last_err = f"{r.replica_id}: {type(e).__name__}: {e}"
+                metrics.counter("router.replica_errors").inc()
+                if _should_evict(e):
+                    self._evict(r, f"{type(e).__name__}: {e}")
+                tried.add(r.replica_id)
+                if budget <= 0:
+                    raise RuntimeError(
+                        f"router: resubmit budget "
+                        f"({self._max_resubmits}) exhausted; last "
+                        f"replica error: {last_err}") from e
+                budget -= 1
+                metrics.counter("router.resubmits").inc()
+                continue
+            finally:
+                with self._rlock:
+                    r.outstanding -= 1
+                    r._g_out.set(r.outstanding)
+            dt = time.perf_counter() - t0
+            metrics.counter("router.requests").inc()
+            metrics.counter("router.replica_requests",
+                            replica=r.replica_id).inc()
+            metrics.histogram("router.request_seconds").observe(dt)
+            metrics.add_span("router.forward", t0, dt, cat="router",
+                             args={"request_id": rid_req,
+                                   "replica": r.replica_id})
+            return outs
+
+    # ------------------------------------------------------------ wire side
+
+    def serve_forever(self):
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.5)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._client_loop, args=(conn,),
+                             daemon=True).start()
+        self._sock.close()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _client_loop(self, conn):
+        """Same protocol discipline as `InferenceServer._client_loop`:
+        authed hello, then ops; any error mid-request reports and drops
+        the connection (stream position is unknowable after a partial
+        body). The framing/auth skeleton is intentionally a sibling copy
+        of serve's loop for now — the op BODIES differ everywhere (local
+        predictor/engine vs forwarding) and serve's loop is interwoven
+        with them; extracting a shared protocol-server core is the
+        follow-up that should ride the next wire-protocol change."""
+        import hmac
+        try:
+            try:
+                conn.settimeout(10.0)
+                hello = _recv_exact(conn, 4 + 32)
+            except (ConnectionError, socket.timeout):
+                return
+            (magic,) = struct.unpack("<I", hello[:4])
+            if magic != MAGIC or not hmac.compare_digest(hello[4:],
+                                                         self._token):
+                return
+            conn.settimeout(None)
+            while not self._stop.is_set():
+                try:
+                    head = _recv_exact(conn, 12)
+                except ConnectionError:
+                    return
+                magic, op, n = struct.unpack("<III", head)
+                if magic != MAGIC:
+                    self._send_err(conn, "bad magic")
+                    return
+                if op == OP_PING:
+                    conn.sendall(struct.pack("<III", MAGIC, 0, 0))
+                    continue
+                if op == OP_STATS:
+                    # the ROUTER's registry: router.* counters, per-replica
+                    # outstanding gauges, plus anything else this process
+                    # recorded
+                    conn.sendall(struct.pack("<III", MAGIC, 0, 1))
+                    send_arrays(conn, [stats_payload()])
+                    continue
+                if op == OP_PROMETHEUS:
+                    conn.sendall(struct.pack("<III", MAGIC, 0, 1))
+                    send_arrays(conn, [np.frombuffer(
+                        metrics.to_prometheus().encode(),
+                        dtype=np.uint8).copy()])
+                    continue
+                if op == OP_SHUTDOWN:
+                    conn.sendall(struct.pack("<III", MAGIC, 0, 0))
+                    self.stop()
+                    return
+                try:
+                    arrays = recv_arrays(conn, n)
+                    if op == OP_RUN:
+                        raise RuntimeError(
+                            "router fronts GENERATE/STATS/PROMETHEUS "
+                            "only; RUN needs a direct replica connection")
+                    if op != OP_GENERATE:
+                        raise RuntimeError(f"unknown op {op}")
+                    outs = self._route_generate(arrays)
+                    conn.sendall(
+                        struct.pack("<III", MAGIC, 0, len(outs)))
+                    send_arrays(conn, outs)
+                except Exception as e:  # noqa: BLE001 — wire to client
+                    metrics.counter("router.errors").inc()
+                    # relay replica app errors VERBATIM: the client (or a
+                    # second-tier router classifying by prefix) must see
+                    # exactly what a direct replica connection would send
+                    msg = str(e) if isinstance(e, _ReplicaAppError) \
+                        else f"{type(e).__name__}: {e}"
+                    self._send_err(conn, msg)
+                    return
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _send_err(conn, msg):
+        raw = msg.encode()
+        conn.sendall(struct.pack("<III", MAGIC, 1, len(raw)) + raw)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("paddle_tpu.serving.router")
+    ap.add_argument("--registry-dir", default=None,
+                    help="shared-filesystem elastic registry to watch for "
+                         "replica membership (observer mode)")
+    ap.add_argument("--registry-addr", default=None,
+                    help="host:port of a TcpRegistryServer to watch "
+                         "(needs PADDLE_ELASTIC_TOKEN)")
+    ap.add_argument("--replica", action="append", default=[],
+                    metavar="ID=HOST:PORT",
+                    help="static replica entry (repeatable; composes with "
+                         "the registry)")
+    ap.add_argument("--policy", default="round_robin",
+                    choices=sorted(POLICIES))
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--auth-name", default=None,
+                    help="router's client-facing auth secret; default "
+                         "PADDLE_SERVE_TOKEN or a random token printed "
+                         "once as 'TOKEN <hex>'")
+    ap.add_argument("--replica-secret", default=None,
+                    help="fleet-shared replica auth secret (each "
+                         "replica's --auth-name); default "
+                         "PADDLE_SERVE_TOKEN")
+    ap.add_argument("--poll-interval", type=float, default=1.0)
+    ap.add_argument("--max-resubmits", type=int, default=2)
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="also serve GET /metrics (Prometheus text) from "
+                         "a stdlib HTTP endpoint on this port")
+    args = ap.parse_args(argv)
+    replicas = {}
+    for spec in args.replica:
+        rid, _, ep = spec.partition("=")
+        if not ep:
+            ap.error(f"--replica wants ID=HOST:PORT, got {spec!r}")
+        replicas[rid] = ep
+    registry = None
+    if args.registry_dir:
+        from paddle_tpu.distributed.fleet.elastic import NodeRegistry
+        registry = NodeRegistry(args.registry_dir)
+    elif args.registry_addr:
+        from paddle_tpu.distributed.fleet.elastic import TcpNodeRegistry
+        registry = TcpNodeRegistry(args.registry_addr)
+    if registry is None and not replicas:
+        ap.error("need --registry-dir, --registry-addr, or --replica")
+    router = Router(registry=registry, replicas=replicas,
+                    policy=args.policy, host=args.host, port=args.port,
+                    auth_name=args.auth_name,
+                    replica_secret=args.replica_secret,
+                    poll_interval_s=args.poll_interval,
+                    max_resubmits=args.max_resubmits)
+    print(f"LISTENING {router.port}", flush=True)
+    if router.generated_secret is not None:
+        print(f"TOKEN {router.generated_secret}", flush=True)
+    if args.metrics_port is not None:
+        from paddle_tpu.observability.prometheus import start_http_exporter
+        exporter = start_http_exporter(host=args.host,
+                                       port=args.metrics_port)
+        print(f"METRICS {exporter.server_address[1]}", flush=True)
+    router.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

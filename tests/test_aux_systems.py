@@ -341,6 +341,22 @@ class TestElasticMembership:
         for r in (r1, r2):
             r.leave()
 
+    def test_observer_mode_watches_but_cannot_register(self, tmp_path):
+        """The serving router's view of the registry: no node_id/endpoint
+        -> alive_nodes() works, register()/leave() refuse (an observer
+        must not be able to publish a phantom member)."""
+        import pytest
+        from paddle_tpu.distributed.fleet.elastic import NodeRegistry
+        member = self._reg(tmp_path, "a", "10.0.0.1:8000").register()
+        obs = NodeRegistry(str(tmp_path))
+        assert obs.alive_nodes() == {"a": "10.0.0.1:8000"}
+        with pytest.raises(RuntimeError, match="observer"):
+            obs.register()
+        with pytest.raises(RuntimeError, match="observer"):
+            obs.leave()
+        member.leave()
+        assert obs.alive_nodes() == {}
+
 
 class TestTcpElasticRegistry:
     """TcpNodeRegistry / TcpRegistryServer (r4 verdict weak #6): etcd-like

@@ -486,3 +486,164 @@ class TestServeGenerate:
         cli2 = RemotePredictor(port=srv.port, model_prefix="engine")
         cli2.shutdown_server()
         cli2.close()
+
+
+class TestChunkedPrefill:
+    """Decode-priority chunked prefill (EngineConfig.prefill_chunk_tokens):
+    token-identical to the one-shot bucketed path, and a long prompt no
+    longer stalls in-flight decodes for its full prefill wall."""
+
+    def test_token_parity_across_chunk_and_page_boundaries(self):
+        """Chunked == unchunked == fast_generate for prompts below the
+        chunk size (one-shot path), exactly 2 chunks, ragged tails, and
+        chunk edges that straddle page edges (page 4, chunk 8, prompt 33:
+        pages and chunks interleave off-phase)."""
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        rng = np.random.RandomState(5)
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8,
+                                           prefill_chunk_tokens=8))
+        for s in (5, 16, 20, 33):
+            prompt = rng.randint(0, 97, s).astype(np.int32)
+            req = eng.submit(prompt, max_new_tokens=10)
+            eng.run_until_idle(max_steps=200)
+            np.testing.assert_array_equal(req.result(timeout=30),
+                                          _fast_ref(m, prompt, 10))
+
+    def test_decodes_keep_running_during_long_prefill(self):
+        """The tentpole scheduling property, pinned by ORDERING (no wall
+        clocks): two short requests mid-decode finish BEFORE a long
+        prompt's first token when its prefill is chunked (one chunk per
+        step interleaves with their decode steps) — and AFTER it when the
+        prefill is one-shot (the whole wall lands inside one step)."""
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        rng = np.random.RandomState(6)
+        long_prompt = rng.randint(0, 97, 40).astype(np.int32)
+
+        def run(chunk):
+            m = _tiny_model()
+            eng = DecodeEngine(m, EngineConfig(
+                page_size=4, max_slots=4, min_bucket=8,
+                prefill_chunk_tokens=chunk))
+            eng.warmup(prompt_lens=[3, 40])
+            shorts = [eng.submit(rng.randint(0, 97, 3).astype(np.int32),
+                                 max_new_tokens=8) for _ in range(2)]
+            for _ in range(2):
+                eng.step()              # shorts are decoding
+            long_req = eng.submit(long_prompt, max_new_tokens=4)
+            eng.run_until_idle(max_steps=300)
+            for r in shorts + [long_req]:
+                assert r.done and r._error is None
+            return shorts, long_req
+
+        shorts, long_req = run(chunk=4)    # 10 chunks vs 6 decode steps
+        assert all(r.trace.t_done < long_req.trace.t_first_token
+                   for r in shorts), (
+            "chunked: shorts must finish while the long prompt prefills")
+        assert metrics.snapshot()["counters"]["engine.prefill_chunks"] >= 10
+
+        shorts, long_req = run(chunk=None)  # one-shot baseline
+        assert all(long_req.trace.t_first_token < r.trace.t_done
+                   for r in shorts), (
+            "unchunked: the one-shot prefill should finish before the "
+            "shorts' remaining decode steps (this is the stall chunking "
+            "removes)")
+
+    def test_chunked_abort_reclaims_prefilling_slot(self):
+        """abort() mid-chunking: the prefilling request fails with the
+        reason, its pages return to the pool, and the engine refuses new
+        submits."""
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8,
+                                           prefill_chunk_tokens=8))
+        rng = np.random.RandomState(7)
+        free0 = eng.allocator.free_pages
+        req = eng.submit(rng.randint(0, 97, 30).astype(np.int32), 8)
+        eng.step()                        # first chunk only
+        assert not req.done
+        eng.abort("test kill")
+        with pytest.raises(RuntimeError, match="test kill"):
+            req.result(timeout=5)
+        assert eng.allocator.free_pages == free0
+        with pytest.raises(RuntimeError, match="engine stopped"):
+            eng.submit(rng.randint(0, 97, 3).astype(np.int32), 2)
+
+
+class TestKVHandoff:
+    """Page-granular KV export/import (KVHandoff): prefill on one engine,
+    decode on another, token-identical to never having moved."""
+
+    def test_round_trip_matches_same_engine_decode(self):
+        from paddle_tpu.inference.engine import (DecodeEngine, EngineConfig,
+                                                 KVHandoff)
+        m = _tiny_model()
+        rng = np.random.RandomState(8)
+        prompt = rng.randint(0, 97, 21).astype(np.int32)
+        ref = _fast_ref(m, prompt, 12)
+
+        # exporter uses CHUNKED prefill, importer is a plain engine: the
+        # handoff format is scheduler-agnostic
+        eng_a = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                             min_bucket=8,
+                                             prefill_chunk_tokens=8))
+        eng_b = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                             min_bucket=8))
+        h = eng_a.prefill_export(prompt)
+        assert eng_a.allocator.free_pages == eng_a.allocator.num_pages - 1
+        blob = h.pack()
+        h2 = KVHandoff.unpack(blob)
+        np.testing.assert_array_equal(h2.k_pages, h.k_pages)
+        req = eng_b.import_request(h2, max_new_tokens=12)
+        eng_b.run_until_idle(max_steps=100)
+        np.testing.assert_array_equal(req.result(timeout=30), ref)
+
+    def test_import_shares_decode_batch_with_local_requests(self):
+        """An imported request decodes alongside locally-prefilled ones in
+        the same fixed-shape step."""
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        rng = np.random.RandomState(9)
+        p_remote = rng.randint(0, 97, 9).astype(np.int32)
+        p_local = rng.randint(0, 97, 6).astype(np.int32)
+        eng_a = DecodeEngine(m, EngineConfig(page_size=4, max_slots=1,
+                                             min_bucket=8))
+        eng_b = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                             min_bucket=8))
+        h = eng_a.prefill_export(p_remote)
+        r_local = eng_b.submit(p_local, max_new_tokens=8)
+        r_remote = eng_b.import_request(h, max_new_tokens=8)
+        eng_b.run_until_idle(max_steps=100)
+        np.testing.assert_array_equal(r_remote.result(timeout=30),
+                                      _fast_ref(m, p_remote, 8))
+        np.testing.assert_array_equal(r_local.result(timeout=30),
+                                      _fast_ref(m, p_local, 8))
+
+    def test_geometry_mismatch_refused(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        rng = np.random.RandomState(10)
+        prompt = rng.randint(0, 97, 9).astype(np.int32)
+        eng_a = DecodeEngine(m, EngineConfig(page_size=4, max_slots=1,
+                                             min_bucket=8))
+        h = eng_a.prefill_export(prompt)
+        eng_psize = DecodeEngine(m, EngineConfig(page_size=8, max_slots=1,
+                                                 min_bucket=8))
+        with pytest.raises(ValueError, match="page_size mismatch"):
+            eng_psize.import_request(h, max_new_tokens=4)
+        m2 = _tiny_model(seed=8)
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        import paddle_tpu as paddle
+        paddle.seed(8)
+        cfg4 = GPTConfig(vocab_size=97, hidden_size=64, num_layers=2,
+                         num_heads=4, intermediate_size=64,
+                         max_position_embeddings=64, hidden_dropout=0.0,
+                         attention_dropout=0.0)
+        eng_heads = DecodeEngine(GPTForCausalLM(cfg4),
+                                 EngineConfig(page_size=4, max_slots=1,
+                                              min_bucket=8))
+        with pytest.raises(ValueError, match="geometry mismatch"):
+            eng_heads.import_request(h, max_new_tokens=4)
+        del m2

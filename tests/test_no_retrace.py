@@ -83,6 +83,40 @@ def test_new_bucket_compiles_exactly_once():
     assert _compile_counters() == after_new
 
 
+def test_chunked_prefill_compiles_once():
+    """Decode-priority chunked prefill keeps the AOT discipline: ONE chunk
+    program regardless of prompt length (every chunk, tail included, pads
+    to the fixed chunk size), and chunked traffic after warmup never
+    retraces — prompts at/below the chunk size still ride the warm
+    bucketed path."""
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    m = _tiny_model()
+    eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=3,
+                                       min_bucket=8,
+                                       prefill_chunk_tokens=8))
+    rng = np.random.RandomState(4)
+    # warmup compiles decode + the chunk program (len 20 > chunk 8) + the
+    # bucket-8 program (len 5 takes the one-shot path)
+    eng.warmup(prompt_lens=[5, 20])
+    r = eng.submit(rng.randint(0, 64, 20).astype(np.int32), 3)
+    eng.run_until_idle(max_steps=60)
+    assert r.done
+    frozen = _compile_counters()
+
+    # churn: chunked prompts of different lengths (2, 3, 5 chunks with
+    # ragged tails), short one-shot prompts, decode running throughout
+    reqs = [eng.submit(rng.randint(0, 64, s).astype(np.int32), 3)
+            for s in (13, 24, 37, 5, 17)]
+    eng.run_until_idle(max_steps=300)
+    for req in reqs:
+        assert req.done
+    assert metrics.snapshot()["counters"].get("engine.prefill_chunks", 0) \
+        >= 3 + 2 + 3 + 5, "chunked path did not run"
+    assert _compile_counters() == frozen, (
+        "chunked prefill recompiled after warmup: every chunk must be one "
+        "fixed program shape")
+
+
 def test_scan_train_step_compiles_once_and_donates():
     """The captured scan-over-layers train step (paddle_tpu/train): exactly
     ONE compile across N steps with changing batch CONTENTS, frozen

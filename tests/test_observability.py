@@ -353,6 +353,11 @@ def test_bench_smoke_emits_structured_json():
     assert d["slo"]["ttft_p50"] <= d["slo"]["e2e_p50"]
     assert 0 < d["train_mfu"] <= 1.0
     assert d["metrics"]["histograms"]["serve.ttft_seconds"]["count"] >= 3
+    # r6: the smoke run routes one request through the serving router (2
+    # wire hops, static membership) and chunk-prefills every engine prompt
+    assert d["router_ok"] is True
+    assert d["prefill_chunks"] >= 3
+    assert d["metrics"]["counters"]["router.requests"] >= 1
 
 
 def test_bench_emission_survives_failing_platform_plugin(tmp_path):

@@ -1,0 +1,515 @@
+"""Multi-replica serving router (paddle_tpu/serving/router.py): policy
+placement, failure resubmission, elastic-registry membership churn, and
+graceful drain.
+
+Replicas here are real in-process InferenceServers with real engines on
+CPU — every routed GENERATE is checked token-identical against dense
+`fast_generate`, so the router can never pass by returning the wrong
+replica's (or a truncated) result.
+"""
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import metrics
+
+FLEET_SECRET = "test-fleet"
+
+
+def _tiny_model(seed=7):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=2, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def _fast_ref(model, prompt, n):
+    ids = paddle.Tensor(np.asarray(prompt)[None].astype(np.int32),
+                        _internal=True)
+    return np.asarray(model.fast_generate(ids, max_new_tokens=n).numpy())[0]
+
+
+def _replica(model, **ekw):
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.inference.serve import InferenceServer
+    eng = DecodeEngine(model, EngineConfig(
+        page_size=4, max_slots=2, min_bucket=8, **ekw))
+    srv = InferenceServer(None, engine=eng, auth_name=FLEET_SECRET)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _router(**kw):
+    from paddle_tpu.serving import Router
+    kw.setdefault("replica_secret", FLEET_SECRET)
+    kw.setdefault("auth_name", "router-front")
+    router = Router(**kw)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    return router
+
+
+def _client(router):
+    from paddle_tpu.inference.serve import RemotePredictor
+    return RemotePredictor(port=router.port, secret="router-front")
+
+
+def _kill(srv):
+    """Hard-kill a replica: stop the engine thread first (its shutdown
+    abort then runs ON the engine thread — no cross-thread race with a
+    mid-device-call step), then close the listener. In-flight wire
+    requests error out ("engine stopped"), new connects are refused."""
+    srv._stop.set()
+    if srv._engine_thread is not None:
+        srv._engine_thread.join(timeout=30)
+    srv._sock.close()
+
+
+def _wait_for(pred, timeout=10.0, msg="condition"):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.02)
+
+
+class TestRetryHelper:
+    """serve.retrying_connect: exponential backoff + jitter + hard
+    deadline (satellite: a replica restart used to be an instant
+    ConnectionRefusedError)."""
+
+    def test_gives_up_after_attempts(self):
+        from paddle_tpu.inference.serve import retrying_connect
+        # a bound-but-unlistened port refuses instantly
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError, match="after 3 attempts"):
+            retrying_connect("127.0.0.1", dead_port, attempts=3,
+                             base_delay_s=0.02, jitter=0.0)
+        # two backoff sleeps happened: 0.02 + 0.04
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_hard_deadline_caps_total_time(self):
+        from paddle_tpu.inference.serve import retrying_connect
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            retrying_connect("127.0.0.1", dead_port, attempts=1000,
+                             base_delay_s=0.05, deadline_s=0.3)
+        assert time.monotonic() - t0 < 2.0
+
+    def test_rides_out_a_restart(self):
+        """The server appears AFTER the first attempts fail — the client
+        connects instead of erroring (RemotePredictor path included)."""
+        from paddle_tpu.inference.serve import retrying_connect
+        holder = socket.socket()
+        holder.bind(("127.0.0.1", 0))
+        port = holder.getsockname()[1]
+        holder.close()
+        srv_sock = {}
+
+        def late_listen():
+            time.sleep(0.25)
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", port))
+            s.listen(1)
+            srv_sock["s"] = s
+
+        t = threading.Thread(target=late_listen, daemon=True)
+        t.start()
+        conn = retrying_connect("127.0.0.1", port, attempts=30,
+                                base_delay_s=0.05, deadline_s=5.0)
+        conn.close()
+        t.join()
+        srv_sock["s"].close()
+
+
+class TestRouterRouting:
+    def test_round_robin_spreads_and_matches_reference(self):
+        m = _tiny_model()
+        s0, s1 = _replica(m), _replica(m)
+        router = _router(replicas={"r0": f"127.0.0.1:{s0.port}",
+                                   "r1": f"127.0.0.1:{s1.port}"})
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 97, 4 + i).astype(np.int32)
+                   for i in range(4)]
+        cli = _client(router)
+        for p in prompts:
+            np.testing.assert_array_equal(cli.generate(p, max_new_tokens=6),
+                                          _fast_ref(m, p, 6))
+        stats = cli.stats()
+        per = {k: v for k, v in stats["counters"].items()
+               if k.startswith("router.replica_requests")}
+        assert per.get("router.replica_requests{replica=r0}", 0) >= 2
+        assert per.get("router.replica_requests{replica=r1}", 0) >= 2
+        assert stats["counters"]["router.requests"] >= 4
+        cli.close()
+        router.stop()
+        _kill(s0), _kill(s1)
+
+    def test_policies_pick_as_documented(self):
+        """Policy unit surface: least_outstanding takes the idle replica,
+        slo_aware ranks by the replica's serve.tpot p99 (optimistic when
+        unobserved), round_robin cycles."""
+        from paddle_tpu.serving.router import (POLICIES, ReplicaState,
+                                               Router)
+        router = Router.__new__(Router)     # policy fns only need ._rr
+        router._rr = -1
+        a, b, c = (ReplicaState(i, f"h:{n}")
+                   for n, i in enumerate(("a", "b", "c")))
+        a.outstanding, b.outstanding, c.outstanding = 3, 1, 2
+        assert POLICIES["least_outstanding"](router, [a, b, c]) is b
+        a.stats = {"histograms": {"serve.tpot_seconds": {"p99": 0.004}}}
+        b.stats = {"histograms": {"serve.tpot_seconds": {"p99": 0.009}}}
+        # c has no stats yet: optimistic 0.0 beats both observed replicas
+        assert POLICIES["slo_aware"](router, [a, b, c]) is c
+        c.stats = {"histograms": {"serve.tpot_seconds": {"p99": 0.007}}}
+        assert POLICIES["slo_aware"](router, [a, b, c]) is a
+        picks = [POLICIES["round_robin"](router, [a, b, c]).replica_id
+                 for _ in range(6)]
+        assert picks == ["a", "b", "c", "a", "b", "c"]
+
+    def test_app_error_relays_without_resubmit(self):
+        """A BAD REQUEST (prompt past engine capacity) fails identically
+        everywhere: the router relays the replica's error and burns no
+        resubmit budget on it."""
+        m = _tiny_model()
+        s0 = _replica(m)
+        router = _router(replicas={"r0": f"127.0.0.1:{s0.port}"})
+        base = metrics.snapshot()["counters"].get("router.resubmits", 0)
+        cli = _client(router)
+        with pytest.raises(RuntimeError, match="max_seq_len") as excinfo:
+            cli.generate(np.arange(50, dtype=np.int32) % 97,
+                         max_new_tokens=60)
+        # relayed VERBATIM: exactly the message a direct replica
+        # connection would send, no router-internal wrapper prefix
+        assert str(excinfo.value).startswith("ValueError:"), excinfo.value
+        assert metrics.snapshot()["counters"].get("router.resubmits",
+                                                  0) == base
+        cli.close()
+        router.stop()
+        _kill(s0)
+
+
+class TestRouterFailover:
+    def test_dead_replica_from_start_is_routed_around(self):
+        """One endpoint never listens: every request still completes, the
+        dead replica is evicted after its first error, resubmits are
+        counted."""
+        m = _tiny_model()
+        s1 = _replica(m)
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        router = _router(replicas={"dead": f"127.0.0.1:{dead_port}",
+                                   "live": f"127.0.0.1:{s1.port}"},
+                         connect_deadline_s=0.5, evict_cooldown_s=60.0)
+        rng = np.random.RandomState(4)
+        cli = _client(router)
+        for i in range(4):
+            p = rng.randint(0, 97, 5 + i).astype(np.int32)
+            np.testing.assert_array_equal(cli.generate(p, max_new_tokens=5),
+                                          _fast_ref(m, p, 5))
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("router.resubmits", 0) >= 1
+        assert snap.get("router.replica_errors", 0) >= 1
+        assert "dead" not in router.replica_ids(healthy_only=True)
+        cli.close()
+        router.stop()
+        _kill(s1)
+
+    def test_kill_replica_mid_run_zero_client_errors(self):
+        """The acceptance scenario: mixed long-prefill + short-decode
+        traffic on 2 chunked replicas; one replica is KILLED mid-run.
+        Every request completes token-correct via resubmission — zero
+        client-visible errors."""
+        m = _tiny_model()
+        s0 = _replica(m, prefill_chunk_tokens=8)
+        s1 = _replica(m, prefill_chunk_tokens=8)
+        router = _router(replicas={"r0": f"127.0.0.1:{s0.port}",
+                                   "r1": f"127.0.0.1:{s1.port}"},
+                         connect_deadline_s=0.5, evict_cooldown_s=60.0)
+        rng = np.random.RandomState(5)
+        shorts = [rng.randint(0, 97, 4).astype(np.int32) for _ in range(8)]
+        long_p = rng.randint(0, 97, 40).astype(np.int32)
+        outs: dict = {}
+        errs: list = []
+
+        def one(i, p, n):
+            from paddle_tpu.inference.serve import RemotePredictor
+            try:
+                cli = RemotePredictor(port=router.port,
+                                      secret="router-front")
+                outs[i] = cli.generate(p, max_new_tokens=n)
+                cli.close()
+            except Exception as e:  # noqa: BLE001 — recorded, test-failed
+                errs.append((i, repr(e)))
+
+        # phase 1: two requests land (both replicas warm + known-good)
+        one(0, shorts[0], 6)
+        one("long", long_p, 4)
+        _kill(s0)          # rolling-deploy kill: r0 gone mid-fleet
+        # phase 2: concurrent mixed burst — round robin WILL pick dead r0
+        ths = [threading.Thread(target=one, args=(i, p, 6))
+               for i, p in enumerate(shorts[1:], start=1)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+        assert not errs, f"client-visible errors: {errs}"
+        for i, p in enumerate(shorts):
+            np.testing.assert_array_equal(outs[i], _fast_ref(m, p, 6))
+        np.testing.assert_array_equal(outs["long"],
+                                      _fast_ref(m, long_p, 4))
+        assert metrics.snapshot()["counters"].get("router.resubmits",
+                                                  0) >= 1
+        cli = _client(router)
+        assert cli.stats()["counters"]["router.requests"] >= 10
+        cli.close()
+        router.stop()
+        _kill(s1)
+
+
+    def test_wire_error_classification(self):
+        """Resubmit/relay split is by exception TYPE: validation and
+        missing-engine config errors relay (identical on every replica);
+        draining/stopped/timeout — including free-form abort reasons —
+        resubmit."""
+        from paddle_tpu.serving.router import (ReplicaUnavailable,
+                                               _classify_wire_error,
+                                               _ReplicaAppError)
+        relayed = (
+            "ValueError: prompt 50 + max_new_tokens 60 exceeds engine "
+            "max_seq_len=64",
+            "RuntimeError: no decode engine attached (start with "
+            "--gpt-config or engine=)",
+        )
+        for m in relayed:
+            assert isinstance(_classify_wire_error(m), _ReplicaAppError), m
+        resubmitted = (
+            "RuntimeError: engine draining: not accepting new requests",
+            "RuntimeError: server draining: not accepting new requests",
+            "RuntimeError: engine stopped: replica killed mid-run",
+            "RuntimeError: some free-form abort reason",
+            "TimeoutError: generation still running",
+        )
+        for m in resubmitted:
+            assert isinstance(_classify_wire_error(m),
+                              ReplicaUnavailable), m
+
+    def test_eviction_reserved_for_not_taking_work(self):
+        """A replica-answered request-scoped failure (pool too small for
+        THIS request, result timeout) resubmits without evicting — one
+        bad request must not empty the rotation; connection-level
+        failures and explicit drain/stopped answers do evict."""
+        from paddle_tpu.serving.router import (ReplicaUnavailable,
+                                               _should_evict)
+        assert not _should_evict(ReplicaUnavailable(
+            "RuntimeError: request needs 40 pages, pool has 16"))
+        assert not _should_evict(ReplicaUnavailable(
+            "TimeoutError: generation still running"))
+        assert _should_evict(ReplicaUnavailable(
+            "RuntimeError: engine draining: not accepting new requests"))
+        assert _should_evict(ReplicaUnavailable(
+            "RuntimeError: engine stopped: replica killed mid-run"))
+        assert _should_evict(ConnectionError("connection refused"))
+        assert _should_evict(socket.timeout("timed out"))
+
+    def test_evicted_static_replica_recovers_after_cooldown(self):
+        """A STATIC fleet (no registry) must also heal: an error-evicted
+        replica re-enters rotation after evict_cooldown_s once its
+        endpoint answers again — eviction is a cooldown, never a death
+        sentence."""
+        m = _tiny_model()
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        router = _router(replicas={"r0": f"127.0.0.1:{port}"},
+                         connect_deadline_s=0.3, evict_cooldown_s=0.5,
+                         poll_interval_s=0.1)
+        cli = _client(router)
+        with pytest.raises(RuntimeError):
+            cli.generate(np.array([1, 2, 3], np.int32), max_new_tokens=2)
+        assert "r0" not in router.replica_ids(healthy_only=True)
+        # the replica comes back on the advertised endpoint; the poll
+        # loop re-admits it after the cooldown and traffic flows again
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        from paddle_tpu.inference.serve import InferenceServer
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8))
+        srv = InferenceServer(None, host="127.0.0.1", port=port,
+                              engine=eng, auth_name=FLEET_SECRET)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        _wait_for(lambda: "r0" in router.replica_ids(healthy_only=True),
+                  msg="cooldown re-admission")
+        p = np.array([4, 5, 6], np.int32)
+        cli2 = _client(router)
+        np.testing.assert_array_equal(cli2.generate(p, max_new_tokens=4),
+                                      _fast_ref(m, p, 4))
+        cli2.close()
+        router.stop()
+        _kill(srv)
+
+
+class TestRegistryMembership:
+    """Elastic-registry-driven membership (satellite): joins mid-stream,
+    heartbeat expiry, deregistration."""
+
+    def test_replica_joins_mid_stream_and_gets_traffic(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import NodeRegistry
+        m = _tiny_model()
+        s0 = _replica(m)
+        reg0 = NodeRegistry(str(tmp_path), "r0", f"127.0.0.1:{s0.port}",
+                            ttl=30.0, heartbeat_interval=0.1).register()
+        router = _router(registry=NodeRegistry(str(tmp_path)),
+                         poll_interval_s=0.05)
+        _wait_for(lambda: "r0" in router.replica_ids(), msg="r0 discovery")
+        rng = np.random.RandomState(6)
+        cli = _client(router)
+        p = rng.randint(0, 97, 5).astype(np.int32)
+        np.testing.assert_array_equal(cli.generate(p, max_new_tokens=5),
+                                      _fast_ref(m, p, 5))
+        # r1 joins mid-stream: registered -> discovered -> serving
+        s1 = _replica(m)
+        reg1 = NodeRegistry(str(tmp_path), "r1", f"127.0.0.1:{s1.port}",
+                            ttl=30.0, heartbeat_interval=0.1).register()
+        _wait_for(lambda: "r1" in router.replica_ids(), msg="r1 discovery")
+        for i in range(4):
+            p = rng.randint(0, 97, 4 + i).astype(np.int32)
+            np.testing.assert_array_equal(cli.generate(p, max_new_tokens=4),
+                                          _fast_ref(m, p, 4))
+        assert metrics.snapshot()["counters"].get(
+            "router.replica_requests{replica=r1}", 0) >= 1, \
+            "joined replica never received traffic"
+        cli.close()
+        router.stop()
+        reg0.leave(), reg1.leave()
+        _kill(s0), _kill(s1)
+
+    def test_heartbeat_expiry_routes_around_dead_replica(self, tmp_path):
+        """A replica whose process died keeps no lease: its entry goes
+        stale past the TTL, the router drops it from rotation, and traffic
+        flows through the survivor with no client errors."""
+        from paddle_tpu.distributed.fleet.elastic import NodeRegistry
+        m = _tiny_model()
+        s0 = _replica(m)
+        reg0 = NodeRegistry(str(tmp_path), "good", f"127.0.0.1:{s0.port}",
+                            ttl=30.0, heartbeat_interval=0.1).register()
+        # "crashed" replica: ONE lease write (ttl 0.3s), no renewals, and
+        # nothing listening on its advertised port
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        dead = NodeRegistry(str(tmp_path), "crashed",
+                            f"127.0.0.1:{dead_port}", ttl=0.3)
+        dead._write()
+        router = _router(registry=NodeRegistry(str(tmp_path)),
+                         poll_interval_s=0.05, connect_deadline_s=0.5)
+        _wait_for(lambda: "good" in router.replica_ids(),
+                  msg="good replica discovery")
+        _wait_for(lambda: "crashed" not in router.replica_ids(),
+                  msg="stale lease expiry")
+        rng = np.random.RandomState(7)
+        cli = _client(router)
+        for i in range(3):
+            p = rng.randint(0, 97, 4 + i).astype(np.int32)
+            np.testing.assert_array_equal(cli.generate(p, max_new_tokens=4),
+                                          _fast_ref(m, p, 4))
+        cli.close()
+        router.stop()
+        reg0.leave()
+        _kill(s0)
+
+
+class TestGracefulDrain:
+    """serve/engine drain semantics (satellite): refuse new, finish
+    in-flight, deregister, exit — the SIGTERM contract."""
+
+    def test_drain_finishes_inflight_refuses_new_deregisters(self,
+                                                             tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import NodeRegistry
+        from paddle_tpu.inference.serve import RemotePredictor
+        m = _tiny_model()
+        srv = _replica(m)
+        reg = NodeRegistry(str(tmp_path), "d0", f"127.0.0.1:{srv.port}",
+                           ttl=30.0, heartbeat_interval=0.1).register()
+        srv.attach_registry(reg)
+        rng = np.random.RandomState(8)
+        p = rng.randint(0, 97, 5).astype(np.int32)
+        result = {}
+
+        def inflight():
+            cli = RemotePredictor(port=srv.port, secret=FLEET_SECRET)
+            result["out"] = cli.generate(p, max_new_tokens=24)
+            cli.close()
+
+        t = threading.Thread(target=inflight, daemon=True)
+        t.start()
+        _wait_for(lambda: srv._engine._occupied() or result,
+                  msg="request admission")
+        drained = {}
+
+        def drain():
+            drained["clean"] = srv.drain(deadline_s=30.0)
+
+        dt = threading.Thread(target=drain, daemon=True)
+        dt.start()
+        _wait_for(lambda: srv._engine._draining, msg="drain flag")
+        # new submits are refused while draining
+        with pytest.raises(RuntimeError, match="draining"):
+            srv._engine.submit(p, max_new_tokens=2)
+        t.join(timeout=60)
+        dt.join(timeout=60)
+        assert drained.get("clean") is True
+        np.testing.assert_array_equal(result["out"], _fast_ref(m, p, 24))
+        # deregistered: the observer view no longer lists d0
+        assert "d0" not in NodeRegistry(str(tmp_path)).alive_nodes()
+        assert srv._stop.is_set()
+
+    def test_sigterm_triggers_drain(self):
+        """install_sigterm_drain wires SIGTERM -> drain(): after a real
+        SIGTERM the engine refuses new submits and the server stops."""
+        from paddle_tpu.inference.serve import install_sigterm_drain
+        m = _tiny_model()
+        srv = _replica(m)
+        prev = signal.getsignal(signal.SIGTERM)
+        handler = install_sigterm_drain(srv, deadline_s=10.0)
+        try:
+            assert signal.getsignal(signal.SIGTERM) is handler
+            os.kill(os.getpid(), signal.SIGTERM)
+            _wait_for(lambda: srv._stop.is_set(), msg="SIGTERM drain")
+            with pytest.raises(RuntimeError, match="draining"):
+                srv._engine.submit(np.array([1, 2, 3], np.int32), 2)
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+
+class TestRouterCLI:
+    def test_main_parses_static_replicas_and_policy(self):
+        """Bad --replica spec and unknown policy fail argparse-loud; a
+        good spec constructs and binds (stopped immediately)."""
+        from paddle_tpu.serving import router as router_mod
+        with pytest.raises(SystemExit):
+            router_mod.main(["--replica", "not-a-spec"])
+        with pytest.raises(SystemExit):
+            router_mod.main([])               # no membership source
+        with pytest.raises(SystemExit):
+            router_mod.main(["--replica", "a=h:1", "--policy", "bogus"])
