@@ -450,6 +450,117 @@ def bench_paged_kernel():
     return times
 
 
+def bench_prefix_cache():
+    """Prefix-caching rung (docs/SERVING.md "Prefix caching"): 8 requests
+    sharing one 256-token system prompt (unique 16-token user suffixes),
+    TTFT with the prefix cache vs without. With the cache, request 1 pays
+    the full prefill and registers the shared pages; requests 2..8 attach
+    them by page-table reference and prefill only their suffix tail — TTFT
+    drops to one small chunk program. Emits its own structured JSON line
+    (cached-vs-uncached TTFT, pages reused, prefill tokens actually run)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import metrics
+
+    paddle.seed(0)
+    NREQ, S_SYS, S_SUF, N = 8, 256, 16, 8
+    cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, max_position_embeddings=512,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    system = rng.randint(0, cfg.vocab_size, S_SYS).astype(np.int32)
+    prompts = [np.concatenate([system, rng.randint(0, cfg.vocab_size, S_SUF)
+                               .astype(np.int32)]) for _ in range(NREQ)]
+
+    def run(prefix_cache):
+        eng = DecodeEngine(model, EngineConfig(
+            page_size=16, max_slots=NREQ, max_seq_len=S_SYS + S_SUF + N,
+            prefix_cache=prefix_cache))
+        # warm the miss bucket AND the hit path's tail-chunk program: a
+        # compile inside an admission would land in every later TTFT
+        # (admission is serial)
+        eng.warmup(prompt_lens=[S_SYS + S_SUF],
+                   tail_lens=[S_SUF] if prefix_cache else [])
+        # prime every program with a real execution (first AOT run costs
+        # ~1s of lazy backend init) — the primer's pages are then dropped
+        # so the timed phase's request 1 is a true cache MISS either way
+        r = eng.submit(prompts[0], max_new_tokens=2, cache=False)
+        eng.run_until_idle(max_steps=100)
+        r.result(timeout=300)
+        tok0 = metrics.counter("engine.prefill_tokens").value
+        reqs = []
+        for p in prompts:       # submitted together; admission is serial,
+            reqs.append(eng.submit(p, max_new_tokens=N))  # TTFT per-request
+        eng.run_until_idle(max_steps=2000)
+        ttfts = sorted(r.trace.t_first_token - r.trace.t_submit
+                       for r in reqs)
+        outs = [r.result(timeout=300) for r in reqs]
+        return dict(ttft_p50=ttfts[NREQ // 2], ttft_max=ttfts[-1],
+                    ttft_sum=sum(ttfts),
+                    prefill_tokens=metrics.counter(
+                        "engine.prefill_tokens").value - tok0), outs
+
+    off, outs_off = run(prefix_cache=False)
+    on, outs_on = run(prefix_cache=True)
+    for a, b in zip(outs_off, outs_on):
+        # EVERY request — the 7 cache HITS especially — must be
+        # token-identical to its uncached twin
+        assert np.array_equal(a, b), "prefix cache changed tokens"
+    snap = metrics.snapshot()["counters"]
+    return on, off, {k: snap.get(f"engine.prefix_{k}", 0)
+                     for k in ("hit", "miss", "pages_reused", "evictions")}
+
+
+def bench_spec_decode():
+    """Speculative-decoding rung: repetitive-text prompt (the n-gram
+    drafter's home turf) decoded with k-token verify steps vs the plain
+    engine — accepted-tokens-per-step and tok/s, plus a token-parity check
+    (speculation must be invisible in the output). Greedy decode on
+    repetitive context re-walks its own suffix, so the self-drafter's
+    proposals verify at a high rate and each step emits >1 token. Emits
+    its own structured JSON line."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import metrics
+
+    paddle.seed(0)
+    S0, N, K = 64, 64, 4
+    cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, max_position_embeddings=256,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    phrase = rng.randint(0, cfg.vocab_size, 8).astype(np.int32)
+    prompt = np.tile(phrase, S0 // phrase.size)[:S0]     # repetitive text
+
+    def run(speculate_k):
+        eng = DecodeEngine(model, EngineConfig(
+            page_size=16, max_slots=1, max_seq_len=S0 + N,
+            prefix_cache=False, speculate_k=speculate_k))
+        eng.warmup(prompt_lens=[S0])
+        r = eng.submit(prompt, max_new_tokens=2)         # prime execution
+        eng.run_until_idle(max_steps=100)
+        r.result(timeout=300)
+        steps0 = metrics.counter("engine.steps").value
+        t0 = time.perf_counter()
+        r = eng.submit(prompt, max_new_tokens=N)
+        eng.run_until_idle(max_steps=500)
+        out = r.result(timeout=300)
+        dt = time.perf_counter() - t0
+        steps = metrics.counter("engine.steps").value - steps0
+        return out, N / dt, N / max(1, steps)
+    out_plain, plain_tps, _ = run(None)
+    out_spec, spec_tps, tok_per_step = run(K)
+    assert np.array_equal(out_plain, out_spec), \
+        "speculative output diverged from plain decode"
+    rate = metrics.snapshot()["gauges"].get("engine.spec_accept_rate", 0.0)
+    return dict(tokens_per_step=tok_per_step, spec_tok_s=spec_tps,
+                plain_tok_s=plain_tps, accept_rate=rate, k=K)
+
+
 def bench_router():
     """Multi-replica serving rung (paddle_tpu/serving): 2 in-process engine
     replicas behind the router under MIXED traffic — 1 long-prefill request
@@ -824,6 +935,29 @@ def bench_smoke():
                                               0) >= 3, \
         "smoke engine run did not exercise chunked prefill"
 
+    # one prefix-cache HIT: resubmit a prompt whose full pages the engine
+    # just registered — the cached pages attach by reference and only the
+    # last page's tokens prefill (docs/SERVING.md "Prefix caching")
+    rehit = eng.submit(ids[0, :5].astype(np.int32), max_new_tokens=2)
+    eng.run_until_idle(max_steps=32)
+    assert rehit.result(timeout=30).shape == (7,)
+    prefix_hits = metrics.snapshot()["counters"].get("engine.prefix_hit", 0)
+    assert prefix_hits >= 1, "smoke run produced no prefix-cache hit"
+
+    # one SPECULATIVE step: a repetitive prompt through a k=2 verify-step
+    # engine — the n-gram self-drafter proposes, the fixed-shape verify
+    # program accepts/rejects, output stays bit-identical to plain decode
+    spec_eng = DecodeEngine(model, EngineConfig(page_size=2, max_slots=2,
+                                                min_bucket=4, speculate_k=2))
+    spec_req = spec_eng.submit(np.tile(ids[0, :2], 2).astype(np.int32),
+                               max_new_tokens=4)
+    spec_eng.run_until_idle(max_steps=32)
+    assert spec_req.result(timeout=30).shape == (8,)
+    snapc = metrics.snapshot()["counters"]
+    assert snapc.get("engine.spec_steps", 0) >= 1, "no speculative step ran"
+    spec_accepted = snapc.get("engine.spec_accepted", 0)
+    assert spec_accepted >= 0
+
     # one ROUTED request on CPU (paddle_tpu/serving): an in-process engine
     # replica behind the router front door, static membership — keeps the
     # multi-replica subsystem import- and wire-clean under tier-1
@@ -857,7 +991,8 @@ def bench_smoke():
     assert "serve_ttft_seconds_count" in metrics.to_prometheus()
     slo = {f"{short}_{q}": round(hists[f"serve.{short}_seconds"][q], 6)
            for short in ("ttft", "tpot", "e2e") for q in ("p50", "p99")}
-    return dt, batch * seq / dt, snap, slo, wd.dump_count == 0, router_ok
+    return (dt, batch * seq / dt, snap, slo, wd.dump_count == 0, router_ok,
+            prefix_hits, spec_accepted)
 
 
 def _retry(fn, attempts=3):
@@ -897,7 +1032,8 @@ def main(argv=None):
 
     if args.smoke:
         try:
-            dt, tps, snap, slo, wd_clean, router_ok = bench_smoke()
+            (dt, tps, snap, slo, wd_clean, router_ok, prefix_hits,
+             spec_accepted) = bench_smoke()
             impls = {k.rsplit(".", 1)[-1]: v
                      for k, v in snap["counters"].items()
                      if k.startswith("paged_attention.impl.") and v}
@@ -906,6 +1042,8 @@ def main(argv=None):
                    "backend_error": backend_error,
                    "slo": slo, "watchdog_clean": wd_clean,
                    "router_ok": router_ok,
+                   "prefix_hits": prefix_hits,
+                   "spec_accepted": spec_accepted,
                    "prefill_chunks": snap["counters"].get(
                        "engine.prefill_chunks", 0),
                    "train_mfu": snap["gauges"].get("train.mfu"),
@@ -1015,6 +1153,45 @@ def main(argv=None):
     except Exception as e:
         _emit({"metric": "paged_attention_step_seconds", "value": 0.0,
                "unit": "s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
+    try:
+        on, off, pstats = _retry(bench_prefix_cache)
+        _emit({"metric": "prefix_cache_ttft_p50_seconds",
+               "value": round(on["ttft_p50"], 6), "unit": "s", "ok": True,
+               "platform": platform,
+               "cached": {k: round(v, 6) if isinstance(v, float) else v
+                          for k, v in on.items()},
+               "uncached": {k: round(v, 6) if isinstance(v, float) else v
+                            for k, v in off.items()},
+               "ttft_sum_speedup": round(off["ttft_sum"] / on["ttft_sum"], 3),
+               "prefix": pstats,
+               "mix": "8x(256-shared+16-unique prompt, 8 new tokens)"})
+        print(f"# prefix_cache 8x(256+16): ttft_p50 cached="
+              f"{on['ttft_p50']*1e3:.1f}ms uncached="
+              f"{off['ttft_p50']*1e3:.1f}ms, prefill tokens "
+              f"{on['prefill_tokens']} vs {off['prefill_tokens']}, "
+              f"pages_reused={pstats['pages_reused']}", file=sys.stderr)
+    except Exception as e:
+        _emit({"metric": "prefix_cache_ttft_p50_seconds", "value": 0.0,
+               "unit": "s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
+    try:
+        sd = _retry(bench_spec_decode)
+        _emit({"metric": "spec_decode_accepted_tokens_per_step",
+               "value": round(sd["tokens_per_step"], 3), "unit": "tokens",
+               "ok": True, "platform": platform,
+               "spec_tok_s": round(sd["spec_tok_s"], 1),
+               "plain_tok_s": round(sd["plain_tok_s"], 1),
+               "accept_rate": round(sd["accept_rate"], 3), "k": sd["k"],
+               "mix": "repetitive 64-token prompt, 64 new tokens, greedy"})
+        print(f"# spec_decode k={sd['k']}: {sd['tokens_per_step']:.2f} "
+              f"tok/step, {sd['spec_tok_s']:.0f} tok/s vs plain "
+              f"{sd['plain_tok_s']:.0f} tok/s, accept_rate="
+              f"{sd['accept_rate']:.2f}", file=sys.stderr)
+    except Exception as e:
+        _emit({"metric": "spec_decode_accepted_tokens_per_step",
+               "value": 0.0, "unit": "tokens", "ok": False,
+               "platform": platform,
                "backend_error": f"{type(e).__name__}: {e}"})
     try:
         ips, dt_r, loss_r = _retry(bench_resnet50)

@@ -30,6 +30,23 @@ Python owns admission/retirement, the device runs fixed-shape steps:
   serialize into a replica-independent blob, so a prefill finished on one
   replica resumes decode on another token-identically — the transfer
   primitive full disaggregation rides (docs/SERVING.md).
+- **Prefix caching** (`EngineConfig.prefix_cache`): full prompt-prefix
+  pages are rolling-hashed into a per-engine prefix store over the page
+  pool; a submit whose leading pages match attaches them by page-table
+  reference (refcounted copy-on-write sharing — the page holding the last
+  prompt token is always recomputed, never shared) and prefills ONLY the
+  uncached tail through the chunk program. Refcount-0 cached pages stay
+  resident and are LRU-evicted under pool pressure; eviction can never
+  touch a live slot's pages (docs/SERVING.md "Prefix caching").
+- **Speculative decoding** (`EngineConfig.speculate_k`): a self-drafting
+  n-gram proposer (suffix lookup over each slot's own tokens, zero extra
+  model) drafts up to k tokens per slot per step; ONE fixed-shape verify
+  program (`models/gpt.py::verify_step`) scores all k+1 positions over the
+  paged gather and accepts the longest matching draft prefix plus one
+  corrected token — 1..k+1 tokens per step, bit-identical to plain greedy
+  decode (parity-tested). Rollback of rejected tokens is host-side length
+  bookkeeping: their stale KV sits past every live position and is
+  rewritten before any query attends it.
 - **De-synchronized hot path**: the per-slot host mirrors (token, length,
   flags, page-table row) are fused into ONE packed int32 upload per step
   (`engine.h2d_transfers` counts them — exactly one per step); sampled
@@ -51,11 +68,12 @@ serve process dedicates a thread; tests/bench call them inline).
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import struct
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 
 import numpy as np
@@ -73,8 +91,12 @@ __all__ = ["EngineConfig", "PageAllocator", "GenerateRequest", "DecodeEngine",
            "KVHandoff"]
 
 # packed slot-state upload layout: [B, _STATE_COLS + pages_per_slot] int32,
-# ONE host->device transfer per step (engine.h2d_transfers)
+# ONE host->device transfer per step (engine.h2d_transfers). The
+# speculative verify step widens it to [B, _SPEC_COLS + K + pages_per_slot]
+# (an extra draft-length column + K drafted-token columns) — still one
+# fused upload per step.
 _COL_TOKEN, _COL_LENGTH, _COL_FLAGS, _STATE_COLS = 0, 1, 2, 3
+_COL_DRAFT, _SPEC_COLS = 3, 4
 _FLAG_ACTIVE, _FLAG_FRESH = 1, 2
 
 
@@ -106,6 +128,22 @@ class EngineConfig:
                    a long prompt fills. None (default) keeps the one-shot
                    bucketed prefill; prompts <= the chunk size always take
                    the one-shot path
+    prefix_cache : share full prompt-prefix pages copy-on-write across
+                   requests (docs/SERVING.md "Prefix caching"): a submit
+                   whose leading pages hash-match an earlier prompt's
+                   attaches them by page-table reference and prefills ONLY
+                   the uncached tail. Refcount-0 cached pages stay resident
+                   and are LRU-evicted under pool pressure. Per-request
+                   opt-out via ``submit(..., cache=False)``
+    speculate_k  : when set (>= 1), every decode step drafts up to k tokens
+                   per slot from a self-drafting n-gram proposer and
+                   verifies all k+1 positions in ONE fixed-shape program
+                   (`models/gpt.py::verify_step`) — between 1 and k+1
+                   tokens emitted per step, bit-identical to plain greedy
+                   decode. Readback is synchronous in this mode (the host
+                   needs each step's accepted tokens to draft the next),
+                   so ``inflight`` does not apply. Per-request opt-out via
+                   ``submit(..., speculate=False)``
     """
     page_size: int = 16
     max_slots: int = 8
@@ -116,38 +154,120 @@ class EngineConfig:
     donate: bool | None = None
     inflight: int = 2
     prefill_chunk_tokens: int | None = None
+    prefix_cache: bool = True
+    speculate_k: int | None = None
 
 
 class PageAllocator:
-    """Host-side free-list over the page pool. Page 0 (TRASH_PAGE) is never
-    handed out — it is the spill target for masked writes."""
+    """Host-side REFCOUNTED free-list over the page pool. Page 0
+    (TRASH_PAGE) is never handed out — it is the spill target for masked
+    writes.
+
+    Prefix caching (docs/SERVING.md) shares pages copy-on-write across
+    slots: `share` grows a page's refcount and `free` releases one owner's
+    claim, reclaiming only at refcount 0. A page the engine's prefix store
+    still indexes is RETAINED at refcount 0 (its contents stay valid for
+    future hits) instead of returning to the free list; under pool pressure
+    `alloc` reclaims retained pages through ``evict_hook`` (LRU order, the
+    engine owns the policy), so eviction can never touch a live slot's
+    pages — only refcount-0 ones."""
 
     def __init__(self, num_pages: int):
         if num_pages < 2:
             raise ValueError(f"need >= 2 pages (1 is reserved), got {num_pages}")
         self.num_pages = num_pages
         self._free = deque(range(1, num_pages))
+        self._refcnt = [0] * num_pages
+        self._retained: set[int] = set()
+        self.retain_hook = None   # page -> bool: keep this refcount-0 page?
+        self.evict_hook = None    # n -> list[page]: reclaim retained pages
         self._g_in_use = metrics.gauge("engine.pages_in_use")
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        """Pages allocatable RIGHT NOW: the free list plus refcount-0
+        cached pages (reclaimable by eviction)."""
+        return len(self._free) + len(self._retained)
+
+    def _update_gauge(self):
+        self._g_in_use.set(self.num_pages - 1 - self.free_pages)
+
+    def refcount(self, page: int) -> int:
+        return self._refcnt[page]
 
     def alloc(self, n: int) -> list[int] | None:
         """n pages or None (caller keeps the request queued — admission
-        control is 'wait', never 'partially allocate')."""
+        control is 'wait', never 'partially allocate'). Evicts refcount-0
+        cached pages (LRU via ``evict_hook``) when the free list alone
+        cannot cover the request."""
+        if n > self.free_pages:
+            return None
+        if n > len(self._free) and self.evict_hook is not None:
+            for p in self.evict_hook(n - len(self._free)):
+                if p not in self._retained or self._refcnt[p] != 0:
+                    raise RuntimeError(
+                        f"evict hook surrendered live page {p}")
+                self._retained.discard(p)
+                self._free.append(p)
         if n > len(self._free):
             return None
         pages = [self._free.popleft() for _ in range(n)]
-        self._g_in_use.set(self.num_pages - 1 - len(self._free))
+        for p in pages:
+            self._refcnt[p] = 1
+        self._update_gauge()
         return pages
 
-    def free(self, pages: list[int]):
+    def reclaim(self, pages: list[int]):
+        """Return RETAINED (refcount-0 cached) pages to the free list —
+        the prefix store dropping its index outside an alloc-driven
+        eviction (e.g. a weight swap invalidating every cached page)."""
+        for p in pages:
+            if p not in self._retained or self._refcnt[p] != 0:
+                raise ValueError(f"reclaiming non-retained page {p}")
+        for p in pages:
+            self._retained.discard(p)
+            self._free.append(p)
+        self._update_gauge()
+
+    def share(self, pages: list[int]):
+        """Attach cached pages to ONE more owner (a prefix-cache hit):
+        refcount-0 retained pages come back to life, live shared pages just
+        gain a reference."""
         for p in pages:
             if not (0 < p < self.num_pages):
+                raise ValueError(f"sharing bogus page {p}")
+            if self._refcnt[p] == 0 and p not in self._retained:
+                raise ValueError(f"sharing unallocated page {p}")
+        for p in pages:
+            self._retained.discard(p)
+            self._refcnt[p] += 1
+        self._update_gauge()
+
+    def free(self, pages: list[int]):
+        """Release one owner's claim on each page. Fails LOUDLY — before
+        mutating anything — on a double-free (refcount already 0), a
+        duplicate page id within the call, an out-of-pool id, or the
+        reserved trash page 0: tolerating any of these would eventually
+        hand the same page to two live sequences."""
+        seen = set()
+        for p in pages:
+            if p == TRASH_PAGE:
+                raise ValueError("freeing reserved trash page 0")
+            if not (0 < p < self.num_pages):
                 raise ValueError(f"freeing bogus page {p}")
-        self._free.extend(pages)
-        self._g_in_use.set(self.num_pages - 1 - len(self._free))
+            if p in seen:
+                raise ValueError(f"duplicate page {p} in one free() call")
+            seen.add(p)
+            if self._refcnt[p] <= 0:
+                raise ValueError(f"double free of page {p}")
+        for p in pages:
+            self._refcnt[p] -= 1
+            if self._refcnt[p] == 0:
+                if self.retain_hook is not None and self.retain_hook(p):
+                    self._retained.add(p)
+                else:
+                    self._free.append(p)
+        self._update_gauge()
 
 
 class GenerateRequest:
@@ -157,12 +277,16 @@ class GenerateRequest:
     created at wire-accept so TTFT/e2e include the wire wait; a direct
     `submit()` gets a fresh one."""
 
-    def __init__(self, prompt: np.ndarray, max_new_tokens: int, trace=None):
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int, trace=None,
+                 cache: bool = True, speculate: bool = True):
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.generated: list[int] = []
         self.submit_t = time.perf_counter()
         self.trace = trace if trace is not None else RequestTrace()
+        self.cache = bool(cache)          # prefix-cache participation
+        self.speculate = bool(speculate)  # n-gram drafting participation
+        self.page_hashes: list[bytes] = []  # rolling full-page prompt hashes
         self._done = threading.Event()
         self._error: str | None = None
 
@@ -186,6 +310,44 @@ class GenerateRequest:
             raise RuntimeError(self._error)
         return np.concatenate(
             [self.prompt, np.asarray(self.generated, self.prompt.dtype)])
+
+
+_NGRAM_NS = (3, 2, 1)          # longest-match-first draft lookup order
+
+
+class _DraftIndex:
+    """Per-slot n-gram index for the self-drafting proposer: ``{n-gram ->
+    most recent start position that has >= 1 following token}``, maintained
+    O(1) per generated token so drafting costs O(k) host work per step —
+    never an O(context) rescan on the latency-critical step loop. An
+    n-gram is registered only once its follower exists, so a draft lookup
+    always has at least one token to propose."""
+
+    __slots__ = ("hist", "maps")
+
+    def __init__(self, prompt):
+        self.hist: list[int] = []
+        self.maps = {n: {} for n in _NGRAM_NS}
+        for t in prompt:
+            self.append(int(t))
+
+    def append(self, tok: int):
+        h = self.hist
+        p = len(h)
+        h.append(int(tok))
+        for n in _NGRAM_NS:
+            if p >= n:                 # grams ending at p-1 gained a follower
+                self.maps[n][tuple(h[p - n:p])] = p - n
+
+    def draft(self, k: int) -> list[int]:
+        h = self.hist
+        for n in _NGRAM_NS:
+            if len(h) <= n:
+                continue
+            j = self.maps[n].get(tuple(h[-n:]))
+            if j is not None:
+                return h[j + n:j + n + k]
+        return []
 
 
 @dataclass
@@ -301,6 +463,7 @@ class DecodeEngine:
         self._budget = np.zeros(B, np.int32)  # tokens left to dispatch
         self._slot_req: list[GenerateRequest | None] = [None] * B
         self._slot_pages: list[list[int]] = [[] for _ in range(B)]
+        self._slot_draft: list[_DraftIndex | None] = [None] * B
         # device-resident sampled-token chain + deferred-readback fifo of
         # (device tokens, [(slot, request)] snapshot, dispatch t0)
         self._tok_dev = jnp.zeros(B, jnp.int32)
@@ -321,6 +484,21 @@ class DecodeEngine:
             raise ValueError(
                 f"prefill_chunk_tokens must be >= 1, "
                 f"got {ecfg.prefill_chunk_tokens}")
+        if ecfg.speculate_k is not None and int(ecfg.speculate_k) < 1:
+            raise ValueError(
+                f"speculate_k must be >= 1, got {ecfg.speculate_k}")
+        self._spec = ecfg.speculate_k is not None
+        self._spec_k = int(ecfg.speculate_k) if self._spec else 0
+        # prefix cache: rolling full-page hash -> resident page, plus the
+        # reverse map and the LRU of refcount-0 ("idle") cached pages the
+        # allocator retains for us. All mutations happen on the driver
+        # thread (admission/retire) — submit only COMPUTES hashes.
+        self._prefix_enabled = bool(ecfg.prefix_cache)
+        self._prefix_pages: dict[bytes, int] = {}
+        self._page_hash: dict[int, bytes] = {}
+        self._prefix_idle: OrderedDict[int, None] = OrderedDict()
+        self.allocator.retain_hook = self._retain_page
+        self.allocator.evict_hook = self._evict_prefix_pages
         self.step_seq = 0             # advances once per step(); the
         #                               watchdog's progress reading
 
@@ -333,6 +511,17 @@ class DecodeEngine:
         self._m_h2d = metrics.counter("engine.h2d_transfers")
         self._m_d2h = metrics.counter("engine.d2h_transfers")
         self._m_chunks = metrics.counter("engine.prefill_chunks")
+        self._m_prefill_tokens = metrics.counter("engine.prefill_tokens")
+        self._m_prefix_hit = metrics.counter("engine.prefix_hit")
+        self._m_prefix_miss = metrics.counter("engine.prefix_miss")
+        self._m_prefix_reused = metrics.counter("engine.prefix_pages_reused")
+        self._m_prefix_evict = metrics.counter("engine.prefix_evictions")
+        self._g_prefix_pages = metrics.gauge("engine.prefix_pages")
+        self._m_spec_steps = metrics.counter("engine.spec_steps")
+        self._m_spec_drafted = metrics.counter("engine.spec_drafted")
+        self._m_spec_accepted = metrics.counter("engine.spec_accepted")
+        self._g_spec_rate = metrics.gauge("engine.spec_accept_rate")
+        self._g_spec_tps = metrics.gauge("engine.spec_tokens_per_step")
         self._g_occupancy = metrics.gauge("engine.batch_occupancy")
         self._g_queue = metrics.gauge("engine.queue_depth")
         self._g_tps = metrics.gauge("engine.tokens_per_s")
@@ -427,11 +616,16 @@ class DecodeEngine:
 
         return self._compiled(("prefill", bucket), build)
 
-    def _prefill_chunk_exe(self):
+    def _prefill_chunk_exe(self, c: int | None = None):
+        """The chunk program serves two callers with one shape family:
+        decode-priority chunked prefill (c = prefill_chunk_tokens) and the
+        prefix-cache TAIL prefill (c = the tail's pow-2 bucket) — both are
+        'prefill a window starting at an absolute position', which is
+        exactly `prefill_chunk_step`'s contract."""
         from paddle_tpu.models import gpt as gpt_mod
         cfg = self.cfg
         maxp = self.pages_per_slot
-        c = int(self.ecfg.prefill_chunk_tokens)
+        c = int(self.ecfg.prefill_chunk_tokens) if c is None else int(c)
 
         def chunk_fn(params, kc, vc, packed):
             # packed [c + 2 + maxp] int32: chunk ids | start | valid | page
@@ -455,6 +649,46 @@ class DecodeEngine:
 
         return self._compiled(("prefill_chunk", c), build)
 
+    def _verify_exe(self):
+        """The speculative k-token verify step: ONE AOT program regardless
+        of which slots drafted how much — draft contents and draft_len ride
+        the packed upload, never a shape (tests/test_no_retrace.py)."""
+        from paddle_tpu.models import gpt as gpt_mod
+        cfg = self.cfg
+        B, maxp = self.ecfg.max_slots, self.pages_per_slot
+        K = self._spec_k
+
+        def step_fn(params, kc, vc, tokens, slot_state):
+            # slot_state: [B, 4 + K + maxp] int32 — (fresh token, length,
+            # flags, draft_len, K drafted tokens, page-table row)
+            flags = slot_state[:, _COL_FLAGS]
+            active = (flags & _FLAG_ACTIVE) != 0
+            fresh = (flags & _FLAG_FRESH) != 0
+            tok0 = jnp.where(fresh, slot_state[:, _COL_TOKEN], tokens)
+            draft_len = slot_state[:, _COL_DRAFT]
+            drafts = slot_state[:, _SPEC_COLS:_SPEC_COLS + K]
+            tok_seq = jnp.concatenate([tok0[:, None], drafts], axis=1)
+            cache = dict(k_pages=kc, v_pages=vc,
+                         page_table=slot_state[:, _SPEC_COLS + K:],
+                         lengths=slot_state[:, _COL_LENGTH])
+            emitted, n_emitted, cache = gpt_mod.verify_step(
+                params, tok_seq, draft_len, cache, active, cfg=cfg)
+            nxt = jnp.take_along_axis(
+                emitted, jnp.maximum(n_emitted - 1, 0)[:, None], axis=1)[:, 0]
+            nxt = jnp.where(active, nxt, tok0)
+            return emitted, n_emitted, nxt, cache["k_pages"], \
+                cache["v_pages"]
+
+        def build():
+            donate = (1, 2) if self._donate else ()
+            return jax.jit(step_fn, donate_argnums=donate).lower(
+                self._params, self._kc, self._vc,
+                jnp.zeros(B, jnp.int32),
+                jnp.zeros((B, _SPEC_COLS + K + maxp), jnp.int32),
+            ).compile()
+
+        return self._compiled(("verify", K), build)
+
     def _use_chunked(self, prompt_len: int) -> bool:
         c = self.ecfg.prefill_chunk_tokens
         return c is not None and prompt_len > int(c)
@@ -465,33 +699,132 @@ class DecodeEngine:
         b = max(self.ecfg.min_bucket, 1 << max(0, prompt_len - 1).bit_length())
         return min(b, self.cfg.max_position_embeddings)
 
-    def warmup(self, prompt_lens=(1,)):
-        """Compile the decode step + the prefill programs (buckets or the
-        chunk program) covering ``prompt_lens``. Optional — programs also
-        compile lazily on first use — but lets servers front-load compiles
-        before traffic."""
-        self._decode_exe()
+    def warmup(self, prompt_lens=(1,), tail_lens=()):
+        """Compile the decode/verify step + the prefill programs (buckets
+        or the chunk program) covering ``prompt_lens``. ``tail_lens``
+        front-loads the prefix-cache TAIL chunk programs (one per pow-2
+        tail bucket) so a server's first cache hit doesn't pay a compile
+        inside a request's TTFT. Optional — programs also compile lazily on
+        first use — but lets servers front-load compiles before traffic."""
+        if self._spec:
+            self._verify_exe()
+        else:
+            self._decode_exe()
         need_chunk = False
         for s in prompt_lens:
             if self._use_chunked(int(s)):
                 need_chunk = True
             else:
                 self._prefill_exe(self.bucket_for(int(s)))
+        for t in tail_lens:
+            if self.ecfg.prefill_chunk_tokens is not None:
+                need_chunk = True
+            else:
+                self._prefill_chunk_exe(self.bucket_for(int(t)))
         if need_chunk:
             self._prefill_chunk_exe()
 
     def refresh_params(self, model):
         """Swap in current weights; programs take params as inputs, so this
-        never recompiles."""
+        never recompiles. The prefix store is FLUSHED: cached pages hold KV
+        computed under the old weights, and a hit after the swap would
+        silently condition new-weights decode on stale KV."""
         self._params = {k: t._data for k, t in model.state_dict().items()}
+        self._flush_prefix()
+
+    # --------------------------------------------------------- prefix cache
+
+    def _page_hashes(self, ids: np.ndarray) -> list[bytes]:
+        """Rolling hash over the prompt's FULL token pages: ``h_i =
+        H(h_{i-1} | page_i tokens)``. Chained keys mean a page is only
+        reusable when every page before it matches too — a lookup walks the
+        chain from page 0 and stops at the first miss."""
+        ps = self.ecfg.page_size
+        out, h = [], b"pt-prefix-v1"
+        for i in range(ids.size // ps):
+            h = hashlib.blake2b(h + ids[i * ps:(i + 1) * ps].tobytes(),
+                                digest_size=16).digest()
+            out.append(h)
+        return out
+
+    def _retain_page(self, page: int) -> bool:
+        """Allocator retain hook: a refcount-0 page the prefix store still
+        indexes stays resident (LRU-tracked) instead of rejoining the free
+        list — its contents are a future request's prefill."""
+        if page in self._page_hash:
+            self._prefix_idle[page] = None        # most-recently idled last
+            return True
+        return False
+
+    def _evict_prefix_pages(self, n: int) -> list[int]:
+        """Allocator evict hook: surrender up to n LRU refcount-0 cached
+        pages under pool pressure, dropping their store entries. Live
+        (refcount > 0) pages are never offered — eviction cannot touch a
+        running slot."""
+        out = []
+        while len(out) < n and self._prefix_idle:
+            page, _ = self._prefix_idle.popitem(last=False)
+            h = self._page_hash.pop(page)
+            if self._prefix_pages.get(h) == page:
+                del self._prefix_pages[h]
+            out.append(page)
+            self._m_prefix_evict.inc()
+        self._g_prefix_pages.set(len(self._page_hash))
+        return out
+
+    def _flush_prefix(self):
+        """Drop EVERY prefix-store entry: idle cached pages return to the
+        free list immediately; pages still owned by live slots merely lose
+        their index (the retain hook declines them at retirement). Used by
+        `refresh_params` — KV cached under old weights must never serve a
+        new-weights request."""
+        idle = list(self._prefix_idle)
+        self._prefix_idle.clear()
+        self._prefix_pages.clear()
+        self._page_hash.clear()
+        if idle:
+            self.allocator.reclaim(idle)
+        self._g_prefix_pages.set(0)
+
+    def _prefix_lookup(self, hashes: list[bytes]) -> list[int]:
+        """Longest cached prefix: pages for the leading run of hash hits."""
+        pages = []
+        for h in hashes:
+            p = self._prefix_pages.get(h)
+            if p is None:
+                break
+            pages.append(p)
+        return pages
+
+    def _attach_prefix(self, pages: list[int]):
+        """A hit: grow the shared pages' refcounts and pull any idle ones
+        off the LRU (they are live again)."""
+        self.allocator.share(pages)
+        for p in pages:
+            self._prefix_idle.pop(p, None)
+
+    def _register_prefix(self, hashes: list[bytes], pages: list[int]):
+        """Index a freshly prefilled prompt's full pages in the store (the
+        shared leading pages of a hit are already indexed — first writer
+        wins; contents are identical by construction)."""
+        for h, p in zip(hashes, pages):
+            if h in self._prefix_pages or p in self._page_hash:
+                continue
+            self._prefix_pages[h] = p
+            self._page_hash[p] = h
+        self._g_prefix_pages.set(len(self._page_hash))
 
     # ------------------------------------------------------------ admission
 
-    def submit(self, prompt_ids, max_new_tokens=32,
-               trace=None) -> GenerateRequest:
+    def submit(self, prompt_ids, max_new_tokens=32, trace=None,
+               cache=True, speculate=True) -> GenerateRequest:
         """Queue one prompt (1-D or [1, S] int array). Thread-safe.
         ``trace``: a `RequestTrace` created upstream (serve's wire-accept)
-        so the SLO clock starts there; default starts it here."""
+        so the SLO clock starts there; default starts it here.
+        ``cache=False`` keeps this prompt out of the prefix cache (neither
+        reuses nor registers pages); ``speculate=False`` disables n-gram
+        drafting for this request on a speculating engine — both default
+        on, gated by the engine-level knobs."""
         ids = np.asarray(
             prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids)
         ids = np.ascontiguousarray(ids).reshape(-1).astype(np.int32)
@@ -499,12 +832,17 @@ class DecodeEngine:
             raise ValueError("empty prompt")
         n = int(max_new_tokens)
         if n < 1:
-            raise ValueError(f"max_new_tokens must be >= 1, got {n}")
+            raise ValueError(f"max_new_tokens must be >= 1, got {n}: a "
+                             "request that can never emit would occupy a "
+                             "slot it can never retire from")
         if ids.size + n > self.max_seq_len:
             raise ValueError(
                 f"prompt {ids.size} + max_new_tokens {n} exceeds engine "
                 f"max_seq_len={self.max_seq_len}")
-        req = GenerateRequest(ids, n, trace=trace)
+        req = GenerateRequest(ids, n, trace=trace, cache=cache,
+                              speculate=speculate)
+        if self._prefix_enabled and req.cache:
+            req.page_hashes = self._page_hashes(ids)
         with self._work:
             if self._dead is not None:
                 raise RuntimeError(f"engine stopped: {self._dead}")
@@ -534,7 +872,9 @@ class DecodeEngine:
 
     def _admit(self):
         """Drain the queue into free slots while pages allow: assign slot,
-        allocate pages, run the bucketed prefill, seed the first token."""
+        attach the longest cached prefix (prefix cache), allocate fresh
+        pages for the rest, prefill the uncached tail, seed the first
+        token."""
         while True:
             slots = self._free_slots()
             if not slots:
@@ -544,61 +884,100 @@ class DecodeEngine:
                     self._g_queue.set(0)
                     return
                 req = self._queue[0]
-                need = -(-(req.prompt.size + req.max_new_tokens)
-                         // self.ecfg.page_size)
-                pages = self.allocator.alloc(need)
+                total = -(-(req.prompt.size + req.max_new_tokens)
+                          // self.ecfg.page_size)
+                shared: list[int] = []
+                if self._prefix_enabled and req.cache:
+                    shared = self._prefix_lookup(req.page_hashes)
+                    # the page holding the LAST prompt token is always
+                    # recomputed, never shared (the copy-on-write "last
+                    # partial page" copy): the tail prefill needs >= 1 real
+                    # token to produce the first sampled output
+                    shared = shared[:(req.prompt.size - 1)
+                                    // self.ecfg.page_size]
+                if shared:
+                    # claim the cached pages BEFORE alloc: alloc may evict
+                    # refcount-0 cached pages under pressure, and claiming
+                    # makes these ones live (un-evictable)
+                    self._attach_prefix(shared)
+                pages = self.allocator.alloc(total - len(shared))
                 if pages is None:
+                    if shared:
+                        self.allocator.free(shared)  # back to idle cache
                     if not (self._occupied() or self._inflight):
                         # nothing will ever retire to free pages: the pool
-                        # itself is too small for this request
+                        # itself is too small for this request (report the
+                        # TOTAL need — a post-sharing count could look
+                        # satisfiable next to the pool size)
                         self._queue.popleft()
                         self._g_queue.set(len(self._queue))
-                        req._finish(error=f"request needs {need} pages, pool "
-                                    f"has {self.allocator.num_pages - 1}")
+                        req._finish(error=f"request needs {total} pages, "
+                                    f"pool has "
+                                    f"{self.allocator.num_pages - 1}")
                         continue
                     return                 # wait for a retirement
+                if self._prefix_enabled and req.cache:
+                    (self._m_prefix_hit if shared
+                     else self._m_prefix_miss).inc()
+                    self._m_prefix_reused.inc(len(shared))
                 self._queue.popleft()
                 self._g_queue.set(len(self._queue))
             self._h_wait.observe(time.perf_counter() - req.submit_t)
-            self._place(req, slots[0], pages)
+            self._place(req, slots[0], shared + pages, len(shared))
 
-    def _place(self, req: GenerateRequest, slot: int, pages: list[int]):
+    def _place(self, req: GenerateRequest, slot: int, pages: list[int],
+               n_shared: int = 0):
+        """``pages``: the slot's allocation in token order — ``n_shared``
+        leading prefix-cache pages (already refcounted) then fresh ones.
+        Prefill covers only positions past the shared pages."""
         req.trace.mark_admitted()
         flight.record("engine.admit", request_id=req.request_id,
-                      slot=slot, pages=len(pages),
+                      slot=slot, pages=len(pages), shared=n_shared,
                       prompt_len=int(req.prompt.size))
         maxp = self.pages_per_slot
+        cached = n_shared * self.ecfg.page_size   # tokens already resident
         row = np.full(maxp, TRASH_PAGE, np.int32)
         row[:len(pages)] = pages
         self._page_table[slot] = row
         self._slot_req[slot] = req
         self._slot_pages[slot] = pages
-        if self._use_chunked(req.prompt.size):
+        if self._use_chunked(req.prompt.size - cached):
             # decode-priority chunked prefill: the slot holds its pages but
             # stays decode-inactive; step() runs ONE chunk per step after
             # the decode dispatch (`_advance_prefill`) until the prompt is
-            # fully cached, then the slot joins the decode batch
+            # fully cached, then the slot joins the decode batch. A prefix
+            # hit just starts the chunk cursor past the shared pages.
             self._lengths[slot] = 0
-            self._prefilling[slot] = {"req": req, "done": 0,
+            self._prefilling[slot] = {"req": req, "done": cached,
                                       "t0": time.perf_counter()}
             return
         t0 = time.perf_counter()
-        first = self._run_prefill(req.prompt, row)
+        first = self._run_prefill(req.prompt, row, start=cached)
         self._h_prefill.observe(time.perf_counter() - t0)
         self._seed_first_token(slot, req, first)
 
-    def _run_prefill(self, ids: np.ndarray, row: np.ndarray) -> int:
-        """Fill ``row``'s pages with the prompt's KV — one-shot bucketed or
-        back-to-back chunks per config — and return the sampled first
-        token. Shared by `_place` and `prefill_export` (which has no slot
-        to interleave around, so its chunks run consecutively)."""
+    def _run_prefill(self, ids: np.ndarray, row: np.ndarray,
+                     start: int = 0) -> int:
+        """Fill ``row``'s pages with the prompt's KV from position
+        ``start`` on (0 = whole prompt; a prefix-cache hit passes the
+        cached token count) — one-shot bucketed, back-to-back chunks, or a
+        bucketed TAIL chunk — and return the sampled first token. Shared by
+        `_place` and `prefill_export` (which has no slot to interleave
+        around, so its chunks run consecutively)."""
         s0 = ids.size
         maxp = self.pages_per_slot
-        if self._use_chunked(s0):
-            c = int(self.ecfg.prefill_chunk_tokens)
+        if start or self._use_chunked(s0):
+            # chunk-program prefill from ``start`` on: the configured chunk
+            # size when chunking is on, else the tail's own pow-2 bucket
+            # (one program per bucket, AOT). A prefix-cache tail attends
+            # its queries over the SHARED pages + its own writes, masked by
+            # absolute position — zero prefill work for cached pages.
+            c = int(self.ecfg.prefill_chunk_tokens) \
+                if self.ecfg.prefill_chunk_tokens is not None \
+                else self.bucket_for(s0 - start)
             tok = None
-            for done in range(0, s0, c):
-                tok = self._run_chunk(ids, done, row)
+            for done in range(start, s0, c):
+                tok = self._run_chunk(ids, done, row, c)
         else:
             bucket = self.bucket_for(s0)
             packed = np.zeros(bucket + 1 + maxp, np.int32)
@@ -607,6 +986,7 @@ class DecodeEngine:
             packed[bucket + 1:] = row
             exe = self._prefill_exe(bucket)
             self._m_h2d.inc()
+            self._m_prefill_tokens.inc(s0)
             tok, self._kc, self._vc = exe(
                 self._params, self._kc, self._vc, jax.device_put(packed))
         tb = time.perf_counter()
@@ -615,22 +995,24 @@ class DecodeEngine:
         self._m_d2h.inc()
         return first
 
-    def _run_chunk(self, ids: np.ndarray, done: int, row: np.ndarray):
+    def _run_chunk(self, ids: np.ndarray, done: int, row: np.ndarray,
+                   c: int | None = None):
         """Pack and enqueue ONE prefill chunk (``ids[done:done+c]`` against
         page ``row``) — the single owner of the packed chunk layout for
-        both the interleaved (`_advance_prefill`) and back-to-back
-        (`_run_prefill`) paths. Returns the chunk program's on-device
-        sampled token (meaningful only for the final chunk; no readback
-        here)."""
-        c = int(self.ecfg.prefill_chunk_tokens)
+        the interleaved (`_advance_prefill`), back-to-back
+        (`_run_prefill`), and prefix-tail paths. Returns the chunk
+        program's on-device sampled token (meaningful only for the final
+        chunk; no readback here)."""
+        c = int(self.ecfg.prefill_chunk_tokens) if c is None else int(c)
         chunk = ids[done:done + c]
         packed = np.zeros(c + 2 + self.pages_per_slot, np.int32)
         packed[:chunk.size] = chunk
         packed[c] = done
         packed[c + 1] = chunk.size
         packed[c + 2:] = row
-        exe = self._prefill_chunk_exe()
+        exe = self._prefill_chunk_exe(c)
         self._m_h2d.inc()
+        self._m_prefill_tokens.inc(int(chunk.size))
         tok, self._kc, self._vc = exe(
             self._params, self._kc, self._vc, jax.device_put(packed))
         self._m_chunks.inc()
@@ -647,9 +1029,21 @@ class DecodeEngine:
         self._active[slot] = True
         self._fresh[slot] = True
         self._budget[slot] = req.max_new_tokens - 1
+        if self._spec and req.speculate:
+            # O(prompt) once at admission, O(1) per token after: the
+            # drafter must not rescan the history inside the step loop
+            idx = _DraftIndex(req.prompt)
+            idx.append(first)
+            self._slot_draft[slot] = idx
         req.generated.append(first)
         req.trace.mark_first_token()
         self._m_tokens.inc()
+        if self._prefix_enabled and req.cache:
+            # the prompt's full pages are now resident and correct —
+            # index them for future submits (shared leading pages of a
+            # hit are already indexed; chunked and imported pages are
+            # equally cache-eligible since all three land here)
+            self._register_prefix(req.page_hashes, self._slot_pages[slot])
         if req.max_new_tokens == 1 or first == self.ecfg.eos_id:
             self._retire(slot)
 
@@ -686,6 +1080,7 @@ class DecodeEngine:
         self.allocator.free(self._slot_pages[slot])
         self._slot_pages[slot] = []
         self._slot_req[slot] = None
+        self._slot_draft[slot] = None
         self._active[slot] = False
         self._fresh[slot] = False
         self._budget[slot] = 0
@@ -731,6 +1126,102 @@ class DecodeEngine:
         self._m_steps.inc()
         metrics.add_span("engine.dispatch", t0,
                          time.perf_counter() - t0, cat="engine")
+
+    # ----------------------------------------------------- speculative step
+
+    def _packed_spec_state(self, drafts: np.ndarray,
+                           draft_lens: np.ndarray) -> np.ndarray:
+        B, maxp, K = self.ecfg.max_slots, self.pages_per_slot, self._spec_k
+        packed = np.empty((B, _SPEC_COLS + K + maxp), np.int32)
+        packed[:, _COL_TOKEN] = self._tokens
+        packed[:, _COL_LENGTH] = self._lengths
+        packed[:, _COL_FLAGS] = (self._active.astype(np.int32) * _FLAG_ACTIVE
+                                 | self._fresh.astype(np.int32) * _FLAG_FRESH)
+        packed[:, _COL_DRAFT] = draft_lens
+        packed[:, _SPEC_COLS:_SPEC_COLS + K] = drafts
+        packed[:, _SPEC_COLS + K:] = self._page_table
+        return packed
+
+    def _dispatch_spec(self):
+        """Enqueue ONE speculative verify step: draft on host (n-gram),
+        upload the fused state, return the un-read device handles. The
+        harvest is SYNCHRONOUS later in the same step() — the host needs
+        each step's accepted tokens to draft the next step's proposals, so
+        the in-flight window cannot apply; the >1 tokens an accepted step
+        emits amortize the readback it forces."""
+        K, B = self._spec_k, self.ecfg.max_slots
+        drafts = np.zeros((B, K), np.int32)
+        draft_lens = np.zeros(B, np.int32)
+        for slot in np.flatnonzero(self._active):
+            idx = self._slot_draft[slot]
+            budget = int(self._budget[slot])   # tokens this step may emit
+            if idx is None or budget <= 1:
+                continue                       # <=1 left: drafting is waste
+            d = idx.draft(K)                   # n-gram proposer: the tokens
+            n = min(len(d), K, budget - 1)     # that followed this suffix's
+            if n > 0:                          # most recent occurrence
+                drafts[slot, :n] = d[:n]
+                draft_lens[slot] = n
+        exe = self._verify_exe()
+        self._m_h2d.inc()
+        state = jax.device_put(self._packed_spec_state(drafts, draft_lens))
+        t0 = time.perf_counter()
+        emitted_dev, n_emit_dev, self._tok_dev, self._kc, self._vc = exe(
+            self._params, self._kc, self._vc, self._tok_dev, state)
+        snapshot = [(int(i), self._slot_req[i])
+                    for i in np.flatnonzero(self._active)]
+        self._fresh[:] = False
+        self._m_steps.inc()
+        self._m_spec_steps.inc()
+        self._m_spec_drafted.inc(int(draft_lens.sum()))
+        metrics.add_span("engine.dispatch", t0,
+                         time.perf_counter() - t0, cat="engine")
+        return emitted_dev, n_emit_dev, snapshot
+
+    def _harvest_spec(self, emitted_dev, n_emit_dev, snapshot) -> int:
+        """Read back the verify step's emitted tokens and apply them:
+        append 1..k+1 tokens per slot (clamped to budget, truncated at
+        EOS), roll lengths forward by exactly the accepted count — the
+        page-granular 'rollback' of rejected tokens is just NOT advancing
+        past them; their stale KV sits beyond every live position and is
+        rewritten before any later query can attend it."""
+        tb = time.perf_counter()
+        emitted = np.asarray(emitted_dev)
+        n_emit = np.asarray(n_emit_dev)
+        self._blocked_s += time.perf_counter() - tb
+        self._m_d2h.inc()
+        harvested = accepted = 0
+        for slot, req in snapshot:
+            if req.done or self._slot_req[slot] is not req:
+                continue
+            n = min(int(n_emit[slot]), int(self._budget[slot]))
+            toks = [int(t) for t in emitted[slot, :n]]
+            if self.ecfg.eos_id is not None and self.ecfg.eos_id in toks:
+                toks = toks[:toks.index(self.ecfg.eos_id) + 1]
+            n = len(toks)
+            req.generated.extend(toks)
+            idx = self._slot_draft[slot]
+            if idx is not None:
+                for t in toks:
+                    idx.append(t)
+            req.trace.mark_tokens(n)
+            harvested += n
+            accepted += n - 1
+            self._lengths[slot] += n
+            self._budget[slot] -= n
+            self._tokens[slot] = toks[-1]
+            self._fresh[slot] = True      # host-authoritative after clamping
+            if self._budget[slot] <= 0 or toks[-1] == self.ecfg.eos_id \
+                    or len(req.generated) >= req.max_new_tokens:
+                self._retire(slot)
+        self._m_tokens.inc(harvested)
+        self._m_spec_accepted.inc(accepted)
+        drafted = self._m_spec_drafted.value
+        if drafted:
+            self._g_spec_rate.set(self._m_spec_accepted.value / drafted)
+        if snapshot:
+            self._g_spec_tps.set(harvested / len(snapshot))
+        return harvested
 
     def _harvest_one(self) -> int:
         """Block on the OLDEST in-flight step's sampled token ids (the only
@@ -781,13 +1272,22 @@ class DecodeEngine:
             flight.record("engine.step", step_seq=self.step_seq,
                           occupancy=n_active, inflight=len(self._inflight))
         harvested = 0
+        spec_pending = None
         if n_active:
-            self._dispatch()
+            if self._spec:
+                spec_pending = self._dispatch_spec()
+            else:
+                self._dispatch()
         # decode-priority: the chunk enqueues AFTER the decode step, so the
         # in-flight decodes' cadence bounds how much a long prompt can add
         # per step (one chunk), never the whole prefill wall
         chunked = self._advance_prefill()
-        if n_active:
+        if spec_pending is not None:
+            # synchronous harvest (after the chunk enqueued, so chunked
+            # prefill keeps its decode-priority slot in the device queue):
+            # the host needs the accepted tokens to draft the next step
+            harvested += self._harvest_spec(*spec_pending)
+        elif n_active:
             while len(self._inflight) >= max(1, self.ecfg.inflight):
                 harvested += self._harvest_one()
         elif self._inflight:
@@ -834,20 +1334,46 @@ class DecodeEngine:
                 f"prompt {ids.size} leaves no room to decode within "
                 f"max_seq_len={self.max_seq_len}")
         n_src = -(-ids.size // self.ecfg.page_size)
-        pages = self.allocator.alloc(n_src)
+        shared: list[int] = []
+        hashes: list[bytes] = []
+        if self._prefix_enabled:
+            # the export path serves the fleet's REPEATED prompts — it gets
+            # the same cached-prefix attach as submit (last prompt-token
+            # page always recomputed), so only the tail prefills
+            hashes = self._page_hashes(ids)
+            shared = self._prefix_lookup(hashes)
+            shared = shared[:(ids.size - 1) // self.ecfg.page_size]
+            if shared:
+                self._attach_prefix(shared)
+        pages = self.allocator.alloc(n_src - len(shared))
         if pages is None:
+            if shared:
+                self.allocator.free(shared)
             raise RuntimeError(
-                f"prefill_export needs {n_src} pages, "
+                f"prefill_export needs {n_src} pages "
+                f"({len(shared)} cached), "
                 f"{self.allocator.free_pages} free")
+        if self._prefix_enabled:
+            # counted only once the export can actually proceed (same rule
+            # as _admit): a failed alloc must not inflate hit/reuse stats
+            (self._m_prefix_hit if shared else self._m_prefix_miss).inc()
+            self._m_prefix_reused.inc(len(shared))
+        all_pages = shared + pages
         row = np.full(self.pages_per_slot, TRASH_PAGE, np.int32)
-        row[:n_src] = pages
+        row[:n_src] = all_pages
         try:
-            first = self._run_prefill(ids, row)
+            first = self._run_prefill(
+                ids, row, start=len(shared) * self.ecfg.page_size)
             from paddle_tpu.kernels.paged_attention import export_pages
-            k_blob, v_blob = export_pages(self._kc, self._vc, pages)
+            k_blob, v_blob = export_pages(self._kc, self._vc, all_pages)
             k_np, v_np = np.asarray(k_blob), np.asarray(v_blob)
+            if self._prefix_enabled:
+                # the freshly prefilled pages are cache-eligible: register
+                # BEFORE freeing so the retain hook keeps them resident —
+                # a local resubmit of this prompt then skips the prefill
+                self._register_prefix(hashes, all_pages)
         finally:
-            self.allocator.free(pages)
+            self.allocator.free(all_pages)
         metrics.counter("engine.kv_exports").inc()
         return KVHandoff(prompt=ids, first_token=first, k_pages=k_np,
                          v_pages=v_np, page_size=int(self.ecfg.page_size),
@@ -895,6 +1421,10 @@ class DecodeEngine:
                 f"handoff has {n_src} pages for a {ids.size}-token prompt "
                 f"at page_size {self.ecfg.page_size}")
         req = GenerateRequest(ids, n, trace=trace)
+        if self._prefix_enabled:
+            # imported pages are cache-eligible: _seed_first_token indexes
+            # them, so a shared-prefix submit AFTER the import reuses them
+            req.page_hashes = self._page_hashes(ids)
         with self._work:
             if self._dead is not None:
                 raise RuntimeError(f"engine stopped: {self._dead}")

@@ -388,13 +388,24 @@ class InferenceServer:
         if self._engine is None:
             raise RuntimeError("no decode engine attached "
                                "(start with --gpt-config or engine=)")
-        if len(arrays) != 2:
+        if len(arrays) not in (2, 3):
             raise ValueError(
-                f"GENERATE wants [prompt_ids, max_new_tokens], got "
-                f"{len(arrays)} arrays")
-        ids, mnt = arrays
+                f"GENERATE wants [prompt_ids, max_new_tokens[, options]], "
+                f"got {len(arrays)} arrays")
+        ids, mnt = arrays[0], arrays[1]
+        kw = {}
+        if len(arrays) == 3:
+            # optional per-request knobs: int32 [cache, speculate] flags
+            # (prefix-cache / n-gram-drafting participation; both default
+            # on, gated by the engine-level config — docs/SERVING.md)
+            opts = np.asarray(arrays[2]).reshape(-1)
+            if opts.size != 2:
+                raise ValueError(
+                    f"GENERATE options wants int32 [cache, speculate], "
+                    f"got {opts.size} values")
+            kw = dict(cache=bool(int(opts[0])), speculate=bool(int(opts[1])))
         req = self._engine.submit(ids, int(np.asarray(mnt).reshape(-1)[0]),
-                                  trace=trace)
+                                  trace=trace, **kw)
         out = req.result(timeout=600.0)
         metrics.counter("serve.generate_requests").inc()
         return np.ascontiguousarray(out, np.int32)
@@ -516,14 +527,27 @@ class RemotePredictor:
             return payload.tobytes().decode()
         return self._idempotent(_do)
 
-    def generate(self, prompt_ids, max_new_tokens=32):
+    def generate(self, prompt_ids, max_new_tokens=32, cache=None,
+                 speculate=None):
         """Batched server-side decode: ship the prompt, get prompt +
         generated ids back. Concurrent generate() calls from any number of
-        clients share the server engine's decode batch."""
+        clients share the server engine's decode batch.
+
+        ``cache`` / ``speculate`` (default None = server default, on):
+        per-request prefix-cache / speculative-drafting participation —
+        sent as an optional third options array so old servers keep
+        working with knob-less calls (docs/SERVING.md)."""
         ids = np.ascontiguousarray(np.asarray(prompt_ids).reshape(-1),
                                    np.int32)
-        self._sock.sendall(struct.pack("<III", MAGIC, OP_GENERATE, 2))
-        send_arrays(self._sock, [ids, np.asarray([max_new_tokens], np.int32)])
+        arrays = [ids, np.asarray([max_new_tokens], np.int32)]
+        if cache is not None or speculate is not None:
+            arrays.append(np.asarray(
+                [1 if cache is None else int(bool(cache)),
+                 1 if speculate is None else int(bool(speculate))],
+                np.int32))
+        self._sock.sendall(struct.pack("<III", MAGIC, OP_GENERATE,
+                                       len(arrays)))
+        send_arrays(self._sock, arrays)
         magic, status, n = struct.unpack(
             "<III", _recv_exact(self._sock, 12))
         if magic != MAGIC:

@@ -21,10 +21,22 @@ the only (and correct) choice.
 
 The measured table can be inspected via :func:`cache_table` and persists
 in-process; set ``FLAGS_autotune_verbose=1`` to log decisions.
+
+**Persistent cache** (``PADDLE_AUTOTUNE_CACHE=/path/table.json``): measured
+winners are additionally written to a small on-disk JSON table keyed by the
+same (backend, shape-class, dtype) signatures, and consulted before
+measuring — a server fleet stops re-paying the measurement wall at every
+startup (cold-start matters at fleet scale, ROADMAP item 5). The file is
+advisory only: corrupt, stale, or unwritable cache files are IGNORED (the
+winner is re-measured and the table rewritten when possible), and a
+persisted winner naming an impl that is not viable on the current backend
+is discarded — a table copied from a TPU host cannot poison a CPU one.
 """
 from __future__ import annotations
 
+import json
 import logging
+import os
 import time
 
 import numpy as np
@@ -32,6 +44,9 @@ import numpy as np
 _LOG = logging.getLogger("paddle_tpu.autotune")
 
 _CACHE: dict = {}
+
+_DISK_VERSION = 1
+_DISK_STATE: dict = {"path": None, "table": None}   # loaded-once per path
 
 
 def cache_table():
@@ -41,6 +56,65 @@ def cache_table():
 
 def clear_cache():
     _CACHE.clear()
+    _DISK_STATE["path"] = _DISK_STATE["table"] = None
+
+
+def _disk_path():
+    return os.environ.get("PADDLE_AUTOTUNE_CACHE") or None
+
+
+def _load_disk_table(path) -> dict:
+    """Read the persisted winner table; ANY failure (missing, corrupt,
+    wrong schema) degrades to an empty table — never fatal."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict) or data.get("version") != _DISK_VERSION:
+            return {}
+        table = data.get("winners")
+        return table if isinstance(table, dict) else {}
+    except Exception as e:  # noqa: BLE001 — a bad cache file is advisory
+        if not isinstance(e, FileNotFoundError):
+            _LOG.info("autotune: ignoring unreadable cache %s: %s", path, e)
+        return {}
+
+
+def _disk_lookup(key, viable):
+    """Persisted winner for ``key``, or None. Winners outside the backend's
+    ``viable`` candidate list are stale (table copied across backends or an
+    impl renamed) and are ignored."""
+    path = _disk_path()
+    if path is None:
+        return None
+    if _DISK_STATE["path"] != path or _DISK_STATE["table"] is None:
+        _DISK_STATE["path"] = path
+        _DISK_STATE["table"] = _load_disk_table(path)
+    win = _DISK_STATE["table"].get(repr(key))
+    if isinstance(win, str) and win in viable:
+        from paddle_tpu.observability import metrics
+        metrics.counter("autotune.disk_hits").inc()
+        return win
+    return None
+
+
+def _disk_store(key, winner):
+    """Merge one measured winner into the on-disk table (atomic replace;
+    re-reads first so concurrent processes lose at most their own entry).
+    Failures are logged and swallowed — persistence is an optimization."""
+    path = _disk_path()
+    if path is None:
+        return
+    try:
+        table = _load_disk_table(path)
+        table[repr(key)] = winner
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"version": _DISK_VERSION, "winners": table}, f,
+                      sort_keys=True)
+        os.replace(tmp, path)
+        _DISK_STATE["path"], _DISK_STATE["table"] = path, table
+    except Exception as e:  # noqa: BLE001
+        _LOG.info("autotune: cache write to %s failed: %s", path, e)
 
 
 def _backend_kind():
@@ -117,6 +191,10 @@ def flash_winner(shape_q, shape_k, dtype, causal, tileable, run_impl):
     if len(cands) == 1:
         _CACHE[key] = (cands[0], {})
         return cands[0]
+    disk = _disk_lookup(key, cands)
+    if disk is not None:
+        _CACHE[key] = (disk, {})
+        return disk
 
     import jax
     import jax.numpy as jnp
@@ -149,6 +227,7 @@ def flash_winner(shape_q, shape_k, dtype, causal, tileable, run_impl):
         _LOG.warning("autotune flash %s -> %s (%s)", key, winner,
                      {k_: f"{v_ * 1e3:.2f}ms" for k_, v_ in timings.items()})
     _CACHE[key] = (winner, timings)
+    _disk_store(key, winner)
     return winner
 
 
@@ -179,6 +258,10 @@ def paged_winner(b, pages_per_slot, page_size, nh, dh, dtype, run_impl):
     if len(cands) == 1:
         _CACHE[key] = (cands[0], {})
         return cands[0]
+    disk = _disk_lookup(key, cands)
+    if disk is not None:
+        _CACHE[key] = (disk, {})
+        return disk
 
     import jax
     import jax.numpy as jnp
@@ -215,4 +298,5 @@ def paged_winner(b, pages_per_slot, page_size, nh, dh, dtype, run_impl):
         _LOG.warning("autotune paged %s -> %s (%s)", key, winner,
                      {k_: f"{v_ * 1e3:.2f}ms" for k_, v_ in timings.items()})
     _CACHE[key] = (winner, timings)
+    _disk_store(key, winner)
     return winner

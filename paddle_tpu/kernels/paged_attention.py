@@ -49,8 +49,9 @@ from paddle_tpu.observability import metrics
 TRASH_PAGE = 0
 
 __all__ = ["TRASH_PAGE", "gather_kv", "paged_attention", "token_page_coords",
-           "prompt_page_coords", "chunk_page_coords", "write_token_kv",
-           "write_prompt_kv", "export_pages", "import_pages"]
+           "prompt_page_coords", "chunk_page_coords", "verify_page_coords",
+           "write_token_kv", "write_prompt_kv", "export_pages",
+           "import_pages"]
 
 
 def gather_kv(pages, page_table):
@@ -173,6 +174,26 @@ def chunk_page_coords(page_table, start, valid, seq_len, page_size):
     page = jnp.where((jnp.arange(seq_len) < valid) & (idx < maxp),
                      page_table[jnp.clip(idx, 0, maxp - 1)], TRASH_PAGE)
     return page, t % page_size
+
+
+def verify_page_coords(page_table, pos, valid, page_size):
+    """(page, offset) for writing a [B, W] WINDOW of tokens per sequence —
+    the speculative-decode verify step's write pattern (`models/gpt.py::
+    verify_step`): each slot writes its current token plus up to W-1
+    drafted tokens in one step.
+
+    page_table : [B, pages_per_slot] int32; pos : [B, W] int32 absolute
+    positions; valid : [B, W] bool — padding drafts, inactive slots, and
+    positions past the slot's capacity all route to TRASH_PAGE (rejected
+    drafts leave garbage ONLY at positions past the rolled-back length,
+    which every later step overwrites before attending). Returns
+    ([B, W], [B, W]).
+    """
+    maxp = page_table.shape[1]
+    idx = pos // page_size
+    page = jnp.take_along_axis(page_table, jnp.clip(idx, 0, maxp - 1), axis=1)
+    page = jnp.where(valid & (idx < maxp), page, TRASH_PAGE)
+    return page, pos % page_size
 
 
 def export_pages(k_pages, v_pages, page_list):

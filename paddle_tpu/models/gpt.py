@@ -286,6 +286,115 @@ def prefill_chunk_step(params, ids, start, valid, page_table, k_pages,
     return _final_logits(params, last), k_pages, v_pages
 
 
+def verify_step(params, tok_seq, draft_len, cache, slot_mask, *, cfg,
+                sampler=None, keys=None):
+    """Speculative-decode VERIFY: score k+1 positions per slot in ONE
+    fixed-shape step over the paged gather.
+
+    The engine drafts up to k tokens per slot (self-drafting n-gram
+    proposer, `inference/engine.py`); this program writes all k+1 tokens'
+    K/V into the slot's pages, computes logits at every position in one
+    batched pass, and accepts the longest draft prefix that matches what
+    plain decode would have emitted — plus ONE corrected token from the
+    first mismatching position. Rejected tokens need no device rollback:
+    the host rolls the slot's length back and every later step rewrites
+    those positions before any query attends them (page-granular rollback
+    is free by construction of the write-before-attend cache discipline).
+
+    tok_seq   : [B, K+1] int32 — column 0 is each slot's CURRENT token
+                (same semantics as `decode_step`'s ids), columns 1..K the
+                drafted continuation (padding past ``draft_len``)
+    draft_len : [B] int32 — true drafted tokens per slot (0..K; 0 degrades
+                to exactly `decode_step` emitting one token)
+    cache     : as `decode_step` (k_pages/v_pages/page_table/lengths)
+    slot_mask : [B] bool — inactive slots write to TRASH_PAGE and emit 0
+    sampler   : optional `_make_sampler` fn for sampled verification;
+                greedy argmax when None (the engine's mode)
+    keys      : with ``sampler``, [B, 2] uint32 per-slot PRNG keys; the key
+                chain is split once per position EXACTLY as `fast_generate`
+                splits once per emitted token, and the returned keys are
+                each slot's chain advanced by its n_emitted splits — so
+                sampled speculative decode is bit-identical to plain
+                sampled decode (parity-tested incl. top-k)
+    returns   : (emitted [B, K+1] int32 — positions < n_emitted are the
+                 step's output tokens —, n_emitted [B] int32 in 0..K+1,
+                 new cache with lengths advanced by n_emitted[, new_keys])
+
+    Acceptance is EXACT, not approximate: emitted tokens are precisely the
+    tokens the non-speculative loop would produce, because position i's
+    logits condition on drafts 1..i and are only consumed when every one of
+    those drafts equals the token the model itself emitted at that slot.
+    """
+    from paddle_tpu.kernels import paged_attention as pa
+    nl, nh = cfg.num_layers, cfg.num_heads
+    dh = cfg.hidden_size // nh
+    scale = 1.0 / (dh ** 0.5)
+    kc, vc = cache["k_pages"], cache["v_pages"]
+    page_table, lengths = cache["page_table"], cache["lengths"]
+    ps = kc.shape[2]
+    b, kp1 = tok_seq.shape
+    offs = jnp.arange(kp1)
+    pos = lengths[:, None] + offs[None, :]                     # [B, K+1]
+    valid = slot_mask[:, None] & (offs[None, :] <= draft_len[:, None])
+    wpe = params["gpt.wpe.weight"]
+    x = params["gpt.wte.weight"][tok_seq] + \
+        wpe[jnp.clip(pos, 0, wpe.shape[0] - 1)]                # [B, K+1, H]
+
+    def attend(i, q, k, v):
+        nonlocal kc, vc
+        page, off = pa.verify_page_coords(page_table, pos, valid, ps)
+        kc = kc.at[i, page, off].set(k)
+        vc = vc.at[i, page, off].set(v)
+        kk = pa.gather_kv(kc[i], page_table)                   # [B, Lmax, ..]
+        vv = pa.gather_kv(vc[i], page_table)
+        lmax = kk.shape[1]
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                        kk.astype(jnp.float32))
+        # absolute-position causality: query at position p sees keys 0..p —
+        # within-window future drafts mask out exactly like unwritten pages
+        mask = jnp.arange(lmax)[None, None, :] <= pos[:, :, None]
+        sc = jnp.where(mask[:, None], sc, -1e30)
+        pr = jax.nn.softmax(sc, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", pr,
+                          vv.astype(jnp.float32)).astype(x.dtype)
+
+    x = _block_stack(params, x, nl, nh, dh, attend)
+    logits = _final_logits(params, x)                          # [B, K+1, V]
+
+    new_keys = None
+    if sampler is None:
+        out = jnp.argmax(logits, axis=-1).astype(tok_seq.dtype)
+    else:
+        def chain(key, lg):            # one slot: [K+1, V] logits
+            def one(k_, l_):
+                t, k2 = sampler(l_[None], k_)
+                return k2, (t[0], k2)
+            _, (toks, keys_after) = jax.lax.scan(one, key, lg)
+            return toks, keys_after
+        out, keys_after = jax.vmap(chain)(keys, logits)
+        out = out.astype(tok_seq.dtype)
+
+    k = kp1 - 1
+    if k > 0:
+        match = (tok_seq[:, 1:] == out[:, :-1]) \
+            & (jnp.arange(k)[None] < draft_len[:, None])
+        # contiguous-prefix acceptance: the first mismatch rejects the rest
+        n_acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+    else:
+        n_acc = jnp.zeros(b, jnp.int32)
+    n_emitted = jnp.where(slot_mask, n_acc + 1, 0).astype(jnp.int32)
+    new_cache = dict(k_pages=kc, v_pages=vc, page_table=page_table,
+                     lengths=jnp.where(slot_mask, lengths + n_emitted,
+                                       lengths))
+    if sampler is None:
+        return out, n_emitted, new_cache
+    new_keys = jnp.take_along_axis(
+        keys_after, jnp.maximum(n_emitted - 1, 0)[:, None, None], axis=1)[:, 0]
+    # an inactive slot emitted nothing: its chain must not move at all
+    new_keys = jnp.where((n_emitted > 0)[:, None], new_keys, keys)
+    return out, n_emitted, new_cache, new_keys
+
+
 def _sp_constrain(x, cfg):
     """[B, S, H] activations: batch over dp, sequence over sp."""
     if not cfg.seq_parallel or get_mesh() is None:

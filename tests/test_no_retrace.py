@@ -117,6 +117,75 @@ def test_chunked_prefill_compiles_once():
         "fixed program shape")
 
 
+def test_verify_step_compiles_once():
+    """Speculative decoding keeps the AOT discipline: ONE verify program
+    per k (draft contents and draft_len ride the packed upload, never a
+    shape), and draft-availability churn — slots with full drafts, partial
+    drafts, and none in the same step — never retraces."""
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    m = _tiny_model()
+    eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=3,
+                                       min_bucket=8, speculate_k=3,
+                                       prefix_cache=False))
+    rng = np.random.RandomState(5)
+    eng.warmup(prompt_lens=[8])
+    r = eng.submit(rng.randint(0, 64, 5).astype(np.int32), 3)
+    eng.run_until_idle(max_steps=30)
+    assert r.done
+    frozen = _compile_counters()
+
+    # churn: repetitive prompts (drafts accepted), random prompts (drafts
+    # rejected), staggered joins — every step is the one warm verify shape
+    reqs = [eng.submit(np.tile(rng.randint(0, 64, 2).astype(np.int32), 3),
+                       8)]
+    reqs += [eng.submit(rng.randint(0, 64, 3 + i).astype(np.int32), 4 + i)
+             for i in range(2)]
+    eng.step()
+    reqs.append(eng.submit(rng.randint(0, 64, 7).astype(np.int32), 5))
+    eng.run_until_idle(max_steps=120)
+    for req in reqs:
+        assert req.done
+    assert metrics.snapshot()["counters"].get("engine.spec_steps", 0) > 0
+    assert _compile_counters() == frozen, (
+        "speculative engine recompiled after warmup: draft churn must be "
+        "shape-invariant")
+
+
+def test_prefix_hit_skips_prefill_programs():
+    """A prefix-cached resubmission performs ZERO prefill-program work for
+    the cached pages (counter-pinned via engine.prefill_tokens): the first
+    hit compiles exactly one tail-chunk program (a new pow-2 bucket), and
+    every later hit runs entirely warm."""
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    m = _tiny_model()
+    eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                       min_bucket=8))
+    rng = np.random.RandomState(6)
+    prompt = rng.randint(0, 64, 16).astype(np.int32)
+    r = eng.submit(prompt, 3)                    # miss: bucket-16 prefill
+    eng.run_until_idle(max_steps=30)
+    assert r.done
+    base = _compile_counters()
+    tok0 = metrics.snapshot()["counters"].get("engine.prefill_tokens", 0)
+
+    r2 = eng.submit(prompt, 3)                   # hit: 3 pages shared,
+    eng.run_until_idle(max_steps=30)             # 4-token tail re-prefilled
+    assert r2.done
+    after = _compile_counters()
+    assert after[0] == base[0] + 1, (
+        "first prefix hit should compile exactly the tail-chunk program")
+    toks = metrics.snapshot()["counters"]["engine.prefill_tokens"] - tok0
+    assert toks == 4, (
+        f"prefill ran {toks} tokens for a 16-token prompt with 12 cached — "
+        "cached pages must cost zero prefill-program work")
+
+    r3 = eng.submit(prompt, 3)                   # warm hit: nothing compiles
+    eng.run_until_idle(max_steps=30)
+    assert r3.done
+    assert _compile_counters() == after, (
+        "a warm prefix hit must not compile anything")
+
+
 def test_scan_train_step_compiles_once_and_donates():
     """The captured scan-over-layers train step (paddle_tpu/train): exactly
     ONE compile across N steps with changing batch CONTENTS, frozen

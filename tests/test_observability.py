@@ -358,6 +358,13 @@ def test_bench_smoke_emits_structured_json():
     assert d["router_ok"] is True
     assert d["prefill_chunks"] >= 3
     assert d["metrics"]["counters"]["router.requests"] >= 1
+    # r7: the smoke run exercises one prefix-cache HIT (a resubmitted
+    # prompt attaches its cached pages by reference) and at least one
+    # speculative verify step (n-gram draft, k-token verify)
+    assert d["prefix_hits"] >= 1
+    assert d["spec_accepted"] >= 0
+    assert d["metrics"]["counters"]["engine.spec_steps"] >= 1
+    assert d["metrics"]["counters"]["engine.prefix_pages_reused"] >= 1
 
 
 def test_bench_emission_survives_failing_platform_plugin(tmp_path):
