@@ -561,6 +561,77 @@ def bench_spec_decode():
                 plain_tok_s=plain_tps, accept_rate=rate, k=K)
 
 
+def bench_overload():
+    """Overload-containment rung (docs/ROBUSTNESS.md): offered load
+    deliberately EXCEEDS engine capacity, with per-request deadlines set
+    and admission control on — measures what a fleet under pressure
+    cares about: the shed ratio (typed `Overloaded` refusals / offered),
+    the GOODPUT (tokens/s of requests that actually completed — shed
+    work costs nothing), and the accepted-request TTFT p99 (admission
+    control exists so the work that IS accepted keeps flat latency
+    instead of everyone degrading together). Load arrives in waves with
+    a few engine steps between them, so later waves land on a
+    part-drained queue — both accept and shed paths run every wave.
+    Emits its own structured JSON line."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import (DeadlineExceeded, DecodeEngine,
+                                             EngineConfig, Overloaded)
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    S, N = 32, 16
+    WAVES, PER_WAVE, STEPS_BETWEEN = 4, 8, 4
+    cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, max_position_embeddings=128,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    eng = DecodeEngine(model, EngineConfig(
+        page_size=16, max_slots=4, max_seq_len=S + N,
+        max_queue_depth=4, prefix_cache=False))
+    eng.warmup(prompt_lens=[S])
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, S).astype(np.int32)
+               for _ in range(WAVES * PER_WAVE)]
+    # prime every program with a real execution (first AOT run pays ~1s
+    # of lazy backend init that would otherwise be wave 1's "TTFT")
+    r = eng.submit(prompts[0], max_new_tokens=2)
+    eng.run_until_idle(max_steps=100)
+    r.result(timeout=300)
+
+    accepted, shed = [], 0
+    t0 = time.perf_counter()
+    it = iter(prompts)
+    for _ in range(WAVES):
+        for _ in range(PER_WAVE):
+            try:
+                accepted.append(eng.submit(next(it), max_new_tokens=N,
+                                           deadline_s=120.0))
+            except Overloaded:
+                shed += 1
+        for _ in range(STEPS_BETWEEN):
+            eng.step()
+    eng.run_until_idle(max_steps=4000)
+    dt = time.perf_counter() - t0
+    done_tokens, ttfts, deadline_errors = 0, [], 0
+    for r in accepted:
+        try:
+            out = r.result(timeout=300)
+            done_tokens += out.size - S
+            ttfts.append(r.trace.t_first_token - r.trace.t_submit)
+        except DeadlineExceeded:
+            deadline_errors += 1
+        # any OTHER failure (abort, pool-too-small) propagates and fails
+        # the rung — it must not masquerade as benign deadline expiry
+    ttfts.sort()
+    offered = WAVES * PER_WAVE
+    return dict(
+        offered=offered, shed=shed, completed=len(ttfts),
+        deadline_errors=deadline_errors,
+        shed_ratio=shed / offered,
+        goodput_tok_s=done_tokens / dt,
+        ttft_p99=ttfts[int(0.99 * (len(ttfts) - 1))] if ttfts else None)
+
+
 def bench_router():
     """Multi-replica serving rung (paddle_tpu/serving): 2 in-process engine
     replicas behind the router under MIXED traffic — 1 long-prefill request
@@ -958,6 +1029,34 @@ def bench_smoke():
     spec_accepted = snapc.get("engine.spec_accepted", 0)
     assert spec_accepted >= 0
 
+    # one typed SHED + one CANCEL (overload protection & failure
+    # containment, docs/ROBUSTNESS.md): admission control refuses the
+    # over-limit submit with a typed Overloaded, and a cancelled queued
+    # request is reaped BEFORE any prefill runs, pool back to baseline
+    from paddle_tpu.inference.engine import Cancelled, Overloaded
+    ov_eng = DecodeEngine(model, EngineConfig(page_size=2, max_slots=1,
+                                              min_bucket=4,
+                                              max_queue_depth=1))
+    held = ov_eng.submit(ids[0, :3].astype(np.int32), max_new_tokens=2)
+    try:
+        ov_eng.submit(ids[0, :3].astype(np.int32), max_new_tokens=2)
+        raise AssertionError("queue-full submit was not shed")
+    except Overloaded:
+        pass
+    assert ov_eng.cancel(held.request_id) is True
+    ov_eng.run_until_idle(max_steps=16)
+    try:
+        held.result(timeout=10)
+        raise AssertionError("cancel did not land")
+    except Cancelled:
+        pass
+    assert ov_eng.allocator.free_pages == ov_eng.allocator.num_pages - 1, \
+        "cancel leaked pages"
+    snapo = metrics.snapshot()["counters"]
+    shed_count = snapo.get("engine.shed", 0)
+    cancelled_count = snapo.get("engine.cancelled", 0)
+    assert shed_count >= 1 and cancelled_count >= 1
+
     # one ROUTED request on CPU (paddle_tpu/serving): an in-process engine
     # replica behind the router front door, static membership — keeps the
     # multi-replica subsystem import- and wire-clean under tier-1
@@ -992,7 +1091,7 @@ def bench_smoke():
     slo = {f"{short}_{q}": round(hists[f"serve.{short}_seconds"][q], 6)
            for short in ("ttft", "tpot", "e2e") for q in ("p50", "p99")}
     return (dt, batch * seq / dt, snap, slo, wd.dump_count == 0, router_ok,
-            prefix_hits, spec_accepted)
+            prefix_hits, spec_accepted, shed_count, cancelled_count)
 
 
 def _retry(fn, attempts=3):
@@ -1033,7 +1132,7 @@ def main(argv=None):
     if args.smoke:
         try:
             (dt, tps, snap, slo, wd_clean, router_ok, prefix_hits,
-             spec_accepted) = bench_smoke()
+             spec_accepted, shed_count, cancelled_count) = bench_smoke()
             impls = {k.rsplit(".", 1)[-1]: v
                      for k, v in snap["counters"].items()
                      if k.startswith("paged_attention.impl.") and v}
@@ -1044,6 +1143,8 @@ def main(argv=None):
                    "router_ok": router_ok,
                    "prefix_hits": prefix_hits,
                    "spec_accepted": spec_accepted,
+                   "shed": shed_count,
+                   "cancelled": cancelled_count,
                    "prefill_chunks": snap["counters"].get(
                        "engine.prefill_chunks", 0),
                    "train_mfu": snap["gauges"].get("train.mfu"),
@@ -1218,6 +1319,29 @@ def main(argv=None):
     except Exception as e:
         print(f"# dataloader rung failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    try:
+        ov = _retry(bench_overload)
+        _emit({"metric": "overload_goodput_tokens_per_sec",
+               "value": round(ov["goodput_tok_s"], 1), "unit": "tokens/s",
+               "ok": True, "platform": platform,
+               "offered": ov["offered"], "shed": ov["shed"],
+               "completed": ov["completed"],
+               "deadline_errors": ov["deadline_errors"],
+               "shed_ratio": round(ov["shed_ratio"], 3),
+               "accepted_ttft_p99_s": (round(ov["ttft_p99"], 6)
+                                       if ov["ttft_p99"] is not None
+                                       else None),
+               "mix": "32x(32+16) in 4 waves, slots=4 queue<=4, "
+                      "deadline 120s"})
+        print(f"# overload 4x8 waves onto slots=4/queue<=4: shed_ratio="
+              f"{ov['shed_ratio']:.2f}, goodput={ov['goodput_tok_s']:.0f} "
+              f"tok/s, accepted ttft_p99="
+              f"{(ov['ttft_p99'] or 0) * 1e3:.0f}ms, "
+              f"deadline_errors={ov['deadline_errors']}", file=sys.stderr)
+    except Exception as e:
+        _emit({"metric": "overload_goodput_tokens_per_sec", "value": 0.0,
+               "unit": "tokens/s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
     try:
         # LAST rung by design: its per-phase metrics.reset() must run after
         # every other rung has read the registry

@@ -80,15 +80,18 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.inference.errors import (Cancelled, DeadlineExceeded,
+                                         Overloaded, from_wire)
 from paddle_tpu.kernels.paged_attention import TRASH_PAGE
 from paddle_tpu.observability import metrics
 from paddle_tpu.observability.flight_recorder import (Watchdog,
                                                       default_deadline,
                                                       flight)
 from paddle_tpu.observability.tracing import RequestTrace
+from paddle_tpu.testing import faults
 
 __all__ = ["EngineConfig", "PageAllocator", "GenerateRequest", "DecodeEngine",
-           "KVHandoff"]
+           "KVHandoff", "DeadlineExceeded", "Cancelled", "Overloaded"]
 
 # packed slot-state upload layout: [B, _STATE_COLS + pages_per_slot] int32,
 # ONE host->device transfer per step (engine.h2d_transfers). The
@@ -144,6 +147,17 @@ class EngineConfig:
                    needs each step's accepted tokens to draft the next),
                    so ``inflight`` does not apply. Per-request opt-out via
                    ``submit(..., speculate=False)``
+    max_queue_depth  : admission control (docs/ROBUSTNESS.md): a submit
+                   arriving with this many requests already queued fails
+                   FAST with a typed ``Overloaded`` error instead of
+                   joining an unbounded queue — the router resubmits it
+                   elsewhere, the client gets a bounded answer. None
+                   (default) keeps the queue unbounded
+    max_queue_tokens : same, bounding the SUM of queued prompt tokens
+                   (a few giant prompts can overload a queue long before
+                   max_queue_depth does). Backlog-only: an empty queue
+                   always admits, so one prompt larger than the bound is
+                   never shed with a retry-forever Overloaded
     """
     page_size: int = 16
     max_slots: int = 8
@@ -156,6 +170,8 @@ class EngineConfig:
     prefill_chunk_tokens: int | None = None
     prefix_cache: bool = True
     speculate_k: int | None = None
+    max_queue_depth: int | None = None
+    max_queue_tokens: int | None = None
 
 
 class PageAllocator:
@@ -200,6 +216,8 @@ class PageAllocator:
         control is 'wait', never 'partially allocate'). Evicts refcount-0
         cached pages (LRU via ``evict_hook``) when the free list alone
         cannot cover the request."""
+        if faults.ENABLED and faults.fire("engine.pool_pressure"):
+            return None        # injected pool pressure (testing/faults.py)
         if n > self.free_pages:
             return None
         if n > len(self._free) and self.evict_hook is not None:
@@ -275,10 +293,15 @@ class GenerateRequest:
     retires and returns prompt + generated ids (fast_generate's contract).
     ``trace`` is the request's :class:`RequestTrace` — serve passes one
     created at wire-accept so TTFT/e2e include the wire wait; a direct
-    `submit()` gets a fresh one."""
+    `submit()` gets a fresh one. ``deadline_s`` starts the request's
+    deadline clock HERE (construction = wire accept / submit): past it the
+    engine retires the request with a typed ``DeadlineExceeded`` at the
+    next enforcement point (admission, step start, or harvest — never
+    mid-device-call; docs/ROBUSTNESS.md)."""
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int, trace=None,
-                 cache: bool = True, speculate: bool = True):
+                 cache: bool = True, speculate: bool = True,
+                 deadline_s: float | None = None):
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.generated: list[int] = []
@@ -286,9 +309,16 @@ class GenerateRequest:
         self.trace = trace if trace is not None else RequestTrace()
         self.cache = bool(cache)          # prefix-cache participation
         self.speculate = bool(speculate)  # n-gram drafting participation
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.deadline_t = None if deadline_s is None \
+            else time.monotonic() + float(deadline_s)
         self.page_hashes: list[bytes] = []  # rolling full-page prompt hashes
         self._done = threading.Event()
         self._error: str | None = None
+
+    def expired(self, now: float | None = None) -> bool:
+        return self.deadline_t is not None and \
+            (time.monotonic() if now is None else now) >= self.deadline_t
 
     @property
     def request_id(self) -> str:
@@ -307,7 +337,11 @@ class GenerateRequest:
         if not self._done.wait(timeout):
             raise TimeoutError("generation still running")
         if self._error is not None:
-            raise RuntimeError(self._error)
+            # typed where the error string carries a known type name
+            # ("DeadlineExceeded: ...", "Cancelled: ...") so callers can
+            # except-clause on the class; everything else stays the
+            # RuntimeError it always was
+            raise from_wire(self._error)
         return np.concatenate(
             [self.prompt, np.asarray(self.generated, self.prompt.dtype)])
 
@@ -476,6 +510,11 @@ class DecodeEngine:
         self._programs: dict = {}     # the engine's ProgramCache analog
         self._dead: str | None = None  # set by abort(); submits then fail fast
         self._draining = False        # drain(): refuse NEW submits only
+        self._queue_tokens = 0        # sum of queued prompt tokens (_qlock)
+        # cancellation mailbox: any thread posts request_id -> reason, the
+        # driver applies it between fixed-shape steps (_reap)
+        self._cancels: dict[str, str] = {}
+        self._deg = 0                 # applied degradation level (driver)
         # chunked-prefill progress: slot -> {"req", "done", "t0"}; slots
         # here are occupied (slot_req set, pages held) but NOT decode-active
         self._prefilling: dict[int, dict] = {}
@@ -522,6 +561,10 @@ class DecodeEngine:
         self._m_spec_accepted = metrics.counter("engine.spec_accepted")
         self._g_spec_rate = metrics.gauge("engine.spec_accept_rate")
         self._g_spec_tps = metrics.gauge("engine.spec_tokens_per_step")
+        self._m_shed = metrics.counter("engine.shed")
+        self._m_cancelled = metrics.counter("engine.cancelled")
+        self._m_deadline = metrics.counter("engine.deadline_exceeded")
+        self._g_deg = metrics.gauge("engine.degradation_level")
         self._g_occupancy = metrics.gauge("engine.batch_occupancy")
         self._g_queue = metrics.gauge("engine.queue_depth")
         self._g_tps = metrics.gauge("engine.tokens_per_s")
@@ -750,7 +793,17 @@ class DecodeEngine:
     def _retain_page(self, page: int) -> bool:
         """Allocator retain hook: a refcount-0 page the prefix store still
         indexes stays resident (LRU-tracked) instead of rejoining the free
-        list — its contents are a future request's prefill."""
+        list — its contents are a future request's prefill. Under
+        degradation level >= 2 retention stops: freed pages go straight
+        back to the free list (capacity over cache warmth) and their
+        store index is dropped."""
+        if self._deg >= 2:
+            h = self._page_hash.pop(page, None)
+            if h is not None and self._prefix_pages.get(h) == page:
+                del self._prefix_pages[h]
+            self._prefix_idle.pop(page, None)
+            self._g_prefix_pages.set(len(self._page_hash))
+            return False
         if page in self._page_hash:
             self._prefix_idle[page] = None        # most-recently idled last
             return True
@@ -817,14 +870,22 @@ class DecodeEngine:
     # ------------------------------------------------------------ admission
 
     def submit(self, prompt_ids, max_new_tokens=32, trace=None,
-               cache=True, speculate=True) -> GenerateRequest:
+               cache=True, speculate=True,
+               deadline_s=None) -> GenerateRequest:
         """Queue one prompt (1-D or [1, S] int array). Thread-safe.
         ``trace``: a `RequestTrace` created upstream (serve's wire-accept)
         so the SLO clock starts there; default starts it here.
         ``cache=False`` keeps this prompt out of the prefix cache (neither
         reuses nor registers pages); ``speculate=False`` disables n-gram
         drafting for this request on a speculating engine — both default
-        on, gated by the engine-level knobs."""
+        on, gated by the engine-level knobs. ``deadline_s`` bounds the
+        request end to end: past it the engine retires it with a typed
+        ``DeadlineExceeded`` instead of tokens (enforced at admission —
+        an expired request never reaches a prefill program — and at every
+        harvest; docs/ROBUSTNESS.md). Raises typed ``Overloaded`` when
+        the queue is past `EngineConfig.max_queue_depth` /
+        ``max_queue_tokens`` — admission control fails fast so the router
+        can place the work elsewhere."""
         ids = np.asarray(
             prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids)
         ids = np.ascontiguousarray(ids).reshape(-1).astype(np.int32)
@@ -839,16 +900,24 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt {ids.size} + max_new_tokens {n} exceeds engine "
                 f"max_seq_len={self.max_seq_len}")
+        if deadline_s is not None and float(deadline_s) <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         req = GenerateRequest(ids, n, trace=trace, cache=cache,
-                              speculate=speculate)
+                              speculate=speculate, deadline_s=deadline_s)
+        # double-checked admission: the FIRST check fails a shed/dead/
+        # draining submit fast, BEFORE the O(prompt) blake2b pass below —
+        # admission control exists for exactly the moments that pass
+        # would hurt most. The hash then runs on the submitter's thread
+        # with no lock held (never on the driver, never under _qlock),
+        # and the SECOND check inside the enqueue lock re-validates
+        # (state may have moved during the hash; the rare wasted hash of
+        # a late shed is the cheap side of that race).
+        with self._qlock:
+            self._check_admission(ids.size)
         if self._prefix_enabled and req.cache:
             req.page_hashes = self._page_hashes(ids)
         with self._work:
-            if self._dead is not None:
-                raise RuntimeError(f"engine stopped: {self._dead}")
-            if self._draining:
-                raise RuntimeError(
-                    "engine draining: not accepting new requests")
+            self._check_admission(ids.size)
             # trace/ring entries only for ACCEPTED submits: a rejected one
             # must not leave a phantom never-retired request in a watchdog
             # post-mortem
@@ -856,10 +925,173 @@ class DecodeEngine:
             flight.record("engine.submit", request_id=req.request_id,
                           prompt_len=int(ids.size), max_new_tokens=n)
             self._queue.append(req)
+            self._queue_tokens += int(ids.size)
             self._g_queue.set(len(self._queue))
             self._work.notify()
         self._m_requests.inc()
         return req
+
+    def _check_admission(self, n_tokens: int):
+        """Refuse-or-pass gate for one submit. Caller holds ``_qlock``.
+        Raises the typed not-taking-work errors (dead/draining) or the
+        SHED rung of the pressure ladder: past the configured queue bound
+        the submit fails fast with a typed, resubmittable ``Overloaded``
+        instead of joining a queue it would only time out in."""
+        if self._dead is not None:
+            raise RuntimeError(f"engine stopped: {self._dead}")
+        if self._draining:
+            raise RuntimeError(
+                "engine draining: not accepting new requests")
+        mqd, mqt = self.ecfg.max_queue_depth, self.ecfg.max_queue_tokens
+        if mqd is not None and len(self._queue) >= int(mqd):
+            self._m_shed.inc()
+            raise Overloaded(
+                f"engine queue full: depth {len(self._queue)} >= "
+                f"max_queue_depth {int(mqd)}")
+        # backlog bound only: an EMPTY queue always admits — a single
+        # prompt bigger than the bound would otherwise shed with a
+        # "retry elsewhere" error that every identically-configured
+        # replica repeats forever (max_seq_len already validated the
+        # prompt itself)
+        if mqt is not None and self._queue and \
+                self._queue_tokens + n_tokens > int(mqt):
+            self._m_shed.inc()
+            raise Overloaded(
+                f"engine queue full: {self._queue_tokens} queued + "
+                f"{n_tokens} new tokens > max_queue_tokens {int(mqt)}")
+
+    def cancel(self, request_id: str,
+               reason: str = "cancelled by client") -> bool:
+        """Cancel a queued or running request by id. Thread-safe: posts to
+        the driver's cancellation mailbox; the driver retires the slot and
+        reclaims its pages (shared prefix-cache pages via the per-owner
+        refcounted free — a cancel can never free a page another slot
+        still attends) BETWEEN fixed-shape steps, so cancellation never
+        perturbs a program shape (tests/test_no_retrace.py). Returns True
+        when the id names a request the engine still owes an answer;
+        False for unknown/already-finished ids (idempotent — a retirement
+        racing the cancel is a no-op, not an error). The mailbox post is
+        UNCONDITIONAL: a live request caught mid-admission (popped from
+        the queue, slot not yet published) is visible in neither
+        structure, and its cancel must still land — the return value may
+        then be a conservative False while the cancel takes effect; a
+        post for a truly unknown id is discarded at the next `_reap`
+        swap."""
+        with self._work:
+            if self._dead is not None:
+                return False
+            self._cancels[request_id] = reason
+            known = any(r.request_id == request_id for r in self._queue)
+            self._work.notify()
+        # slot/prefilling membership is driver-owned state; this read is a
+        # benign race (a stale True just means the reap finds nothing)
+        return known or any(
+            r is not None and r.request_id == request_id and not r.done
+            for r in self._slot_req)
+
+    # ------------------------------------------- cancellation / deadlines
+
+    def _reap(self):
+        """Driver-side enforcement point, run at every step start BEFORE
+        admission/dispatch: apply posted cancellations and expire blown
+        deadlines. A queued request leaves the FIFO here — before its
+        prefill (or next chunk) is ever dispatched, so a dead request
+        costs zero prefill tokens (`engine.prefill_tokens` pins this) —
+        and a slotted one retires between fixed-shape steps, freeing its
+        slot and pages (per-owner refcounted free: shared prefix pages
+        survive for other owners)."""
+        with self._qlock:
+            cancels, self._cancels = self._cancels, {}
+            now = time.monotonic()
+            drop = []
+            for req in self._queue:
+                if req.request_id in cancels:
+                    drop.append((req, f"Cancelled: "
+                                      f"{cancels[req.request_id]}"))
+                elif req.expired(now):
+                    drop.append((req, self._deadline_error(req)))
+            for req, _ in drop:
+                self._queue.remove(req)
+                self._queue_tokens -= int(req.prompt.size)
+            if drop:
+                self._g_queue.set(len(self._queue))
+        for req, err in drop:
+            self._count_reap(err)
+            flight.record("engine.reap", request_id=req.request_id,
+                          where="queue", error=err)
+            req._finish(err)
+        now = time.monotonic()
+        for slot in range(self.ecfg.max_slots):
+            req = self._slot_req[slot]
+            if req is None or req.done:
+                continue
+            if req.request_id in cancels:
+                err = f"Cancelled: {cancels[req.request_id]}"
+            elif req.expired(now):
+                err = self._deadline_error(req)
+            else:
+                continue
+            self._count_reap(err)
+            flight.record("engine.reap", request_id=req.request_id,
+                          where="slot", error=err)
+            self._retire(slot, error=err)
+
+    @staticmethod
+    def _deadline_error(req: GenerateRequest) -> str:
+        return (f"DeadlineExceeded: request deadline "
+                f"({req.deadline_s:g}s) passed after "
+                f"{len(req.generated)} generated tokens")
+
+    def _count_reap(self, err: str):
+        (self._m_deadline if err.startswith("DeadlineExceeded")
+         else self._m_cancelled).inc()
+
+    # -------------------------------------------------- degradation ladder
+
+    def _pressure(self) -> float:
+        """Queue pressure in [0, inf): the occupied fraction of whichever
+        admission-control bound is closest to tripping. Caller holds
+        ``_qlock``. 0.0 when no bound is configured (the ladder is
+        inert without admission control — pressure has no yardstick)."""
+        frac = 0.0
+        if self.ecfg.max_queue_depth:
+            frac = max(frac, len(self._queue)
+                       / int(self.ecfg.max_queue_depth))
+        if self.ecfg.max_queue_tokens:
+            frac = max(frac, self._queue_tokens
+                       / int(self.ecfg.max_queue_tokens))
+        return frac
+
+    def _apply_degradation(self):
+        """Degrade BEFORE shedding (docs/ROBUSTNESS.md "Pressure
+        ladder"): level 1 (pressure >= 0.5) turns speculation off —
+        verify-step overhead stops competing with the backlog; level 2
+        (>= 0.75) additionally stops retaining prefix-cache pages and
+        returns the idle ones to the free list — capacity over cache
+        warmth; level 3 (>= 1.0) is the shed threshold `submit` enforces.
+        Levels drop back automatically as the queue drains. Driver-thread
+        only (mutates the prefix store/allocator)."""
+        with self._qlock:
+            frac = self._pressure()
+        target = 3 if frac >= 1.0 else 2 if frac >= 0.75 \
+            else 1 if frac >= 0.5 else 0
+        if target == self._deg:
+            return
+        if target >= 2 > self._deg:
+            self._shrink_prefix()
+        self._deg = target
+        self._g_deg.set(target)
+        flight.record("engine.degradation", level=target,
+                      pressure=round(frac, 3))
+
+    def _shrink_prefix(self):
+        """Degradation level >= 2: return every IDLE cached page to the
+        free list (same store bookkeeping as pressure eviction — live
+        slots' pages only lose their index via the retain hook declining
+        them at retirement)."""
+        idle = self._evict_prefix_pages(len(self._prefix_idle))
+        if idle:
+            self.allocator.reclaim(idle)
 
     def _free_slots(self):
         # occupancy, not the dispatch mask: a slot whose budget is spent
@@ -884,6 +1116,22 @@ class DecodeEngine:
                     self._g_queue.set(0)
                     return
                 req = self._queue[0]
+                if req.done or req.expired():
+                    # cancelled/aborted/expired while queued: skipped
+                    # BEFORE any prefill program runs — zero prefill
+                    # tokens spent on a request nobody will read
+                    # (engine.prefill_tokens pins this)
+                    self._queue.popleft()
+                    self._queue_tokens -= int(req.prompt.size)
+                    self._g_queue.set(len(self._queue))
+                    if not req.done:
+                        err = self._deadline_error(req)
+                        self._count_reap(err)
+                        flight.record("engine.reap",
+                                      request_id=req.request_id,
+                                      where="admission", error=err)
+                        req._finish(err)
+                    continue
                 total = -(-(req.prompt.size + req.max_new_tokens)
                           // self.ecfg.page_size)
                 shared: list[int] = []
@@ -910,6 +1158,7 @@ class DecodeEngine:
                         # TOTAL need — a post-sharing count could look
                         # satisfiable next to the pool size)
                         self._queue.popleft()
+                        self._queue_tokens -= int(req.prompt.size)
                         self._g_queue.set(len(self._queue))
                         req._finish(error=f"request needs {total} pages, "
                                     f"pool has "
@@ -921,6 +1170,7 @@ class DecodeEngine:
                      else self._m_prefix_miss).inc()
                     self._m_prefix_reused.inc(len(shared))
                 self._queue.popleft()
+                self._queue_tokens -= int(req.prompt.size)
                 self._g_queue.set(len(self._queue))
             self._h_wait.observe(time.perf_counter() - req.submit_t)
             self._place(req, slots[0], shared + pages, len(shared))
@@ -1152,7 +1402,11 @@ class DecodeEngine:
         K, B = self._spec_k, self.ecfg.max_slots
         drafts = np.zeros((B, K), np.int32)
         draft_lens = np.zeros(B, np.int32)
-        for slot in np.flatnonzero(self._active):
+        # degradation level >= 1: stop drafting (zero-draft verify steps
+        # emit exactly 1 token — SAME warm program, so the ladder never
+        # compiles anything mid-overload; tests/test_no_retrace.py)
+        active = () if self._deg >= 1 else np.flatnonzero(self._active)
+        for slot in active:
             idx = self._slot_draft[slot]
             budget = int(self._budget[slot])   # tokens this step may emit
             if idx is None or budget <= 1:
@@ -1214,6 +1468,10 @@ class DecodeEngine:
             if self._budget[slot] <= 0 or toks[-1] == self.ecfg.eos_id \
                     or len(req.generated) >= req.max_new_tokens:
                 self._retire(slot)
+            elif req.expired():
+                err = self._deadline_error(req)
+                self._count_reap(err)
+                self._retire(slot, error=err)
         self._m_tokens.inc(harvested)
         self._m_spec_accepted.inc(accepted)
         drafted = self._m_spec_drafted.value
@@ -1244,6 +1502,13 @@ class DecodeEngine:
             if len(req.generated) >= req.max_new_tokens \
                     or tok == self.ecfg.eos_id:
                 self._retire(slot)
+            elif req.expired():
+                # harvest-side deadline enforcement: the tokens already
+                # cost device time, but nobody inside the deadline will
+                # read them — typed error, slot + pages back to the pool
+                err = self._deadline_error(req)
+                self._count_reap(err)
+                self._retire(slot, error=err)
         self._m_tokens.inc(n)
         return n
 
@@ -1254,6 +1519,12 @@ class DecodeEngine:
         t_step = time.perf_counter()
         self.step_seq += 1
         self._blocked_s = 0.0
+        if faults.ENABLED:
+            faults.fire("engine.step_delay")   # armed: sleeps delay_s
+            faults.fire("engine.crash")        # armed with exc=: raises —
+            #                                    serve_loop aborts waiters
+        self._reap()
+        self._apply_degradation()
         self._admit()
         # capacity tripwire: a token at pos >= slot_capacity would spill to
         # the trash page on device (kernels/paged_attention.py); the engine
@@ -1521,6 +1792,8 @@ class DecodeEngine:
             self._dead = reason
             queued = list(self._queue)
             self._queue.clear()
+            self._queue_tokens = 0
+            self._cancels.clear()
             self._g_queue.set(0)
         for req in queued:
             req._finish(reason)

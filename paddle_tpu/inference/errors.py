@@ -1,0 +1,61 @@
+"""Typed serving errors shared by the engine, the serve wire layer, the
+router, and wire clients (docs/ROBUSTNESS.md).
+
+The serving contract is "every request terminates in bounded time with
+either tokens or a TYPED error": an overloaded fleet must answer
+``Overloaded``, a blown deadline ``DeadlineExceeded``, a client-abandoned
+request ``Cancelled`` — never a raw socket traceback or an indefinite
+hang. On the wire every error travels as one line, ``<TypeName>: <text>``
+(the format `InferenceServer._send_err` has always used); this module owns
+the classes and the two conversions:
+
+- `from_wire(msg)`: wire/engine error string -> the matching typed
+  exception (unknown type names stay `RuntimeError` with the FULL message,
+  preserving the pre-typed behavior every existing caller relies on).
+- Raising one of these classes server-side and formatting it as
+  ``f"{type(e).__name__}: {e}"`` round-trips: the client's `from_wire`
+  reconstructs the same type.
+
+All three subclass `RuntimeError`, so pre-existing ``except RuntimeError``
+/ ``pytest.raises(RuntimeError)`` call sites keep working unchanged.
+
+The router classifies these by name (`serving/router.py`):
+``Overloaded`` resubmits elsewhere WITHOUT evicting the replica (it is
+healthy, just full); ``DeadlineExceeded`` and ``Cancelled`` relay to the
+client (the deadline is global and the cancellation was the client's own
+doing — another replica would change neither).
+"""
+from __future__ import annotations
+
+__all__ = ["DeadlineExceeded", "Cancelled", "Overloaded", "from_wire"]
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before it finished: shed at
+    admission, expired in queue, or cut off mid-decode. Retrying without
+    a fresh deadline is pointless by definition."""
+
+
+class Cancelled(RuntimeError):
+    """The request was cancelled — an explicit CANCEL op or the client
+    disconnecting mid-GENERATE. Nobody is waiting for the answer."""
+
+
+class Overloaded(RuntimeError):
+    """Admission control refused the work: the engine's queue is past its
+    configured bound (`EngineConfig.max_queue_depth`/``max_queue_tokens``)
+    or every replica behind the router is shedding. Safe to retry
+    elsewhere/later — nothing about the request itself is wrong."""
+
+
+_BY_NAME = {c.__name__: c for c in (DeadlineExceeded, Cancelled,
+                                    Overloaded)}
+
+
+def from_wire(msg: str) -> Exception:
+    """``"<TypeName>: <text>"`` -> the typed exception (message stripped
+    of the name, so re-formatting with the type name round-trips), or
+    ``RuntimeError(msg)`` verbatim for everything else."""
+    head, sep, rest = msg.partition(": ")
+    cls = _BY_NAME.get(head) if sep else None
+    return cls(rest) if cls is not None else RuntimeError(msg)

@@ -15,18 +15,30 @@ Run:  python -m paddle_tpu.inference.serve --model /path/prefix --port 0
 Wire protocol (little-endian):
   hello   : u32 magic | 32-byte sha256 auth digest (once per connection)
   request : u32 magic 'PRPD' | u32 op (1=run 2=ping 3=shutdown 4=stats
-            5=generate 6=prometheus) | u32 n_arrays | arrays...
+            5=generate 6=prometheus 7=cancel) | u32 n_arrays | arrays...
   array   : u8 dtype | u8 ndim | u32 dims[ndim] | u64 nbytes | bytes
   response: u32 magic | u32 status (0 ok else error) |
             ok: u32 n_arrays | arrays...   err: u32 len | utf8 message
 
-GENERATE (op 5, docs/SERVING.md): two request arrays — int32 prompt ids
-(1-D) and int32 [1] max_new_tokens. The request lands in the decode
-engine's scheduler queue (`inference/engine.py`); the engine thread batches
-it with whatever else is in flight (continuous batching over the paged KV
-cache) and the response is one int32 array of prompt + generated ids.
-Requires the server to be started with an engine attached
+GENERATE (op 5, docs/SERVING.md): int32 prompt ids (1-D), int32 [1]
+max_new_tokens, then OPTIONALLY an int32 options array
+``[cache, speculate[, deadline_ms]]`` (deadline_ms > 0 bounds the request
+end to end — past it the engine answers a typed ``DeadlineExceeded``
+error, docs/ROBUSTNESS.md) and a uint8 cancel TAG (an opaque
+client-chosen id a later CANCEL op can name). The request lands in the
+decode engine's scheduler queue (`inference/engine.py`); the engine
+thread batches it with whatever else is in flight (continuous batching
+over the paged KV cache) and the response is one int32 array of prompt +
+generated ids. Requires the server to be started with an engine attached
 (`--gpt-config`, or `InferenceServer(..., engine=...)`).
+
+CANCEL (op 7): one uint8 array — the tag a concurrent GENERATE was
+submitted with (necessarily over ANOTHER connection; GENERATE is
+synchronous on its own). Lands in `DecodeEngine.cancel`: the slot and its
+pages come back between fixed-shape steps, the generate answers a typed
+``Cancelled`` error. Response: int32 [1] — 1 if the tag named live work.
+The server also cancels on its own when it detects the GENERATE client
+disconnecting mid-request (docs/ROBUSTNESS.md "Cancellation").
 
 Auth mirrors `distributed/rpc.py` (the r3 hardening this server lacked —
 r4 advisor + verdict weak #5: anyone who could reach the port could
@@ -54,6 +66,7 @@ import json
 import os
 import random
 import secrets as _secrets
+import select
 import socket
 import struct
 import threading
@@ -61,12 +74,15 @@ import time
 
 import numpy as np
 
+from paddle_tpu.inference.errors import (Cancelled, DeadlineExceeded,
+                                         Overloaded, from_wire)
 from paddle_tpu.observability import metrics
 from paddle_tpu.observability.tracing import RequestTrace
+from paddle_tpu.testing import faults
 
 MAGIC = 0x50445250
-OP_RUN, OP_PING, OP_SHUTDOWN, OP_STATS, OP_GENERATE, OP_PROMETHEUS = \
-    1, 2, 3, 4, 5, 6
+(OP_RUN, OP_PING, OP_SHUTDOWN, OP_STATS, OP_GENERATE, OP_PROMETHEUS,
+ OP_CANCEL) = 1, 2, 3, 4, 5, 6, 7
 
 
 def auth_token(secret_name: str | None = None) -> bytes:
@@ -126,6 +142,24 @@ _DTYPES = ["float32", "float64", "int32", "int64", "uint8", "bool",
            "float16", "bfloat16", "int8", "int16", "uint16", "uint32",
            "uint64"]
 _DTYPE_CODE = {n: i for i, n in enumerate(_DTYPES)}
+
+
+def peek_disconnect(conn) -> str:
+    """Non-blocking client-liveness peek, shared by serve's GENERATE wait
+    and the router's replica wait (the cross-tier disconnect chain,
+    docs/ROBUSTNESS.md): a request/response client sends NOTHING while
+    awaiting its answer, so readable means EOF (``"gone"``) or
+    protocol-violating pipelined bytes (``"pipelined"`` — the caller
+    stops watching and lets the op loop sort it out); ``"quiet"`` is the
+    healthy case. A socket torn down under the peek reads as gone."""
+    try:
+        readable, _, _ = select.select([conn], [], [], 0)
+        if not readable:
+            return "quiet"
+        return "gone" if conn.recv(1, socket.MSG_PEEK) == b"" \
+            else "pipelined"
+    except OSError:
+        return "gone"
 
 
 def _recv_exact(sock, n):
@@ -218,6 +252,8 @@ class InferenceServer:
             basis if basis is None else str(basis))
         self._registry = None          # elastic-registry lease (drain leaves)
         self._draining = False
+        self._tags: dict[bytes, str] = {}   # cancel tag -> engine req id
+        self._tag_lock = threading.Lock()
         self._drain_thread = None      # set by install_sigterm_drain's handler
         self._engine_thread = None
         if engine is not None:
@@ -331,11 +367,17 @@ class InferenceServer:
                 # body receive, queue wait, prefill and decode all count
                 trace = RequestTrace() if op == OP_GENERATE else None
                 try:
+                    if faults.ENABLED:
+                        faults.fire("serve.slow_read")   # slow client
+                        if faults.fire("serve.socket_drop"):
+                            return      # network drop: close, no response
                     arrays = recv_arrays(conn, n)
                     metrics.counter("serve.request_bytes").inc(
                         sum(a.nbytes for a in arrays))
                     if op == OP_GENERATE:
-                        outs = [self._generate(arrays, trace)]
+                        outs = [self._generate(arrays, trace, conn)]
+                    elif op == OP_CANCEL:
+                        outs = [self._cancel_op(arrays)]
                     else:
                         if self._predictor is None:
                             raise RuntimeError(
@@ -364,7 +406,11 @@ class InferenceServer:
                         # serve.request_errors and the Chrome trace instead
                         # of vanishing from the per-request tooling
                         trace.mark_done(f"{type(e).__name__}: {e}")
-                    self._send_err(conn, f"{type(e).__name__}: {e}")
+                    try:
+                        self._send_err(conn, f"{type(e).__name__}: {e}")
+                    except OSError:
+                        pass    # client gone (disconnect-cancel path):
+                        #         nothing to report to, nobody to crash
                     # the request body may be partially unconsumed (e.g. a
                     # reshape error mid-recv_arrays): the stream position is
                     # unknowable, so the next 12-byte header read would parse
@@ -374,11 +420,15 @@ class InferenceServer:
         finally:
             conn.close()
 
-    def _generate(self, arrays, trace=None):
+    def _generate(self, arrays, trace=None, conn=None):
         """GENERATE op body: enqueue into the engine's scheduler and block
         this connection thread on the request future — the engine thread
         does the actual batched decoding. ``trace`` is the wire-accept
-        `RequestTrace`; the engine carries it to retirement."""
+        `RequestTrace`; the engine carries it to retirement. While
+        blocked, the wait WATCHES ``conn`` for a client disconnect: a
+        GENERATE whose client hung up is cancelled into the engine
+        (`DecodeEngine.cancel`) instead of decoding tokens nobody will
+        read (docs/ROBUSTNESS.md "Cancellation")."""
         if self._draining:
             # wire-level refusal ahead of the engine's own: a draining
             # server must not accept work even in the window before
@@ -388,27 +438,101 @@ class InferenceServer:
         if self._engine is None:
             raise RuntimeError("no decode engine attached "
                                "(start with --gpt-config or engine=)")
-        if len(arrays) not in (2, 3):
+        if len(arrays) not in (2, 3, 4):
             raise ValueError(
-                f"GENERATE wants [prompt_ids, max_new_tokens[, options]], "
-                f"got {len(arrays)} arrays")
+                f"GENERATE wants [prompt_ids, max_new_tokens[, options[, "
+                f"cancel_tag]]], got {len(arrays)} arrays")
         ids, mnt = arrays[0], arrays[1]
         kw = {}
-        if len(arrays) == 3:
+        deadline_s = None
+        if len(arrays) >= 3:
             # optional per-request knobs: int32 [cache, speculate] flags
             # (prefix-cache / n-gram-drafting participation; both default
             # on, gated by the engine-level config — docs/SERVING.md)
+            # plus an optional third deadline_ms value (> 0 arms the
+            # engine's per-request deadline — docs/ROBUSTNESS.md)
             opts = np.asarray(arrays[2]).reshape(-1)
-            if opts.size != 2:
+            if opts.size not in (2, 3):
                 raise ValueError(
-                    f"GENERATE options wants int32 [cache, speculate], "
-                    f"got {opts.size} values")
+                    f"GENERATE options wants int32 [cache, speculate"
+                    f"[, deadline_ms]], got {opts.size} values")
             kw = dict(cache=bool(int(opts[0])), speculate=bool(int(opts[1])))
+            if opts.size == 3 and int(opts[2]) > 0:
+                deadline_s = int(opts[2]) / 1000.0
+        tag = None
+        if len(arrays) == 4:
+            tag = np.ascontiguousarray(arrays[3], np.uint8).tobytes()
         req = self._engine.submit(ids, int(np.asarray(mnt).reshape(-1)[0]),
-                                  trace=trace, **kw)
-        out = req.result(timeout=600.0)
+                                  trace=trace, deadline_s=deadline_s, **kw)
+        if tag is not None:
+            with self._tag_lock:
+                self._tags[tag] = req.request_id
+        try:
+            out = self._await_result(req, conn, deadline_s)
+        finally:
+            if tag is not None:
+                with self._tag_lock:
+                    # pop only OUR registration: a concurrent GENERATE
+                    # reusing the tag has overwritten the mapping, and
+                    # deleting it here would make that request
+                    # uncancellable
+                    if self._tags.get(tag) == req.request_id:
+                        del self._tags[tag]
         metrics.counter("serve.generate_requests").inc()
         return np.ascontiguousarray(out, np.int32)
+
+    def _await_result(self, req, conn, deadline_s):
+        """Block on the request future, but never blindly: the wait polls
+        so it can (a) notice the CLIENT disconnecting and cancel the
+        request into the engine — freeing its slot and pages for work
+        someone still wants — and (b) bound the total wait (the deadline
+        plus scheduling grace when one is set, the legacy 600 s
+        otherwise), so a wedged engine surfaces a typed timeout error
+        instead of an indefinite hang."""
+        budget = 600.0 if deadline_s is None else float(deadline_s) + 30.0
+        t_end = time.monotonic() + budget
+        watch = conn is not None
+        while True:
+            try:
+                return req.result(timeout=0.2)
+            except TimeoutError:
+                pass
+            if time.monotonic() >= t_end:
+                # abandoning the wait must also abandon the WORK: without
+                # the cancel the slot keeps decoding tokens nobody will
+                # read — and the router, classifying this timeout as
+                # resubmittable, would start a duplicate elsewhere while
+                # this replica still burns steps on the original
+                self._engine.cancel(req.request_id,
+                                    reason="serve wait budget exhausted")
+                raise TimeoutError("generation still running")
+            if watch and not self._stop.is_set():
+                state = peek_disconnect(conn)
+                if state == "pipelined":
+                    watch = False
+                elif state == "gone":
+                    self._engine.cancel(
+                        req.request_id, reason="client disconnected")
+                    metrics.counter("serve.disconnect_cancels").inc()
+                    raise ConnectionError(
+                        "client disconnected mid-GENERATE "
+                        "(request cancelled)")
+
+    def _cancel_op(self, arrays):
+        """CANCEL op body: map the client tag to the live engine request
+        (if any) and cancel it. Unknown tags are a clean miss (int32 [0]),
+        never an error — cancellation racing completion is normal."""
+        if len(arrays) != 1:
+            raise ValueError(
+                f"CANCEL wants one uint8 tag array, got {len(arrays)}")
+        tag = np.ascontiguousarray(arrays[0], np.uint8).tobytes()
+        with self._tag_lock:
+            rid = self._tags.get(tag)
+        ok = False
+        if rid is not None and self._engine is not None:
+            ok = self._engine.cancel(rid, reason="CANCEL wire op")
+        metrics.counter("serve.cancels").inc()
+        return np.asarray([1 if ok else 0], np.int32)
 
     @staticmethod
     def _send_err(conn, msg):
@@ -528,7 +652,7 @@ class RemotePredictor:
         return self._idempotent(_do)
 
     def generate(self, prompt_ids, max_new_tokens=32, cache=None,
-                 speculate=None):
+                 speculate=None, deadline_s=None, tag=None):
         """Batched server-side decode: ship the prompt, get prompt +
         generated ids back. Concurrent generate() calls from any number of
         clients share the server engine's decode batch.
@@ -536,15 +660,32 @@ class RemotePredictor:
         ``cache`` / ``speculate`` (default None = server default, on):
         per-request prefix-cache / speculative-drafting participation —
         sent as an optional third options array so old servers keep
-        working with knob-less calls (docs/SERVING.md)."""
+        working with knob-less calls (docs/SERVING.md).
+
+        ``deadline_s`` bounds the request end to end: past it the server
+        answers a typed :class:`DeadlineExceeded` instead of tokens
+        (rides the options array as deadline_ms; a router forwards the
+        REMAINING budget on every resubmit). ``tag`` (str/bytes) names
+        the request for a concurrent `cancel` call from another
+        connection. Server-side failures raise TYPED exceptions —
+        `DeadlineExceeded` / `Cancelled` / `Overloaded` (all RuntimeError
+        subclasses) — reconstructed from the one-line wire error
+        (docs/ROBUSTNESS.md)."""
         ids = np.ascontiguousarray(np.asarray(prompt_ids).reshape(-1),
                                    np.int32)
         arrays = [ids, np.asarray([max_new_tokens], np.int32)]
-        if cache is not None or speculate is not None:
-            arrays.append(np.asarray(
-                [1 if cache is None else int(bool(cache)),
-                 1 if speculate is None else int(bool(speculate))],
-                np.int32))
+        if cache is not None or speculate is not None \
+                or deadline_s is not None or tag is not None:
+            opts = [1 if cache is None else int(bool(cache)),
+                    1 if speculate is None else int(bool(speculate))]
+            if deadline_s is not None or tag is not None:
+                # the tag array is positional (4th), so it forces the
+                # 3-wide options shape even with no deadline (0 = none)
+                opts.append(0 if deadline_s is None
+                            else max(1, int(float(deadline_s) * 1000)))
+            arrays.append(np.asarray(opts, np.int32))
+        if tag is not None:
+            arrays.append(np.frombuffer(self._tag_bytes(tag), np.uint8))
         self._sock.sendall(struct.pack("<III", MAGIC, OP_GENERATE,
                                        len(arrays)))
         send_arrays(self._sock, arrays)
@@ -553,10 +694,33 @@ class RemotePredictor:
         if magic != MAGIC:
             raise ConnectionError("bad magic in response")
         if status != 0:
-            raise RuntimeError(
+            raise from_wire(
                 _recv_exact(self._sock, n).decode(errors="replace"))
         (out,) = recv_arrays(self._sock, n)
         return out
+
+    @staticmethod
+    def _tag_bytes(tag) -> bytes:
+        return tag.encode() if isinstance(tag, str) else bytes(tag)
+
+    def cancel(self, tag) -> bool:
+        """Cancel a GENERATE submitted (from ANOTHER connection) with this
+        ``tag``. Returns True when the tag named live work; a miss —
+        already finished, never seen — is False, not an error."""
+        def _do():
+            self._sock.sendall(struct.pack("<III", MAGIC, OP_CANCEL, 1))
+            send_arrays(self._sock,
+                        [np.frombuffer(self._tag_bytes(tag), np.uint8)])
+            magic, status, n = struct.unpack(
+                "<III", _recv_exact(self._sock, 12))
+            if magic != MAGIC:
+                raise ConnectionError("bad magic in response")
+            if status != 0:
+                raise from_wire(
+                    _recv_exact(self._sock, n).decode(errors="replace"))
+            (out,) = recv_arrays(self._sock, n)
+            return bool(int(np.asarray(out).reshape(-1)[0]))
+        return self._idempotent(_do)
 
     def run(self, inputs):
         self._sock.sendall(struct.pack("<III", MAGIC, OP_RUN, len(inputs)))
@@ -593,6 +757,23 @@ class RemotePredictor:
 
     def close(self):
         self._sock.close()
+
+
+def install_sigusr1_dump():
+    """SIGUSR1 -> faulthandler all-thread stack dump to stderr (the ops
+    contract for a live hang, docs/ROBUSTNESS.md: ``kill -USR1 <pid>``
+    shows where every thread is stuck WITHOUT killing the process).
+    Installed by the serve and router CLIs; no-op where the platform has
+    no SIGUSR1. Returns True when installed."""
+    import faulthandler
+    import signal
+
+    if not hasattr(signal, "SIGUSR1"):
+        return False
+    # chain=False: the default SIGUSR1 disposition is process TERMINATION,
+    # so chaining would dump the stacks and then kill the server anyway
+    faulthandler.register(signal.SIGUSR1, all_threads=True, chain=False)
+    return True
 
 
 def install_sigterm_drain(server: InferenceServer, deadline_s=30.0):
@@ -686,6 +867,7 @@ def main(argv=None):
         srv.attach_registry(registry)
         print(f"REGISTERED {rid} {endpoint}", flush=True)
     install_sigterm_drain(srv, deadline_s=args.drain_deadline)
+    install_sigusr1_dump()
     print(f"LISTENING {srv.port}", flush=True)
     if srv.generated_secret is not None:
         # printed ONCE at startup; clients pass it as secret= / the C

@@ -20,13 +20,27 @@ unchanged. Behind the front door:
   (the decode SLO the tracing layer maintains), outstanding count as the
   tiebreak; replicas with no observations yet rank optimistically so fresh
   capacity warms up.
-- **Failure handling**: a replica that refuses/drops a connection, or
-  answers with a drain/shutdown error, is EVICTED from rotation
-  (``evict_cooldown_s`` before the registry may vouch it back in) and the
-  request is resubmitted to another replica under a bounded budget
-  (``max_resubmits``) — a mid-flight replica kill is a retry, not a
-  client-visible error. Application errors (bad request) relay to the
-  client unchanged and are never resubmitted.
+- **Failure handling — a circuit breaker per replica**
+  (docs/ROBUSTNESS.md): every replica carries a breaker with the classic
+  three states. CLOSED = in rotation; background PING health probes run
+  each poll cycle, and ``breaker_threshold`` consecutive probe failures —
+  or ONE request-path connection failure / not-taking-work answer — OPEN
+  it (out of rotation, the old "eviction"). After ``evict_cooldown_s`` an
+  open breaker goes HALF-OPEN: the next health probe (or a trial request,
+  when no closed replica remains) decides — success re-closes it, failure
+  re-opens with a fresh cooldown. The failed request itself is resubmitted
+  to another replica under a bounded budget (``max_resubmits``) — a
+  mid-flight replica kill is a retry, not a client-visible error.
+  Application errors (bad request, ``DeadlineExceeded``, ``Cancelled``)
+  relay to the client unchanged and are never resubmitted; a typed
+  ``Overloaded`` answer resubmits WITHOUT opening the breaker (the
+  replica is healthy, just full) — and when every replica sheds, the
+  client gets one clean typed ``Overloaded`` line, never a hang.
+- **Deadline budget forwarding**: a GENERATE whose options array carries
+  ``deadline_ms`` is forwarded with the REMAINING budget on every
+  (re)submit, and the per-attempt IO timeout is clipped to it — the
+  client's deadline bounds the whole routed attempt chain, resubmits
+  included (``router.deadline_exceeded`` counts budget exhaustion).
 
 Observability (docs/OBSERVABILITY.md): ``router.requests``,
 ``router.replica_errors``, ``router.resubmits``, ``router.no_replica``,
@@ -52,11 +66,13 @@ import time
 
 import numpy as np
 
-from paddle_tpu.inference.serve import (MAGIC, OP_GENERATE, OP_PING,
-                                        OP_PROMETHEUS, OP_RUN, OP_SHUTDOWN,
-                                        OP_STATS, _recv_exact, auth_token,
-                                        recv_arrays, retrying_connect,
-                                        send_arrays, stats_payload)
+from paddle_tpu.inference.errors import DeadlineExceeded, Overloaded
+from paddle_tpu.inference.serve import (MAGIC, OP_CANCEL, OP_GENERATE,
+                                        OP_PING, OP_PROMETHEUS, OP_RUN,
+                                        OP_SHUTDOWN, OP_STATS, _recv_exact,
+                                        auth_token, recv_arrays,
+                                        retrying_connect, send_arrays,
+                                        stats_payload)
 from paddle_tpu.observability import metrics
 from paddle_tpu.observability.flight_recorder import flight
 from paddle_tpu.observability.tracing import new_request_id
@@ -75,17 +91,27 @@ class _ReplicaAppError(RuntimeError):
     client and never burns resubmit budget."""
 
 
+class _ClientDisconnected(RuntimeError):
+    """The ROUTER's client hung up mid-GENERATE. Deliberately NOT a
+    ConnectionError/OSError: it must escape the resubmit loop (nobody is
+    left to answer) instead of burning budget on another replica."""
+
+
 def _classify_wire_error(msg: str) -> Exception:
     """Split replica wire errors by the exception TYPE the replica raised
     (the wire message is ``<Type>: <text>``): a ``ValueError`` is request
     validation (bad prompt/length — identical on every replica, relay it),
-    as is an engine-less replica serving only RUN; everything else —
-    draining, engine stopped/aborted/died, result timeout — means THIS
-    replica can't finish the work, which is exactly what resubmission is
-    for. Defaulting to resubmittable is deliberate: abort reasons are
-    free-form text, and a missed marker must cost a bounded retry, not a
-    client-visible error."""
-    if msg.startswith("ValueError") or "no decode engine attached" in msg:
+    as is an engine-less replica serving only RUN; ``DeadlineExceeded``
+    and ``Cancelled`` are terminal per-request outcomes — the deadline is
+    global and the cancel was the client's own, so another replica changes
+    neither: relay them verbatim. Everything else — draining, engine
+    stopped/aborted/died, result timeout, a typed ``Overloaded`` shed —
+    means THIS replica can't finish the work, which is exactly what
+    resubmission is for. Defaulting to resubmittable is deliberate: abort
+    reasons are free-form text, and a missed marker must cost a bounded
+    retry, not a client-visible error."""
+    if msg.startswith(("ValueError", "DeadlineExceeded", "Cancelled")) \
+            or "no decode engine attached" in msg:
         return _ReplicaAppError(msg)
     return ReplicaUnavailable(msg)
 
@@ -110,22 +136,36 @@ def _should_evict(e: Exception) -> bool:
 
 
 class ReplicaState:
-    """Router-side view of one engine replica."""
+    """Router-side view of one engine replica, including its circuit
+    breaker: ``closed`` (in rotation) -> ``open`` (out of rotation —
+    request-path eviction or ``breaker_threshold`` consecutive probe
+    failures) -> after the cooldown ``half_open`` (one probe/trial
+    decides) -> ``closed`` again or back to ``open``
+    (docs/ROBUSTNESS.md "Circuit breaker")."""
 
     __slots__ = ("replica_id", "endpoint", "outstanding", "errors",
-                 "draining", "evicted_at", "stats", "stats_at", "_g_out")
+                 "breaker", "consec_fail", "probe_at", "evicted_at",
+                 "stats", "stats_at", "_g_out")
 
     def __init__(self, replica_id: str, endpoint: str):
         self.replica_id = replica_id
         self.endpoint = endpoint
         self.outstanding = 0
         self.errors = 0
-        self.draining = False
-        self.evicted_at = 0.0
+        self.breaker = "closed"
+        self.consec_fail = 0       # consecutive health-probe failures
+        self.probe_at = 0.0        # last health probe (monotonic)
+        self.evicted_at = 0.0      # breaker-open timestamp (monotonic)
         self.stats = None          # last STATS snapshot (slo_aware policy)
         self.stats_at = 0.0
         self._g_out = metrics.gauge("router.outstanding",
                                     replica=replica_id)
+
+    @property
+    def draining(self) -> bool:
+        """Back-compat view: out of normal rotation (breaker not
+        closed)."""
+        return self.breaker != "closed"
 
 
 def _pick_round_robin(router: "Router", cands: list[ReplicaState]):
@@ -186,7 +226,8 @@ class Router:
                  replica_secret=None, poll_interval_s=1.0,
                  stats_interval_s=5.0, max_resubmits=2,
                  evict_cooldown_s=5.0, connect_deadline_s=5.0,
-                 request_timeout_s=600.0):
+                 request_timeout_s=600.0, breaker_threshold=3,
+                 health_interval_s=None):
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown policy {policy!r}; have {sorted(POLICIES)}")
@@ -200,6 +241,12 @@ class Router:
         self._evict_cooldown = float(evict_cooldown_s)
         self._connect_deadline = float(connect_deadline_s)
         self._request_timeout = float(request_timeout_s)
+        self._breaker_threshold = max(1, int(breaker_threshold))
+        # PING probe cadence per replica; defaults to the poll interval
+        # (probes ride the poll thread's cycle)
+        self._health_interval = float(poll_interval_s
+                                      if health_interval_s is None
+                                      else health_interval_s)
         self._replica_token = auth_token(
             None if replica_secret is None else str(replica_secret))
         self._rr = -1
@@ -242,6 +289,11 @@ class Router:
         self._poll_thread = threading.Thread(
             target=self._poll_loop, daemon=True, name="pt-router-poll")
         self._poll_thread.start()
+        # PING health probes get their OWN thread (docs/ROBUSTNESS.md):
+        # probe IO against a dead replica must never stall membership
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, daemon=True, name="pt-router-health")
+        self._probe_thread.start()
         self._stats_thread = None
         if self._policy == "slo_aware":
             self._stats_thread = threading.Thread(
@@ -257,12 +309,12 @@ class Router:
                           if not (healthy_only and r.draining))
 
     def _sync_membership(self, alive: dict):
-        """Fold one registry view in: new ids join rotation, missing ids
-        (lease expired or deregistered) leave it, and an error-evicted
-        replica the registry still vouches for re-enters after the
-        cooldown (a crashed process keeps a fresh lease until its TTL —
-        eviction-by-error covers that gap)."""
-        now = time.monotonic()
+        """Fold one registry view in: new ids join rotation (breaker
+        closed), missing ids (lease expired or deregistered) leave it.
+        An OPEN breaker is NOT reset by the registry still vouching for
+        the replica — a crashed process keeps a fresh lease until its
+        TTL; re-admission is the health probe's job (open -> half_open
+        after the cooldown, then a successful PING closes it)."""
         with self._rlock:
             for rid, ep in alive.items():
                 r = self._replicas.get(rid)
@@ -273,9 +325,6 @@ class Router:
                                   endpoint=str(ep))
                 else:
                     r.endpoint = str(ep)
-                    if r.draining and \
-                            now - r.evicted_at >= self._evict_cooldown:
-                        r.draining = False
             for rid in [rid for rid in self._replicas if rid not in alive]:
                 self._replicas.pop(rid)._g_out.set(0)
                 metrics.counter("router.replica_leaves").inc()
@@ -290,6 +339,114 @@ class Router:
                 except OSError:
                     continue       # transient registry outage: hold steady
             self._sync_membership(alive)
+
+    # ------------------------------------------------------ circuit breaker
+
+    def _probe_loop(self):
+        # probes live on their OWN thread: an unreachable replica's probe
+        # IO (up to the probe deadline each) must never stall membership
+        # sync or delay the other replicas' breaker transitions. The loop
+        # survives ANY probe exception — open->half_open->closed recovery
+        # happens nowhere else, so a dead probe thread would turn every
+        # future breaker-open into a permanent eviction
+        while not self._stop.wait(self._health_interval):
+            try:
+                self._probe_replicas()
+            except Exception:  # noqa: BLE001 — recovery must outlive bugs
+                metrics.counter("router.probe_errors").inc()
+
+    def _probe_replicas(self):
+        """Background PING health probes (one per replica per
+        ``health_interval_s``, on the dedicated health thread): a closed
+        replica failing ``breaker_threshold`` consecutive probes opens
+        its breaker BEFORE a client request has to discover the corpse;
+        an open breaker past the cooldown goes half-open and the probe's
+        verdict closes or re-opens it."""
+        now = time.monotonic()
+        due = []
+        with self._rlock:
+            for r in self._replicas.values():
+                if r.breaker == "open" and \
+                        now - r.evicted_at >= self._evict_cooldown:
+                    r.breaker = "half_open"
+                    metrics.counter("router.breaker_half_open").inc()
+                    flight.record("router.breaker", replica=r.replica_id,
+                                  state="half_open")
+                if r.breaker == "half_open" or (
+                        r.breaker == "closed"
+                        and now - r.probe_at >= self._health_interval):
+                    due.append(r)
+        # concurrent fan-out (same pattern as _route_cancel): one dead
+        # replica's probe must cost the CYCLE its own deadline, not push
+        # every later replica's probe and breaker transition behind it
+        def _one(rep):
+            rep.probe_at = time.monotonic()
+            self._record_probe(rep, self._ping_replica(rep))
+        ths = [threading.Thread(target=_one, args=(rep,), daemon=True)
+               for rep in due]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+
+    def _ping_replica(self, r: ReplicaState) -> bool:
+        """One authed PING exchange at probe-grade timeouts (clipped to
+        2 s regardless of the request-path connect deadline) — a probe
+        must cost this loop milliseconds-to-seconds, never a request
+        timeout."""
+        probe_deadline = min(self._connect_deadline, 2.0)
+        try:
+            # endpoint parse INSIDE the guard: a malformed registry entry
+            # ("host" with no port) is a failed probe, not a probe-thread
+            # killer
+            host, port = r.endpoint.rsplit(":", 1)
+            sock = retrying_connect(host, int(port),
+                                    timeout=probe_deadline + 2.0,
+                                    attempts=1,
+                                    deadline_s=probe_deadline)
+        except (OSError, ConnectionError, ValueError):
+            return False
+        try:
+            sock.sendall(struct.pack("<I", MAGIC) + self._replica_token)
+            sock.sendall(struct.pack("<III", MAGIC, OP_PING, 0))
+            magic, status, _ = struct.unpack(
+                "<III", _recv_exact(sock, 12))
+            return magic == MAGIC and status == 0
+        except (OSError, ConnectionError, struct.error):
+            return False
+        finally:
+            sock.close()
+
+    def _record_probe(self, r: ReplicaState, ok: bool):
+        with self._rlock:
+            if ok:
+                r.consec_fail = 0
+                # a successful probe closes HALF-OPEN only: a stale PING
+                # that was in flight when the request path opened the
+                # breaker must not re-close it with no cooldown (PING
+                # succeeding is weak evidence — a dead engine's serve
+                # loop still answers it); an open breaker waits out its
+                # cooldown and earns closure from the half-open probe
+                if r.breaker == "half_open":
+                    r.breaker = "closed"
+                    metrics.counter("router.breaker_close").inc()
+                    flight.record("router.breaker",
+                                  replica=r.replica_id, state="closed")
+                return
+            r.consec_fail += 1
+            if r.breaker == "half_open" or (
+                    r.breaker == "closed"
+                    and r.consec_fail >= self._breaker_threshold):
+                self._open_breaker_locked(r, "health probe failed")
+
+    def _open_breaker_locked(self, r: ReplicaState, reason: str):
+        """Caller holds ``_rlock``."""
+        r.breaker = "open"
+        r.evicted_at = time.monotonic()
+        r.errors += 1
+        metrics.counter("router.breaker_open").inc()
+        flight.record("router.breaker", replica=r.replica_id,
+                      state="open", reason=reason)
 
     def _stats_loop(self):
         while not self._stop.wait(self._poll_interval):
@@ -327,7 +484,14 @@ class Router:
     def _pick(self, tried: set) -> ReplicaState | None:
         with self._rlock:
             cands = [r for r in self._replicas.values()
-                     if not r.draining and r.replica_id not in tried]
+                     if r.breaker == "closed" and r.replica_id not in tried]
+            if not cands:
+                # no closed replica left: a HALF-OPEN one may carry trial
+                # traffic — its success re-closes the breaker, its failure
+                # re-opens it (the request still has its resubmit budget)
+                cands = [r for r in self._replicas.values()
+                         if r.breaker == "half_open"
+                         and r.replica_id not in tried]
             if not cands:
                 return None
             cands.sort(key=lambda r: r.replica_id)
@@ -335,13 +499,11 @@ class Router:
 
     def _evict(self, r: ReplicaState, reason: str):
         with self._rlock:
-            r.draining = True
-            r.evicted_at = time.monotonic()
-            r.errors += 1
+            self._open_breaker_locked(r, reason)
         flight.record("router.evict", replica=r.replica_id, reason=reason)
 
     def _replica_op(self, r: ReplicaState, op: int, arrays=(),
-                    timeout=None):
+                    timeout=None, client_conn=None):
         """One request/response exchange with a replica on a fresh authed
         connection. Returns the response arrays (GENERATE) or single
         payload array (STATS/PROMETHEUS). A connection per exchange is
@@ -350,17 +512,29 @@ class Router:
         never read a half-delivered response from a previous exchange —
         and it keeps the router stateless about replica sockets; a
         persistent-pool optimization would buy one connect RTT per
-        request at the cost of desync tracking."""
+        request at the cost of desync tracking.
+
+        ``client_conn`` (GENERATE only): while the replica decodes, the
+        ROUTER's own client socket is watched; client EOF drops the
+        replica connection — whose serve-side disconnect watch then
+        cancels the request into its engine — and raises
+        `_ClientDisconnected`. The disconnect chain composes across
+        tiers: client -> router -> replica -> engine.cancel
+        (docs/ROBUSTNESS.md "Cancellation")."""
+        eff_timeout = timeout if timeout is not None \
+            else self._request_timeout
         host, port = r.endpoint.rsplit(":", 1)
-        sock = retrying_connect(host, int(port),
-                                timeout=timeout if timeout is not None
-                                else self._request_timeout, attempts=2,
+        sock = retrying_connect(host, int(port), timeout=eff_timeout,
+                                attempts=2,
                                 deadline_s=self._connect_deadline)
         try:
             sock.sendall(struct.pack("<I", MAGIC) + self._replica_token)
             sock.sendall(struct.pack("<III", MAGIC, op, len(arrays)))
             if arrays:
                 send_arrays(sock, arrays)
+            if client_conn is not None:
+                self._await_replica_or_client_gone(sock, client_conn,
+                                                   eff_timeout)
             magic, status, n = struct.unpack(
                 "<III", _recv_exact(sock, 12))
             if magic != MAGIC:
@@ -376,19 +550,96 @@ class Router:
         finally:
             sock.close()
 
-    def _route_generate(self, arrays) -> list[np.ndarray]:
+    @staticmethod
+    def _await_replica_or_client_gone(sock, conn, timeout):
+        """Block until the replica's response STARTS, peeking the
+        router's own client socket each cycle (`serve.peek_disconnect` —
+        the same liveness idiom serve's GENERATE wait uses, shared so the
+        two tiers of the disconnect chain cannot drift). On client EOF:
+        count it and raise — the enclosing finally closes the replica
+        socket, which is exactly the disconnect the replica's serve-side
+        watch turns into an engine cancel."""
+        import select as _select
+
+        from paddle_tpu.inference.serve import peek_disconnect
+        t_end = time.monotonic() + timeout
+        watch = True
+        while True:
+            readable, _, _ = _select.select([sock], [], [], 0.25)
+            if readable:
+                return
+            if watch:
+                state = peek_disconnect(conn)
+                if state == "pipelined":
+                    watch = False
+                elif state == "gone":
+                    metrics.counter("router.disconnect_drops").inc()
+                    raise _ClientDisconnected(
+                        "client disconnected mid-GENERATE (replica "
+                        "connection dropped; the replica cancels)")
+            if time.monotonic() >= t_end:
+                raise socket.timeout(
+                    "timed out waiting for replica response")
+
+    @staticmethod
+    def _deadline_ms(arrays) -> int | None:
+        """The GENERATE options array's deadline_ms (> 0), if present."""
+        if len(arrays) >= 3:
+            opts = np.asarray(arrays[2]).reshape(-1)
+            if opts.size >= 3 and int(opts[2]) > 0:
+                return int(opts[2])
+        return None
+
+    def _route_generate(self, arrays, conn=None) -> list[np.ndarray]:
         """Forward one GENERATE to a policy-picked replica; on replica
-        failure evict it and resubmit elsewhere, up to ``max_resubmits``
-        times. Raises to the client only when the budget or the healthy
-        set is exhausted (or the request itself is bad)."""
+        failure open its breaker and resubmit elsewhere, up to
+        ``max_resubmits`` times. A request carrying a deadline forwards
+        its REMAINING budget on every attempt (and clips the attempt's IO
+        timeout to it), so resubmission can never stretch a request past
+        its deadline. Raises to the client only when the budget, the
+        deadline, or the healthy set is exhausted (or the request itself
+        is bad) — always one clean typed line, never a hang."""
         rid_req = new_request_id()
         budget = self._max_resubmits
         tried: set[str] = set()
         t0 = time.perf_counter()
+        deadline_ms = self._deadline_ms(arrays)
+        t_deadline = None if deadline_ms is None \
+            else time.monotonic() + deadline_ms / 1000.0
         last_err = None
+        overloads = others = 0
         while True:
+            fwd, timeout = arrays, None
+            if t_deadline is not None:
+                remaining = t_deadline - time.monotonic()
+                if remaining <= 0:
+                    metrics.counter("router.deadline_exceeded").inc()
+                    raise DeadlineExceeded(
+                        f"request deadline ({deadline_ms} ms) exhausted "
+                        f"after {len(tried)} attempt(s)"
+                        + (f"; last replica error: {last_err}"
+                           if last_err else ""))
+                # forward the REMAINING budget, not the original: the
+                # replica's engine must expire the request by the
+                # CLIENT's clock, resubmits included
+                fwd = list(arrays)
+                opts = np.array(np.asarray(arrays[2]).reshape(-1),
+                                np.int32, copy=True)
+                opts[2] = max(1, int(remaining * 1000))
+                fwd[2] = opts
+                # grace past the replica's own deadline handling: the
+                # engine answers DeadlineExceeded first; the clip only
+                # catches a wedged replica
+                timeout = min(self._request_timeout, remaining + 10.0)
             r = self._pick(tried)
             if r is None:
+                if overloads and not others:
+                    # every reachable replica answered a typed shed:
+                    # relay ONE typed Overloaded line (retryable-later),
+                    # not a router-internal wrapper
+                    metrics.counter("router.shed").inc()
+                    raise Overloaded(
+                        f"all replicas shedding load; last: {last_err}")
                 metrics.counter("router.no_replica").inc()
                 raise RuntimeError(
                     "router: no healthy replica available"
@@ -398,15 +649,27 @@ class Router:
                 r.outstanding += 1
                 r._g_out.set(r.outstanding)
             try:
-                outs = self._replica_op(r, OP_GENERATE, arrays)
+                outs = self._replica_op(r, OP_GENERATE, fwd,
+                                        timeout=timeout, client_conn=conn)
             except (ReplicaUnavailable, ConnectionError, socket.timeout,
                     OSError) as e:
                 last_err = f"{r.replica_id}: {type(e).__name__}: {e}"
                 metrics.counter("router.replica_errors").inc()
+                if isinstance(e, ReplicaUnavailable) \
+                        and str(e).startswith("Overloaded"):
+                    overloads += 1     # healthy replica, full queue: no
+                    #                    breaker action, try elsewhere
+                else:
+                    others += 1
                 if _should_evict(e):
                     self._evict(r, f"{type(e).__name__}: {e}")
                 tried.add(r.replica_id)
                 if budget <= 0:
+                    if overloads and not others:
+                        metrics.counter("router.shed").inc()
+                        raise Overloaded(
+                            f"all replicas shedding load; last: "
+                            f"{last_err}") from e
                     raise RuntimeError(
                         f"router: resubmit budget "
                         f"({self._max_resubmits}) exhausted; last "
@@ -418,6 +681,18 @@ class Router:
                 with self._rlock:
                     r.outstanding -= 1
                     r._g_out.set(r.outstanding)
+            with self._rlock:
+                r.consec_fail = 0
+                # half-open trial succeeded: the replica is back. ONLY
+                # half-open — a success that was in flight when another
+                # request's failure opened the breaker must not re-close
+                # it with zero cooldown (same stale-success guard as
+                # `_record_probe`)
+                if r.breaker == "half_open":
+                    r.breaker = "closed"
+                    metrics.counter("router.breaker_close").inc()
+                    flight.record("router.breaker",
+                                  replica=r.replica_id, state="closed")
             dt = time.perf_counter() - t0
             metrics.counter("router.requests").inc()
             metrics.counter("router.replica_requests",
@@ -427,6 +702,42 @@ class Router:
                              args={"request_id": rid_req,
                                    "replica": r.replica_id})
             return outs
+
+    def _route_cancel(self, arrays) -> np.ndarray:
+        """CANCEL op: the router is stateless about which replica holds a
+        tag, so the cancel fans out to every non-open replica; the one
+        holding live work answers 1 (docs/ROBUSTNESS.md). Probe-grade
+        timeouts — a cancel must never cost a request timeout."""
+        if len(arrays) != 1:
+            raise ValueError(
+                f"CANCEL wants one uint8 tag array, got {len(arrays)}")
+        with self._rlock:
+            # EVERY replica, open breakers included: a breaker opened by
+            # an unrelated transient failure can still hold the live
+            # request this cancel is for, and a cancel is cheap and
+            # idempotent — a dead endpoint just times out at probe grade
+            reps = list(self._replicas.values())
+        hits: list[int] = []
+
+        def _one(rep):
+            try:
+                out = self._replica_op(
+                    rep, OP_CANCEL, arrays,
+                    timeout=min(self._connect_deadline, 2.0) + 3.0)
+                hits.append(int(np.asarray(out).reshape(-1)[0]))
+            except (OSError, ConnectionError, RuntimeError):
+                pass        # a cancel miss must never become an error
+        # concurrent fan-out: cancellation latency is the slowest single
+        # replica, not the sum — one wedged replica must not delay the
+        # cancel reaching the replica actually holding the work
+        ths = [threading.Thread(target=_one, args=(rep,), daemon=True)
+               for rep in reps]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        metrics.counter("router.cancels").inc()
+        return np.asarray([1 if any(hits) else 0], np.int32)
 
     # ------------------------------------------------------------ wire side
 
@@ -504,11 +815,15 @@ class Router:
                     arrays = recv_arrays(conn, n)
                     if op == OP_RUN:
                         raise RuntimeError(
-                            "router fronts GENERATE/STATS/PROMETHEUS "
-                            "only; RUN needs a direct replica connection")
-                    if op != OP_GENERATE:
+                            "router fronts GENERATE/CANCEL/STATS/"
+                            "PROMETHEUS only; RUN needs a direct replica "
+                            "connection")
+                    if op == OP_CANCEL:
+                        outs = [self._route_cancel(arrays)]
+                    elif op == OP_GENERATE:
+                        outs = self._route_generate(arrays, conn=conn)
+                    else:
                         raise RuntimeError(f"unknown op {op}")
-                    outs = self._route_generate(arrays)
                     conn.sendall(
                         struct.pack("<III", MAGIC, 0, len(outs)))
                     send_arrays(conn, outs)
@@ -516,10 +831,16 @@ class Router:
                     metrics.counter("router.errors").inc()
                     # relay replica app errors VERBATIM: the client (or a
                     # second-tier router classifying by prefix) must see
-                    # exactly what a direct replica connection would send
+                    # exactly what a direct replica connection would send.
+                    # Router-raised typed errors (Overloaded,
+                    # DeadlineExceeded) format as the same one-line
+                    # "<Type>: <text>" a replica would send
                     msg = str(e) if isinstance(e, _ReplicaAppError) \
                         else f"{type(e).__name__}: {e}"
-                    self._send_err(conn, msg)
+                    try:
+                        self._send_err(conn, msg)
+                    except OSError:
+                        pass    # client already gone
                     return
         finally:
             conn.close()
@@ -581,6 +902,8 @@ def main(argv=None):
                     replica_secret=args.replica_secret,
                     poll_interval_s=args.poll_interval,
                     max_resubmits=args.max_resubmits)
+    from paddle_tpu.inference.serve import install_sigusr1_dump
+    install_sigusr1_dump()
     print(f"LISTENING {router.port}", flush=True)
     if router.generated_secret is not None:
         print(f"TOKEN {router.generated_secret}", flush=True)

@@ -1,0 +1,4 @@
+"""Test-support machinery that ships with the package (not under tests/):
+deterministic fault injection (`paddle_tpu.testing.faults`) used by the
+chaos suite, the overload bench rung, and ops drills against live
+deployments (docs/ROBUSTNESS.md)."""

@@ -1,0 +1,776 @@
+"""Chaos suite: overload protection + failure containment under
+DETERMINISTIC injected faults (paddle_tpu/testing/faults.py,
+docs/ROBUSTNESS.md).
+
+The contract under test, for every scenario: each submitted request
+terminates in bounded time with either tokens or a TYPED error (never a
+hang, never a raw socket traceback), the allocator returns to its
+baseline (zero leaked pages — shared prefix-cache pages refcount down,
+never double-free), and no program recompiles (cancellation/deadlines
+act between fixed-shape steps; see also tests/test_no_retrace.py).
+
+Every test here is deterministic — faults fire exact counts at named
+sites, no random kills, no load-dependent timing assertions — so the
+whole module runs in tier-1 (marker ``chaos``)."""
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import metrics
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.chaos
+
+FLEET_SECRET = "chaos-fleet"
+
+
+def _tiny_model(seed=7):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=2, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def _engine(model, **ekw):
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    ekw.setdefault("page_size", 4)
+    ekw.setdefault("max_slots", 2)
+    ekw.setdefault("min_bucket", 8)
+    return DecodeEngine(model, EngineConfig(**ekw))
+
+
+def _fast_ref(model, prompt, n):
+    ids = paddle.Tensor(np.asarray(prompt)[None].astype(np.int32),
+                        _internal=True)
+    return np.asarray(model.fast_generate(ids, max_new_tokens=n).numpy())[0]
+
+
+def _assert_pool_baseline(eng):
+    """Zero leaked pages: every page is either on the free list or a
+    refcount-0 retained prefix page — all reclaimable."""
+    assert eng.allocator.free_pages == eng.allocator.num_pages - 1, (
+        f"leaked pages: {eng.allocator.num_pages - 1 - eng.allocator.free_pages}")
+
+
+def _counter(name):
+    return metrics.snapshot()["counters"].get(name, 0)
+
+
+def _gauge(name):
+    return metrics.snapshot()["gauges"].get(name)
+
+
+def _wait_for(pred, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.02)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    """A failing chaos test must never leave a fault armed for the rest
+    of the suite."""
+    yield
+    faults.disarm()
+
+
+# ------------------------------------------------------------ fault harness
+
+
+class TestFaultHarness:
+    def test_off_by_default_and_cheap(self):
+        assert faults.ENABLED is False
+        assert faults.fire("engine.step_delay") is False
+
+    def test_times_and_fired_accounting(self):
+        base = faults.fired("t.site")
+        faults.arm("t.site", times=2)
+        assert faults.ENABLED
+        assert faults.fire("t.site") and faults.fire("t.site")
+        assert faults.fire("t.site") is False          # spent
+        assert faults.fired("t.site") == base + 2
+        faults.disarm("t.site")
+        assert faults.ENABLED is False
+
+    def test_exception_and_scope(self):
+        with faults.scoped("t.crash", exc=faults.FaultInjected):
+            with pytest.raises(faults.FaultInjected, match="t.crash"):
+                faults.fire("t.crash")
+        assert faults.ENABLED is False
+
+    def test_env_spec_parsing(self):
+        faults.arm_from_env("t.a:times=3:delay_s=0.0,"
+                            "t.b:exc=FaultInjected")
+        try:
+            assert faults.fire("t.a")
+            with pytest.raises(faults.FaultInjected):
+                faults.fire("t.b")
+        finally:
+            faults.disarm()
+        with pytest.raises(ValueError, match="unknown key"):
+            faults.arm_from_env("t.c:bogus=1")
+        with pytest.raises(ValueError, match="unknown exception"):
+            faults.arm_from_env("t.d:exc=NoSuchError")
+        faults.disarm()
+
+
+# ----------------------------------------------------- deadlines (engine)
+
+
+class TestDeadlines:
+    def test_expired_in_queue_never_prefills(self):
+        """A request whose deadline passes while QUEUED is retired with a
+        typed DeadlineExceeded BEFORE any prefill program runs: zero
+        prefill tokens spent, zero pages leaked."""
+        from paddle_tpu.inference.engine import DeadlineExceeded
+        m = _tiny_model()
+        eng = _engine(m)
+        base_deadline = _counter("engine.deadline_exceeded")
+        tok0 = _counter("engine.prefill_tokens")
+        r = eng.submit(np.arange(16, dtype=np.int32) % 97,
+                       max_new_tokens=4, deadline_s=0.02)
+        time.sleep(0.05)                      # expire while still queued
+        eng.run_until_idle(max_steps=20)
+        with pytest.raises(DeadlineExceeded, match="deadline"):
+            r.result(timeout=5)
+        assert _counter("engine.prefill_tokens") == tok0, \
+            "an expired queued request burned prefill tokens"
+        assert _counter("engine.deadline_exceeded") == base_deadline + 1
+        _assert_pool_baseline(eng)
+
+    def test_deadline_cuts_off_mid_decode(self):
+        """A slow engine (injected step delay) blows the deadline
+        mid-decode: the slot retires with a typed error between
+        fixed-shape steps and its pages return to the pool."""
+        from paddle_tpu.inference.engine import DeadlineExceeded
+        m = _tiny_model()
+        eng = _engine(m, prefix_cache=False)
+        # warm + prime so compile wall can't eat the deadline
+        w = eng.submit(np.arange(6, dtype=np.int32), max_new_tokens=2)
+        eng.run_until_idle(max_steps=40)
+        w.result(timeout=30)
+        with faults.scoped("engine.step_delay", times=-1, delay_s=0.05):
+            r = eng.submit(np.arange(6, dtype=np.int32),
+                           max_new_tokens=50, deadline_s=0.2)
+            eng.run_until_idle(max_steps=200)
+        with pytest.raises(DeadlineExceeded):
+            r.result(timeout=5)
+        _assert_pool_baseline(eng)
+
+    def test_submit_validates_deadline(self):
+        eng = _engine(_tiny_model())
+        with pytest.raises(ValueError, match="deadline_s"):
+            eng.submit(np.arange(4, dtype=np.int32), 2, deadline_s=0.0)
+
+
+# -------------------------------------------------- cancellation (engine)
+
+
+class TestCancellation:
+    def test_cancel_queued_skips_prefill(self):
+        """Satellite pin: a request cancelled while QUEUED is skipped
+        before its prefill is dispatched — engine.prefill_tokens moves
+        only for the admitted request."""
+        from paddle_tpu.inference.engine import Cancelled
+        m = _tiny_model()
+        eng = _engine(m, max_slots=1, prefix_cache=False)
+        a = eng.submit(np.arange(6, dtype=np.int32), max_new_tokens=12)
+        eng.step()                            # admit A (prefills 6 tokens)
+        tok0 = _counter("engine.prefill_tokens")
+        b = eng.submit(np.arange(16, dtype=np.int32) % 97,
+                       max_new_tokens=4)
+        assert eng.cancel(b.request_id) is True
+        eng.run_until_idle(max_steps=60)
+        with pytest.raises(Cancelled):
+            b.result(timeout=5)
+        a.result(timeout=30)                  # A unaffected
+        assert _counter("engine.prefill_tokens") == tok0, \
+            "cancelled queued request reached a prefill program"
+        _assert_pool_baseline(eng)
+
+    def test_cancel_mid_decode_reclaims_slot_and_pages(self):
+        from paddle_tpu.inference.engine import Cancelled
+        m = _tiny_model()
+        eng = _engine(m, max_slots=2, prefix_cache=False)
+        base_cancel = _counter("engine.cancelled")
+        r = eng.submit(np.arange(6, dtype=np.int32), max_new_tokens=40)
+        for _ in range(3):
+            eng.step()
+        assert eng.cancel(r.request_id, reason="test says stop") is True
+        eng.run_until_idle(max_steps=60)
+        with pytest.raises(Cancelled, match="test says stop"):
+            r.result(timeout=5)
+        assert _counter("engine.cancelled") == base_cancel + 1
+        assert eng.cancel(r.request_id) is False   # idempotent miss
+        _assert_pool_baseline(eng)
+
+    def test_cancel_shared_prefix_pages_refcounts_not_freed(self):
+        """Satellite pin: cancelling a request holding SHARED prefix-cache
+        pages decrements refcounts via the per-owner free — a concurrent
+        request attending the same pages keeps decoding token-correct,
+        and the cached pages survive and re-hit afterwards."""
+        from paddle_tpu.inference.engine import Cancelled
+        m = _tiny_model()
+        eng = _engine(m, max_slots=2, page_size=4, prefix_cache=True)
+        prompt = (np.arange(12, dtype=np.int32) * 5) % 97   # 3 pages
+        ref = _fast_ref(m, prompt, 8)
+        # prime: registers the prompt's pages in the prefix store
+        a = eng.submit(prompt, max_new_tokens=2)
+        eng.run_until_idle(max_steps=60)
+        a.result(timeout=30)
+        hits0 = _counter("engine.prefix_hit")
+        # two sharers of the cached pages decode concurrently
+        b = eng.submit(prompt, max_new_tokens=20)
+        d = eng.submit(prompt, max_new_tokens=8)
+        for _ in range(3):
+            eng.step()
+        assert _counter("engine.prefix_hit") >= hits0 + 2
+        assert eng.cancel(b.request_id) is True
+        eng.run_until_idle(max_steps=100)
+        with pytest.raises(Cancelled):
+            b.result(timeout=5)
+        # the surviving sharer's tokens are untouched by the cancel
+        np.testing.assert_array_equal(d.result(timeout=30), ref)
+        _assert_pool_baseline(eng)
+        # cached pages SURVIVED the cancel: a fresh submit re-hits and
+        # prefills only the uncached tail (12 - 2 full shared pages = 4)
+        tok0 = _counter("engine.prefill_tokens")
+        c = eng.submit(prompt, max_new_tokens=8)
+        eng.run_until_idle(max_steps=60)
+        np.testing.assert_array_equal(c.result(timeout=30), ref)
+        assert _counter("engine.prefix_hit") >= hits0 + 3
+        assert _counter("engine.prefill_tokens") - tok0 == 4, \
+            "cancel broke the prefix cache: re-hit re-prefilled"
+        _assert_pool_baseline(eng)
+
+    def test_cancel_mid_chunked_prefill(self):
+        """A slot cancelled while still chunk-prefilling stops before its
+        NEXT chunk: prefill_tokens freezes at the chunks already run."""
+        from paddle_tpu.inference.engine import Cancelled
+        m = _tiny_model()
+        eng = _engine(m, max_slots=1, prefix_cache=False,
+                      prefill_chunk_tokens=4)
+        r = eng.submit(np.arange(24, dtype=np.int32) % 97,
+                       max_new_tokens=4)
+        eng.step()                    # admit + first chunk (4 tokens)
+        tok_mid = _counter("engine.prefill_tokens")
+        assert eng.cancel(r.request_id) is True
+        eng.run_until_idle(max_steps=40)
+        with pytest.raises(Cancelled):
+            r.result(timeout=5)
+        assert _counter("engine.prefill_tokens") == tok_mid, \
+            "cancelled prefilling slot dispatched another chunk"
+        _assert_pool_baseline(eng)
+
+
+# --------------------------------- admission control + degradation ladder
+
+
+class TestAdmissionControl:
+    def test_queue_depth_shed_is_typed_overloaded(self):
+        from paddle_tpu.inference.engine import Overloaded
+        m = _tiny_model()
+        eng = _engine(m, max_slots=1, max_queue_depth=2)
+        base_shed = _counter("engine.shed")
+        q1 = eng.submit(np.arange(4, dtype=np.int32), 4)
+        q2 = eng.submit(np.arange(4, dtype=np.int32), 4)
+        with pytest.raises(Overloaded, match="max_queue_depth"):
+            eng.submit(np.arange(4, dtype=np.int32), 4)
+        assert _counter("engine.shed") == base_shed + 1
+        eng.run_until_idle(max_steps=200)     # accepted work still lands
+        q1.result(timeout=30), q2.result(timeout=30)
+        _assert_pool_baseline(eng)
+
+    def test_queue_tokens_shed(self):
+        from paddle_tpu.inference.engine import Overloaded
+        m = _tiny_model()
+        eng = _engine(m, max_slots=1, max_queue_tokens=20)
+        eng.submit(np.arange(4, dtype=np.int32), 4)
+        eng.submit(np.arange(16, dtype=np.int32) % 97, 4)  # 16 queued
+        with pytest.raises(Overloaded, match="max_queue_tokens"):
+            eng.submit(np.arange(8, dtype=np.int32), 4)    # 16+8 > 20
+        eng.run_until_idle(max_steps=200)
+        _assert_pool_baseline(eng)
+
+    def test_degradation_ladder_spec_off_then_prefix_shrunk(self):
+        """Pressure ladder (docs/ROBUSTNESS.md): level 1 stops drafting
+        (same warm verify program — no recompile), level 2 drops idle
+        prefix pages, and levels fall back as the queue drains."""
+        m = _tiny_model()
+        eng = _engine(m, max_slots=1, max_queue_depth=8,
+                      speculate_k=2, page_size=4)
+        rep = np.tile(np.arange(4, dtype=np.int32), 3)   # draftable
+        # prime the prefix store + the verify program
+        a = eng.submit(rep, max_new_tokens=6)
+        eng.run_until_idle(max_steps=60)
+        a.result(timeout=30)
+        pages_before = _gauge("engine.prefix_pages")
+        assert pages_before > 0
+        assert _gauge("engine.degradation_level") in (None, 0)
+        evict0 = _counter("engine.prefix_evictions")
+        # a long-running slot + 6 queued = pressure 6/8 -> level 2
+        run = eng.submit(rep, max_new_tokens=30)
+        eng.step()                                   # admit `run`
+        drafted_mid = _counter("engine.spec_drafted")
+        queued = [eng.submit(rep, max_new_tokens=2) for _ in range(6)]
+        eng.step()
+        assert _gauge("engine.degradation_level") == 2
+        # the IDLE cached pages were dropped; pages a live slot still
+        # shares keep their index (eviction never touches live pages)
+        assert _gauge("engine.prefix_pages") < pages_before, \
+            "level 2 must drop idle prefix pages"
+        assert _counter("engine.prefix_evictions") > evict0
+        for _ in range(3):
+            eng.step()
+        assert _counter("engine.spec_drafted") == drafted_mid, \
+            "degraded engine kept drafting"
+        eng.run_until_idle(max_steps=400)
+        run.result(timeout=30)
+        for q in queued:
+            q.result(timeout=30)
+        assert _gauge("engine.degradation_level") == 0, \
+            "ladder did not step back down after the queue drained"
+        _assert_pool_baseline(eng)
+
+
+# ----------------------------------------------------- injected pressure
+
+
+class TestInjectedFaults:
+    def test_pool_pressure_transient_then_admits(self):
+        """Injected allocator pressure while another request holds the
+        batch: the queued request WAITS (admission control is wait, not
+        partial-allocate), then admits when the fault exhausts."""
+        m = _tiny_model()
+        eng = _engine(m, max_slots=2, prefix_cache=False)
+        a = eng.submit(np.arange(6, dtype=np.int32), max_new_tokens=12)
+        eng.step()                            # A owns a slot
+        with faults.scoped("engine.pool_pressure", times=2):
+            b = eng.submit(np.arange(6, dtype=np.int32), max_new_tokens=4)
+            eng.run_until_idle(max_steps=100)
+        np.testing.assert_array_equal(b.result(timeout=30),
+                                      _fast_ref(m, np.arange(6), 4))
+        a.result(timeout=30)
+        assert faults.fired("engine.pool_pressure") >= 2
+        _assert_pool_baseline(eng)
+
+    def test_pool_pressure_on_empty_engine_fails_fast(self):
+        """With nothing running that could ever free pages, injected
+        pressure surfaces as the pool-too-small typed failure — bounded,
+        never a hang."""
+        m = _tiny_model()
+        eng = _engine(m, max_slots=2, prefix_cache=False)
+        with faults.scoped("engine.pool_pressure", times=-1):
+            r = eng.submit(np.arange(6, dtype=np.int32), max_new_tokens=4)
+            eng.run_until_idle(max_steps=20)
+        with pytest.raises(RuntimeError, match="pages"):
+            r.result(timeout=5)
+        _assert_pool_baseline(eng)
+
+
+# ------------------------------------------------------------- wire level
+
+
+def _serve(model, **ekw):
+    from paddle_tpu.inference.serve import InferenceServer
+    eng = _engine(model, **ekw)
+    srv = InferenceServer(None, engine=eng, auth_name=FLEET_SECRET)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, eng
+
+
+def _stop(srv):
+    srv._stop.set()
+    if srv._engine_thread is not None:
+        srv._engine_thread.join(timeout=30)
+    srv._sock.close()
+
+
+class TestServeRobustness:
+    def test_client_disconnect_cancels_request(self):
+        """Serve detects the GENERATE client hanging up mid-request and
+        cancels into the engine: slot + pages come back, nobody decodes
+        for a dead socket."""
+        from paddle_tpu.inference.serve import (MAGIC, OP_GENERATE,
+                                                auth_token, send_arrays)
+        m = _tiny_model()
+        srv, eng = _serve(m, prefix_cache=False)
+        base = _counter("serve.disconnect_cancels")
+        try:
+            with faults.scoped("engine.step_delay", times=-1,
+                               delay_s=0.02):
+                sock = socket.create_connection(("127.0.0.1", srv.port),
+                                                timeout=10)
+                sock.sendall(struct.pack("<I", MAGIC)
+                             + auth_token(FLEET_SECRET))
+                sock.sendall(struct.pack("<III", MAGIC, OP_GENERATE, 2))
+                send_arrays(sock, [np.arange(6, dtype=np.int32),
+                                   np.asarray([50], np.int32)])
+                _wait_for(lambda: eng._occupied(), msg="request admitted")
+                sock.close()                  # client walks away
+                _wait_for(lambda: _counter("serve.disconnect_cancels")
+                          > base, msg="disconnect-cancel")
+            _wait_for(lambda: not eng._has_work(), msg="engine quiesce")
+            _assert_pool_baseline(eng)
+            assert _counter("engine.cancelled") >= 1
+        finally:
+            _stop(srv)
+
+    def test_cancel_wire_op_by_tag(self):
+        """CANCEL (op 7) from a second connection lands in
+        DecodeEngine.cancel; the blocked GENERATE answers a typed
+        Cancelled line."""
+        from paddle_tpu.inference.errors import Cancelled
+        from paddle_tpu.inference.serve import RemotePredictor
+        m = _tiny_model()
+        srv, eng = _serve(m, prefix_cache=False)
+        res = {}
+        try:
+            with faults.scoped("engine.step_delay", times=-1,
+                               delay_s=0.02):
+                def gen():
+                    cli = RemotePredictor(port=srv.port,
+                                          secret=FLEET_SECRET)
+                    try:
+                        res["out"] = cli.generate(
+                            np.arange(6, dtype=np.int32),
+                            max_new_tokens=50, tag="req-under-test")
+                    except Exception as e:  # noqa: BLE001 — recorded
+                        res["err"] = e
+                    cli.close()
+                t = threading.Thread(target=gen, daemon=True)
+                t.start()
+                _wait_for(lambda: eng._occupied(), msg="request admitted")
+                cli2 = RemotePredictor(port=srv.port, secret=FLEET_SECRET)
+                assert cli2.cancel("req-under-test") is True
+                assert cli2.cancel("never-seen") is False
+                cli2.close()
+                t.join(timeout=60)
+                assert not t.is_alive(), "client hung after cancel"
+            assert isinstance(res.get("err"), Cancelled), res
+            assert "\n" not in str(res["err"])
+            _wait_for(lambda: not eng._has_work(), msg="engine quiesce")
+            _assert_pool_baseline(eng)
+        finally:
+            _stop(srv)
+
+    def test_deadline_over_wire_is_typed_single_line(self):
+        from paddle_tpu.inference.errors import DeadlineExceeded
+        from paddle_tpu.inference.serve import RemotePredictor
+        m = _tiny_model()
+        srv, eng = _serve(m, prefix_cache=False)
+        try:
+            cli = RemotePredictor(port=srv.port, secret=FLEET_SECRET)
+            # warm first so the compile wall can't eat the deadline
+            cli.generate(np.arange(6, dtype=np.int32), max_new_tokens=2)
+            with faults.scoped("engine.step_delay", times=-1,
+                               delay_s=0.05):
+                with pytest.raises(DeadlineExceeded) as exc:
+                    cli.generate(np.arange(6, dtype=np.int32),
+                                 max_new_tokens=50, deadline_s=0.3)
+            assert "\n" not in str(exc.value)
+            cli.close()
+            _wait_for(lambda: not eng._has_work(), msg="engine quiesce")
+            _assert_pool_baseline(eng)
+        finally:
+            _stop(srv)
+
+    def test_engine_thread_crash_surfaces_typed_not_hang(self):
+        """Injected engine-thread death: the serve loop aborts every
+        waiter with the loop-died reason and later submits are refused
+        fast — no client ever hangs on a dead engine."""
+        from paddle_tpu.inference.serve import RemotePredictor
+        m = _tiny_model()
+        srv, eng = _serve(m)
+        try:
+            faults.arm("engine.crash", times=1,
+                       exc=faults.FaultInjected)
+            _wait_for(lambda: eng._dead is not None,
+                      msg="engine thread death")
+            cli = RemotePredictor(port=srv.port, secret=FLEET_SECRET)
+            with pytest.raises(RuntimeError, match="FaultInjected") as exc:
+                cli.generate(np.arange(4, dtype=np.int32),
+                             max_new_tokens=2)
+            assert "engine stopped" in str(exc.value)
+            cli.close()
+        finally:
+            faults.disarm()
+            _stop(srv)
+
+    def test_socket_drop_fault_drops_cleanly(self):
+        """Injected mid-request socket drop: THIS client sees a clean
+        connection error, the NEXT connection is served normally."""
+        from paddle_tpu.inference.serve import RemotePredictor
+        m = _tiny_model()
+        srv, eng = _serve(m)
+        try:
+            cli = RemotePredictor(port=srv.port, secret=FLEET_SECRET)
+            with faults.scoped("serve.socket_drop", times=1):
+                with pytest.raises((ConnectionError, OSError)):
+                    cli.generate(np.arange(4, dtype=np.int32),
+                                 max_new_tokens=2)
+            cli.close()
+            cli2 = RemotePredictor(port=srv.port, secret=FLEET_SECRET)
+            out = cli2.generate(np.arange(4, dtype=np.int32),
+                                max_new_tokens=2)
+            assert out.shape == (6,)
+            cli2.close()
+            _assert_pool_baseline(eng)
+        finally:
+            _stop(srv)
+
+
+# ------------------------------------------------------------ router level
+
+
+def _router(**kw):
+    from paddle_tpu.serving import Router
+    kw.setdefault("replica_secret", FLEET_SECRET)
+    kw.setdefault("auth_name", "chaos-front")
+    router = Router(**kw)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    return router
+
+
+def _client(router):
+    from paddle_tpu.inference.serve import RemotePredictor
+    return RemotePredictor(port=router.port, secret="chaos-front")
+
+
+def _dead_port():
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    return port
+
+
+class TestRouterRobustness:
+    def test_all_replicas_shedding_is_one_typed_overloaded_line(self):
+        """Satellite pin: when every replica answers a typed shed, the
+        client gets ONE clean Overloaded line (no hang, no socket
+        traceback) and router.shed counts it."""
+        from paddle_tpu.inference.errors import Overloaded
+        m = _tiny_model()
+        s0, e0 = _serve(m, max_queue_depth=0)   # sheds every submit
+        s1, e1 = _serve(m, max_queue_depth=0)
+        router = _router(replicas={"r0": f"127.0.0.1:{s0.port}",
+                                   "r1": f"127.0.0.1:{s1.port}"})
+        base_shed = _counter("router.shed")
+        try:
+            cli = _client(router)
+            with pytest.raises(Overloaded) as exc:
+                cli.generate(np.arange(4, dtype=np.int32),
+                             max_new_tokens=2)
+            msg = str(exc.value)
+            assert "\n" not in msg and "Traceback" not in msg, msg
+            assert "socket.timeout" not in msg, msg
+            assert _counter("router.shed") == base_shed + 1
+            # shedding replicas stay IN rotation (healthy, just full)
+            assert set(router.replica_ids(healthy_only=True)) \
+                == {"r0", "r1"}
+            cli.close()
+        finally:
+            router.stop()
+            _stop(s0), _stop(s1)
+
+    def test_resubmit_budget_exhaustion_is_one_clean_line(self):
+        """Satellite pin: budget exhaustion over dead replicas surfaces
+        as one single-line RuntimeError naming the budget — never a raw
+        socket traceback, never a hang."""
+        router = _router(replicas={"d0": f"127.0.0.1:{_dead_port()}",
+                                   "d1": f"127.0.0.1:{_dead_port()}"},
+                         connect_deadline_s=0.3, max_resubmits=1)
+        try:
+            cli = _client(router)
+            with pytest.raises(RuntimeError,
+                               match="resubmit budget") as exc:
+                cli.generate(np.arange(4, dtype=np.int32),
+                             max_new_tokens=2)
+            msg = str(exc.value)
+            assert "\n" not in msg and "Traceback" not in msg, msg
+            assert _counter("router.resubmits") >= 1
+            cli.close()
+        finally:
+            router.stop()
+
+    def test_router_deadline_budget_exhaustion_counts_and_types(self):
+        """A deadline too small to survive even one attempt surfaces as a
+        typed DeadlineExceeded from the ROUTER (router.deadline_exceeded
+        counts it) — the client's clock bounds the whole attempt chain."""
+        from paddle_tpu.inference.errors import DeadlineExceeded
+        base = _counter("router.deadline_exceeded")
+        router = _router(replicas={"d0": f"127.0.0.1:{_dead_port()}"},
+                         connect_deadline_s=0.3, max_resubmits=3)
+        try:
+            cli = _client(router)
+            with pytest.raises(DeadlineExceeded) as exc:
+                cli.generate(np.arange(4, dtype=np.int32),
+                             max_new_tokens=2, deadline_s=0.001)
+            assert "\n" not in str(exc.value)
+            assert _counter("router.deadline_exceeded") == base + 1
+            cli.close()
+        finally:
+            router.stop()
+
+    def test_replica_deadline_relayed_verbatim_no_resubmit(self):
+        """A replica-answered DeadlineExceeded is terminal: relayed
+        typed to the client, no resubmit burned (the deadline is global —
+        another replica can't un-expire it)."""
+        from paddle_tpu.inference.errors import DeadlineExceeded
+        m = _tiny_model()
+        s0, e0 = _serve(m, prefix_cache=False)
+        router = _router(replicas={"r0": f"127.0.0.1:{s0.port}"})
+        try:
+            cli = _client(router)
+            cli.generate(np.arange(6, dtype=np.int32),
+                         max_new_tokens=2)          # warm/prime
+            base_rs = _counter("router.resubmits")
+            with faults.scoped("engine.step_delay", times=-1,
+                               delay_s=0.05):
+                with pytest.raises(DeadlineExceeded):
+                    cli.generate(np.arange(6, dtype=np.int32),
+                                 max_new_tokens=50, deadline_s=0.3)
+            assert _counter("router.resubmits") == base_rs
+            cli.close()
+            _wait_for(lambda: not e0._has_work(), msg="engine quiesce")
+            _assert_pool_baseline(e0)
+        finally:
+            router.stop()
+            _stop(s0)
+
+    def test_breaker_opens_half_opens_closes(self):
+        """Breaker walk: request failure opens; past the cooldown the
+        health probe half-opens and its verdict closes — the replica
+        serves again with zero operator action."""
+        m = _tiny_model()
+        port = _dead_port()
+        router = _router(replicas={"r0": f"127.0.0.1:{port}"},
+                         connect_deadline_s=0.3, evict_cooldown_s=0.4,
+                         poll_interval_s=0.1)
+        base_open = _counter("router.breaker_open")
+        base_close = _counter("router.breaker_close")
+        try:
+            cli = _client(router)
+            with pytest.raises(RuntimeError):
+                cli.generate(np.arange(4, dtype=np.int32),
+                             max_new_tokens=2)
+            assert router._replicas["r0"].breaker == "open"
+            assert _counter("router.breaker_open") > base_open
+            assert "r0" not in router.replica_ids(healthy_only=True)
+            # replica appears on the advertised endpoint: probe closes it
+            from paddle_tpu.inference.engine import DecodeEngine, \
+                EngineConfig
+            from paddle_tpu.inference.serve import InferenceServer
+            eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                               min_bucket=8))
+            srv = InferenceServer(None, host="127.0.0.1", port=port,
+                                  engine=eng, auth_name=FLEET_SECRET)
+            threading.Thread(target=srv.serve_forever,
+                             daemon=True).start()
+            _wait_for(lambda: router._replicas["r0"].breaker == "closed",
+                      msg="probe re-close")
+            assert _counter("router.breaker_close") > base_close
+            p = np.arange(4, dtype=np.int32)
+            cli2 = _client(router)
+            np.testing.assert_array_equal(
+                cli2.generate(p, max_new_tokens=3), _fast_ref(m, p, 3))
+            cli2.close(), cli.close()
+            _stop(srv)
+        finally:
+            router.stop()
+
+    def test_probe_failures_open_breaker_without_traffic(self):
+        """A replica that dies QUIETLY (no request in flight) is opened by
+        consecutive background probe failures alone."""
+        m = _tiny_model()
+        s0, e0 = _serve(m)
+        router = _router(replicas={"r0": f"127.0.0.1:{s0.port}"},
+                         connect_deadline_s=0.3, poll_interval_s=0.1,
+                         breaker_threshold=2, evict_cooldown_s=60.0)
+        try:
+            _wait_for(lambda: router._replicas["r0"].probe_at > 0,
+                      msg="first probe")
+            _stop(s0)                        # dies with no traffic
+            _wait_for(lambda: router._replicas["r0"].breaker == "open",
+                      msg="probe-driven breaker open")
+            assert "r0" not in router.replica_ids(healthy_only=True)
+        finally:
+            router.stop()
+
+    def test_client_disconnect_propagates_through_router(self):
+        """The disconnect chain composes across tiers: client EOF at the
+        ROUTER drops the replica connection, whose own serve-side watch
+        cancels into the engine — no tier keeps decoding for a dead
+        socket."""
+        from paddle_tpu.inference.serve import (MAGIC, OP_GENERATE,
+                                                auth_token, send_arrays)
+        m = _tiny_model()
+        s0, e0 = _serve(m, prefix_cache=False)
+        router = _router(replicas={"r0": f"127.0.0.1:{s0.port}"})
+        base = _counter("serve.disconnect_cancels")
+        try:
+            with faults.scoped("engine.step_delay", times=-1,
+                               delay_s=0.02):
+                sock = socket.create_connection(
+                    ("127.0.0.1", router.port), timeout=10)
+                sock.sendall(struct.pack("<I", MAGIC)
+                             + auth_token("chaos-front"))
+                sock.sendall(struct.pack("<III", MAGIC, OP_GENERATE, 2))
+                send_arrays(sock, [np.arange(6, dtype=np.int32),
+                                   np.asarray([50], np.int32)])
+                _wait_for(lambda: e0._occupied(), msg="request admitted")
+                sock.close()              # client walks away mid-route
+                _wait_for(lambda: _counter("serve.disconnect_cancels")
+                          > base, msg="cross-tier disconnect cancel")
+                assert _counter("router.disconnect_drops") >= 1
+            _wait_for(lambda: not e0._has_work(), msg="engine quiesce")
+            _assert_pool_baseline(e0)
+        finally:
+            router.stop()
+            _stop(s0)
+
+    def test_cancel_broadcast_through_router(self):
+        """CANCEL through the router fans out to the replicas; the one
+        holding the tag cancels and the blocked GENERATE answers typed."""
+        from paddle_tpu.inference.errors import Cancelled
+        m = _tiny_model()
+        s0, e0 = _serve(m, prefix_cache=False)
+        router = _router(replicas={"r0": f"127.0.0.1:{s0.port}"})
+        res = {}
+        try:
+            with faults.scoped("engine.step_delay", times=-1,
+                               delay_s=0.02):
+                def gen():
+                    cli = _client(router)
+                    try:
+                        res["out"] = cli.generate(
+                            np.arange(6, dtype=np.int32),
+                            max_new_tokens=50, tag="routed-tag")
+                    except Exception as e:  # noqa: BLE001 — recorded
+                        res["err"] = e
+                    cli.close()
+                t = threading.Thread(target=gen, daemon=True)
+                t.start()
+                _wait_for(lambda: e0._occupied(), msg="request admitted")
+                cli2 = _client(router)
+                assert cli2.cancel("routed-tag") is True
+                cli2.close()
+                t.join(timeout=60)
+                assert not t.is_alive(), "client hung after routed cancel"
+            assert isinstance(res.get("err"), Cancelled), res
+            _wait_for(lambda: not e0._has_work(), msg="engine quiesce")
+            _assert_pool_baseline(e0)
+        finally:
+            router.stop()
+            _stop(s0)

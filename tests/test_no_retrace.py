@@ -257,3 +257,49 @@ def test_pallas_path_compiles_once_per_bucket():
         assert after_new[0] == frozen[0] + 1         # exactly ONE new program
     finally:
         set_flags({"tpu_paged_impl": "auto"})
+
+
+def test_cancel_and_deadline_paths_zero_recompiles():
+    """Cancellation and deadline expiry retire slots BETWEEN fixed-shape
+    steps (docs/ROBUSTNESS.md): reclaiming a slot early, re-admitting into
+    it, and expiring a queued request must all leave every compile counter
+    frozen — containment must never cost a retrace."""
+    import time
+
+    import pytest
+
+    from paddle_tpu.inference.engine import (Cancelled, DeadlineExceeded,
+                                             DecodeEngine, EngineConfig)
+    m = _tiny_model()
+    eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=3,
+                                       min_bucket=8))
+    rng = np.random.RandomState(6)
+    eng.warmup(prompt_lens=[8])
+    r = eng.submit(rng.randint(0, 64, 5).astype(np.int32), 3)
+    eng.run_until_idle(max_steps=30)
+    assert r.done
+    frozen = _compile_counters()
+
+    # cancel one of two running decodes mid-flight; the survivor decodes
+    # on, and a later submit reuses the reclaimed slot — all warm shapes
+    a = eng.submit(rng.randint(0, 64, 6).astype(np.int32), 20)
+    b = eng.submit(rng.randint(0, 64, 6).astype(np.int32), 20)
+    for _ in range(2):
+        eng.step()
+    assert eng.cancel(a.request_id)
+    # a queued request expires (deadline passes before admission is even
+    # attempted) and a slotted one expires mid-decode
+    c = eng.submit(rng.randint(0, 64, 7).astype(np.int32), 20,
+                   deadline_s=0.01)
+    time.sleep(0.03)
+    late = eng.submit(rng.randint(0, 64, 8).astype(np.int32), 4)
+    eng.run_until_idle(max_steps=200)
+    with pytest.raises(Cancelled):
+        a.result(timeout=5)
+    with pytest.raises(DeadlineExceeded):
+        c.result(timeout=5)
+    assert b.result(timeout=30) is not None
+    assert late.result(timeout=30) is not None
+    assert _compile_counters() == frozen, (
+        "cancel/deadline retirement recompiled after warmup: containment "
+        "must be shape-invariant")

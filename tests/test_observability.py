@@ -365,6 +365,12 @@ def test_bench_smoke_emits_structured_json():
     assert d["spec_accepted"] >= 0
     assert d["metrics"]["counters"]["engine.spec_steps"] >= 1
     assert d["metrics"]["counters"]["engine.prefix_pages_reused"] >= 1
+    # r8: the smoke run exercises one typed SHED (admission control) and
+    # one CANCEL (failure containment, docs/ROBUSTNESS.md)
+    assert d["shed"] >= 1
+    assert d["cancelled"] >= 1
+    assert d["metrics"]["counters"]["engine.shed"] >= 1
+    assert d["metrics"]["counters"]["engine.cancelled"] >= 1
 
 
 def test_bench_emission_survives_failing_platform_plugin(tmp_path):

@@ -299,6 +299,12 @@ class TestRouterFailover:
             "max_seq_len=64",
             "RuntimeError: no decode engine attached (start with "
             "--gpt-config or engine=)",
+            # terminal per-request outcomes: the deadline is global and
+            # the cancel was the client's — another replica changes
+            # neither (docs/ROBUSTNESS.md)
+            "DeadlineExceeded: request deadline (0.5s) passed after 3 "
+            "generated tokens",
+            "Cancelled: client disconnected",
         )
         for m in relayed:
             assert isinstance(_classify_wire_error(m), _ReplicaAppError), m
@@ -308,6 +314,9 @@ class TestRouterFailover:
             "RuntimeError: engine stopped: replica killed mid-run",
             "RuntimeError: some free-form abort reason",
             "TimeoutError: generation still running",
+            # a typed shed is resubmittable — another replica may have
+            # queue room
+            "Overloaded: engine queue full: depth 8 >= max_queue_depth 8",
         )
         for m in resubmitted:
             assert isinstance(_classify_wire_error(m),
@@ -324,6 +333,10 @@ class TestRouterFailover:
             "RuntimeError: request needs 40 pages, pool has 16"))
         assert not _should_evict(ReplicaUnavailable(
             "TimeoutError: generation still running"))
+        # a shedding replica is healthy, just full: resubmit elsewhere,
+        # breaker stays closed
+        assert not _should_evict(ReplicaUnavailable(
+            "Overloaded: engine queue full: depth 8 >= max_queue_depth 8"))
         assert _should_evict(ReplicaUnavailable(
             "RuntimeError: engine draining: not accepting new requests"))
         assert _should_evict(ReplicaUnavailable(
