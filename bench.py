@@ -312,6 +312,96 @@ def bench_train_step():
     return out, ratio
 
 
+def bench_train_ft():
+    """Fault-tolerant training rung (paddle_tpu/train/fault_tolerance).
+
+    Three claims, three measurements:
+    - async-checkpoint step-stall: per-step wall p99 with an async save
+      EVERY step vs a no-checkpoint baseline — the blocking cost is only
+      the host snapshot (the background write overlaps the donated steps),
+      so the ratio should stay near 1;
+    - resume wall time: fresh model/optimizer/step restoring the LATEST
+      checkpoint (params + opt state + rng + step clock);
+    - resume correctness: the next step's loss after restore is IDENTICAL
+      to the uninterrupted run's (dp=1 bit parity, asserted).
+    """
+    import shutil
+    import tempfile
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.train import CheckpointManager, ScanTrainStep
+
+    on_cpu = _platform() == "cpu"
+    batch, seq = (4, 128) if on_cpu else (16, 1024)
+    hs, nh, im, vocab, nl = (256, 4, 1024, 8192, 4) if on_cpu else \
+        (768, 12, 3072, 50304, 12)
+    steps = 10
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hs, num_layers=nl,
+                    num_heads=nh, intermediate_size=im,
+                    max_position_embeddings=seq, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+
+    def mk(seed=0):
+        paddle.seed(seed)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        return ScanTrainStep(model, opt, microbatches=1)
+
+    def batch_fn(i):
+        r = np.random.RandomState(100 + i)
+        ids = r.randint(0, vocab, (batch, seq + 1))
+        return ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int32)
+
+    def timed_steps(step, mgr=None):
+        walls = []
+        for i in range(1, steps + 1):
+            t0 = time.perf_counter()
+            step.step(*batch_fn(i))
+            if mgr is not None:
+                mgr.after_step(data_cursor=i + 1)
+            walls.append(time.perf_counter() - t0)
+        return walls
+
+    # baseline: no checkpointing
+    step = mk()
+    step.step(*batch_fn(0))                        # compile
+    base = timed_steps(step)
+
+    # fault-tolerant: async checkpoint EVERY step (worst case for stall)
+    root = tempfile.mkdtemp(prefix="bench_train_ft_")
+    try:
+        step_ft = mk()
+        mgr = CheckpointManager(root, step_ft, every=1, keep=2)
+        step_ft.step(*batch_fn(0))
+        ft = timed_steps(step_ft, mgr)
+        mgr.wait()
+        cont_loss = step_ft.step(*batch_fn(steps + 1))
+
+        # kill + resume: fresh objects, different init, restore LATEST
+        step_r = mk(seed=1)
+        mgr_r = CheckpointManager(root, step_r)
+        t0 = time.perf_counter()
+        info = mgr_r.restore(require=True)
+        resume_s = time.perf_counter() - t0
+        resumed_loss = step_r.step(*batch_fn(steps + 1))
+        assert resumed_loss == cont_loss, (
+            f"resume diverged: {resumed_loss!r} vs {cont_loss!r}")
+        hist = metrics.snapshot()["histograms"].get(
+            "train.checkpoint_seconds", {})
+        p99 = lambda xs: float(np.percentile(xs, 99))   # noqa: E731
+        return {"base_p99_s": p99(base), "ft_p99_s": p99(ft),
+                "stall_ratio_p99": p99(ft) / max(p99(base), 1e-9),
+                "ckpt_stall_p50_s": hist.get("p50"),
+                "ckpt_stall_p99_s": hist.get("p99"),
+                "latest_step": int(info["step"]),
+                "resume_wall_s": resume_s, "resume_ok": True,
+                "steps": steps}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_decode():
     """Autoregressive decode rung: GPT-2s fast_generate (single compiled
     program: static KV cache + lax.scan; see models/gpt.py). B=8 prompts
@@ -971,6 +1061,36 @@ def bench_smoke():
     snap_mb = metrics.snapshot()["counters"].get("train.microbatches", 0)
     assert snap_mb >= 2, "scan step did not report train.microbatches"
 
+    # one save -> kill -> resume cycle (paddle_tpu/train fault_tolerance):
+    # synchronous checkpoint, "kill" (discard the live step), restore into
+    # a FRESH model/optimizer/step with a different init, and the next
+    # step's loss must match the uninterrupted continuation BIT-IDENTICALLY
+    # — emitted as `resume_ok` (asserted in tests/test_observability.py)
+    import shutil as _sh
+    import tempfile as _tf
+    from paddle_tpu.train import CheckpointManager
+    ft_root = _tf.mkdtemp(prefix="bench_ft_smoke_")
+    try:
+        ft_mgr = CheckpointManager(ft_root, scan_step, keep=2)
+        ft_mgr.save(data_cursor=2, sync=True)
+        cont_loss = scan_step.step(ids[:, :-1].astype(np.int32),
+                                   ids[:, 1:].astype(np.int64))
+        paddle.seed(123)               # different init: restore overwrites
+        rmodel = GPTForCausalLM(cfg)
+        ropt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                      parameters=rmodel.parameters())
+        rstep = ScanTrainStep(rmodel, ropt, microbatches=2)
+        rinfo = CheckpointManager(ft_root, rstep).restore(require=True)
+        resumed_loss = rstep.step(ids[:, :-1].astype(np.int32),
+                                  ids[:, 1:].astype(np.int64))
+        resume_ok = bool(resumed_loss == cont_loss)
+        assert resume_ok, (resumed_loss, cont_loss, rinfo)
+    finally:
+        _sh.rmtree(ft_root, ignore_errors=True)
+    snapc0 = metrics.snapshot()["counters"]
+    assert snapc0.get("train.checkpoints", 0) >= 1
+    assert snapc0.get("train.resumes", 0) >= 1
+
     # batched-engine decode on the same tiny model, now under a stall
     # WATCHDOG and with enough concurrent requests to land real SLO
     # observations: keeps the decode engine (paged KV cache + bucketed
@@ -1091,7 +1211,8 @@ def bench_smoke():
     slo = {f"{short}_{q}": round(hists[f"serve.{short}_seconds"][q], 6)
            for short in ("ttft", "tpot", "e2e") for q in ("p50", "p99")}
     return (dt, batch * seq / dt, snap, slo, wd.dump_count == 0, router_ok,
-            prefix_hits, spec_accepted, shed_count, cancelled_count)
+            prefix_hits, spec_accepted, shed_count, cancelled_count,
+            resume_ok)
 
 
 def _retry(fn, attempts=3):
@@ -1132,7 +1253,8 @@ def main(argv=None):
     if args.smoke:
         try:
             (dt, tps, snap, slo, wd_clean, router_ok, prefix_hits,
-             spec_accepted, shed_count, cancelled_count) = bench_smoke()
+             spec_accepted, shed_count, cancelled_count,
+             resume_ok) = bench_smoke()
             impls = {k.rsplit(".", 1)[-1]: v
                      for k, v in snap["counters"].items()
                      if k.startswith("paged_attention.impl.") and v}
@@ -1145,6 +1267,7 @@ def main(argv=None):
                    "spec_accepted": spec_accepted,
                    "shed": shed_count,
                    "cancelled": cancelled_count,
+                   "resume_ok": resume_ok,
                    "prefill_chunks": snap["counters"].get(
                        "engine.prefill_chunks", 0),
                    "train_mfu": snap["gauges"].get("train.mfu"),
@@ -1225,6 +1348,32 @@ def main(argv=None):
     except Exception as e:
         _emit({"metric": "train_step_tokens_per_sec", "value": 0.0,
                "unit": "tokens/s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
+    try:
+        ft = _retry(bench_train_ft)
+        _emit({"metric": "train_ft_step_stall_ratio_p99",
+               "value": round(ft["stall_ratio_p99"], 3), "unit": "x",
+               "ok": True, "platform": platform,
+               "base_p99_s": round(ft["base_p99_s"], 4),
+               "ft_p99_s": round(ft["ft_p99_s"], 4),
+               "ckpt_stall_p50_s": (round(ft["ckpt_stall_p50_s"], 4)
+                                    if ft["ckpt_stall_p50_s"] is not None
+                                    else None),
+               "ckpt_stall_p99_s": (round(ft["ckpt_stall_p99_s"], 4)
+                                    if ft["ckpt_stall_p99_s"] is not None
+                                    else None),
+               "resume_wall_s": round(ft["resume_wall_s"], 3),
+               "resume_ok": ft["resume_ok"],
+               "mix": f"async ckpt every step x{ft['steps']}, keep=2"})
+        print(f"# train_ft async-ckpt step-stall p99 "
+              f"{ft['ft_p99_s']*1e3:.1f}ms vs baseline "
+              f"{ft['base_p99_s']*1e3:.1f}ms "
+              f"({ft['stall_ratio_p99']:.2f}x), snapshot stall p99="
+              f"{(ft['ckpt_stall_p99_s'] or 0)*1e3:.1f}ms, resume wall="
+              f"{ft['resume_wall_s']:.2f}s bit-identical", file=sys.stderr)
+    except Exception as e:
+        _emit({"metric": "train_ft_step_stall_ratio_p99", "value": 0.0,
+               "unit": "x", "ok": False, "platform": platform,
                "backend_error": f"{type(e).__name__}: {e}"})
     try:
         eng_tps, seq_tps = _retry(bench_engine_decode)

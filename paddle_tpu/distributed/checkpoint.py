@@ -16,9 +16,19 @@ Format: a directory with
 Each process writes only the shards it owns (multi-host writes disjoint files;
 rank 0 writes the index). ``async_save`` returns immediately and writes from a
 background thread (the reference's auto_checkpoint/async pattern).
+
+Durability (docs/ROBUSTNESS.md "Training fault tolerance"): every shard entry
+records a content checksum (blake2b over the exact bytes written) plus a
+format version stamp in the index, and ``load_sharded`` VERIFIES both — a
+truncated, bit-flipped, or future-format checkpoint is refused with a typed
+`CheckpointCorrupt`, a structurally missing one (no index, missing shard
+file, coverage gap) with `CheckpointIncomplete`; neither is ever silently
+loaded. The crash-consistency protocol on top (LATEST pointer, COMPLETE
+markers, retention) lives in `paddle_tpu/train/fault_tolerance.py`.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
@@ -28,6 +38,39 @@ import numpy as np
 import jax
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.testing import faults
+
+# Bumped when the on-disk layout changes incompatibly. Indexes carry it under
+# _META_KEY; loaders refuse a mismatched stamp (a checkpoint written by a
+# NEWER format must not be half-understood). Legacy indexes without the stamp
+# (pre-checksum checkpoints) still load — they simply skip verification.
+CKPT_FORMAT_VERSION = 2
+_META_KEY = "__ckpt_meta__"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """The checkpoint's payload fails integrity verification: a shard file
+    is truncated or undecodable, its content hash does not match the one
+    recorded at save time, its shape disagrees with the index, or the
+    index carries an incompatible format-version stamp. Never load it —
+    resume from an older complete checkpoint instead."""
+
+
+class CheckpointIncomplete(RuntimeError):
+    """The checkpoint is structurally missing pieces: no index, a shard
+    file named by the index is absent, the shards do not cover the full
+    array, or (at the manager level) there is no LATEST pointer to resume
+    from. Typically a save that crashed partway — by protocol such a
+    checkpoint was never published and must be ignored, not repaired."""
+
+
+def _digest(data: np.ndarray) -> str:
+    """Content hash of the EXACT array bytes written to disk (post any
+    bf16->f32 widening), so load can verify without re-reading the file.
+    Hashes through the buffer protocol — no tobytes() copy, so a multi-GB
+    shard costs no transient second allocation on the writer thread."""
+    return hashlib.blake2b(np.ascontiguousarray(data).data,
+                           digest_size=16).hexdigest()
 
 
 def _sanitize(key):
@@ -57,7 +100,25 @@ def save_sharded(state_dict, path):
     atomically (tmp + rename)."""
     os.makedirs(path, exist_ok=True)
     pid = jax.process_index()
-    index = {}
+    index = {_META_KEY: {"version": CKPT_FORMAT_VERSION}}
+    nwritten = 0
+
+    def _write_shard(fname, data):
+        # chaos sites (tests/test_train_chaos.py): a save that dies between
+        # shard files must leave the checkpoint INVISIBLE (no index, no
+        # LATEST), and a torn write must be refused at load by checksum
+        nonlocal nwritten
+        if faults.ENABLED and nwritten > 0 \
+                and faults.fire("ckpt.crash_between_shards"):
+            raise faults.FaultInjected(
+                f"fault injected at ckpt.crash_between_shards ({fname})")
+        fpath = os.path.join(path, fname)
+        np.save(fpath, data)
+        nwritten += 1
+        if faults.ENABLED and faults.fire("ckpt.write_truncate"):
+            with open(fpath, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(fpath) // 2))
+
     for key, value in _flatten(state_dict).items():
         if isinstance(value, (int, float, str, bool, type(None))) or (
                 isinstance(value, (list, tuple)) and all(
@@ -73,10 +134,11 @@ def save_sharded(state_dict, path):
             dtype = str(arr.dtype)
             data = arr
             fname = f"{skey}.p{pid}s0.npy"
-            np.save(os.path.join(path, fname), data)
+            _write_shard(fname, data)
             index[key] = {"shape": list(arr.shape), "dtype": dtype,
                           "shards": [{"file": fname, "slices": [
-                              [0, d] for d in arr.shape]}]}
+                              [0, d] for d in arr.shape],
+                              "sum": _digest(data)}]}
             continue
         if not hasattr(arr, "addressable_shards"):
             arr = jax.numpy.asarray(arr)
@@ -93,8 +155,9 @@ def save_sharded(state_dict, path):
             data = np.asarray(shard.data)
             if str(arr.dtype) == "bfloat16":
                 data = data.astype(np.float32)   # npy-portable; dtype in index
-            np.save(os.path.join(path, fname), data)
-            entries.append({"file": fname, "slices": tup})
+            _write_shard(fname, data)
+            entries.append({"file": fname, "slices": tup,
+                            "sum": _digest(data)})
         index[key] = {"shape": list(arr.shape), "dtype": str(arr.dtype),
                       "shards": entries}
     idx_path = os.path.join(path, f"index.p{pid}.json")
@@ -112,17 +175,24 @@ def save_sharded(state_dict, path):
 
 
 class _SaveThread(threading.Thread):
-    """Background writer that re-raises its exception on join()."""
+    """Background writer that re-raises its exception on join(). A failed
+    write must surface on the NEXT join()/wait(), never vanish in a
+    daemon thread — callers (`CheckpointManager`) join before starting
+    the next save, so at most one checkpoint interval passes between a
+    write failing and the training loop hearing about it."""
 
-    def __init__(self, snapshot, path):
-        super().__init__(daemon=True)
+    def __init__(self, snapshot, path, on_complete=None):
+        super().__init__(daemon=True, name="pt-ckpt-save")
         self._snapshot = snapshot
         self._path = path
+        self._on_complete = on_complete
         self.exc = None
 
     def run(self):
         try:
             save_sharded(self._snapshot, self._path)
+            if self._on_complete is not None:
+                self._on_complete(self._path)
         except BaseException as e:   # noqa: BLE001 — stored, re-raised on join
             self.exc = e
 
@@ -131,11 +201,19 @@ class _SaveThread(threading.Thread):
         if not self.is_alive() and self.exc is not None:
             raise self.exc
 
+    # checkpoint-manager alias: `wait()` = join + error propagation
+    wait = join
 
-def async_save(state_dict, path):
+
+def async_save(state_dict, path, on_complete=None):
     """Copy values to HOST on the calling thread (compiled train steps donate
     the device buffers — a reference would race the next step's in-place
-    update), then write in the background. join() re-raises write errors."""
+    update), then write in the background. join()/wait() re-raises write
+    errors. The blocking cost to the caller is ONLY the host snapshot — the
+    step-stall `bench_train_ft` measures. ``on_complete(path)`` runs on the
+    writer thread after a fully successful save (the manager's hook for the
+    COMPLETE marker + LATEST pointer); its errors propagate like write
+    errors."""
     snapshot = {}
     for key, value in _flatten(state_dict).items():
         arr = value._data if isinstance(value, Tensor) else value
@@ -143,31 +221,69 @@ def async_save(state_dict, path):
             arr = np.asarray(arr)      # synchronous host copy
         snapshot[key] = arr
 
-    t = _SaveThread(snapshot, path)
+    t = _SaveThread(snapshot, path, on_complete)
     t.start()
     return t
 
 
-def load_sharded(path, template=None, return_numpy=False):
+def read_literal(path, key, default=None):
+    """Read ONE literal entry (int/str/list metadata) from a checkpoint's
+    index without touching any shard — the cheap metadata peek the
+    checkpoint manager uses. Returns ``default`` when the index or the
+    key is absent/unreadable. Keeps index-format knowledge in this module
+    only."""
+    import glob as _glob
+    out = default
+    for pf in sorted(_glob.glob(os.path.join(path, "index.p*.json"))):
+        try:
+            with open(pf) as f:
+                part = json.load(f)
+        except Exception:  # noqa: BLE001 — a peek must never raise
+            return default
+        entry = part.get(key)
+        if isinstance(entry, dict) and "literal" in entry:
+            out = entry["literal"]
+    return out
+
+
+def load_sharded(path, template=None, return_numpy=False, verify=True):
     """Load a sharded checkpoint into a flat {key: Tensor} dict.
 
-    ``template``: optional {key: Tensor} (e.g. a freshly built model's
-    state_dict under the CURRENT mesh) — loaded arrays adopt each template
-    tensor's sharding, which IS the cross-plan reshard (save under dp=8, load
-    under dp2 x mp2 x sp2, any layout)."""
+    ``template``: optional {key: Tensor-or-array} (e.g. a freshly built
+    model's state_dict under the CURRENT mesh) — loaded arrays adopt each
+    template leaf's sharding, which IS the cross-plan reshard (save under
+    dp=8, load under dp2 x mp2 x sp2, any layout).
+
+    Integrity: a missing index or shard file raises `CheckpointIncomplete`;
+    a truncated/undecodable shard, a shape that disagrees with the index, a
+    content-hash mismatch, or an incompatible format-version stamp raises
+    `CheckpointCorrupt`. ``verify=False`` skips only the content hashing
+    (structural checks always run) — for resumes the default stays on: a
+    corrupt checkpoint must be REFUSED, never trained on."""
     import glob as _glob
     index = {}
     partials = sorted(_glob.glob(os.path.join(path, "index.p*.json")))
     if not partials:
-        partials = [os.path.join(path, "index.json")]
+        legacy = os.path.join(path, "index.json")
+        if not os.path.exists(legacy):
+            raise CheckpointIncomplete(
+                f"no checkpoint index under {path!r} — save crashed before "
+                "publishing, or wrong directory")
+        partials = [legacy]
     for pf in partials:
         with open(pf) as f:
             part = json.load(f)
-        for key, meta in part.items():
-            if key in index and "shards" in meta:
-                index[key]["shards"].extend(meta["shards"])
+        meta = part.pop(_META_KEY, None)
+        if meta is not None and meta.get("version") != CKPT_FORMAT_VERSION:
+            raise CheckpointCorrupt(
+                f"checkpoint {path!r} has format version "
+                f"{meta.get('version')!r}, this reader understands "
+                f"{CKPT_FORMAT_VERSION} — refusing to half-interpret it")
+        for key, entry in part.items():
+            if key in index and "shards" in entry:
+                index[key]["shards"].extend(entry["shards"])
             else:
-                index[key] = meta
+                index[key] = entry
     tpl_flat = _flatten(template) if template is not None else {}
     out = {}
     for key, meta in index.items():
@@ -179,8 +295,29 @@ def load_sharded(path, template=None, return_numpy=False):
         cast_bf16 = meta["dtype"] == "bfloat16"
         boxes = []
         for e in meta["shards"]:
-            data = np.load(os.path.join(path, e["file"]),
-                           allow_pickle=False)
+            fpath = os.path.join(path, e["file"])
+            if not os.path.exists(fpath):
+                raise CheckpointIncomplete(
+                    f"checkpoint shard {e['file']!r} for {key!r} is missing "
+                    f"from {path!r}")
+            try:
+                data = np.load(fpath, allow_pickle=False)
+            except Exception as exc:  # noqa: BLE001 — any decode failure
+                raise CheckpointCorrupt(
+                    f"checkpoint shard {e['file']!r} for {key!r} is "
+                    f"truncated or undecodable: {type(exc).__name__}: {exc}"
+                ) from exc
+            want = tuple(b - a for a, b in e["slices"])
+            if tuple(data.shape) != want:
+                raise CheckpointCorrupt(
+                    f"checkpoint shard {e['file']!r} for {key!r} has shape "
+                    f"{tuple(data.shape)}, index says {want}")
+            if verify and e.get("sum") is not None \
+                    and _digest(data) != e["sum"]:
+                raise CheckpointCorrupt(
+                    f"checkpoint shard {e['file']!r} for {key!r} fails its "
+                    "content checksum — bit rot or a torn write; refusing "
+                    "to load it")
             sl = tuple(slice(a, b) for a, b in e["slices"])
             full[sl] = data.astype(full.dtype) if cast_bf16 else data
             boxes.append([tuple(p) for p in e["slices"]])
@@ -193,12 +330,13 @@ def load_sharded(path, template=None, return_numpy=False):
             out[key] = arr
             continue
         tpl = tpl_flat.get(key)
-        if tpl is not None and isinstance(
-                getattr(tpl._data, "sharding", None),
+        tpl_arr = tpl._data if isinstance(tpl, Tensor) else tpl
+        if tpl_arr is not None and isinstance(
+                getattr(tpl_arr, "sharding", None),
                 jax.sharding.NamedSharding):
             # adopt the template's mesh placement (the cross-plan reshard);
             # non-mesh params stay UNCOMMITTED so jit may place them freely
-            arr = jax.device_put(arr, tpl._data.sharding)
+            arr = jax.device_put(arr, tpl_arr.sharding)
         else:
             import jax.numpy as jnp
             arr = jnp.asarray(arr)
@@ -224,10 +362,10 @@ def _check_coverage(key, shape, boxes):
         for b in boxes[i + 1:]:
             if all(lo1 < hi2 and lo2 < hi1
                    for (lo1, hi1), (lo2, hi2) in zip(a, b)):
-                raise ValueError(
+                raise CheckpointCorrupt(
                     f"checkpoint shards for '{key}' overlap: {a} vs {b}")
     if vol != total:
-        raise ValueError(
+        raise CheckpointIncomplete(
             f"checkpoint shard files for '{key}' cover {vol} of {total} "
             f"elements of {shape} — incomplete multi-host save?")
 

@@ -159,7 +159,19 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None,
+            checkpoint_manager=None):
+        """``checkpoint_manager``: a `paddle_tpu.train.CheckpointManager`
+        makes the fit loop preemption-safe on the fused GPT route — it
+        binds to the scanned step, resumes from LATEST (restoring params,
+        optimizer state, rng, and the [epoch, batch] cursor; already-
+        consumed batches of the resume epoch are skipped, which assumes a
+        deterministic loader order — pass shuffle=False or a seeded
+        sampler), checkpoints every ``manager.every`` optimizer steps, and
+        on SIGTERM (`manager.install_sigterm()`) finishes the current
+        accumulation group, writes a final synchronous checkpoint, and
+        stops training cleanly. `TooManyBadSteps` from the bad-step ladder
+        propagates to the caller with the state already rolled back."""
         from paddle_tpu.hapi.callbacks import config_callbacks
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
@@ -180,18 +192,60 @@ class Model:
                                 metrics=["loss"] + self._metric_names())
         k = max(1, int(accumulate_grad_batches or 1))
         fused = self._maybe_fused_step(k) if k >= 1 else None
+        mgr = checkpoint_manager
+        resume_epoch, resume_batch, mgr_cursor = 0, -1, None
+        if mgr is not None:
+            if fused is None:
+                raise ValueError(
+                    "checkpoint_manager needs the fused scanned GPT route "
+                    "(GPTForCausalLM training on its own causal-LM loss "
+                    "with a scan-fusable optimizer and no streaming "
+                    "metrics) — the eager per-batch path has no "
+                    "preemption-safe capture")
+            if isinstance(train_data, Dataset) and shuffle:
+                # resume skips batches BY LOADER INDEX: a reshuffled
+                # restart would skip different samples than were trained,
+                # silently double-training some and dropping others
+                raise ValueError(
+                    "checkpoint_manager resume replays the loader by "
+                    "batch index — pass shuffle=False (or supply your own "
+                    "deterministically-ordered DataLoader)")
+            mgr.bind(fused)
+            restored = mgr.restore()
+            if restored is not None:
+                cur = restored.get("data_cursor")
+                if not (isinstance(cur, (list, tuple)) and len(cur) == 2):
+                    # an int cursor (CheckpointManager.run) or a
+                    # cursor-less manual save: fit cannot know which
+                    # loader batches were consumed — resuming from epoch 0
+                    # would silently double-train them
+                    raise ValueError(
+                        f"checkpoint at {restored['path']} has data_cursor="
+                        f"{cur!r}; Model.fit resume needs the [epoch, "
+                        "batch] cursor fit itself writes — resume this "
+                        "checkpoint with CheckpointManager.run instead")
+                resume_epoch, resume_batch = int(cur[0]), int(cur[1])
         cbks.on_begin("train")
         for epoch in range(epochs):
             if self.stop_training:
                 break
+            if mgr is not None and epoch < resume_epoch:
+                continue          # fully consumed before the preemption
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             logs = {}
             buf, pending, last_loss = [], 0, None
+            consumed = -1          # last loader index actually trained on
             for step, batch in enumerate(train_loader):
                 if num_iters is not None and step >= num_iters:
                     break
+                if mgr is not None and epoch == resume_epoch \
+                        and step <= resume_batch:
+                    continue      # consumed before the preemption (the
+                    # cursor lands on group boundaries, so no partial
+                    # accumulation group is ever split across a resume)
+                consumed = step
                 cbks.on_batch_begin("train", step, logs)
                 ins, labels = self._split_batch(batch)
                 if fused is not None:
@@ -199,6 +253,11 @@ class Model:
                     if len(buf) == k:
                         last_loss = self._fused_apply(fused, buf)
                         buf = []
+                        if mgr is not None:
+                            mgr_cursor = [epoch, step]
+                            mgr.after_step(data_cursor=mgr_cursor)
+                            if mgr.should_stop:
+                                self.stop_training = True
                     # before the first apply there IS no loss yet: omit the
                     # key rather than poison callbacks with NaN
                     logs = (self._result_to_logs([last_loss], step,
@@ -212,10 +271,28 @@ class Model:
                                               loss_divisor=k)
                     logs = self._result_to_logs(result, step, batch_size)
                 cbks.on_batch_end("train", step, logs)
+                if self.stop_training:
+                    break         # SIGTERM preemption: group boundary
+                    # reached, buf is empty, final checkpoint below
             if fused is not None and buf:
                 # leftover partial accumulation group at epoch end
                 last_loss = self._fused_apply(fused, buf)
                 logs["loss"] = last_loss
+                if mgr is not None:
+                    # the leftover apply is an optimizer step like any
+                    # other: move the cursor past its batches and run the
+                    # ladder/periodic save, or a later checkpoint would
+                    # pair post-apply state with a pre-apply cursor and
+                    # resume would double-apply these gradients. Cursor =
+                    # last CONSUMED index — on a num_iters break `step`
+                    # names a batch that never trained. The stop flag is
+                    # honored here too: a loader whose epochs never fill a
+                    # group only ever applies through THIS branch, and
+                    # SIGTERM must not be deferred past it
+                    mgr_cursor = [epoch, consumed]
+                    mgr.after_step(data_cursor=mgr_cursor)
+                    if mgr.should_stop:
+                        self.stop_training = True
             elif pending:
                 # flush generic-path leftover grads: they accumulated as
                 # sum(g_i)/k over only `pending` batches — rescale to the
@@ -232,11 +309,20 @@ class Model:
                 pending = 0
             if fused is not None:
                 self._sync_fused()   # state_dict/parameters see the epoch
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0 \
+                    and not (mgr is not None and mgr.should_stop):
+                # draining on SIGTERM: don't spend the eviction grace
+                # window on eval — the final checkpoint below is the
+                # contract, the eval can rerun after the resume
                 eval_logs = self._run_eval(eval_loader, batch_size)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
         cbks.on_end("train", logs if "logs" in dir() else {})
+        if mgr is not None:
+            # drain any in-flight async write and leave a final complete
+            # checkpoint — on the SIGTERM path this IS the graceful-drain
+            # contract: rc 0 with the trained state durably on disk
+            mgr.finalize(data_cursor=mgr_cursor)
         return self
 
     def _run_eval(self, eval_loader, batch_size):

@@ -42,6 +42,15 @@ Sites currently wired (the catalog lives in docs/ROBUSTNESS.md):
                           reading a request body (slow-client simulation)
 ``serve.socket_drop``     serve's client loop drops the connection before
                           answering (network partition mid-request)
+``train.step_nan``        `ScanTrainStep.step` feeds a NaN through the
+                          program's finite-reduce INPUT — the bad-step skip
+                          path runs in the warm program (no recompile)
+``ckpt.write_truncate``   `save_sharded` truncates the shard file it just
+                          wrote (torn-write simulation; load must refuse by
+                          checksum with `CheckpointCorrupt`)
+``ckpt.crash_between_shards``  `save_sharded` dies between shard files (the
+                          checkpoint must stay INVISIBLE: no index, no
+                          COMPLETE, LATEST untouched)
 ========================  ====================================================
 """
 from __future__ import annotations

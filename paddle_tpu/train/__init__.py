@@ -1,10 +1,21 @@
-"""Training-step capture: the scan-over-layers donated GPT hot path.
+"""Training-step capture + fault tolerance.
 
-See scan_step.py — stacked [nl, ...] params, lax.scan forward/backward,
+scan_step.py — stacked [nl, ...] params, lax.scan forward/backward,
 gradient-accumulation microbatching, ZeRO-1 sharded optimizer update,
-buffer donation. Engine (distributed/auto_parallel.py) and hapi Model
-route here when the (model, optimizer) pair supports it.
+buffer donation, in-program bad-step skip. Engine
+(distributed/auto_parallel.py) and hapi Model route here when the
+(model, optimizer) pair supports it.
+
+fault_tolerance.py — preemption-safe checkpointing around the step:
+durable checksummed checkpoints with a crash-consistent LATEST pointer,
+SIGTERM -> drain -> checkpoint -> exit, kill -9 resume with bit-identical
+loss trajectory, and the consecutive-bad-step rollback ladder.
 """
 from paddle_tpu.train.scan_step import ScanTrainStep, ScanUnsupported
+from paddle_tpu.train.fault_tolerance import (CheckpointCorrupt,
+                                              CheckpointIncomplete,
+                                              CheckpointManager,
+                                              TooManyBadSteps)
 
-__all__ = ["ScanTrainStep", "ScanUnsupported"]
+__all__ = ["ScanTrainStep", "ScanUnsupported", "CheckpointManager",
+           "TooManyBadSteps", "CheckpointCorrupt", "CheckpointIncomplete"]

@@ -34,6 +34,7 @@ from paddle_tpu.observability import metrics
 from paddle_tpu.observability.flight_recorder import (Watchdog,
                                                       default_deadline,
                                                       flight)
+from paddle_tpu.testing import faults
 
 
 # per-chip peak for MFU denominators — bench.py imports THIS constant so
@@ -122,14 +123,21 @@ class ScanTrainStep:
         self._dirty = False
         self._compiles = 0
         self._seen_sigs = set()
+        # bad-step containment (docs/ROBUSTNESS.md "Training fault
+        # tolerance"): the program reduces an all-finite flag over loss +
+        # grads and SKIPS the optimizer apply when it trips — same program,
+        # zero recompiles. The host-side ladder lives in CheckpointManager.
+        self.bad_steps = 0
+        self.consecutive_bad_steps = 0
+        self.last_step_ok = True
         self.refresh_from_model()
         if self.mesh is not None:
             # pin the output placements to the input placements: params and
             # opt state come back exactly where they went in, so the SECOND
             # step sees identical (aval, sharding) signatures and the
             # program compiles exactly once on the mesh
-            out_sh = (NamedSharding(self.mesh, PartitionSpec()),
-                      self._param_sh, self._state_sh)
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            out_sh = (repl, repl, self._param_sh, self._state_sh)
             self._jit = jax.jit(self._make_step_fn(),
                                 donate_argnums=(0, 1), out_shardings=out_sh)
         else:
@@ -321,11 +329,22 @@ class ScanTrainStep:
             return lsum * inv, jax.tree_util.tree_map(
                 lambda a: a * inv, gsum)
 
-        def step_fn(params, opt_state, xs, ys, ms, lr, t, key_data):
+        def step_fn(params, opt_state, xs, ys, ms, lr, t, key_data, poison):
             key = jax.random.wrap_key_data(key_data)
             mkeys = jax.random.split(key, xs.shape[0])
             loss, grads = grads_of(params, xs, ys, ms if use_mask else None,
                                    mkeys)
+            # poison: 0.0 normally, NaN when the train.step_nan fault is
+            # armed — rides the loss into the finite reduce below so chaos
+            # tests drive the skip path through the SAME compiled program
+            loss = loss + poison
+            # all-finite reduce over loss + raw (pre-clip) grads: one
+            # non-finite value anywhere makes ok False and the apply below
+            # becomes the identity — the step is SKIPPED in-program, no
+            # host round-trip, no recompile (test_no_retrace.py pin)
+            ok = jnp.isfinite(loss)
+            for gk in _leaf_keys(grads):
+                ok = ok & jnp.all(jnp.isfinite(grads[gk[0]][gk[1]]))
             if clip_norm is not None:
                 sq = jnp.zeros((), jnp.float32)
                 for gk in _leaf_keys(grads):
@@ -339,6 +358,7 @@ class ScanTrainStep:
             for grp, k in _leaf_keys(params):
                 p, g = params[grp][k], grads[grp][k]
                 st, mt = opt_state[grp][k], meta[(grp, k)]
+                st0 = st               # pre-update state: the skip target
                 if mt["zsh"] is not None:
                     # ZeRO-1: grads + moments dp-sharded, so the update math
                     # partitions over dp and each replica touches only its
@@ -360,11 +380,15 @@ class ScanTrainStep:
                     out = {n: jax.lax.with_sharding_constraint(v, mt["zsh"])
                            for n, v in out.items()}
                 new_p = new_p32.astype(p.dtype)
+                # non-finite step: keep the OLD params/state (NaNs computed
+                # on the not-taken side are discarded by the select)
+                new_p = jnp.where(ok, new_p, p)
+                out = {n: jnp.where(ok, v, st0[n]) for n, v in out.items()}
                 if mt["psh"] is not None:
                     new_p = jax.lax.with_sharding_constraint(new_p, mt["psh"])
                 new_params[grp][k] = new_p
                 new_state[grp][k] = out
-            return loss, new_params, new_state
+            return loss, ok, new_params, new_state
 
         return step_fn
 
@@ -399,6 +423,10 @@ class ScanTrainStep:
         lr = jnp.asarray(self.opt.get_lr(), jnp.float32)
         t = jnp.asarray(self.opt._global_step + 1, jnp.float32)
         self._key, sub = jax.random.split(self._key)
+        # train.step_nan chaos site: poison is a PROGRAM INPUT (0.0 or NaN),
+        # so an injected bad step exercises the warm program, not a retrace
+        injected = faults.ENABLED and faults.fire("train.step_nan")
+        poison = jnp.asarray(float("nan") if injected else 0.0, jnp.float32)
         before = self._cache_size()
         # dispatch marker BEFORE the jit call: if the step (or its compile)
         # wedges, the watchdog dump's last ring event shows WHERE — a
@@ -408,10 +436,11 @@ class ScanTrainStep:
         t0 = time.perf_counter()
         from jax.experimental import disable_x64
         with disable_x64():
-            loss, self._params, self._opt_state = self._jit(
+            loss, ok, self._params, self._opt_state = self._jit(
                 self._params, self._opt_state, xs, ys, ms, lr, t,
-                jax.random.key_data(sub))
+                jax.random.key_data(sub), poison)
         lossf = float(loss)                        # sync: real device time
+        okb = bool(ok)
         dt = time.perf_counter() - t0
         after = self._cache_size()
         if before >= 0 and after >= 0:
@@ -435,7 +464,7 @@ class ScanTrainStep:
             metrics.counter("train.compile_count").inc()
             metrics.gauge("train.compile_ms").set(dt * 1e3)
             metrics.add_span("train.compile", t0, dt, cat="compile")
-        else:
+        elif okb:
             metrics.gauge("train.step_ms").set(dt * 1e3)
             metrics.histogram("train.step_seconds").observe(dt)
             # goodput + model FLOPs utilization from the ANALYTIC flop
@@ -448,6 +477,21 @@ class ScanTrainStep:
                 tokens / max(dt, 1e-9))
         metrics.counter("train.steps").inc()
         metrics.counter("train.microbatches").inc(m)
+        self.last_step_ok = okb
+        if not okb:
+            # non-finite loss/grads: the program kept the old params/state,
+            # so the step NEVER HAPPENED as far as the optimizer clock, the
+            # lr schedule, and the token/goodput accounting are concerned.
+            # The host only counts it and flight-records — the rollback
+            # ladder (M consecutive) is CheckpointManager.after_step's job.
+            self.bad_steps += 1
+            self.consecutive_bad_steps += 1
+            metrics.counter("train.bad_steps").inc()
+            flight.record("train.bad_step", step=self.opt._global_step + 1,
+                          loss=lossf, consecutive=self.consecutive_bad_steps,
+                          injected=bool(injected))
+            return lossf
+        self.consecutive_bad_steps = 0
         metrics.counter("train.tokens").inc(tokens)
         flight.record("train.step", step=self.opt._global_step + 1,
                       loss=lossf, ms=round(dt * 1e3, 3),

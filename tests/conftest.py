@@ -45,9 +45,11 @@ def pytest_configure(config):
         "slow: long-running test, deselected by default (pass --runslow)")
     config.addinivalue_line(
         "markers",
-        "chaos: deterministic fault-injection test (tests/test_chaos.py, "
-        "docs/ROBUSTNESS.md) — armed via paddle_tpu.testing.faults, runs "
-        "in tier-1 (select with -m chaos, exclude with -m 'not chaos')")
+        "chaos: deterministic fault-injection test (tests/test_chaos.py "
+        "for serving, tests/test_train_chaos.py for training fault "
+        "tolerance; docs/ROBUSTNESS.md) — armed via "
+        "paddle_tpu.testing.faults, runs in tier-1 (select with -m chaos, "
+        "exclude with -m 'not chaos')")
     config.addinivalue_line(
         "markers",
         "timeout(seconds): per-test wall-clock limit, enforced by the "

@@ -303,3 +303,41 @@ def test_cancel_and_deadline_paths_zero_recompiles():
     assert _compile_counters() == frozen, (
         "cancel/deadline retirement recompiled after warmup: containment "
         "must be shape-invariant")
+
+
+def test_bad_step_skip_and_rollback_zero_recompiles(tmp_path):
+    """Bad-step containment is IN-PROGRAM (paddle_tpu/train): a non-finite
+    step selects the old params/opt-state inside the same donated program,
+    and a checkpoint rollback re-places arrays under identical shardings —
+    neither may ever retrace the train step after warmup."""
+    import pytest
+    from paddle_tpu.testing import faults
+    from paddle_tpu.train import (CheckpointManager, ScanTrainStep,
+                                  TooManyBadSteps)
+    m = _tiny_model()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    step = ScanTrainStep(m, opt, microbatches=1)
+    mgr = CheckpointManager(str(tmp_path), step, max_consecutive_bad=2)
+    rng = np.random.RandomState(3)
+
+    def batch():
+        ids = rng.randint(0, 64, (2, 9))
+        return ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int64)
+
+    step.step(*batch())                        # warmup: the ONE compile
+    mgr.save(data_cursor=1, sync=True)
+    frozen = _compile_counters()
+    try:
+        faults.arm("train.step_nan", times=3)
+        step.step(*batch())                    # bad: skip path, warm program
+        step.step(*batch())                    # bad again: ladder trips
+        with pytest.raises(TooManyBadSteps):
+            mgr.after_step()                   # rollback to the checkpoint
+    finally:
+        faults.disarm()
+    step.step(*batch())                        # post-rollback healthy step
+    assert step.compile_count == 1, (
+        f"bad-step/rollback retraced the train step: {step.compile_count}")
+    assert _compile_counters() == frozen, (
+        "bad-step skip or checkpoint rollback recompiled after warmup")

@@ -371,6 +371,12 @@ def test_bench_smoke_emits_structured_json():
     assert d["cancelled"] >= 1
     assert d["metrics"]["counters"]["engine.shed"] >= 1
     assert d["metrics"]["counters"]["engine.cancelled"] >= 1
+    # r9: the smoke run exercises one save -> kill -> resume cycle on the
+    # scanned train step (train fault tolerance, docs/ROBUSTNESS.md): the
+    # resumed step's loss matched the uninterrupted continuation exactly
+    assert d["resume_ok"] is True
+    assert d["metrics"]["counters"]["train.checkpoints"] >= 1
+    assert d["metrics"]["counters"]["train.resumes"] >= 1
 
 
 def test_bench_emission_survives_failing_platform_plugin(tmp_path):

@@ -1,0 +1,946 @@
+"""Training fault-tolerance chaos suite (docs/ROBUSTNESS.md "Training
+fault tolerance").
+
+The contract under test: a training run survives the preemptible-fleet
+failure modes — SIGTERM with a grace window, SIGKILL with none, torn or
+bit-rotted checkpoint writes, and non-finite steps — without ever (a)
+silently loading a corrupt checkpoint, (b) publishing a partial one, or
+(c) training on garbage after NaNs. Resume is BIT-IDENTICAL on one
+replica (loss trajectories compared as exact reprs across a real
+``kill -9``) and float-ulp across a mesh reshard.
+
+Every test is deterministic: faults fire exact counts at named sites
+(``train.step_nan``, ``ckpt.write_truncate``,
+``ckpt.crash_between_shards`` — paddle_tpu/testing/faults.py), and the
+two subprocess drills signal on observed stdout markers, not timers."""
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint import (CheckpointCorrupt,
+                                               CheckpointIncomplete,
+                                               async_save, load_sharded,
+                                               save_sharded)
+from paddle_tpu.distributed.mesh import auto_mesh, get_mesh, set_mesh
+from paddle_tpu.observability import metrics
+from paddle_tpu.testing import faults
+from paddle_tpu.train import CheckpointManager, TooManyBadSteps
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _restore_mesh():
+    prev = get_mesh()
+    yield
+    set_mesh(prev)
+
+
+def _tiny_step(seed=5, microbatches=1):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.train import ScanTrainStep
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+                    intermediate_size=32, max_position_embeddings=8,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    return ScanTrainStep(m, opt, microbatches=microbatches)
+
+
+def _batch(i, b=2, s=8, vocab=64):
+    rng = np.random.RandomState(1000 + i)
+    ids = rng.randint(0, vocab, (b, s + 1))
+    return ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int32)
+
+
+def _counter(name):
+    return metrics.snapshot()["counters"].get(name, 0)
+
+
+# ---------------------------------------------------- checkpoint integrity
+
+
+def _simple_state():
+    paddle.seed(3)
+    return {"w": paddle.randn([4, 4]), "b": paddle.randn([4]),
+            "step": 7}
+
+
+def test_checksum_bitflip_refused(tmp_path):
+    """A flipped byte in a shard file fails its recorded content hash:
+    load refuses with CheckpointCorrupt, never returns the bad values."""
+    d = str(tmp_path / "c")
+    save_sharded(_simple_state(), d)
+    shard = next(f for f in sorted(os.listdir(d))
+                 if f.startswith("w") and f.endswith(".npy"))
+    p = os.path.join(d, shard)
+    blob = bytearray(open(p, "rb").read())
+    blob[-1] ^= 0xFF                      # corrupt payload, header intact
+    open(p, "wb").write(bytes(blob))
+    with pytest.raises(CheckpointCorrupt, match="checksum"):
+        load_sharded(d)
+
+
+def test_truncated_shard_refused(tmp_path):
+    """`ckpt.write_truncate` tears the write after the checksum was
+    recorded — exactly what a crash mid-flush leaves behind. Refused."""
+    d = str(tmp_path / "t")
+    with faults.scoped("ckpt.write_truncate", times=1):
+        save_sharded(_simple_state(), d)
+    assert faults.fired("ckpt.write_truncate") == 1
+    with pytest.raises(CheckpointCorrupt):
+        load_sharded(d)
+
+
+def test_version_stamp_mismatch_refused(tmp_path):
+    """An index stamped by an incompatible (newer) format version must be
+    refused outright, not half-interpreted."""
+    import json
+    d = str(tmp_path / "v")
+    save_sharded(_simple_state(), d)
+    for name in os.listdir(d):
+        if name.startswith("index.") and name.endswith(".json"):
+            p = os.path.join(d, name)
+            idx = json.load(open(p))
+            assert idx["__ckpt_meta__"]["version"] == 2
+            idx["__ckpt_meta__"]["version"] = 99
+            json.dump(idx, open(p, "w"))
+    with pytest.raises(CheckpointCorrupt, match="version"):
+        load_sharded(d)
+
+
+def test_missing_index_refused(tmp_path):
+    with pytest.raises(CheckpointIncomplete, match="index"):
+        load_sharded(str(tmp_path))
+
+
+def test_missing_shard_refused(tmp_path):
+    d = str(tmp_path / "m")
+    save_sharded(_simple_state(), d)
+    victim = next(f for f in os.listdir(d) if f.endswith(".npy"))
+    os.remove(os.path.join(d, victim))
+    with pytest.raises(CheckpointIncomplete, match="missing"):
+        load_sharded(d)
+
+
+def test_legacy_unstamped_checkpoint_still_loads(tmp_path):
+    """Pre-checksum checkpoints (no version stamp, no sums) keep loading —
+    they simply skip verification. Retired only on a format bump."""
+    import json
+    d = str(tmp_path / "l")
+    state = _simple_state()
+    save_sharded(state, d)
+    for name in os.listdir(d):
+        if name.startswith("index.") and name.endswith(".json"):
+            p = os.path.join(d, name)
+            idx = json.load(open(p))
+            idx.pop("__ckpt_meta__", None)
+            for meta in idx.values():
+                for e in meta.get("shards", []):
+                    e.pop("sum", None)
+            json.dump(idx, open(p, "w"))
+    out = load_sharded(d, return_numpy=True)
+    np.testing.assert_array_equal(out["w"], np.asarray(state["w"]._data))
+    assert out["step"] == 7
+
+
+def test_missing_latest_refused(tmp_path):
+    """The rollback path must fail LOUDLY when there is nothing to resume
+    from — restarting from init silently would be the worst outcome."""
+    mgr = CheckpointManager(str(tmp_path / "empty"))
+    assert mgr.restore() is None
+    with pytest.raises(CheckpointIncomplete, match="LATEST"):
+        mgr.restore(require=True)
+
+
+# ------------------------------------------------- crash-consistent LATEST
+
+
+def test_crash_between_shards_stays_invisible(tmp_path):
+    """A save that dies between shard files publishes NOTHING: LATEST
+    still names the previous checkpoint and restore lands there."""
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path / "cc"), step, keep=3)
+    step.step(*_batch(0))
+    mgr.save(data_cursor=1, sync=True)
+    ref = np.asarray(step._params["top"]["gpt.wte.weight"])
+    step.step(*_batch(1))
+    with faults.scoped("ckpt.crash_between_shards", times=1):
+        with pytest.raises(faults.FaultInjected):
+            mgr.save(data_cursor=2, sync=True)
+    assert mgr.latest() is not None and mgr.latest()[0] == 1
+    assert [n for n, _ in mgr.complete_checkpoints()] == [1]
+    info = mgr.restore(require=True)
+    assert info["step"] == 1 and info["data_cursor"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(step._params["top"]["gpt.wte.weight"]), ref)
+
+
+def test_retention_prunes_complete_never_resumed(tmp_path):
+    """keep-last-N sweeps old complete checkpoints and crash leftovers,
+    but NEVER the LATEST target or the checkpoint currently resumed from
+    — even after newer saves push it out of the keep window."""
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path / "r"), step, every=1, keep=2)
+    losses = mgr.run(lambda i: _batch(i), until_step=5)
+    assert len(losses) == 5
+    kept = [n for n, _ in mgr.complete_checkpoints()]
+    assert kept == [4, 5], kept
+    # fresh manager resumes from step 5, trains on: the resumed-from dir
+    # survives pruning while 6,7,8 rotate through the keep=2 window
+    step2 = _tiny_step(seed=99)
+    mgr2 = CheckpointManager(str(tmp_path / "r"), step2, every=1, keep=2)
+    mgr2.run(lambda i: _batch(i), until_step=8)
+    kept = [n for n, _ in mgr2.complete_checkpoints()]
+    assert 5 in kept and kept[-2:] == [7, 8], kept
+
+
+# ------------------------------------------------------- async-save hygiene
+
+
+def test_async_write_failure_surfaces_on_wait(tmp_path):
+    """A background write that dies must re-raise on the next wait()/save,
+    not vanish in a daemon thread."""
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path / "a"), step, use_async=True)
+    step.step(*_batch(0))
+    with faults.scoped("ckpt.crash_between_shards", times=1):
+        mgr.save(data_cursor=1)          # async: returns before the crash
+        with pytest.raises(faults.FaultInjected):
+            mgr.wait()
+    assert mgr.latest() is None          # nothing was published
+    mgr.save(data_cursor=1, sync=True)   # and the manager recovered
+    assert mgr.latest()[0] == 1
+
+
+def test_async_snapshot_immune_to_later_steps(tmp_path):
+    """async_save copies device state to host ON THE CALLING THREAD; the
+    donated buffers the next steps destroy must not leak into the write."""
+    step = _tiny_step()
+    step.step(*_batch(0))
+    ref = {"wte": np.array(np.asarray(step._params["top"]["gpt.wte.weight"])),
+           "m1": np.array(np.asarray(
+               step._opt_state["top"]["gpt.wte.weight"]["moment1"]))}
+    h = async_save({"params": step._params, "opt": step._opt_state},
+                   str(tmp_path / "s"))
+    step.step(*_batch(1))                # donates/overwrites device buffers
+    step.step(*_batch(2))
+    h.wait()
+    out = load_sharded(str(tmp_path / "s"), return_numpy=True)
+    np.testing.assert_array_equal(out["params/top/gpt.wte.weight"],
+                                  ref["wte"])
+    np.testing.assert_array_equal(
+        out["opt/top/gpt.wte.weight/moment1"], ref["m1"])
+    # ...and the live state HAS moved on (the snapshot is a snapshot)
+    assert not np.array_equal(
+        np.asarray(step._params["top"]["gpt.wte.weight"]), ref["wte"])
+
+
+# ------------------------------------------------------ bad-step containment
+
+
+def test_bad_step_skips_apply_and_clock(tmp_path):
+    """One injected NaN: loss reads non-finite, params/opt-state/step
+    clock/lr all unchanged, `train.bad_steps` counts it — and the next
+    step trains normally through the same program."""
+    step = _tiny_step()
+    step.step(*_batch(0))
+    gs = step.opt._global_step
+    wte = np.array(np.asarray(step._params["top"]["gpt.wte.weight"]))
+    m1 = np.array(np.asarray(
+        step._opt_state["top"]["gpt.wte.weight"]["moment1"]))
+    bad0 = _counter("train.bad_steps")
+    with faults.scoped("train.step_nan", times=1):
+        loss = step.step(*_batch(1))
+    assert not np.isfinite(loss)
+    assert not step.last_step_ok and step.consecutive_bad_steps == 1
+    assert step.opt._global_step == gs          # clock did not advance
+    np.testing.assert_array_equal(
+        np.asarray(step._params["top"]["gpt.wte.weight"]), wte)
+    np.testing.assert_array_equal(
+        np.asarray(step._opt_state["top"]["gpt.wte.weight"]["moment1"]), m1)
+    assert _counter("train.bad_steps") == bad0 + 1
+    loss = step.step(*_batch(2))                # recovers
+    assert np.isfinite(loss) and step.consecutive_bad_steps == 0
+    assert step.compile_count == 1              # skip path = same program
+
+
+def test_rollback_after_consecutive_bad_steps(tmp_path):
+    """M consecutive non-finite steps: the manager restores the last
+    checkpoint and raises typed TooManyBadSteps — never trains on
+    garbage, never dies with a bare NaN."""
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path / "rb"), step, every=2, keep=2,
+                            max_consecutive_bad=2)
+    mgr.run(lambda i: _batch(i), until_step=2)
+    ckpt_wte = np.array(np.asarray(step._params["top"]["gpt.wte.weight"]))
+    rb0 = _counter("train.rollbacks")
+    faults.arm("train.step_nan", times=-1)
+    try:
+        with pytest.raises(TooManyBadSteps, match="rolled back to step 2"):
+            mgr.run(lambda i: _batch(i), until_step=9, resume=False,
+                    data_cursor=2)
+    finally:
+        faults.disarm()
+    assert _counter("train.rollbacks") == rb0 + 1
+    assert step.opt._global_step == 2
+    np.testing.assert_array_equal(
+        np.asarray(step._params["top"]["gpt.wte.weight"]), ckpt_wte)
+    # state is rolled back and healthy: training continues to completion
+    losses = mgr.run(lambda i: _batch(i), until_step=4, resume=False,
+                     data_cursor=4)
+    assert len(losses) == 2 and all(np.isfinite(l) for l in losses)
+
+
+def test_restore_refuses_checkpoint_missing_leaves(tmp_path):
+    """A checkpoint that lacks leaves the bound step needs (older model
+    config, different optimizer slots) must refuse with
+    CheckpointIncomplete — silently keeping the fresh random init for the
+    missing leaves would be a half-restored model with no error."""
+    import json
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path / "ml"), step)
+    step.step(*_batch(0))
+    d = mgr.save(data_cursor=1, sync=True)
+    for name in os.listdir(d):
+        if name.startswith("index.") and name.endswith(".json"):
+            p = os.path.join(d, name)
+            idx = json.load(open(p))
+            victim = next(k for k in idx if k.startswith("params/"))
+            del idx[victim]
+            json.dump(idx, open(p, "w"))
+    step2 = _tiny_step(seed=99)
+    mgr2 = CheckpointManager(str(tmp_path / "ml"), step2)
+    with pytest.raises(CheckpointIncomplete, match="leaves"):
+        mgr2.restore(require=True)
+
+
+def test_restore_refuses_checkpoint_extra_leaves(tmp_path):
+    """The opposite direction: a checkpoint carrying leaves the bound step
+    has no slot for must refuse typed at restore time — silently inserting
+    them into the pytree would make the next step retrace and die with an
+    untyped KeyError mid-trace."""
+    import json
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path / "xl"), step)
+    step.step(*_batch(0))
+    d = mgr.save(data_cursor=1, sync=True)
+    for name in os.listdir(d):
+        if name.startswith("index.") and name.endswith(".json"):
+            p = os.path.join(d, name)
+            idx = json.load(open(p))
+            src = next(k for k in idx if k.startswith("params/top/"))
+            idx["params/top/ghost.weight"] = idx[src]
+            json.dump(idx, open(p, "w"))
+    step2 = _tiny_step(seed=99)
+    with pytest.raises(CheckpointCorrupt, match="no slot"):
+        CheckpointManager(str(tmp_path / "xl"), step2).restore(require=True)
+
+
+def test_fit_num_iters_cursor_records_last_consumed(tmp_path):
+    """num_iters truncation + a leftover accumulation group: the break
+    fires on a batch that never trained, and the checkpoint cursor must
+    name the last CONSUMED index, not the break index — over-advancing
+    would make resume silently skip a never-trained batch."""
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.io import Dataset
+
+    class Toy(Dataset):
+        def __init__(self):
+            rng = np.random.RandomState(0)
+            self.rows = [rng.randint(0, 64, 9).astype(np.int32)
+                         for _ in range(6)]
+
+        def __len__(self):
+            return len(self.rows)
+
+        def __getitem__(self, i):
+            return self.rows[i][:-1], self.rows[i][1:].astype(np.int64)
+
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(5)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                    num_heads=2, intermediate_size=32,
+                    max_position_embeddings=8, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    mgr = CheckpointManager(str(tmp_path), every=100)   # only final save
+    # k=2 over batches 0..2 (num_iters=3): one full group (0,1) + a
+    # leftover (2); batch 3 hits the break without training
+    Model(net).prepare(optimizer=opt).fit(
+        Toy(), batch_size=2, epochs=1, shuffle=False, verbose=0,
+        num_iters=3, accumulate_grad_batches=2, checkpoint_manager=mgr)
+    lat = mgr.latest()
+    assert lat is not None and lat[0] == 2              # two applies
+    loaded = load_sharded(lat[1], return_numpy=True)
+    assert loaded["meta/data_cursor"] == [0, 2], loaded["meta/data_cursor"]
+
+
+def test_finalize_persists_bad_step_cursor_advance(tmp_path):
+    """A bad step advances the DATA cursor without advancing the step
+    clock; the final checkpoint must persist that advance — or every
+    resume would re-feed the same NaN-producing batch (review finding:
+    finalize used to skip whenever global_step matched LATEST)."""
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path / "bc"), step, every=1, keep=3,
+                            max_consecutive_bad=5)
+
+    def batch_fn(i):
+        return _batch(i)
+
+    # good step 1 -> periodic save with cursor 1; then ONE bad batch
+    # (cursor -> 2, clock stays 1); then a clean preemption
+    faults.arm("train.step_nan", times=1)
+    try:
+        step.step(*batch_fn(0))
+        mgr.after_step(data_cursor=1)
+        step.step(*batch_fn(1))          # the armed NaN batch
+        mgr.after_step(data_cursor=2)
+    finally:
+        faults.disarm()
+    mgr.finalize(data_cursor=2)
+    info = CheckpointManager(str(tmp_path / "bc"),
+                             _tiny_step(seed=99)).restore(require=True)
+    assert info["step"] == 1
+    assert info["data_cursor"] == 2, (
+        "resume would replay the NaN batch: cursor advance was dropped")
+
+
+def test_rollback_without_checkpoint_is_typed(tmp_path):
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path / "nc"), step,
+                            max_consecutive_bad=1)
+    with faults.scoped("train.step_nan", times=1):
+        step.step(*_batch(0))
+    with pytest.raises(TooManyBadSteps, match="no checkpoint"):
+        mgr.after_step()
+
+
+def test_run_refuses_fit_style_cursor(tmp_path):
+    """The symmetric direction: a fit-written [epoch, batch] cursor must
+    refuse typed in run() — not crash with an untyped TypeError on
+    int([0, 3])."""
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path), step)
+    step.step(*_batch(0))
+    mgr.save(data_cursor=[0, 1], sync=True)    # fit-style cursor
+    step2 = _tiny_step(seed=99)
+    mgr2 = CheckpointManager(str(tmp_path), step2)
+    with pytest.raises(ValueError, match="data_cursor"):
+        mgr2.run(lambda i: _batch(i), until_step=4)
+
+
+def test_fit_drain_honors_stop_on_leftover_only_epochs(tmp_path):
+    """A loader whose epochs never fill an accumulation group applies
+    only through the epoch-end leftover branch — the SIGTERM flag must
+    stop training there too (and the drain must skip eval: the eviction
+    grace window belongs to the final checkpoint)."""
+    from paddle_tpu.hapi.callbacks import Callback
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.io import Dataset
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    eval_reads = []
+
+    class Toy(Dataset):
+        def __init__(self, log=None):
+            rng = np.random.RandomState(0)
+            self.rows = [rng.randint(0, 64, 9).astype(np.int32)
+                         for _ in range(3)]
+            self.log = log
+
+        def __len__(self):
+            return len(self.rows)
+
+        def __getitem__(self, i):
+            if self.log is not None:
+                self.log.append(i)
+            return self.rows[i][:-1], self.rows[i][1:].astype(np.int64)
+
+    paddle.seed(5)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                    num_heads=2, intermediate_size=32,
+                    max_position_embeddings=8, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    mgr = CheckpointManager(str(tmp_path), every=100)
+
+    class Preempt(Callback):
+        def on_batch_end(self, mode, step, logs=None):
+            mgr.request_stop()     # SIGTERM equivalent, first batch
+
+    # k=4 > 3 batches/epoch: every apply is a leftover-branch apply
+    Model(net).prepare(optimizer=opt).fit(
+        Toy(), eval_data=Toy(log=eval_reads), batch_size=1, epochs=5,
+        shuffle=False, verbose=0, accumulate_grad_batches=4,
+        checkpoint_manager=mgr, callbacks=[Preempt()])
+    lat = mgr.latest()
+    assert lat is not None and lat[0] == 1, (
+        "stop flag was deferred past the leftover apply: trained "
+        f"{lat and lat[0]} steps instead of draining after 1")
+    assert eval_reads == [], "drain path spent the grace window on eval"
+
+
+def test_rewrite_crash_keeps_old_checkpoint_durable(tmp_path):
+    """Re-saving at an unchanged step (resume -> cursor-only advance ->
+    finalize) must write a FRESH generation, never degrade the live dir:
+    a crash mid-rewrite leaves the original checkpoint fully restorable
+    (review finding: the old code stripped COMPLETE first)."""
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path / "rw"), step, keep=3)
+    step.step(*_batch(0))
+    mgr.save(data_cursor=1, sync=True)
+    ref = np.array(np.asarray(step._params["top"]["gpt.wte.weight"]))
+    with faults.scoped("ckpt.crash_between_shards", times=1):
+        with pytest.raises(faults.FaultInjected):
+            mgr.save(data_cursor=2, sync=True)   # rewrite at same step dies
+    step2 = _tiny_step(seed=99)
+    info = CheckpointManager(str(tmp_path / "rw"), step2).restore(
+        require=True)
+    assert info["step"] == 1 and info["data_cursor"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(step2._params["top"]["gpt.wte.weight"]), ref)
+    # and a SUCCESSFUL rewrite publishes the new cursor
+    mgr2 = CheckpointManager(str(tmp_path / "rw"), step2)
+    mgr2.finalize(data_cursor=5)
+    assert mgr2._saved_cursor(mgr2.latest()[1]) == 5
+
+
+def test_restore_skips_structurally_broken_complete_dir(tmp_path):
+    """A dir wearing a COMPLETE marker but missing a shard (interrupted
+    prune, manual tampering) must be skipped like a corrupt one, not
+    brick the resume."""
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path / "sb"), step, every=1, keep=3)
+    mgr.run(lambda i: _batch(i), until_step=3)
+    newest = mgr.latest()[1]
+    victim = next(f for f in os.listdir(newest) if f.endswith(".npy"))
+    os.remove(os.path.join(newest, victim))
+    info = CheckpointManager(str(tmp_path / "sb"),
+                             _tiny_step(seed=99)).restore(require=True)
+    assert info["step"] == 2
+
+
+def test_fit_refuses_run_style_cursor(tmp_path):
+    """A checkpoint written by CheckpointManager.run stores an int data
+    cursor; Model.fit cannot map it to loader batches and must refuse
+    typed instead of crashing or silently replaying from epoch 0."""
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.io import Dataset
+
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path), step)
+    step.step(*_batch(0))
+    mgr.save(data_cursor=1, sync=True)     # int cursor, run()-style
+
+    class Toy(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            ids = np.arange(9, dtype=np.int32) + i
+            return ids[:-1], ids[1:].astype(np.int64)
+
+    model = Model(step.model).prepare(optimizer=step.opt)
+    with pytest.raises(ValueError, match="data_cursor"):
+        model.fit(Toy(), batch_size=2, epochs=1, shuffle=False, verbose=0,
+                  checkpoint_manager=CheckpointManager(str(tmp_path)))
+
+
+def test_resume_restores_lr_scheduler_position(tmp_path):
+    """A scheduler-driven lr is training state: resume must restore the
+    schedule POSITION (warmup at step 10k must not restart from epoch 0),
+    and the post-resume loss must still match bit-identically."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.optimizer.lr import NoamDecay
+    from paddle_tpu.train import ScanTrainStep
+
+    def make(seed=5):
+        paddle.seed(seed)
+        cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                        num_heads=2, intermediate_size=32,
+                        max_position_embeddings=8, hidden_dropout=0.0,
+                        attention_dropout=0.0)
+        m = GPTForCausalLM(cfg)
+        sched = NoamDecay(d_model=16, warmup_steps=4, learning_rate=1.0)
+        opt = paddle.optimizer.AdamW(learning_rate=sched,
+                                     parameters=m.parameters())
+        return ScanTrainStep(m, opt, microbatches=1), sched
+
+    step, sched = make()
+    mgr = CheckpointManager(str(tmp_path / "lr"), step)
+    for i in range(3):
+        step.step(*_batch(i))
+        sched.step()                   # mid-warmup: lr changes every step
+    mgr.save(data_cursor=3, sync=True)
+    cont = step.step(*_batch(3))
+
+    step2, sched2 = make(seed=99)
+    assert sched2.last_epoch == 0      # fresh schedule...
+    mgr2 = CheckpointManager(str(tmp_path / "lr"), step2)
+    mgr2.restore(require=True)
+    assert sched2.last_epoch == sched.last_epoch   # ...restored position
+    assert sched2.last_lr == sched.last_lr
+    assert step2.step(*_batch(3)) == cont          # bit-identical
+
+
+def test_restore_skips_corrupt_latest_falls_back(tmp_path):
+    """Bit rot in the newest checkpoint must not brick the resume: the
+    keep-N retention exists so restore can skip the corrupt one (counted)
+    and land on the next-newest verified-good checkpoint."""
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path / "fb"), step, every=1, keep=3)
+    mgr.run(lambda i: _batch(i), until_step=3)
+    newest = mgr.latest()
+    assert newest[0] == 3
+    shard = next(f for f in sorted(os.listdir(newest[1]))
+                 if f.startswith("params") and f.endswith(".npy"))
+    p = os.path.join(newest[1], shard)
+    blob = bytearray(open(p, "rb").read())
+    blob[-1] ^= 0xFF
+    open(p, "wb").write(bytes(blob))
+    skipped0 = _counter("train.resume_corrupt_skipped")
+    step2 = _tiny_step(seed=99)
+    info = CheckpointManager(str(tmp_path / "fb"), step2).restore(
+        require=True)
+    assert info["step"] == 2           # fell back past the rotten one
+    assert _counter("train.resume_corrupt_skipped") == skipped0 + 1
+
+
+def test_run_max_batches_bounds_nan_storm(tmp_path):
+    """With rollback disabled (max_consecutive_bad=0) and every batch
+    producing NaNs, the step clock never advances — max_batches is the
+    termination backstop that keeps run() from spinning forever."""
+    step = _tiny_step()
+    mgr = CheckpointManager(str(tmp_path / "mb"), step,
+                            max_consecutive_bad=0)
+    faults.arm("train.step_nan", times=-1)
+    try:
+        losses = mgr.run(lambda i: _batch(i), until_step=100, resume=False,
+                         max_batches=5)
+    finally:
+        faults.disarm()
+    assert len(losses) == 5 and not any(np.isfinite(l) for l in losses)
+    assert step.opt._global_step == 0
+
+
+def test_fit_shuffle_with_manager_refused(tmp_path):
+    """Resume replays the loader by batch index — fit must refuse the
+    default shuffle=True instead of silently double-training reshuffled
+    samples after a restart."""
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.io import Dataset
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    class Toy(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            ids = np.arange(9, dtype=np.int32) + i
+            return ids[:-1], ids[1:].astype(np.int64)
+
+    paddle.seed(5)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                    num_heads=2, intermediate_size=32,
+                    max_position_embeddings=8, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    with pytest.raises(ValueError, match="shuffle"):
+        Model(net).prepare(optimizer=opt).fit(
+            Toy(), batch_size=2, epochs=1, verbose=0,
+            checkpoint_manager=CheckpointManager(str(tmp_path)))
+
+
+# ------------------------------------------------- kill -9 / SIGTERM drills
+
+
+_CHILD = r'''
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.train import ScanTrainStep, CheckpointManager
+
+root, until = sys.argv[1], int(sys.argv[2])
+paddle.seed(5)
+cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+                intermediate_size=32, max_position_embeddings=8,
+                hidden_dropout=0.0, attention_dropout=0.0)
+model = GPTForCausalLM(cfg)
+opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+step = ScanTrainStep(model, opt, microbatches=1)
+mgr = CheckpointManager(root, step, every=2, keep=3)
+
+
+def batch_fn(i):
+    rng = np.random.RandomState(1000 + i)
+    ids = rng.randint(0, 64, (2, 9))
+    return ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int32)
+
+
+info = mgr.restore()
+print("RESUMED", info["step"] if info else 0, flush=True)
+mgr.run(batch_fn, until_step=until, resume=False,
+        data_cursor=(int(info["data_cursor"]) if info else 0),
+        on_step=lambda n, loss, ok: print(f"STEP {n} {loss!r}", flush=True),
+        install_sigterm=True)
+print("DONE", int(opt._global_step), flush=True)
+'''
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""              # 1 CPU device: fastest child compile
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        paddle.__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn(script, root, until):
+    return subprocess.Popen(
+        [sys.executable, str(script), str(root), str(until)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        bufsize=1, env=_child_env())
+
+
+def _run_child(script, root, until, timeout=240):
+    p = _spawn(script, root, until)
+    out, _ = p.communicate(timeout=timeout)
+    assert p.returncode == 0, f"child rc={p.returncode}:\n{out}"
+    return out
+
+
+def _losses_of(out):
+    d = {}
+    for line in out.splitlines():
+        if line.startswith("STEP "):
+            _, n, rep = line.split(" ", 2)
+            d[int(n)] = rep
+    return d
+
+
+@pytest.mark.timeout(420)
+def test_kill9_resume_bit_identical(tmp_path):
+    """THE acceptance pin: SIGKILL a real training process mid-run, restart
+    it, and the resumed loss trajectory matches the uninterrupted run's
+    EXACTLY (string-equal float reprs) from the restored step on."""
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    ref = _losses_of(_run_child(script, tmp_path / "A", 8))
+    assert sorted(ref) == list(range(1, 9))
+
+    p = _spawn(script, tmp_path / "B", 8)
+    killed_after = None
+    for line in p.stdout:
+        if line.startswith("STEP "):
+            n = int(line.split()[1])
+            if n >= 5:                 # a complete every-2 checkpoint exists
+                killed_after = n
+                os.kill(p.pid, signal.SIGKILL)
+                break
+    p.stdout.close()
+    p.wait(timeout=60)
+    assert killed_after is not None, "child never reached step 5"
+    assert p.returncode == -signal.SIGKILL
+
+    out = _run_child(script, tmp_path / "B", 8)
+    resumed = int(next(l for l in out.splitlines()
+                       if l.startswith("RESUMED")).split()[1])
+    assert 2 <= resumed < killed_after + 1, (resumed, killed_after)
+    got = _losses_of(out)
+    assert sorted(got) == list(range(resumed + 1, 9))
+    for n in got:
+        assert got[n] == ref[n], (
+            f"loss diverged at step {n}: resumed {got[n]} vs "
+            f"uninterrupted {ref[n]}")
+    assert "DONE 8" in out
+
+
+@pytest.mark.timeout(300)
+def test_sigterm_drains_to_complete_checkpoint(tmp_path):
+    """Real SIGTERM mid-training (the pod-eviction contract, mirroring the
+    serve drain test): the loop finishes its step, writes a synchronous
+    checkpoint, and exits rc=0 — and the checkpoint on disk passes full
+    integrity verification."""
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    root = tmp_path / "S"
+    p = _spawn(script, root, 10_000)   # far horizon: only SIGTERM ends it
+    for line in p.stdout:
+        if line.startswith("STEP ") and int(line.split()[1]) >= 3:
+            p.send_signal(signal.SIGTERM)
+            break
+    out_rest = p.stdout.read()
+    p.stdout.close()
+    assert p.wait(timeout=120) == 0, out_rest
+    assert "DONE" in out_rest
+    latest = (root / "LATEST").read_text().strip()
+    assert (root / latest / "COMPLETE").exists()
+    loaded = load_sharded(str(root / latest), return_numpy=True)  # verifies
+    assert loaded["meta/global_step"] >= 3
+    assert any(k.startswith("opt/") for k in loaded)
+
+
+# ----------------------------------------------------- reshard-on-resume
+
+
+def test_resume_across_mesh_reshard(tmp_path):
+    """Save under dp=2 (ZeRO-1 sharded moments), resume under dp=4: the
+    load adopts the NEW plan's shardings and the loss trajectory matches
+    the uninterrupted dp=2 run to float-ulp."""
+    import jax
+    devs = jax.devices()
+    auto_mesh(dp=2, devices=devs[:2])
+    ref_step = _tiny_step()
+    assert ref_step.zero1
+    ref = [ref_step.step(*_batch(i, b=4)) for i in range(6)]
+
+    auto_mesh(dp=2, devices=devs[:2])
+    step_a = _tiny_step()
+    mgr_a = CheckpointManager(str(tmp_path / "rs"), step_a)
+    first = [step_a.step(*_batch(i, b=4)) for i in range(3)]
+    mgr_a.save(data_cursor=3, sync=True)
+
+    auto_mesh(dp=4, devices=devs[:4])
+    step_b = _tiny_step(seed=99)       # different init: must be overwritten
+    mgr_b = CheckpointManager(str(tmp_path / "rs"), step_b)
+    info = mgr_b.restore(require=True)
+    assert info["step"] == 3
+    # the optimizer state adopted the dp=4 ZeRO-1 layout: per-replica
+    # footprint shrinks vs the dp=2 plan it was saved under
+    assert step_b.opt_state_bytes() < step_a.opt_state_bytes()
+    rest = [step_b.step(*_batch(i, b=4)) for i in range(3, 6)]
+    np.testing.assert_allclose(first + rest, ref, rtol=1e-6)
+
+
+# ----------------------------------------------------------- hapi Model.fit
+
+
+def test_fit_resume_parity(tmp_path):
+    """Model.fit(checkpoint_manager=...): preempt after epoch 0, resume a
+    FRESH process-equivalent (new model/opt/manager) into epoch 1 — final
+    weights bit-equal the uninterrupted 2-epoch fit."""
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.io import Dataset
+
+    class Toy(Dataset):
+        def __init__(self, n=8):
+            rng = np.random.RandomState(0)
+            self.rows = [rng.randint(0, 64, 9).astype(np.int32)
+                         for _ in range(n)]
+
+        def __len__(self):
+            return len(self.rows)
+
+        def __getitem__(self, i):
+            return self.rows[i][:-1], self.rows[i][1:].astype(np.int64)
+
+    def make(seed=5):
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        paddle.seed(seed)
+        cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                        num_heads=2, intermediate_size=32,
+                        max_position_embeddings=8, hidden_dropout=0.0,
+                        attention_dropout=0.0)
+        m = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        return Model(m).prepare(optimizer=opt)
+
+    # accumulate_grad_batches=2 over 3 loader batches/epoch: one full
+    # group + a LEFTOVER partial group per epoch — the leftover apply
+    # must advance the checkpoint cursor too (review finding: it used to
+    # leave a pre-apply cursor, so resume double-applied its gradients)
+    ref = make()
+    ref.fit(Toy(n=6), batch_size=2, epochs=2, shuffle=False, verbose=0,
+            accumulate_grad_batches=2)
+    want = {k: np.asarray(v._data)
+            for k, v in ref.network.state_dict().items()}
+
+    part1 = make()
+    part1.fit(Toy(n=6), batch_size=2, epochs=1, shuffle=False, verbose=0,
+              accumulate_grad_batches=2,
+              checkpoint_manager=CheckpointManager(str(tmp_path), every=2))
+    part2 = make(seed=77)              # different init: restore overwrites
+    mgr = CheckpointManager(str(tmp_path), every=2)
+    part2.fit(Toy(n=6), batch_size=2, epochs=2, shuffle=False, verbose=0,
+              accumulate_grad_batches=2, checkpoint_manager=mgr)
+    got = {k: np.asarray(v._data)
+           for k, v in part2.network.state_dict().items()}
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+    assert mgr.latest()[0] == 4        # 2 epochs x (1 full + 1 leftover)
+
+
+def test_fit_request_stop_leaves_complete_checkpoint(tmp_path):
+    """Programmatic preemption mid-fit (the SIGTERM flag without the
+    signal): fit stops at the next group boundary with a complete final
+    checkpoint."""
+    from paddle_tpu.hapi.callbacks import Callback
+    from paddle_tpu.hapi.model import Model
+    from paddle_tpu.io import Dataset
+
+    class Toy(Dataset):
+        def __init__(self):
+            rng = np.random.RandomState(0)
+            self.rows = [rng.randint(0, 64, 9).astype(np.int32)
+                         for _ in range(12)]
+
+        def __len__(self):
+            return len(self.rows)
+
+        def __getitem__(self, i):
+            return self.rows[i][:-1], self.rows[i][1:].astype(np.int64)
+
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(5)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                    num_heads=2, intermediate_size=32,
+                    max_position_embeddings=8, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    model = Model(net).prepare(optimizer=opt)
+    mgr = CheckpointManager(str(tmp_path), every=100)   # only final save
+
+    class Preempt(Callback):
+        def on_batch_end(self, mode, step, logs=None):
+            if step == 2:
+                mgr.request_stop()
+
+    model.fit(Toy(), batch_size=2, epochs=3, shuffle=False, verbose=0,
+              checkpoint_manager=mgr, callbacks=[Preempt()])
+    # the flag lands mid-epoch; fit finishes the NEXT group (step 3,
+    # optimizer step 4), then stops at the boundary with a final sync save
+    lat = mgr.latest()
+    assert lat is not None and lat[0] == 4
+    loaded = load_sharded(lat[1], return_numpy=True)    # full verification
+    assert loaded["meta/global_step"] == 4
+    assert loaded["meta/data_cursor"] == [0, 3]
