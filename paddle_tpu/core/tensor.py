@@ -328,10 +328,13 @@ class Tensor:
         if isinstance(self._data, jax.core.Tracer):
             # `range(t)` / `x[t]` on a traced scalar: signal the dy2static
             # retry (the converter lowers for-over-range to a carried while)
-            # instead of surfacing jax's ConcretizationTypeError
+            # instead of surfacing jax's ConcretizationTypeError. The raise
+            # ALSO inherits TypeError — the index protocol's contract —
+            # so numpy/stdlib fallbacks that probe __index__ inside
+            # `except TypeError` keep degrading gracefully
             from paddle_tpu.jit.dy2static import (
-                DataDependentControlFlowError, _HINT)
-            raise DataDependentControlFlowError(_HINT)
+                DataDependentIndexError, _HINT)
+            raise DataDependentIndexError(_HINT)
         return int(self._data)
 
     def __hash__(self):

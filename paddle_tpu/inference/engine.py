@@ -158,6 +158,23 @@ class EngineConfig:
                    max_queue_depth does). Backlog-only: an empty queue
                    always admits, so one prompt larger than the bound is
                    never shed with a retry-forever Overloaded
+    kv_dtype     : page-pool storage dtype: "native" (default — follow the
+                   weights), "f32", "bf16", or "int8"
+                   (docs/QUANTIZATION.md). "int8" stores pages int8 with a
+                   per-token-slot per-head f32 scale pool [nl, P, ps, nh]
+                   written by the same scatters; every read (XLA gather or
+                   Pallas page DMA) dequantizes in-register after the copy.
+                   ~3.8x more tokens per pool byte at dh=64, so a fixed
+                   byte budget admits ~2x+ the concurrent slots
+                   (bench_quant asserts >= 1.9x); token parity vs f32 is
+                   bounded, not bit-exact — all int8 PATHS (one-shot /
+                   chunked / prefix-hit / handoff / speculative) stay
+                   token-identical to each other
+    weight_dtype : "native" (default) or "int8": convert the GPT matmul
+                   leaves to int8 + per-output-channel scales at engine
+                   construction (quantization/serving.py), dequantized at
+                   use inside the same AOT programs — same program count,
+                   zero extra recompiles (tests/test_no_retrace.py)
     """
     page_size: int = 16
     max_slots: int = 8
@@ -172,6 +189,8 @@ class EngineConfig:
     speculate_k: int | None = None
     max_queue_depth: int | None = None
     max_queue_tokens: int | None = None
+    kv_dtype: str = "native"
+    weight_dtype: str = "native"
 
 
 class PageAllocator:
@@ -397,9 +416,15 @@ class KVHandoff:
     tensor-relayout problem.
 
     ``pack()``/``unpack()`` define the wire blob:
-    ``b"PTKV1\\n" | u32 header_len | JSON header | prompt int32 | k | v``
+    ``b"PTKV1\\n" | u32 header_len | JSON header | prompt int32 | k | v
+    [| k_scales | v_scales]``
     where the header carries page_size, dtype, prompt_len, first_token and
-    the ``[nl, n_pages, page_size, nh, dh]`` pages shape.
+    the ``[nl, n_pages, page_size, nh, dh]`` pages shape — plus, for int8
+    pools, the ``[nl, n_pages, page_size, nh]`` scales shape: the listed
+    pages' f32 scales travel WITH their values, so an imported int8 page
+    dequantizes bit-identically to where it was prefilled. A float-pool
+    blob has no scales section and an int8 engine refuses it (and vice
+    versa) via the dtype check in `import_request` — never a silent cast.
     """
     prompt: np.ndarray          # [S0] int32
     first_token: int            # sampled from the prefill's last logits
@@ -407,20 +432,28 @@ class KVHandoff:
     v_pages: np.ndarray
     page_size: int
     cache_dtype: str            # numpy dtype name of the pool
+    k_scales: np.ndarray | None = None   # [nl, n_pages, page_size, nh] f32
+    v_scales: np.ndarray | None = None   # (int8 pools only)
 
     MAGIC = b"PTKV1\n"
 
     def pack(self) -> bytes:
-        head = json.dumps({
+        head = {
             "page_size": int(self.page_size), "dtype": self.cache_dtype,
             "first_token": int(self.first_token),
             "prompt_len": int(self.prompt.size),
-            "pages_shape": [int(d) for d in self.k_pages.shape]}).encode()
-        return b"".join([
-            self.MAGIC, struct.pack("<I", len(head)), head,
+            "pages_shape": [int(d) for d in self.k_pages.shape]}
+        parts = [
             np.ascontiguousarray(self.prompt, np.int32).tobytes(),
             np.ascontiguousarray(self.k_pages).tobytes(),
-            np.ascontiguousarray(self.v_pages).tobytes()])
+            np.ascontiguousarray(self.v_pages).tobytes()]
+        if self.k_scales is not None:
+            head["scales_shape"] = [int(d) for d in self.k_scales.shape]
+            parts += [
+                np.ascontiguousarray(self.k_scales, np.float32).tobytes(),
+                np.ascontiguousarray(self.v_scales, np.float32).tobytes()]
+        hb = json.dumps(head).encode()
+        return b"".join([self.MAGIC, struct.pack("<I", len(hb)), hb] + parts)
 
     @classmethod
     def unpack(cls, buf: bytes) -> "KVHandoff":
@@ -443,9 +476,28 @@ class KVHandoff:
         k = np.frombuffer(buf, dt, count=n, offset=off).reshape(shape).copy()
         off += n * dt.itemsize
         v = np.frombuffer(buf, dt, count=n, offset=off).reshape(shape).copy()
+        off += n * dt.itemsize
+        ks = vs = None
+        if (dt == np.int8) != ("scales_shape" in head):
+            raise ValueError(
+                f"KV handoff blob dtype {head['dtype']!r} "
+                f"{'missing its' if dt == np.int8 else 'carries unexpected'}"
+                f" scales section — refusing a silently mis-scaled import")
+        if "scales_shape" in head:
+            sshape = tuple(head["scales_shape"])
+            if sshape != shape[:-1]:
+                raise ValueError(
+                    f"KV handoff scales shape {sshape} does not match "
+                    f"pages shape {shape} — expected {shape[:-1]}")
+            ns = int(np.prod(sshape))
+            ks = np.frombuffer(buf, np.float32, count=ns,
+                               offset=off).reshape(sshape).copy()
+            off += ns * 4
+            vs = np.frombuffer(buf, np.float32, count=ns,
+                               offset=off).reshape(sshape).copy()
         return cls(prompt=prompt, first_token=int(head["first_token"]),
                    k_pages=k, v_pages=v, page_size=int(head["page_size"]),
-                   cache_dtype=head["dtype"])
+                   cache_dtype=head["dtype"], k_scales=ks, v_scales=vs)
 
 
 class DecodeEngine:
@@ -467,6 +519,22 @@ class DecodeEngine:
         nh = self.cfg.num_heads
         self._nh, self._dh = nh, self.cfg.hidden_size // nh
         self._nl = self.cfg.num_layers
+        if ecfg.weight_dtype not in ("native", None):
+            # matmul leaves -> int8 + per-channel scales, dequantized at
+            # use inside the same AOT programs (quantization/serving.py);
+            # the conversion wall lands in engine.quant_dequant_ms
+            from paddle_tpu.quantization.serving import quantize_gpt_params
+            self._params = quantize_gpt_params(self._params,
+                                               ecfg.weight_dtype)
+        kvd = ecfg.kv_dtype
+        if kvd not in ("native", None):
+            from paddle_tpu.kernels.paged_attention import KV_DTYPES
+            if kvd not in KV_DTYPES:
+                raise ValueError(
+                    f"kv_dtype={kvd!r}: expected 'native', "
+                    f"{sorted(KV_DTYPES)}")
+            self._cdtype = jnp.dtype(KV_DTYPES[kvd])
+        self._quant_kv = kvd == "int8"
 
         ps = ecfg.page_size
         max_seq = ecfg.max_seq_len or self.cfg.max_position_embeddings
@@ -486,6 +554,21 @@ class DecodeEngine:
         self._kc = jnp.zeros((self._nl, num_pages, ps, nh, self._dh),
                              self._cdtype)
         self._vc = jnp.zeros_like(self._kc)
+        # int8 pool: per-token-slot per-head f32 scales ride the cache
+        # pytree through every step program (written by the same scatters
+        # that write the pages; docs/QUANTIZATION.md)
+        self._ks = self._vs = None
+        if self._quant_kv:
+            self._ks = jnp.zeros((self._nl, num_pages, ps, nh), jnp.float32)
+            self._vs = jnp.zeros_like(self._ks)
+        # bytes each cached token costs across all layers (K+V values plus
+        # scales when quantized) — the capacity yardstick bench_quant's
+        # slots-at-fixed-pool-bytes assertion is computed from
+        self.kv_bytes_per_token = self._nl * 2 * (
+            nh * self._dh * jnp.dtype(self._cdtype).itemsize
+            + (nh * 4 if self._quant_kv else 0))
+        metrics.gauge("engine.kv_bytes_per_token").set(
+            self.kv_bytes_per_token)
         # host-side mirrors of the per-slot state, fused into ONE packed
         # int32 upload per step; sampled tokens live on device and only the
         # _tokens column is consulted for freshly admitted slots
@@ -606,11 +689,12 @@ class DecodeEngine:
         # tpu_flash_impl in the jit ProgramCache)
         impl_flag = flag_value("tpu_paged_impl")
 
-        def step_fn(params, kc, vc, tokens, slot_state):
+        def step_fn(params, kc, vc, tokens, slot_state, *scales):
             # slot_state: the ONE fused upload — [B, 3 + maxp] int32 of
             # (fresh token id, length, flags, page-table row); `tokens` is
             # the previous step's on-device output, overridden only for
-            # slots the host admitted since the last dispatch
+            # slots the host admitted since the last dispatch. ``scales``
+            # is (k_scale, v_scale) on an int8-KV engine, else empty.
             flags = slot_state[:, _COL_FLAGS]
             active = (flags & _FLAG_ACTIVE) != 0
             fresh = (flags & _FLAG_FRESH) != 0
@@ -618,44 +702,74 @@ class DecodeEngine:
             cache = dict(k_pages=kc, v_pages=vc,
                          page_table=slot_state[:, _STATE_COLS:],
                          lengths=slot_state[:, _COL_LENGTH])
+            if scales:
+                cache.update(k_scale=scales[0], v_scale=scales[1])
             logits, cache = gpt_mod.decode_step(params, toks, cache,
                                                 active, cfg=cfg)
             nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
             nxt = jnp.where(active, nxt, toks)
-            return nxt, cache["k_pages"], cache["v_pages"]
+            out = (nxt, cache["k_pages"], cache["v_pages"])
+            if scales:
+                out += (cache["k_scale"], cache["v_scale"])
+            return out
 
         def build():
-            donate = (1, 2) if self._donate else ()
+            donate = ((1, 2) + ((5, 6) if self._quant_kv else ())) \
+                if self._donate else ()
+            args = [self._params, self._kc, self._vc,
+                    jnp.zeros(B, jnp.int32),
+                    jnp.zeros((B, _STATE_COLS + maxp), jnp.int32)]
+            args += self._scale_args()
             return jax.jit(step_fn, donate_argnums=donate).lower(
-                self._params, self._kc, self._vc,
-                jnp.zeros(B, jnp.int32),
-                jnp.zeros((B, _STATE_COLS + maxp), jnp.int32),
-            ).compile()
+                *args).compile()
 
         return self._compiled(("decode", impl_flag), build)
+
+    def _scale_args(self):
+        return [self._ks, self._vs] if self._quant_kv else []
+
+    def _adopt_pools(self, out, n_lead=1):
+        """Unpack one step/prefill program's outputs — ``n_lead`` leading
+        values, then the cache pools (+ scale pools on an int8 engine) —
+        adopting the pools in place. The ONE place the output pytree's
+        pool tail is interpreted: a future pool (fp8, paged metadata)
+        extends this and every invocation site follows."""
+        if self._quant_kv:
+            self._kc, self._vc, self._ks, self._vs = out[n_lead:]
+        else:
+            self._kc, self._vc = out[n_lead:]
+        return out[0] if n_lead == 1 else out[:n_lead]
 
     def _prefill_exe(self, bucket: int):
         from paddle_tpu.models import gpt as gpt_mod
         cfg = self.cfg
         maxp = self.pages_per_slot
 
-        def prefill_fn(params, kc, vc, packed):
+        def prefill_fn(params, kc, vc, packed, *scales):
             # packed [bucket + 1 + maxp] int32: ids | true length | page row
             # — one fused upload per admission
             ids = packed[:bucket]
             length = packed[bucket]
             row = packed[bucket + 1:]
+            if scales:
+                logits, kc, vc, ks, vs = gpt_mod.prefill_step(
+                    params, ids, length, row, kc, vc, cfg=cfg,
+                    k_scale=scales[0], v_scale=scales[1])
+                tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)
+                return tok, kc, vc, ks, vs
             logits, kc, vc = gpt_mod.prefill_step(
                 params, ids, length, row, kc, vc, cfg=cfg)
             tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)
             return tok, kc, vc
 
         def build():
-            donate = (1, 2) if self._donate else ()
+            donate = ((1, 2) + ((4, 5) if self._quant_kv else ())) \
+                if self._donate else ()
+            args = [self._params, self._kc, self._vc,
+                    jnp.zeros(bucket + 1 + maxp, jnp.int32)]
+            args += self._scale_args()
             return jax.jit(prefill_fn, donate_argnums=donate).lower(
-                self._params, self._kc, self._vc,
-                jnp.zeros(bucket + 1 + maxp, jnp.int32),
-            ).compile()
+                *args).compile()
 
         return self._compiled(("prefill", bucket), build)
 
@@ -670,7 +784,7 @@ class DecodeEngine:
         maxp = self.pages_per_slot
         c = int(self.ecfg.prefill_chunk_tokens) if c is None else int(c)
 
-        def chunk_fn(params, kc, vc, packed):
+        def chunk_fn(params, kc, vc, packed, *scales):
             # packed [c + 2 + maxp] int32: chunk ids | start | valid | page
             # row — one fused upload per chunk, no readback until the final
             # chunk's sampled token
@@ -678,17 +792,25 @@ class DecodeEngine:
             start = packed[c]
             valid = packed[c + 1]
             row = packed[c + 2:]
+            if scales:
+                logits, kc, vc, ks, vs = gpt_mod.prefill_chunk_step(
+                    params, ids, start, valid, row, kc, vc, cfg=cfg,
+                    k_scale=scales[0], v_scale=scales[1])
+                tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)
+                return tok, kc, vc, ks, vs
             logits, kc, vc = gpt_mod.prefill_chunk_step(
                 params, ids, start, valid, row, kc, vc, cfg=cfg)
             tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)
             return tok, kc, vc
 
         def build():
-            donate = (1, 2) if self._donate else ()
+            donate = ((1, 2) + ((4, 5) if self._quant_kv else ())) \
+                if self._donate else ()
+            args = [self._params, self._kc, self._vc,
+                    jnp.zeros(c + 2 + maxp, jnp.int32)]
+            args += self._scale_args()
             return jax.jit(chunk_fn, donate_argnums=donate).lower(
-                self._params, self._kc, self._vc,
-                jnp.zeros(c + 2 + maxp, jnp.int32),
-            ).compile()
+                *args).compile()
 
         return self._compiled(("prefill_chunk", c), build)
 
@@ -701,7 +823,7 @@ class DecodeEngine:
         B, maxp = self.ecfg.max_slots, self.pages_per_slot
         K = self._spec_k
 
-        def step_fn(params, kc, vc, tokens, slot_state):
+        def step_fn(params, kc, vc, tokens, slot_state, *scales):
             # slot_state: [B, 4 + K + maxp] int32 — (fresh token, length,
             # flags, draft_len, K drafted tokens, page-table row)
             flags = slot_state[:, _COL_FLAGS]
@@ -714,21 +836,28 @@ class DecodeEngine:
             cache = dict(k_pages=kc, v_pages=vc,
                          page_table=slot_state[:, _SPEC_COLS + K:],
                          lengths=slot_state[:, _COL_LENGTH])
+            if scales:
+                cache.update(k_scale=scales[0], v_scale=scales[1])
             emitted, n_emitted, cache = gpt_mod.verify_step(
                 params, tok_seq, draft_len, cache, active, cfg=cfg)
             nxt = jnp.take_along_axis(
                 emitted, jnp.maximum(n_emitted - 1, 0)[:, None], axis=1)[:, 0]
             nxt = jnp.where(active, nxt, tok0)
-            return emitted, n_emitted, nxt, cache["k_pages"], \
-                cache["v_pages"]
+            out = (emitted, n_emitted, nxt, cache["k_pages"],
+                   cache["v_pages"])
+            if scales:
+                out += (cache["k_scale"], cache["v_scale"])
+            return out
 
         def build():
-            donate = (1, 2) if self._donate else ()
+            donate = ((1, 2) + ((5, 6) if self._quant_kv else ())) \
+                if self._donate else ()
+            args = [self._params, self._kc, self._vc,
+                    jnp.zeros(B, jnp.int32),
+                    jnp.zeros((B, _SPEC_COLS + K + maxp), jnp.int32)]
+            args += self._scale_args()
             return jax.jit(step_fn, donate_argnums=donate).lower(
-                self._params, self._kc, self._vc,
-                jnp.zeros(B, jnp.int32),
-                jnp.zeros((B, _SPEC_COLS + K + maxp), jnp.int32),
-            ).compile()
+                *args).compile()
 
         return self._compiled(("verify", K), build)
 
@@ -773,6 +902,13 @@ class DecodeEngine:
         computed under the old weights, and a hit after the swap would
         silently condition new-weights decode on stale KV."""
         self._params = {k: t._data for k, t in model.state_dict().items()}
+        if self.ecfg.weight_dtype not in ("native", None):
+            # re-quantize: a QuantizedLeaf is part of the traced pytree
+            # STRUCTURE, so the swapped-in params must keep it or the next
+            # warm call would be a structure mismatch, not a hot swap
+            from paddle_tpu.quantization.serving import quantize_gpt_params
+            self._params = quantize_gpt_params(self._params,
+                                               self.ecfg.weight_dtype)
         self._flush_prefix()
 
     # --------------------------------------------------------- prefix cache
@@ -1237,8 +1373,9 @@ class DecodeEngine:
             exe = self._prefill_exe(bucket)
             self._m_h2d.inc()
             self._m_prefill_tokens.inc(s0)
-            tok, self._kc, self._vc = exe(
-                self._params, self._kc, self._vc, jax.device_put(packed))
+            tok = self._adopt_pools(
+                exe(self._params, self._kc, self._vc,
+                    jax.device_put(packed), *self._scale_args()))
         tb = time.perf_counter()
         first = int(tok)                     # sampled-token readback
         self._blocked_s += time.perf_counter() - tb
@@ -1263,8 +1400,9 @@ class DecodeEngine:
         exe = self._prefill_chunk_exe(c)
         self._m_h2d.inc()
         self._m_prefill_tokens.inc(int(chunk.size))
-        tok, self._kc, self._vc = exe(
-            self._params, self._kc, self._vc, jax.device_put(packed))
+        tok = self._adopt_pools(
+            exe(self._params, self._kc, self._vc, jax.device_put(packed),
+                *self._scale_args()))
         self._m_chunks.inc()
         return tok
 
@@ -1360,8 +1498,9 @@ class DecodeEngine:
         self._m_h2d.inc()
         state = jax.device_put(self._packed_state())
         t0 = time.perf_counter()
-        self._tok_dev, self._kc, self._vc = exe(
-            self._params, self._kc, self._vc, self._tok_dev, state)
+        self._tok_dev = self._adopt_pools(
+            exe(self._params, self._kc, self._vc, self._tok_dev, state,
+                *self._scale_args()))
         snapshot = [(int(i), self._slot_req[i])
                     for i in np.flatnonzero(self._active)]
         self._inflight.append((self._tok_dev, snapshot, t0))
@@ -1420,8 +1559,9 @@ class DecodeEngine:
         self._m_h2d.inc()
         state = jax.device_put(self._packed_spec_state(drafts, draft_lens))
         t0 = time.perf_counter()
-        emitted_dev, n_emit_dev, self._tok_dev, self._kc, self._vc = exe(
-            self._params, self._kc, self._vc, self._tok_dev, state)
+        emitted_dev, n_emit_dev, self._tok_dev = self._adopt_pools(
+            exe(self._params, self._kc, self._vc, self._tok_dev, state,
+                *self._scale_args()), n_lead=3)
         snapshot = [(int(i), self._slot_req[i])
                     for i in np.flatnonzero(self._active)]
         self._fresh[:] = False
@@ -1636,7 +1776,17 @@ class DecodeEngine:
             first = self._run_prefill(
                 ids, row, start=len(shared) * self.ecfg.page_size)
             from paddle_tpu.kernels.paged_attention import export_pages
-            k_blob, v_blob = export_pages(self._kc, self._vc, all_pages)
+            ks_np = vs_np = None
+            if self._quant_kv:
+                t0 = time.perf_counter()
+                k_blob, v_blob, ks_blob, vs_blob = export_pages(
+                    self._kc, self._vc, all_pages,
+                    k_scales=self._ks, v_scales=self._vs)
+                ks_np, vs_np = np.asarray(ks_blob), np.asarray(vs_blob)
+                metrics.histogram("engine.quant_dequant_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+            else:
+                k_blob, v_blob = export_pages(self._kc, self._vc, all_pages)
             k_np, v_np = np.asarray(k_blob), np.asarray(v_blob)
             if self._prefix_enabled:
                 # the freshly prefilled pages are cache-eligible: register
@@ -1648,7 +1798,8 @@ class DecodeEngine:
         metrics.counter("engine.kv_exports").inc()
         return KVHandoff(prompt=ids, first_token=first, k_pages=k_np,
                          v_pages=v_np, page_size=int(self.ecfg.page_size),
-                         cache_dtype=np.dtype(self._cdtype).name)
+                         cache_dtype=np.dtype(self._cdtype).name,
+                         k_scales=ks_np, v_scales=vs_np)
 
     def import_request(self, handoff: KVHandoff, max_new_tokens=32,
                        trace=None) -> GenerateRequest:
@@ -1670,7 +1821,18 @@ class DecodeEngine:
             raise ValueError(
                 f"cache dtype mismatch: handoff {handoff.cache_dtype} vs "
                 f"engine {np.dtype(self._cdtype).name} — a silent cast "
-                f"would break bit-identical decode")
+                f"would break bit-identical decode (kv_dtype must match "
+                f"across a handoff)")
+        if self._quant_kv and handoff.k_scales is None:
+            raise ValueError(
+                "int8 KV handoff is missing its scale blobs — refusing a "
+                "silently mis-scaled import")
+        if self._quant_kv and \
+                tuple(handoff.k_scales.shape) != tuple(handoff.k_pages
+                                                       .shape[:-1]):
+            raise ValueError(
+                f"KV handoff scales shape {handoff.k_scales.shape} does "
+                f"not match pages shape {handoff.k_pages.shape}")
         nl, n_src, ps, nh, dh = handoff.k_pages.shape
         if (nl, ps, nh, dh) != (self._nl, self.ecfg.page_size, self._nh,
                                 self._dh):
@@ -1718,9 +1880,16 @@ class DecodeEngine:
         flight.record("engine.kv_import", request_id=req.request_id,
                       slot=slot, pages=len(pages), prompt_len=int(ids.size))
         from paddle_tpu.kernels.paged_attention import import_pages
-        self._kc, self._vc = import_pages(
-            self._kc, self._vc, jnp.asarray(handoff.k_pages),
-            jnp.asarray(handoff.v_pages), pages[:n_src])
+        if self._quant_kv:
+            self._kc, self._vc, self._ks, self._vs = import_pages(
+                self._kc, self._vc, jnp.asarray(handoff.k_pages),
+                jnp.asarray(handoff.v_pages), pages[:n_src],
+                k_scales=self._ks, v_scales=self._vs,
+                k_s_blob=handoff.k_scales, v_s_blob=handoff.v_scales)
+        else:
+            self._kc, self._vc = import_pages(
+                self._kc, self._vc, jnp.asarray(handoff.k_pages),
+                jnp.asarray(handoff.v_pages), pages[:n_src])
         row = np.full(self.pages_per_slot, TRASH_PAGE, np.int32)
         row[:len(pages)] = pages
         self._page_table[slot] = row

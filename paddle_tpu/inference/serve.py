@@ -836,9 +836,27 @@ def main(argv=None):
     ap.add_argument("--drain-deadline", type=float, default=30.0,
                     help="SIGTERM graceful-drain budget in seconds: finish "
                          "in-flight requests up to this long before exit")
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=["native", "f32", "bf16", "int8"],
+                    help="KV page-pool storage dtype (engine servers; "
+                         "overrides the config file's engine.kv_dtype). "
+                         "int8 stores pages with per-token per-head scales "
+                         "— ~2x+ concurrent slots per pool byte "
+                         "(docs/QUANTIZATION.md)")
+    ap.add_argument("--weight-dtype", default=None,
+                    choices=["native", "int8"],
+                    help="serve the model's matmul weights int8 with "
+                         "per-channel scales, dequantized in-program "
+                         "(engine servers; overrides engine.weight_dtype)")
     args = ap.parse_args(argv)
     if args.model is None and args.gpt_config is None:
         ap.error("need --model and/or --gpt-config")
+    if (args.kv_dtype is not None or args.weight_dtype is not None) \
+            and args.gpt_config is None:
+        # silently serving full-width after an operator asked for int8
+        # would be a capacity surprise, not a convenience
+        ap.error("--kv-dtype/--weight-dtype configure the decode engine: "
+                 "they require --gpt-config")
     engine = None
     if args.gpt_config is not None:
         import paddle_tpu as paddle
@@ -847,7 +865,14 @@ def main(argv=None):
         with open(args.gpt_config) as f:
             spec = json.load(f)
         weights = spec.pop("weights", None)
-        ecfg = EngineConfig(**spec.pop("engine", {}))
+        espec = spec.pop("engine", {})
+        # CLI knobs override the config file: the same deployment artifact
+        # serves full-width or quantized by flag flip
+        if args.kv_dtype is not None:
+            espec["kv_dtype"] = args.kv_dtype
+        if args.weight_dtype is not None:
+            espec["weight_dtype"] = args.weight_dtype
+        ecfg = EngineConfig(**espec)
         model = GPTForCausalLM(GPTConfig(**spec))
         if weights:
             model.set_state_dict(paddle.load(weights))

@@ -19,4 +19,4 @@ from paddle_tpu.jit.save_load import save, load, TranslatedLayer  # noqa: F401
 from paddle_tpu.jit.static_function import ignore_module  # noqa: F401
 from paddle_tpu.jit.dy2static import (  # noqa: F401
     cond, while_loop, ifelse, whileloop, convert_to_static,
-    DataDependentControlFlowError)
+    DataDependentControlFlowError, DataDependentIndexError)

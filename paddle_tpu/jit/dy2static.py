@@ -47,6 +47,15 @@ class DataDependentControlFlowError(RuntimeError):
     pass
 
 
+class DataDependentIndexError(DataDependentControlFlowError, TypeError):
+    """Raised from ``Tensor.__index__`` on a traced scalar. Inherits
+    TypeError because that is the index protocol's contract: numpy and the
+    stdlib probe ``__index__`` inside ``try/except TypeError`` fallbacks,
+    and a bare RuntimeError would escape those probes and crash code that
+    was written to degrade gracefully. The dy2static retry still catches it
+    as a DataDependentControlFlowError (jit/static_function.py)."""
+
+
 _HINT = (
     "a Python branch/loop condition depends on a traced Tensor value. "
     "Under paddle.jit.to_static this usually auto-converts; if you see "
@@ -440,6 +449,75 @@ def _set_true(name):
     return _assign(name, _call_jst("true_"))
 
 
+def _scope_shadows_range(fdef) -> bool:
+    """Static twin of :func:`_range_is_builtin` for NESTED defs (no code
+    object to ask at transform time): does this def's OWN scope bind the
+    name ``range``? Parameters, any assignment/deletion target, a nested
+    ``def range``/``class range``, an import binding (``import m as
+    range`` / ``from m import range``), an ``except ... as range``, or a
+    ``global``/``nonlocal range`` declaration (which makes later
+    assignments rebind an outer name we cannot prove is the builtin) all
+    count. The scan stops at nested function boundaries — those are their
+    own scopes."""
+    a = fdef.args
+    params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        params.append(a.vararg.arg)
+    if a.kwarg:
+        params.append(a.kwarg.arg)
+    if "range" in params:
+        return True
+
+    found = [False]
+
+    def binds_range(child) -> bool:
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            return child.name == "range"
+        if isinstance(child, ast.Name):
+            return child.id == "range" and isinstance(
+                child.ctx, (ast.Store, ast.Del))
+        if isinstance(child, (ast.Global, ast.Nonlocal)):
+            return "range" in child.names
+        if isinstance(child, (ast.Import, ast.ImportFrom)):
+            return any((alias.asname or alias.name.split(".")[0]) == "range"
+                       for alias in child.names)
+        if isinstance(child, ast.ExceptHandler):
+            return child.name == "range"
+        return False
+
+    def scan(node):
+        for child in ast.iter_child_nodes(node):
+            if binds_range(child):
+                found[0] = True
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue                 # nested scope: do not descend
+            if isinstance(child, ast.ClassDef):
+                # the class NAME binds in this scope (checked above); its
+                # BODY is class scope — only decorators/bases/keywords
+                # evaluate here
+                for sub in child.decorator_list + child.bases:
+                    scan(sub)
+                for kw in child.keywords:
+                    scan(kw.value)
+                continue
+            if isinstance(child, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                  ast.GeneratorExp)):
+                # comprehension targets live in the comprehension's OWN
+                # scope; only a walrus (PEP 572) binds outward
+                for sub in ast.walk(child):
+                    if (isinstance(sub, ast.NamedExpr)
+                            and isinstance(sub.target, ast.Name)
+                            and sub.target.id == "range"):
+                        found[0] = True
+                continue
+            scan(child)
+
+    scan(fdef)
+    return found[0]
+
+
 class _ForToWhileRewriter(ast.NodeTransformer):
     """``for <name> in range(...)`` -> counter-carried ``while`` (the
     reference's ForToWhileTransformer,
@@ -452,15 +530,38 @@ class _ForToWhileRewriter(ast.NodeTransformer):
     return inside the generated while get the normal escape treatment, and
     before _ControlFlowTransformer so the while converts normally.
 
-    Only ``range`` iterables convert: any other iterable (tensors, lists,
-    enumerate/zip) has a concrete length under tracing (shapes are static)
-    and executes as a plain Python loop during capture."""
+    Only ``range`` iterables convert — and only when the NAME ``range``
+    actually resolves to the builtin at that point (``rewrite_range`` for
+    the outermost function, decided by :func:`_range_is_builtin` from its
+    locals, closure and globals; nested ``def``s re-decide via a static
+    per-scope scan, since a nested scope can shadow ``range`` on its own):
+    a user who shadowed ``range`` must get their own iterable's semantics
+    as a plain Python loop, not a silent lowering to builtin-range counter
+    arithmetic. Any other iterable (tensors, lists, enumerate/zip) has a
+    concrete length under tracing (shapes are static) and executes as a
+    plain Python loop during capture."""
 
-    def __init__(self):
+    def __init__(self, rewrite_range=True):
         self.counter = 0
+        self.rewrite_range = rewrite_range
+
+    def visit_FunctionDef(self, node):
+        # each def is its own scope: a shadow inside it must stop the
+        # rewrite for ITS loops only, and an enclosing shadow carries in
+        # (the nested fn closes over it) — mirror lexical scoping by
+        # push/pop around the subtree
+        saved = self.rewrite_range
+        self.rewrite_range = saved and not _scope_shadows_range(node)
+        self.generic_visit(node)
+        self.rewrite_range = saved
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_For(self, node):
         self.generic_visit(node)        # inner loops first
+        if not self.rewrite_range:
+            return node
         if node.orelse or not isinstance(node.target, ast.Name):
             return node
         it = node.iter
@@ -847,6 +948,28 @@ def _fndef(name, names, body):
 _CONVERT_SEQ = 0
 
 
+def _range_is_builtin(fn) -> bool:
+    """Does the bare name ``range`` resolve to the builtin inside ``fn``?
+    Resolution order mirrors the interpreter's: function locals (any local
+    assignment or parameter named ``range`` makes it local for the WHOLE
+    body), closure cells, then globals, then builtins. Anything that cannot
+    be proven to be the builtin counts as shadowed — the rewrite must never
+    apply builtin-range semantics to a user's own ``range``."""
+    code = fn.__code__
+    if "range" in code.co_varnames or "range" in code.co_cellvars:
+        return False                     # local (param or body assignment)
+    if "range" in code.co_freevars:
+        try:
+            cell = fn.__closure__[code.co_freevars.index("range")]
+            return cell.cell_contents is range
+        except (ValueError, IndexError, TypeError):
+            return False                 # empty/odd cell: cannot prove it
+    glb = fn.__globals__
+    if "range" in glb:
+        return glb["range"] is range
+    return True                          # falls through to builtins
+
+
 def convert_to_static(fn):
     """AST-convert ``fn``'s if/while statements; preserves the original
     closure cells and globals (ref `program_translator.py:283`)."""
@@ -859,7 +982,7 @@ def convert_to_static(fn):
     fdef = tree.body[0]
     # drop decorators — we are already below them
     fdef.decorator_list = []
-    _ForToWhileRewriter().visit(fdef)
+    _ForToWhileRewriter(rewrite_range=_range_is_builtin(fn)).visit(fdef)
     esc = _EscapeRewriter()
     esc.visit(fdef)
     if esc.flag_names:

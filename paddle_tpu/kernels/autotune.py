@@ -241,16 +241,22 @@ def _paged_candidates(backend):
     return ["xla"]
 
 
-def paged_winner(b, pages_per_slot, page_size, nh, dh, dtype, run_impl):
+def paged_winner(b, pages_per_slot, page_size, nh, dh, dtype, run_impl,
+                 variant=""):
     """Pick (and cache) the fastest paged-attention decode impl for this
-    signature — (backend, B, pages_per_slot, page_size, nh, dh, dtype).
+    signature — (backend, B, pages_per_slot, page_size, nh, dh, dtype[,
+    variant]).
 
     run_impl(impl, q, k_pages, v_pages, page_table, pos) must execute the
-    named implementation and return [B, nh, dh].
+    named implementation and return [B, nh, dh]. ``dtype`` must be a REAL
+    dtype (the synthetic test arrays are built with it); ``variant`` is a
+    free-form key suffix for callers whose execution differs beyond the
+    q dtype (e.g. "kv-int8": the dequant changes each candidate's
+    arithmetic intensity, so it must not share the float pools' winner).
     """
     backend = _backend_kind()
     key = ("paged", backend, int(b), int(pages_per_slot), int(page_size),
-           int(nh), int(dh), str(dtype))
+           int(nh), int(dh), str(dtype) + (f"/{variant}" if variant else ""))
     hit = _CACHE.get(key)
     if hit is not None:
         return hit[0]

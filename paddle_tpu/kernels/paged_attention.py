@@ -48,10 +48,39 @@ from paddle_tpu.observability import metrics
 # the reserved spill target for masked writes — never allocated to a sequence
 TRASH_PAGE = 0
 
-__all__ = ["TRASH_PAGE", "gather_kv", "paged_attention", "token_page_coords",
+# EngineConfig.kv_dtype knob values -> page storage dtypes. "int8" pairs the
+# int8 pages with a per-token-slot per-head f32 scale array ([nl, P, ps, nh])
+# written by the same scatters that write the pages (docs/QUANTIZATION.md).
+KV_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16, "int8": jnp.int8}
+
+__all__ = ["TRASH_PAGE", "KV_DTYPES", "gather_kv", "quantize_kv",
+           "dequantize_window", "gather_scales", "paged_attention", "token_page_coords",
            "prompt_page_coords", "chunk_page_coords", "verify_page_coords",
            "write_token_kv", "write_prompt_kv", "export_pages",
            "import_pages"]
+
+
+def quantize_kv(x):
+    """Per-head abs-max int8 for a K or V write of any leading shape
+    ``[..., nh, dh]`` -> (int8 values ``[..., nh, dh]``, f32 scales
+    ``[..., nh]``).
+
+    The scale granularity is per TOKEN-SLOT per head — one scale for each
+    (page, offset, head) cell, stored ``[nl, P, page_size, nh]`` alongside
+    the pool. A single per-page scale cannot survive the engine's
+    incremental writes: decode lands one token per step into a partially
+    filled page, and re-scaling the page for a later token's larger abs-max
+    would silently corrupt every earlier token's dequantization. One scale
+    per written cell makes each write self-contained — pages (and their
+    scales) are immutable once full, which is what lets the prefix cache
+    share them by reference (docs/QUANTIZATION.md)."""
+    from paddle_tpu.quantization.comms import absmax_int8
+    return absmax_int8(x, axis=-1)
+
+
+def dequantize_window(win, scales):
+    """int8 gathered window ``[..., nh, dh]`` + scales ``[..., nh]`` -> f32."""
+    return win.astype(jnp.float32) * scales[..., None]
 
 
 def gather_kv(pages, page_table):
@@ -66,32 +95,50 @@ def gather_kv(pages, page_table):
     return pages[page_table].reshape(b, maxp * ps, nh, dh)
 
 
-def _xla_paged_attention(q, k_pages, v_pages, page_table, pos):
-    """The gather + masked f32-softmax reference implementation."""
+def gather_scales(scales, page_table):
+    """[num_pages, page_size, nh] scales -> [B, Lmax, nh] per-slot windows
+    (the scale-side twin of :func:`gather_kv`)."""
+    _, ps, nh = scales.shape
+    b, maxp = page_table.shape
+    return scales[page_table].reshape(b, maxp * ps, nh)
+
+
+def _xla_paged_attention(q, k_pages, v_pages, page_table, pos,
+                         k_scale=None, v_scale=None):
+    """The gather + masked f32-softmax reference implementation. With
+    ``k_scale``/``v_scale`` ([num_pages, page_size, nh] f32) the pages are
+    int8 and dequantize in-register right after the gather — the same f32
+    score/softmax math runs on the dequantized values."""
     dh = q.shape[-1]
     scale = 1.0 / (dh ** 0.5)
-    k = gather_kv(k_pages, page_table)              # [B, Lmax, nh, dh]
-    v = gather_kv(v_pages, page_table)
+    k = gather_kv(k_pages, page_table).astype(jnp.float32)  # [B, Lmax, nh, dh]
+    v = gather_kv(v_pages, page_table).astype(jnp.float32)
+    if k_scale is not None:
+        k = k * gather_scales(k_scale, page_table)[..., None]
+        v = v * gather_scales(v_scale, page_table)[..., None]
     lmax = k.shape[1]
-    sc = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32) * scale,
-                    k.astype(jnp.float32))
+    sc = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32) * scale, k)
     mask = jnp.arange(lmax)[None, :] <= pos[:, None]         # [B, Lmax]
     sc = jnp.where(mask[:, None, :], sc, -1e30)
     pr = jax.nn.softmax(sc, axis=-1)
-    att = jnp.einsum("bhl,blhd->bhd", pr, v.astype(jnp.float32))
+    att = jnp.einsum("bhl,blhd->bhd", pr, v)
     return att.astype(q.dtype)
 
 
-def _impl_call(impl, q, k_pages, v_pages, page_table, pos):
+def _impl_call(impl, q, k_pages, v_pages, page_table, pos,
+               k_scale=None, v_scale=None):
     """Execute one named implementation (also the autotuner's run_impl)."""
     if impl == "pallas":
         from paddle_tpu.kernels.pallas.paged_attention import (
             paged_attention as pallas_paged)
-        return pallas_paged(q, k_pages, v_pages, page_table, pos)
-    return _xla_paged_attention(q, k_pages, v_pages, page_table, pos)
+        return pallas_paged(q, k_pages, v_pages, page_table, pos,
+                            k_scale=k_scale, v_scale=v_scale)
+    return _xla_paged_attention(q, k_pages, v_pages, page_table, pos,
+                                k_scale=k_scale, v_scale=v_scale)
 
 
-def paged_attention(q, k_pages, v_pages, page_table, pos):
+def paged_attention(q, k_pages, v_pages, page_table, pos,
+                    k_scale=None, v_scale=None):
     """One decode step of attention over paged K/V for B sequences.
 
     q          : [B, nh, dh] query for the CURRENT token of each sequence
@@ -116,11 +163,27 @@ def paged_attention(q, k_pages, v_pages, page_table, pos):
         impl = "xla"
     if impl == "auto":
         from paddle_tpu.kernels.autotune import paged_winner
+        run = _impl_call
+        variant = ""
+        if k_scale is not None:
+            # int8 pools measure with synthetic unit scales (the autotuner
+            # builds its own float test pages — here cast to int8) and key
+            # their own winner via the variant suffix: the dequant changes
+            # each candidate's arithmetic intensity. The q dtype stays a
+            # REAL dtype (paged_winner builds arrays with it)
+            variant = "kv-int8"
+
+            def run(impl_, q_, kp_, vp_, pt_, pos_):
+                ones = jnp.ones(kp_.shape[:3], jnp.float32)
+                return _impl_call(impl_, q_, kp_.astype(jnp.int8),
+                                  vp_.astype(jnp.int8), pt_, pos_,
+                                  k_scale=ones, v_scale=ones)
         impl = paged_winner(q.shape[0], page_table.shape[1],
                             k_pages.shape[1], q.shape[1], q.shape[2],
-                            q.dtype, _impl_call)
+                            q.dtype, run, variant=variant)
     metrics.counter(f"paged_attention.impl.{impl}").inc()
-    return _impl_call(impl, q, k_pages, v_pages, page_table, pos)
+    return _impl_call(impl, q, k_pages, v_pages, page_table, pos,
+                      k_scale=k_scale, v_scale=v_scale)
 
 
 def token_page_coords(page_table, pos, active, page_size):
@@ -196,7 +259,7 @@ def verify_page_coords(page_table, pos, valid, page_size):
     return page, pos % page_size
 
 
-def export_pages(k_pages, v_pages, page_list):
+def export_pages(k_pages, v_pages, page_list, k_scales=None, v_scales=None):
     """Gather the listed pages' contents out of the pool — the send side of
     the page-granular KV handoff (a prefill finished on one replica resumes
     decode on another; docs/SERVING.md). The page table makes the transfer a
@@ -205,25 +268,40 @@ def export_pages(k_pages, v_pages, page_list):
     k_pages/v_pages : [num_layers, num_pages, page_size, nh, dh]
     page_list       : [n] int page indices (a sequence's allocation,
                       in token order)
+    k_scales/v_scales : optional [num_layers, num_pages, page_size, nh] f32
+                      (int8 pools); the listed pages' scales travel with
+                      their values so the handoff stays bit-exact
     returns         : (k_blob, v_blob) each [num_layers, n, page_size, nh, dh]
+                      — plus (k_s_blob, v_s_blob) when scales were given
     """
     idx = jnp.asarray(page_list, jnp.int32)
-    return k_pages[:, idx], v_pages[:, idx]
+    if k_scales is None:
+        return k_pages[:, idx], v_pages[:, idx]
+    return (k_pages[:, idx], v_pages[:, idx],
+            k_scales[:, idx], v_scales[:, idx])
 
 
-def import_pages(k_pages, v_pages, k_blob, v_blob, page_list):
+def import_pages(k_pages, v_pages, k_blob, v_blob, page_list,
+                 k_scales=None, v_scales=None, k_s_blob=None, v_s_blob=None):
     """Scatter exported page contents into a (different) pool at (different)
     page indices — the receive side of the KV handoff. Only the page IDS
-    change across the transfer; contents land bit-identical, so decode on
-    the importing replica matches decode where the prefill ran.
+    change across the transfer; contents (and, for int8 pools, their scales)
+    land bit-identical, so decode on the importing replica matches decode
+    where the prefill ran.
 
     k_blob/v_blob : [num_layers, n, page_size, nh, dh] from `export_pages`
     page_list     : [n] destination page indices in THIS pool
-    returns       : (k_pages, v_pages) updated
+    returns       : (k_pages, v_pages) updated — plus (k_scales, v_scales)
+                    when the scale pools/blobs were given
     """
     idx = jnp.asarray(page_list, jnp.int32)
-    return (k_pages.at[:, idx].set(k_blob.astype(k_pages.dtype)),
-            v_pages.at[:, idx].set(v_blob.astype(v_pages.dtype)))
+    kp = k_pages.at[:, idx].set(k_blob.astype(k_pages.dtype))
+    vp = v_pages.at[:, idx].set(v_blob.astype(v_pages.dtype))
+    if k_scales is None:
+        return kp, vp
+    return (kp, vp,
+            k_scales.at[:, idx].set(jnp.asarray(k_s_blob, k_scales.dtype)),
+            v_scales.at[:, idx].set(jnp.asarray(v_s_blob, v_scales.dtype)))
 
 
 def write_token_kv(k_pages, v_pages, k, v, page_table, pos, active):

@@ -46,16 +46,30 @@ def pages_needed(pos, page_size):
 
 
 def _decode_kernel(pos_ref, pt_ref, q_ref, k_hbm, v_hbm, o_ref, *rest,
-                   page_size, scale):
+                   page_size, scale, quant=False, has_visits=False):
     # one grid cell per (sequence b, head h): q_ref [1, 1, dh] in VMEM,
     # k_hbm/v_hbm the full [num_pages, page_size, nh, dh] pools in HBM,
     # pos/page_table scalar-prefetched into SMEM. The visits output exists
     # only under return_visits (parity tests) — the serving kernel is
-    # single-output.
-    if len(rest) == 4:
-        visits_ref, kbuf, vbuf, sem = rest
+    # single-output. Under ``quant`` the pools are int8 with f32 scale
+    # pools [num_pages, page_size, nh] riding two extra HBM operands; each
+    # page's [page_size] scale slice DMAs in the same double-buffered
+    # rhythm as its values and the dequant happens in-register, right
+    # after the copy lands — so DMA traffic is the int8 bytes, never a
+    # widened page.
+    if quant:
+        ks_hbm, vs_hbm, o_ref, *rest = o_ref, rest[0], rest[1], *rest[2:]
     else:
-        visits_ref, (kbuf, vbuf, sem) = None, rest
+        ks_hbm = vs_hbm = None
+    if has_visits:                     # static flag, like `quant` — never
+        visits_ref, rest = rest[0], rest[1:]   # inferred from arg counts
+    else:
+        visits_ref = None
+    if quant:
+        kbuf, vbuf, ksbuf, vsbuf, sem = rest
+    else:
+        kbuf, vbuf, sem = rest
+        ksbuf = vsbuf = None
     b = pl.program_id(0)
     h = pl.program_id(1)
     pos = pos_ref[b]
@@ -65,16 +79,24 @@ def _decode_kernel(pos_ref, pt_ref, q_ref, k_hbm, v_hbm, o_ref, *rest,
 
     def dma(slot, j):
         # page j of sequence b: DMA this head's [page_size, dh] slice of the
-        # page from HBM into the double buffer
+        # page from HBM into the double buffer (plus its [page_size] scale
+        # slice when the pool is int8)
         pg = pt_ref[b, j]
-        return (pltpu.make_async_copy(k_hbm.at[pg, :, h, :], kbuf.at[slot],
-                                      sem.at[0, slot]),
-                pltpu.make_async_copy(v_hbm.at[pg, :, h, :], vbuf.at[slot],
-                                      sem.at[1, slot]))
+        copies = [pltpu.make_async_copy(k_hbm.at[pg, :, h, :], kbuf.at[slot],
+                                        sem.at[0, slot]),
+                  pltpu.make_async_copy(v_hbm.at[pg, :, h, :], vbuf.at[slot],
+                                        sem.at[1, slot])]
+        if quant:
+            copies += [pltpu.make_async_copy(ks_hbm.at[pg, :, h],
+                                             ksbuf.at[slot],
+                                             sem.at[2, slot]),
+                       pltpu.make_async_copy(vs_hbm.at[pg, :, h],
+                                             vsbuf.at[slot],
+                                             sem.at[3, slot])]
+        return copies
 
-    kd, vd = dma(0, 0)
-    kd.start()
-    vd.start()
+    for c in dma(0, 0):
+        c.start()
     q = q_ref[0, 0][None].astype(jnp.float32) * scale          # [1, dh]
 
     def body(j, carry):
@@ -84,15 +106,18 @@ def _decode_kernel(pos_ref, pt_ref, q_ref, k_hbm, v_hbm, o_ref, *rest,
 
         @pl.when(j + jnp.int32(1) < npages)
         def _():                       # overlap: next page's DMA in flight
-            kn, vn = dma(nslot, j + jnp.int32(1))
-            kn.start()
-            vn.start()
+            for c in dma(nslot, j + jnp.int32(1)):
+                c.start()
 
-        kw, vw = dma(slot, j)
-        kw.wait()
-        vw.wait()
+        for c in dma(slot, j):
+            c.wait()
         k = kbuf[slot].astype(jnp.float32)                     # [ps, dh]
         v = vbuf[slot].astype(jnp.float32)
+        if quant:
+            # dequantize in-register AFTER the page copy: the DMA moved
+            # int8 bytes; only the VMEM-resident working tile widens
+            k = k * ksbuf[slot][:, None]
+            v = v * vsbuf[slot][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [1, ps]
         kpos = j * page_size + jax.lax.broadcasted_iota(
@@ -116,7 +141,7 @@ def _decode_kernel(pos_ref, pt_ref, q_ref, k_hbm, v_hbm, o_ref, *rest,
 
 
 def paged_attention(q, k_pages, v_pages, page_table, pos, *, interpret=None,
-                    return_visits=False):
+                    return_visits=False, k_scale=None, v_scale=None):
     """One decode step of ragged paged attention. Same contract as the XLA
     reference `kernels.paged_attention.paged_attention`:
 
@@ -125,6 +150,10 @@ def paged_attention(q, k_pages, v_pages, page_table, pos, *, interpret=None,
     v_pages    : [num_pages, page_size, nh, dh]
     page_table : [B, pages_per_slot] int32
     pos        : [B] int32 — attends positions 0..pos inclusive
+    k_scale/v_scale : optional [num_pages, page_size, nh] f32 — int8 pools:
+                 each visited page's scale slice DMAs alongside its values
+                 and the dequant runs in-register after the copy, so the
+                 kernel's HBM traffic is the int8 bytes (~1/4 of f32)
     returns    : [B, nh, dh] in q.dtype; with ``return_visits=True`` also
                  the per-(b, h) page-loop trip counts [B, nh] int32 — the
                  ragged-stop proof the parity tests assert on.
@@ -135,37 +164,50 @@ def paged_attention(q, k_pages, v_pages, page_table, pos, *, interpret=None,
     if interpret is None:
         from paddle_tpu.kernels.pallas._compat import default_interpret
         interpret = default_interpret()
+    quant = k_scale is not None
     b, nh, dh = q.shape
     ps = k_pages.shape[1]
     scale = 1.0 / (dh ** 0.5)
-    kern = functools.partial(_decode_kernel, page_size=ps, scale=float(scale))
+    kern = functools.partial(_decode_kernel, page_size=ps,
+                             scale=float(scale), quant=quant,
+                             has_visits=bool(return_visits))
     out_specs = [pl.BlockSpec((1, 1, dh), lambda i, j, *_: (i, j, 0))]
     out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
     if return_visits:
         out_specs.append(pl.BlockSpec((1, 1), lambda i, j, *_: (i, j)))
         out_shape.append(jax.ShapeDtypeStruct((b, nh), jnp.int32))
+    in_specs = [
+        pl.BlockSpec((1, 1, dh), lambda i, j, *_: (i, j, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),         # K pool stays in HBM
+        pl.BlockSpec(memory_space=pltpu.ANY),         # V pool stays in HBM
+    ]
+    scratch = [
+        pltpu.VMEM((2, ps, dh), k_pages.dtype),       # K double buffer
+        pltpu.VMEM((2, ps, dh), v_pages.dtype),       # V double buffer
+    ]
+    operands = [q, k_pages, v_pages]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY),   # K scales (HBM)
+                     pl.BlockSpec(memory_space=pltpu.ANY)]   # V scales (HBM)
+        scratch += [pltpu.VMEM((2, ps), jnp.float32),        # scale buffers
+                    pltpu.VMEM((2, ps), jnp.float32)]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
+    # semaphore rows: one per in-flight copy kind (k, v[, ks, vs])
+    scratch.append(pltpu.SemaphoreType.DMA((4 if quant else 2, 2)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, nh),
-        in_specs=[
-            pl.BlockSpec((1, 1, dh), lambda i, j, *_: (i, j, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),     # K pool stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),     # V pool stays in HBM
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
-        scratch_shapes=[
-            pltpu.VMEM((2, ps, dh), k_pages.dtype),   # K double buffer
-            pltpu.VMEM((2, ps, dh), v_pages.dtype),   # V double buffer
-            pltpu.SemaphoreType.DMA((2, 2)),          # (k/v, buffer slot)
-        ],
+        scratch_shapes=scratch,
     )
     outs = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=bool(interpret),
-    )(pos.astype(jnp.int32), page_table.astype(jnp.int32), q, k_pages,
-      v_pages)
+    )(pos.astype(jnp.int32), page_table.astype(jnp.int32), *operands)
     if return_visits:
         return outs[0], outs[1]
     return outs[0]

@@ -75,8 +75,16 @@ INIT_CACHE = "init"
 # construction — token-identical output across cache layouts is the
 # contract the parity tests enforce.
 
+def _deq(v):
+    """Weight-only int8 serving (paddle_tpu/quantization/serving.py): a
+    params leaf may be a QuantizedLeaf (int8 + per-channel scale) —
+    dequantize AT USE, inside whatever program is tracing. Float leaves
+    pass through untouched, so the same decode math serves both."""
+    return v.dequant() if hasattr(v, "dequant") else v
+
+
 def _pget(p, layer, suffix):
-    return p[f"gpt.h.{layer}.{suffix}"]
+    return _deq(p[f"gpt.h.{layer}.{suffix}"])
 
 
 def _ln_ref(x, w, b):
@@ -116,7 +124,7 @@ def _block_stack(p, x, nl, nh, dh, attend):
 
 def _final_logits(p, x):
     x = _ln_ref(x, p["gpt.ln_f.weight"], p["gpt.ln_f.bias"])
-    return (x @ p["gpt.wte.weight"].T).astype(jnp.float32)
+    return (x @ _deq(p["gpt.wte.weight"]).T).astype(jnp.float32)
 
 
 def _causal_attend(scale, cmask, dtype):
@@ -167,6 +175,11 @@ def decode_step(params, ids, cache, slot_mask, *, cfg):
                   k_pages/v_pages : [nl, num_pages, page_size, nh, dh]
                   page_table      : [B, pages_per_slot] int32
                   lengths         : [B] int32 tokens already cached
+                  k_scale/v_scale : OPTIONAL [nl, num_pages, page_size, nh]
+                                    f32 — present iff the pool is int8
+                                    (EngineConfig.kv_dtype="int8"): writes
+                                    quantize per-head abs-max, reads
+                                    dequantize after the page gather/DMA
     slot_mask : [B] bool — active slots
     returns   : (logits [B, V] f32, new cache with lengths advanced)
     """
@@ -174,6 +187,7 @@ def decode_step(params, ids, cache, slot_mask, *, cfg):
     nl, nh = cfg.num_layers, cfg.num_heads
     dh = cfg.hidden_size // nh
     kc, vc = cache["k_pages"], cache["v_pages"]
+    ks, vs = cache.get("k_scale"), cache.get("v_scale")
     page_table, lengths = cache["page_table"], cache["lengths"]
     ps = kc.shape[2]
     # write position = current length; clamp only to keep gathers in range
@@ -182,20 +196,31 @@ def decode_step(params, ids, cache, slot_mask, *, cfg):
     x = params["gpt.wte.weight"][ids] + params["gpt.wpe.weight"][pos]
 
     def attend(i, q, k, v):
-        nonlocal kc, vc
+        nonlocal kc, vc, ks, vs
         page, off = pa.token_page_coords(page_table, pos, slot_mask, ps)
-        kc = kc.at[i, page, off].set(k)
-        vc = vc.at[i, page, off].set(v)
-        return pa.paged_attention(q, kc[i], vc[i], page_table, pos)
+        if ks is not None:
+            k, sk = pa.quantize_kv(k)
+            v, sv = pa.quantize_kv(v)
+            ks = ks.at[i, page, off].set(sk)
+            vs = vs.at[i, page, off].set(sv)
+        kc = kc.at[i, page, off].set(k.astype(kc.dtype))
+        vc = vc.at[i, page, off].set(v.astype(vc.dtype))
+        return pa.paged_attention(
+            q, kc[i], vc[i], page_table, pos,
+            k_scale=None if ks is None else ks[i],
+            v_scale=None if vs is None else vs[i])
 
     x = _block_stack(params, x, nl, nh, dh, attend)
     logits = _final_logits(params, x)
     new_cache = dict(k_pages=kc, v_pages=vc, page_table=page_table,
                      lengths=jnp.where(slot_mask, lengths + 1, lengths))
+    if ks is not None:
+        new_cache.update(k_scale=ks, v_scale=vs)
     return logits, new_cache
 
 
-def prefill_step(params, ids, length, page_table, k_pages, v_pages, *, cfg):
+def prefill_step(params, ids, length, page_table, k_pages, v_pages, *, cfg,
+                 k_scale=None, v_scale=None):
     """Bucketed single-sequence prefill into the paged cache.
 
     ids is PADDED to its bucket length S (a small power-of-two set, so
@@ -205,7 +230,13 @@ def prefill_step(params, ids, length, page_table, k_pages, v_pages, *, cfg):
     page), and returns the last REAL token's logits so the engine can
     sample the first generated token.
 
-    returns : (logits [V] f32, k_pages, v_pages)
+    With ``k_scale``/``v_scale`` (int8 pool) the writes quantize per-head
+    abs-max AND the prompt's own causal attention runs over the
+    quantize-dequantize round trip of K/V — every later read conditions on
+    the quantized cache, so one-shot, chunked, prefix-hit and handoff
+    prefills stay token-identical to each other (tests/test_quantization).
+
+    returns : (logits [V] f32, k_pages, v_pages[, k_scale, v_scale])
     """
     from paddle_tpu.kernels import paged_attention as pa
     nl, nh = cfg.num_layers, cfg.num_heads
@@ -219,19 +250,34 @@ def prefill_step(params, ids, length, page_table, k_pages, v_pages, *, cfg):
     causal = _causal_attend(scale, cmask, x.dtype)
 
     def attend(i, q, k, v):
-        nonlocal k_pages, v_pages
+        nonlocal k_pages, v_pages, k_scale, v_scale
         page, off = pa.prompt_page_coords(page_table, length, s, ps)
-        k_pages = k_pages.at[i, page, off].set(k[0])
-        v_pages = v_pages.at[i, page, off].set(v[0])
+        if k_scale is not None:
+            qk, sk = pa.quantize_kv(k[0])
+            qv, sv = pa.quantize_kv(v[0])
+            k_pages = k_pages.at[i, page, off].set(qk)
+            v_pages = v_pages.at[i, page, off].set(qv)
+            k_scale = k_scale.at[i, page, off].set(sk)
+            v_scale = v_scale.at[i, page, off].set(sv)
+            k = pa.dequantize_window(qk, sk)[None].astype(x.dtype)
+            v = pa.dequantize_window(qv, sv)[None].astype(x.dtype)
+        else:
+            k_pages = k_pages.at[i, page, off].set(
+                k[0].astype(k_pages.dtype))
+            v_pages = v_pages.at[i, page, off].set(
+                v[0].astype(v_pages.dtype))
         return causal(i, q, k, v)
 
     x = _block_stack(params, x, nl, nh, dh, attend)
     last = x[0, jnp.clip(length - 1, 0, s - 1)]
-    return _final_logits(params, last), k_pages, v_pages
+    logits = _final_logits(params, last)
+    if k_scale is not None:
+        return logits, k_pages, v_pages, k_scale, v_scale
+    return logits, k_pages, v_pages
 
 
 def prefill_chunk_step(params, ids, start, valid, page_table, k_pages,
-                       v_pages, *, cfg):
+                       v_pages, *, cfg, k_scale=None, v_scale=None):
     """One CHUNK of a decode-priority chunked prefill into the paged cache.
 
     The engine splits a long prompt into fixed-size chunks interleaved
@@ -264,26 +310,38 @@ def prefill_chunk_step(params, ids, start, valid, page_table, k_pages,
         wpe[jnp.clip(pos, 0, wpe.shape[0] - 1)][None]        # [1, C, H]
 
     def attend(i, q, k, v):
-        nonlocal k_pages, v_pages
+        nonlocal k_pages, v_pages, k_scale, v_scale
         page, off = pa.chunk_page_coords(page_table, start, valid, c, ps)
-        k_pages = k_pages.at[i, page, off].set(k[0])
-        v_pages = v_pages.at[i, page, off].set(v[0])
-        kk = pa.gather_kv(k_pages[i], page_table[None])      # [1, Lmax, ...]
-        vv = pa.gather_kv(v_pages[i], page_table[None])
+        if k_scale is not None:
+            k, sk = pa.quantize_kv(k[0])
+            v, sv = pa.quantize_kv(v[0])
+            k_scale = k_scale.at[i, page, off].set(sk)
+            v_scale = v_scale.at[i, page, off].set(sv)
+        else:
+            k, v = k[0].astype(k_pages.dtype), v[0].astype(v_pages.dtype)
+        k_pages = k_pages.at[i, page, off].set(k)
+        v_pages = v_pages.at[i, page, off].set(v)
+        kk = pa.gather_kv(k_pages[i], page_table[None]) \
+            .astype(jnp.float32)                             # [1, Lmax, ...]
+        vv = pa.gather_kv(v_pages[i], page_table[None]).astype(jnp.float32)
+        if k_scale is not None:
+            kk = kk * pa.gather_scales(k_scale[i], page_table[None])[..., None]
+            vv = vv * pa.gather_scales(v_scale[i], page_table[None])[..., None]
         lmax = kk.shape[1]
-        sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
-                        kk.astype(jnp.float32))
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, kk)
         # absolute-position causality: within-chunk future tokens sit at
         # positions > start+i and mask out exactly like unwritten pages
         mask = jnp.arange(lmax)[None, :] <= pos[:, None]     # [C, Lmax]
         sc = jnp.where(mask[None, None], sc, -1e30)
         pr = jax.nn.softmax(sc, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd", pr,
-                          vv.astype(jnp.float32)).astype(x.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", pr, vv).astype(x.dtype)
 
     x = _block_stack(params, x, nl, nh, dh, attend)
     last = x[0, jnp.clip(valid - 1, 0, c - 1)]
-    return _final_logits(params, last), k_pages, v_pages
+    logits = _final_logits(params, last)
+    if k_scale is not None:
+        return logits, k_pages, v_pages, k_scale, v_scale
+    return logits, k_pages, v_pages
 
 
 def verify_step(params, tok_seq, draft_len, cache, slot_mask, *, cfg,
@@ -330,6 +388,7 @@ def verify_step(params, tok_seq, draft_len, cache, slot_mask, *, cfg,
     dh = cfg.hidden_size // nh
     scale = 1.0 / (dh ** 0.5)
     kc, vc = cache["k_pages"], cache["v_pages"]
+    ks, vs = cache.get("k_scale"), cache.get("v_scale")
     page_table, lengths = cache["page_table"], cache["lengths"]
     ps = kc.shape[2]
     b, kp1 = tok_seq.shape
@@ -341,22 +400,28 @@ def verify_step(params, tok_seq, draft_len, cache, slot_mask, *, cfg,
         wpe[jnp.clip(pos, 0, wpe.shape[0] - 1)]                # [B, K+1, H]
 
     def attend(i, q, k, v):
-        nonlocal kc, vc
+        nonlocal kc, vc, ks, vs
         page, off = pa.verify_page_coords(page_table, pos, valid, ps)
-        kc = kc.at[i, page, off].set(k)
-        vc = vc.at[i, page, off].set(v)
-        kk = pa.gather_kv(kc[i], page_table)                   # [B, Lmax, ..]
-        vv = pa.gather_kv(vc[i], page_table)
+        if ks is not None:
+            k, sk = pa.quantize_kv(k)
+            v, sv = pa.quantize_kv(v)
+            ks = ks.at[i, page, off].set(sk)
+            vs = vs.at[i, page, off].set(sv)
+        kc = kc.at[i, page, off].set(k.astype(kc.dtype))
+        vc = vc.at[i, page, off].set(v.astype(vc.dtype))
+        kk = pa.gather_kv(kc[i], page_table).astype(jnp.float32)  # [B,Lmax,.]
+        vv = pa.gather_kv(vc[i], page_table).astype(jnp.float32)
+        if ks is not None:
+            kk = kk * pa.gather_scales(ks[i], page_table)[..., None]
+            vv = vv * pa.gather_scales(vs[i], page_table)[..., None]
         lmax = kk.shape[1]
-        sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
-                        kk.astype(jnp.float32))
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, kk)
         # absolute-position causality: query at position p sees keys 0..p —
         # within-window future drafts mask out exactly like unwritten pages
         mask = jnp.arange(lmax)[None, None, :] <= pos[:, :, None]
         sc = jnp.where(mask[:, None], sc, -1e30)
         pr = jax.nn.softmax(sc, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd", pr,
-                          vv.astype(jnp.float32)).astype(x.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", pr, vv).astype(x.dtype)
 
     x = _block_stack(params, x, nl, nh, dh, attend)
     logits = _final_logits(params, x)                          # [B, K+1, V]
@@ -386,6 +451,8 @@ def verify_step(params, tok_seq, draft_len, cache, slot_mask, *, cfg,
     new_cache = dict(k_pages=kc, v_pages=vc, page_table=page_table,
                      lengths=jnp.where(slot_mask, lengths + n_emitted,
                                        lengths))
+    if ks is not None:
+        new_cache.update(k_scale=ks, v_scale=vs)
     if sampler is None:
         return out, n_emitted, new_cache
     new_keys = jnp.take_along_axis(
@@ -569,21 +636,26 @@ def scan_blocks(blocks, x, cfg, *, training=False, dropout_keys=None):
         lp, keys = per_layer if p_drop else (per_layer, None)
         lead = h.shape[:-1]
         hn = _ln_ref(h, lp["ln_1.weight"], lp["ln_1.bias"])
-        qkv = hn @ lp["attn.qkv_proj.weight"] + lp["attn.qkv_proj.bias"]
+        # matmul leaves may be QuantizedLeaf (stacked weight-only int8):
+        # lax.scan slices the leaf's int8 values AND its per-layer scale
+        # along the nl axis, so _deq sees one layer's pair here
+        qkv = hn @ _deq(lp["attn.qkv_proj.weight"]) \
+            + lp["attn.qkv_proj.bias"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         att = attend(q.reshape(*lead, nh, dh), k.reshape(*lead, nh, dh),
                      v.reshape(*lead, nh, dh))
         att = att.reshape(*lead, nh * dh)
-        att = att @ lp["attn.out_proj.weight"] + lp["attn.out_proj.bias"]
+        att = att @ _deq(lp["attn.out_proj.weight"]) \
+            + lp["attn.out_proj.bias"]
         if p_drop:
             att = _fdropout(att, keys[0], p_drop)
         h = h + att
         hn = _ln_ref(h, lp["ln_2.weight"], lp["ln_2.bias"])
         hn = checkpoint_name(hn, "mlp_ln")
-        up = jax.nn.gelu(hn @ lp["mlp.fc_in.weight"] + lp["mlp.fc_in.bias"],
-                         approximate=True)
+        up = jax.nn.gelu(hn @ _deq(lp["mlp.fc_in.weight"])
+                         + lp["mlp.fc_in.bias"], approximate=True)
         up = checkpoint_name(up, "mlp_up")
-        m = up @ lp["mlp.fc_out.weight"] + lp["mlp.fc_out.bias"]
+        m = up @ _deq(lp["mlp.fc_out.weight"]) + lp["mlp.fc_out.bias"]
         if p_drop:
             m = _fdropout(m, keys[1], p_drop)
         h = h + m

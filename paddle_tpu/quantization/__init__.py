@@ -20,10 +20,15 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.nn.layer import Layer
 import paddle_tpu.nn as nn
 
+from paddle_tpu.quantization import comms  # noqa: F401 — runtime half
+from paddle_tpu.quantization.serving import (  # noqa: F401
+    QuantizedLeaf, quantize_gpt_params)
+
 __all__ = ["FakeQuanterWithAbsMaxObserver", "AbsmaxObserver", "QuantConfig",
            "QAT", "PTQ", "quant_dequant", "convert_to_int8", "int8_linear",
            "Int8Linear", "convert_linears_to_int8", "int8_conv2d",
-           "Int8Conv2D", "convert_convs_to_int8"]
+           "Int8Conv2D", "convert_convs_to_int8",
+           "QuantizedLeaf", "quantize_gpt_params", "comms"]
 
 
 @jax.custom_vjp

@@ -186,6 +186,47 @@ def test_prefix_hit_skips_prefill_programs():
         "a warm prefix hit must not compile anything")
 
 
+def test_int8_engine_zero_recompiles_same_program_count():
+    """Quantization keeps the AOT discipline (docs/QUANTIZATION.md): an
+    int8-KV + int8-weight engine compiles the SAME number of programs as
+    the f32 engine for the same traffic shape (scales ride the cache
+    pytree, QuantizedLeaf is pytree structure — neither is a new program),
+    and slot churn after warmup never retraces."""
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    m = _tiny_model()
+    rng = np.random.RandomState(7)
+
+    def drive(eng):
+        eng.warmup(prompt_lens=[8])
+        r = eng.submit(rng.randint(0, 64, 5).astype(np.int32), 3)
+        eng.run_until_idle(max_steps=30)
+        assert r.done
+        return len(eng._programs)
+
+    f32_programs = drive(DecodeEngine(m, EngineConfig(
+        page_size=4, max_slots=3, min_bucket=8)))
+    eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=3,
+                                       min_bucket=8, kv_dtype="int8",
+                                       weight_dtype="int8"))
+    assert drive(eng) == f32_programs, (
+        "quantized engine compiled a different program count than f32")
+    frozen = _compile_counters()
+
+    # churn: staggered joins/retires, all warm shapes — zero recompiles
+    reqs = [eng.submit(rng.randint(0, 64, 3 + i).astype(np.int32), 2 + i)
+            for i in range(3)]
+    for _ in range(2):
+        eng.step()
+    late = eng.submit(rng.randint(0, 64, 8).astype(np.int32), 4)
+    eng.run_until_idle(max_steps=100)
+    for req in reqs + [late]:
+        assert req.done
+    assert len(eng._programs) == f32_programs
+    assert _compile_counters() == frozen, (
+        "int8 engine recompiled after warmup: quantization must be "
+        "shape-invariant")
+
+
 def test_scan_train_step_compiles_once_and_donates():
     """The captured scan-over-layers train step (paddle_tpu/train): exactly
     ONE compile across N steps with changing batch CONTENTS, frozen

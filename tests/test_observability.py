@@ -377,6 +377,11 @@ def test_bench_smoke_emits_structured_json():
     assert d["resume_ok"] is True
     assert d["metrics"]["counters"]["train.checkpoints"] >= 1
     assert d["metrics"]["counters"]["train.resumes"] >= 1
+    # r10: the smoke run decodes through an int8-KV engine and pins the
+    # documented parity contract (docs/QUANTIZATION.md): prefill logits
+    # within the bound of f32, margin-gated top-1 agreement
+    assert d["kv_quant_ok"] is True
+    assert d["metrics"]["gauges"].get("engine.kv_bytes_per_token", 0) > 0
 
 
 def test_bench_emission_survives_failing_platform_plugin(tmp_path):
