@@ -867,6 +867,137 @@ def bench_overload():
         ttft_p99=ttfts[int(0.99 * (len(ttfts) - 1))] if ttfts else None)
 
 
+def bench_autoscale():
+    """Elastic-autoscaling rung (docs/SERVING.md "Autoscaling"): one seed
+    replica behind the router, an `Autoscaler` with an in-process
+    launcher, and sustained client load — the fleet must scale 1 -> N on
+    pressure and back to 1 when the load stops, with scale-down draining
+    via LIVE MIGRATION (in-flight requests resume mid-decode on a peer,
+    token-identical), and ZERO client-visible errors across the whole
+    cycle (asserted — one failed generate fails the rung). Emits its own
+    structured JSON line."""
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.inference.serve import InferenceServer, RemotePredictor
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving import (Autoscaler, AutoscalePolicy,
+                                    CallbackLauncher, Router)
+
+    paddle.seed(0)
+    S, N, CLIENTS, ROUNDS = 16, 24, 8, 3
+    cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, max_position_embeddings=128,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, S).astype(np.int32)
+               for _ in range(CLIENTS)]
+
+    def make_replica():
+        eng = DecodeEngine(model, EngineConfig(
+            page_size=16, max_slots=4, max_seq_len=S + N + 16))
+        eng.warmup(prompt_lens=[S])
+        srv = InferenceServer(None, engine=eng, auth_name="bench-fleet")
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    seed = make_replica()
+    # prime the shared AOT programs (one model object: every replica's
+    # engine reuses the same weights; first execution pays backend init).
+    # The server's serve_loop thread drives the engine — blocking on the
+    # future is the priming; calling run_until_idle here would put a
+    # second thread in the single-threaded driver loop
+    seed._engine.submit(prompts[0], max_new_tokens=2).result(timeout=300)
+
+    router = Router(replicas={"r0": f"127.0.0.1:{seed.port}"},
+                    replica_secret="bench-fleet",
+                    auth_name="bench-router", evict_cooldown_s=600.0)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+
+    servers: dict[str, InferenceServer] = {}
+    scaler = None
+
+    def spawn():
+        srv = make_replica()
+        rid = scaler.next_replica_id()
+        servers[rid] = srv
+        return rid, f"127.0.0.1:{srv.port}"
+
+    def drain(rid, endpoint, peers):
+        # pop only AFTER the drain succeeds: a raise parks the replica in
+        # the autoscaler's retry set, which calls this again — a pre-pop
+        # would turn every retry into a KeyError
+        ok = servers[rid].drain(deadline_s=60.0, migrate_peers=peers)
+        servers.pop(rid, None)
+        return ok
+
+    scaler = Autoscaler(
+        router, CallbackLauncher(spawn, drain),
+        AutoscalePolicy(min_replicas=1, max_replicas=3,
+                        up_outstanding_per_replica=2.0,
+                        down_outstanding_per_replica=0.1,
+                        hysteresis_ticks=1, up_cooldown_s=0.2,
+                        down_cooldown_s=0.2),
+        stats_fn=lambda ep: None)   # in-process fleet shares one registry
+
+    c0 = metrics.snapshot()["counters"]
+    # one cell per client thread: a shared `x[0] += n` is a racy
+    # read-modify-write that silently undercounts goodput
+    errs, done_tokens = [], [0] * CLIENTS
+
+    def one_client(i):
+        try:
+            cli = RemotePredictor(port=router.port, secret="bench-router",
+                                  timeout=300.0)
+            for _ in range(ROUNDS):
+                out = cli.generate(prompts[i], max_new_tokens=N)
+                done_tokens[i] += int(out.size) - S
+            cli.close()
+        except Exception as e:  # noqa: BLE001 — recorded, rung-failed
+            errs.append(f"{type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    ths = [threading.Thread(target=one_client, args=(i,))
+           for i in range(CLIENTS)]
+    for t in ths:
+        t.start()
+    peak = 1
+    t_load_end = time.monotonic() + 600
+    while any(t.is_alive() for t in ths) \
+            and time.monotonic() < t_load_end:
+        scaler.tick()
+        peak = max(peak, len(router.replica_ids(healthy_only=True)))
+        time.sleep(0.25)
+    for t in ths:
+        t.join(timeout=600)
+    wall = time.perf_counter() - t0
+    # load gone: tick until the fleet is back to the seed replica
+    t_idle_end = time.monotonic() + 120
+    while len(router.replica_ids(healthy_only=True)) > 1 \
+            and time.monotonic() < t_idle_end:
+        scaler.tick()
+        time.sleep(0.25)
+    n_final = len(router.replica_ids(healthy_only=True))
+    router.stop()
+    seed.drain(deadline_s=30.0)
+    c1 = metrics.snapshot()["counters"]
+    delta = {k: c1.get(k, 0) - c0.get(k, 0)
+             for k in ("autoscaler.scale_ups", "autoscaler.scale_downs",
+                       "serve.migrations_out", "serve.migrations_in",
+                       "engine.migrations_out", "engine.migrations_in")}
+    assert not errs, f"client errors during autoscale cycle: {errs[:3]}"
+    assert peak >= 2 and delta["autoscaler.scale_ups"] >= 1, (
+        f"fleet never scaled up (peak={peak}) — the rung exercised "
+        f"nothing")
+    assert n_final == 1, f"fleet did not scale back down: {n_final}"
+    return dict(goodput_tok_s=sum(done_tokens) / wall, peak_replicas=peak,
+                final_replicas=n_final, client_errors=len(errs),
+                wall_s=wall, **delta)
+
+
 def bench_router():
     """Multi-replica serving rung (paddle_tpu/serving): 2 in-process engine
     replicas behind the router under MIXED traffic — 1 long-prefill request
@@ -1307,6 +1438,35 @@ def bench_smoke():
         model, cfg, ids[0, :4].astype(np.int32), q_eng.pages_per_slot, 2)
     assert kv_quant_ok, _qdiff
 
+    # one LIVE MIGRATION (docs/SERVING.md "Live migration"): decode a few
+    # steps on a source engine, drain(migrate=True) exports the in-flight
+    # request MID-DECODE as a warm KV handoff, and a second engine resumes
+    # it through the submit_import mailbox — the final sequence must be
+    # IDENTICAL to the uninterrupted run (`migrate_ok`, asserted in
+    # tests/test_observability.py)
+    mig_prompt = ids[0, :3].astype(np.int32)
+    mig_ref = np.asarray(model.fast_generate(
+        paddle.Tensor(mig_prompt[None], _internal=True),
+        max_new_tokens=5).numpy())[0]
+    src = DecodeEngine(model, EngineConfig(page_size=2, max_slots=2,
+                                           min_bucket=4))
+    dst = DecodeEngine(model, EngineConfig(page_size=2, max_slots=2,
+                                           min_bucket=4))
+    mig_req = src.submit(mig_prompt, max_new_tokens=5)
+    for _ in range(3):
+        src.step()
+    assert not mig_req.done, "migration smoke: request finished too early"
+    src.drain(migrate=True)
+    src.step()
+    (mig_item,) = src.take_migrated(timeout=30)
+    assert mig_item.handoff is not None, "expected a warm mid-decode export"
+    rmig = dst.submit_import(mig_item.handoff,
+                             max_new_tokens=mig_item.max_new_tokens)
+    dst.run_until_idle(max_steps=64)
+    out_mig = rmig.result(timeout=30)
+    migrate_ok = bool(np.array_equal(out_mig, mig_ref))
+    assert migrate_ok, (out_mig, mig_ref)
+
     # one typed SHED + one CANCEL (overload protection & failure
     # containment, docs/ROBUSTNESS.md): admission control refuses the
     # over-limit submit with a typed Overloaded, and a cancelled queued
@@ -1370,7 +1530,7 @@ def bench_smoke():
            for short in ("ttft", "tpot", "e2e") for q in ("p50", "p99")}
     return (dt, batch * seq / dt, snap, slo, wd.dump_count == 0, router_ok,
             prefix_hits, spec_accepted, shed_count, cancelled_count,
-            resume_ok, kv_quant_ok)
+            resume_ok, kv_quant_ok, migrate_ok)
 
 
 def _retry(fn, attempts=3):
@@ -1412,7 +1572,7 @@ def main(argv=None):
         try:
             (dt, tps, snap, slo, wd_clean, router_ok, prefix_hits,
              spec_accepted, shed_count, cancelled_count,
-             resume_ok, kv_quant_ok) = bench_smoke()
+             resume_ok, kv_quant_ok, migrate_ok) = bench_smoke()
             impls = {k.rsplit(".", 1)[-1]: v
                      for k, v in snap["counters"].items()
                      if k.startswith("paged_attention.impl.") and v}
@@ -1427,6 +1587,7 @@ def main(argv=None):
                    "cancelled": cancelled_count,
                    "resume_ok": resume_ok,
                    "kv_quant_ok": kv_quant_ok,
+                   "migrate_ok": migrate_ok,
                    "prefill_chunks": snap["counters"].get(
                        "engine.prefill_chunks", 0),
                    "train_mfu": snap["gauges"].get("train.mfu"),
@@ -1673,6 +1834,31 @@ def main(argv=None):
               f"deadline_errors={ov['deadline_errors']}", file=sys.stderr)
     except Exception as e:
         _emit({"metric": "overload_goodput_tokens_per_sec", "value": 0.0,
+               "unit": "tokens/s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
+    try:
+        asd = _retry(bench_autoscale, attempts=2)
+        _emit({"metric": "autoscale_goodput_tokens_per_sec",
+               "value": round(asd["goodput_tok_s"], 1), "unit": "tokens/s",
+               "ok": True, "platform": platform,
+               "peak_replicas": asd["peak_replicas"],
+               "final_replicas": asd["final_replicas"],
+               "client_errors": asd["client_errors"],
+               "scale_ups": asd["autoscaler.scale_ups"],
+               "scale_downs": asd["autoscaler.scale_downs"],
+               "migrations_out": asd["serve.migrations_out"],
+               "migrations_in": asd["serve.migrations_in"],
+               "mix": "8 clients x 3x(16+24) sustained, scale 1->N->1, "
+                      "live migration on scale-down"})
+        print(f"# autoscale 1->{asd['peak_replicas']}->"
+              f"{asd['final_replicas']}: goodput="
+              f"{asd['goodput_tok_s']:.0f} tok/s, "
+              f"scale_ups={asd['autoscaler.scale_ups']} "
+              f"scale_downs={asd['autoscaler.scale_downs']} "
+              f"migrations={asd['serve.migrations_out']}, "
+              f"client_errors={asd['client_errors']}", file=sys.stderr)
+    except Exception as e:
+        _emit({"metric": "autoscale_goodput_tokens_per_sec", "value": 0.0,
                "unit": "tokens/s", "ok": False, "platform": platform,
                "backend_error": f"{type(e).__name__}: {e}"})
     try:

@@ -30,6 +30,18 @@ Python owns admission/retirement, the device runs fixed-shape steps:
   serialize into a replica-independent blob, so a prefill finished on one
   replica resumes decode on another token-identically — the transfer
   primitive full disaggregation rides (docs/SERVING.md).
+- **Live request migration** (`drain(migrate=True)` / `take_migrated` /
+  `submit_import`): a draining replica no longer waits out its in-flight
+  work — the driver harvests the in-flight window and exports every live
+  slot MID-DECODE as a `KVHandoff` (context = prompt + delivered tokens
+  whose KV is resident; the last sampled token rides as the seed, exactly
+  like `prefill_export`'s first token), detaching slots and pages without
+  finishing the request futures; queued / chunk-prefilling requests leave
+  as cold (prompt-only) items. The receive side is a thread-safe
+  `submit_import` MAILBOX the peer's driver applies between fixed-shape
+  steps — the same discipline as cancellation — so migration never
+  perturbs a program shape and the resumed decode is TOKEN-IDENTICAL to
+  an uninterrupted run (docs/SERVING.md "Live migration").
 - **Prefix caching** (`EngineConfig.prefix_cache`): full prompt-prefix
   pages are rolling-hashed into a per-engine prefix store over the page
   pool; a submit whose leading pages match attaches them by page-table
@@ -91,7 +103,8 @@ from paddle_tpu.observability.tracing import RequestTrace
 from paddle_tpu.testing import faults
 
 __all__ = ["EngineConfig", "PageAllocator", "GenerateRequest", "DecodeEngine",
-           "KVHandoff", "DeadlineExceeded", "Cancelled", "Overloaded"]
+           "KVHandoff", "MigrationItem", "DeadlineExceeded", "Cancelled",
+           "Overloaded", "pack_migration", "unpack_migration"]
 
 # packed slot-state upload layout: [B, _STATE_COLS + pages_per_slot] int32,
 # ONE host->device transfer per step (engine.h2d_transfers). The
@@ -500,6 +513,91 @@ class KVHandoff:
                    cache_dtype=head["dtype"], k_scales=ks, v_scales=vs)
 
 
+@dataclass
+class MigrationItem:
+    """One request leaving a draining engine (docs/SERVING.md "Live
+    migration"). WARM items (``handoff`` set) left mid-decode: the handoff's
+    prompt is the full resident CONTEXT — original prompt + every delivered
+    token whose KV is on device — and its first_token is the last sampled
+    token, riding as the seed exactly like `prefill_export`'s. COLD items
+    (``prompt`` set) never reached a seeded slot (queued, or mid
+    chunk-prefill) and re-enter a peer through plain `submit`.
+
+    ``max_new_tokens`` is the PEER-facing budget: for a warm item the seed
+    counts as the peer's first emission, so it is ``original budget -
+    delivered + 1`` — the peer's answer (context + its generated tokens) is
+    then exactly the uninterrupted run's full sequence. ``deadline_ms`` is
+    the REMAINING deadline budget at export. ``request`` is the source-local
+    future the serving layer splices the peer's tokens into; it never
+    crosses the wire (`pack_migration` drops it). ``tag`` is the request's
+    CANCEL wire tag, if one was registered: it travels WITH the request so
+    the peer can register it too — a client cancel issued after the
+    migration still reaches the engine actually decoding (serve.py).
+    ``cache``/``speculate`` carry the request's per-request opt-outs: a
+    ``cache=False`` submit promised its KV would never be shared, and a
+    migration must not quietly re-enroll it in the peer's prefix store."""
+    max_new_tokens: int
+    handoff: KVHandoff | None = None
+    prompt: np.ndarray | None = None     # cold items only
+    deadline_ms: int | None = None
+    request: GenerateRequest | None = None
+    tag: bytes | None = None
+    cache: bool = True
+    speculate: bool = True
+
+
+MIG_MAGIC = b"PTMG1\n"
+
+
+def pack_migration(item: MigrationItem) -> bytes:
+    """Serialize a :class:`MigrationItem` for the OP_MIGRATE wire op:
+    ``b"PTMG1\\n" | u32 header_len | JSON header | body`` where the body is
+    the PTKV1 handoff blob (warm) or the bare int32 prompt (cold)."""
+    head = {"max_new_tokens": int(item.max_new_tokens),
+            "deadline_ms": 0 if item.deadline_ms is None
+            else int(item.deadline_ms),
+            "warm": item.handoff is not None}
+    if item.tag is not None:
+        head["tag"] = bytes(item.tag).hex()
+    if not item.cache:
+        head["cache"] = False
+    if not item.speculate:
+        head["speculate"] = False
+    if item.handoff is None:
+        if item.prompt is None:
+            raise ValueError("cold migration item has no prompt")
+        head["prompt_len"] = int(item.prompt.size)
+        body = np.ascontiguousarray(item.prompt, np.int32).tobytes()
+    else:
+        body = item.handoff.pack()
+    hb = json.dumps(head).encode()
+    return b"".join([MIG_MAGIC, struct.pack("<I", len(hb)), hb, body])
+
+
+def unpack_migration(buf: bytes) -> MigrationItem:
+    """Wire blob -> :class:`MigrationItem` (``request`` is None — the
+    receiving engine creates its own future)."""
+    m = len(MIG_MAGIC)
+    if buf[:m] != MIG_MAGIC:
+        raise ValueError("not a migration blob (bad magic)")
+    (hlen,) = struct.unpack("<I", buf[m:m + 4])
+    head = json.loads(buf[m + 4:m + 4 + hlen].decode())
+    off = m + 4 + hlen
+    dl = int(head.get("deadline_ms", 0)) or None
+    mnt = int(head["max_new_tokens"])
+    tag = bytes.fromhex(head["tag"]) if "tag" in head else None
+    cache = bool(head.get("cache", True))
+    speculate = bool(head.get("speculate", True))
+    if head.get("warm"):
+        return MigrationItem(max_new_tokens=mnt, deadline_ms=dl, tag=tag,
+                             cache=cache, speculate=speculate,
+                             handoff=KVHandoff.unpack(buf[off:]))
+    s0 = int(head["prompt_len"])
+    prompt = np.frombuffer(buf, np.int32, count=s0, offset=off).copy()
+    return MigrationItem(max_new_tokens=mnt, deadline_ms=dl, tag=tag,
+                         cache=cache, speculate=speculate, prompt=prompt)
+
+
 class DecodeEngine:
     """Continuous-batching decode over a paged KV cache for one GPT model.
 
@@ -597,6 +695,17 @@ class DecodeEngine:
         # cancellation mailbox: any thread posts request_id -> reason, the
         # driver applies it between fixed-shape steps (_reap)
         self._cancels: dict[str, str] = {}
+        # live-migration state (docs/SERVING.md "Live migration"): the
+        # OUTBOUND side is driver-only — drain(migrate=True) posts a flag,
+        # step() exports every live request into _migrated and sets the
+        # event take_migrated() waits on. The INBOUND side is a mailbox:
+        # submit_import() posts (handoff, request) from any thread and the
+        # driver places it between fixed-shape steps (_apply_imports), the
+        # same discipline as cancellation
+        self._migrate_requested = False
+        self._migrated: list[MigrationItem] = []
+        self._migrate_done = threading.Event()
+        self._imports: deque = deque()
         self._deg = 0                 # applied degradation level (driver)
         # chunked-prefill progress: slot -> {"req", "done", "t0"}; slots
         # here are occupied (slot_req set, pages held) but NOT decode-active
@@ -645,6 +754,8 @@ class DecodeEngine:
         self._g_spec_rate = metrics.gauge("engine.spec_accept_rate")
         self._g_spec_tps = metrics.gauge("engine.spec_tokens_per_step")
         self._m_shed = metrics.counter("engine.shed")
+        self._m_mig_out = metrics.counter("engine.migrations_out")
+        self._m_mig_in = metrics.counter("engine.migrations_in")
         self._m_cancelled = metrics.counter("engine.cancelled")
         self._m_deadline = metrics.counter("engine.deadline_exceeded")
         self._g_deg = metrics.gauge("engine.degradation_level")
@@ -1073,11 +1184,7 @@ class DecodeEngine:
         SHED rung of the pressure ladder: past the configured queue bound
         the submit fails fast with a typed, resubmittable ``Overloaded``
         instead of joining a queue it would only time out in."""
-        if self._dead is not None:
-            raise RuntimeError(f"engine stopped: {self._dead}")
-        if self._draining:
-            raise RuntimeError(
-                "engine draining: not accepting new requests")
+        self._refuse_not_accepting()
         mqd, mqt = self.ecfg.max_queue_depth, self.ecfg.max_queue_tokens
         if mqd is not None and len(self._queue) >= int(mqd):
             self._m_shed.inc()
@@ -1117,7 +1224,9 @@ class DecodeEngine:
             if self._dead is not None:
                 return False
             self._cancels[request_id] = reason
-            known = any(r.request_id == request_id for r in self._queue)
+            known = any(r.request_id == request_id for r in self._queue) \
+                or any(r.request_id == request_id
+                       for _, r in self._imports)
             self._work.notify()
         # slot/prefilling membership is driver-owned state; this read is a
         # benign race (a stale True just means the reap finds nothing)
@@ -1151,10 +1260,31 @@ class DecodeEngine:
                 self._queue_tokens -= int(req.prompt.size)
             if drop:
                 self._g_queue.set(len(self._queue))
+            # the import mailbox is cancellable too: a deferred migration
+            # import whose sender gave up (disconnect, wait budget) must
+            # not later claim a slot and decode into a dead future
+            drop_imports = [(h, req) for h, req in self._imports
+                            if req.request_id in cancels]
+            if drop_imports:
+                # rebuild instead of deque.remove: equality on the
+                # (KVHandoff, req) tuple hits the dataclass __eq__ over
+                # numpy page arrays — "truth value is ambiguous" on the
+                # driver thread the moment two deferred imports share a
+                # shape. Filter by request identity like abort() does.
+                keep = [(h, req) for h, req in self._imports
+                        if req.request_id not in cancels]
+                self._imports.clear()
+                self._imports.extend(keep)
         for req, err in drop:
             self._count_reap(err)
             flight.record("engine.reap", request_id=req.request_id,
                           where="queue", error=err)
+            req._finish(err)
+        for _, req in drop_imports:
+            err = f"Cancelled: {cancels[req.request_id]}"
+            self._count_reap(err)
+            flight.record("engine.reap", request_id=req.request_id,
+                          where="import_mailbox", error=err)
             req._finish(err)
         now = time.monotonic()
         for slot in range(self.ecfg.max_slots):
@@ -1462,8 +1592,12 @@ class DecodeEngine:
             self._seed_first_token(slot, req, first)
         return True
 
-    def _retire(self, slot: int, error: str | None = None):
-        req = self._slot_req[slot]
+    def _detach_slot(self, slot: int):
+        """Release a slot's device-facing state — pages (per-owner
+        refcounted free: shared prefix pages survive for other owners),
+        mirrors, draft index — WITHOUT touching the request future. Shared
+        by `_retire` (which then finishes the future) and the migration
+        export (which hands the future to the serving layer instead)."""
         self._prefilling.pop(slot, None)
         self.allocator.free(self._slot_pages[slot])
         self._slot_pages[slot] = []
@@ -1474,6 +1608,10 @@ class DecodeEngine:
         self._budget[slot] = 0
         self._page_table[slot] = TRASH_PAGE
         self._lengths[slot] = 0
+
+    def _retire(self, slot: int, error: str | None = None):
+        req = self._slot_req[slot]
+        self._detach_slot(slot)
         if req is not None:
             flight.record("engine.retire", request_id=req.request_id,
                           slot=slot, tokens=len(req.generated), error=error)
@@ -1664,6 +1802,9 @@ class DecodeEngine:
             faults.fire("engine.crash")        # armed with exc=: raises —
             #                                    serve_loop aborts waiters
         self._reap()
+        if self._migrate_requested:
+            self._do_migrate_out()
+        self._apply_imports()
         self._apply_degradation()
         self._admit()
         # capacity tripwire: a token at pos >= slot_capacity would spill to
@@ -1707,7 +1848,7 @@ class DecodeEngine:
             harvested += self._harvest_one()
         elif not chunked:
             with self._qlock:
-                return bool(self._queue)
+                return bool(self._queue) or bool(self._imports)
         dt = time.perf_counter() - t_step
         self._h_step.observe(dt)
         self._h_host.observe((dt - self._blocked_s) * 1e3)
@@ -1802,7 +1943,8 @@ class DecodeEngine:
                          k_scales=ks_np, v_scales=vs_np)
 
     def import_request(self, handoff: KVHandoff, max_new_tokens=32,
-                       trace=None) -> GenerateRequest:
+                       trace=None, cache=True,
+                       speculate=True) -> GenerateRequest:
         """Resume decode from a :class:`KVHandoff` exported on ANOTHER
         engine/replica: allocate a slot + pages here, scatter the imported
         page contents in, and continue decoding — token-identical to having
@@ -1813,6 +1955,69 @@ class DecodeEngine:
         queueing. Pass the ORIGINATING request's ``trace`` to keep SLO
         accounting honest across the transfer — with the default fresh
         trace, TTFT on this engine measures only the import itself."""
+        req = self._build_import_request(handoff, max_new_tokens,
+                                         trace=trace, cache=cache,
+                                         speculate=speculate)
+        with self._work:
+            self._refuse_not_accepting()
+            req.trace.mark_submit()
+        slots = self._free_slots()
+        if not slots:
+            raise RuntimeError("no free slot for KV import")
+        need = -(-(int(req.prompt.size) + req.max_new_tokens)
+                 // self.ecfg.page_size)
+        pages = self.allocator.alloc(need)
+        if pages is None:
+            raise RuntimeError(
+                f"KV import needs {need} pages, "
+                f"{self.allocator.free_pages} free")
+        self._place_import(req, handoff, slots[0], pages)
+        return req
+
+    def _build_import_request(self, handoff: KVHandoff, max_new_tokens,
+                              deadline_s=None, trace=None, cache=True,
+                              speculate=True) -> GenerateRequest:
+        """Shared validation for BOTH import paths (`import_request` and
+        the migration mailbox `submit_import`): check the handoff and the
+        budget on the CALLING thread — a refusal must travel back to the
+        sender, never surface on the driver — and build the request
+        future. Both paths accept the same handoffs by construction; the
+        caller applies `_refuse_not_accepting` under its own ``_work``
+        acquisition (the mailbox path must refuse and append atomically)."""
+        self._check_handoff(handoff)
+        ids = np.ascontiguousarray(handoff.prompt).reshape(-1)\
+            .astype(np.int32)
+        n = int(max_new_tokens)
+        if n < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {n}")
+        if ids.size + n > self.max_seq_len:
+            raise ValueError(
+                f"prompt {ids.size} + max_new_tokens {n} exceeds engine "
+                f"max_seq_len={self.max_seq_len}")
+        req = GenerateRequest(ids, n, trace=trace, cache=cache,
+                              speculate=speculate, deadline_s=deadline_s)
+        if self._prefix_enabled and req.cache:
+            # imported pages are cache-eligible: _seed_first_token indexes
+            # them, so a shared-prefix submit AFTER the import reuses them
+            # — unless the request opted out (the opt-out survives the
+            # migration: a cache=False promise holds on every engine)
+            req.page_hashes = self._page_hashes(ids)
+        return req
+
+    def _refuse_not_accepting(self):
+        """Typed not-taking-work refusals (dead/draining). Caller holds
+        ``_work`` (or ``_qlock`` on the submit path)."""
+        if self._dead is not None:
+            raise RuntimeError(f"engine stopped: {self._dead}")
+        if self._draining:
+            raise RuntimeError(
+                "engine draining: not accepting new requests")
+
+    def _check_handoff(self, handoff: KVHandoff):
+        """Geometry/dtype refusal shared by `import_request` and the
+        migration mailbox (`submit_import`) — a mismatched handoff must
+        fail LOUDLY on the posting thread, never silently cast on the
+        driver."""
         if int(handoff.page_size) != int(self.ecfg.page_size):
             raise ValueError(
                 f"page_size mismatch: handoff {handoff.page_size} vs "
@@ -1840,45 +2045,24 @@ class DecodeEngine:
                 f"cache geometry mismatch: handoff pages "
                 f"{handoff.k_pages.shape} vs engine [nl={self._nl}, "
                 f"ps={self.ecfg.page_size}, nh={self._nh}, dh={self._dh}]")
-        ids = np.ascontiguousarray(handoff.prompt).reshape(-1)\
-            .astype(np.int32)
-        n = int(max_new_tokens)
-        if n < 1:
-            raise ValueError(f"max_new_tokens must be >= 1, got {n}")
-        if ids.size + n > self.max_seq_len:
+        if n_src != -(-int(handoff.prompt.size) // self.ecfg.page_size):
             raise ValueError(
-                f"prompt {ids.size} + max_new_tokens {n} exceeds engine "
-                f"max_seq_len={self.max_seq_len}")
-        if n_src != -(-ids.size // self.ecfg.page_size):
-            raise ValueError(
-                f"handoff has {n_src} pages for a {ids.size}-token prompt "
-                f"at page_size {self.ecfg.page_size}")
-        req = GenerateRequest(ids, n, trace=trace)
-        if self._prefix_enabled:
-            # imported pages are cache-eligible: _seed_first_token indexes
-            # them, so a shared-prefix submit AFTER the import reuses them
-            req.page_hashes = self._page_hashes(ids)
-        with self._work:
-            if self._dead is not None:
-                raise RuntimeError(f"engine stopped: {self._dead}")
-            if self._draining:
-                raise RuntimeError(
-                    "engine draining: not accepting new requests")
-            req.trace.mark_submit()
-        slots = self._free_slots()
-        if not slots:
-            raise RuntimeError("no free slot for KV import")
-        need = -(-(ids.size + n) // self.ecfg.page_size)
-        pages = self.allocator.alloc(need)
-        if pages is None:
-            raise RuntimeError(
-                f"KV import needs {need} pages, "
-                f"{self.allocator.free_pages} free")
+                f"handoff has {n_src} pages for a {handoff.prompt.size}-"
+                f"token prompt at page_size {self.ecfg.page_size}")
+
+    def _place_import(self, req: GenerateRequest, handoff: KVHandoff,
+                      slot: int, pages: list[int]):
+        """Driver-thread placement of a VALIDATED handoff: scatter the
+        imported page contents into this pool's pages, publish the slot,
+        seed the first token. Shared by `import_request` (immediate,
+        raises on a full engine) and `_apply_imports` (the migration
+        mailbox, which defers instead)."""
+        n_src = handoff.k_pages.shape[1]
         self._m_requests.inc()
-        slot = slots[0]
         req.trace.mark_admitted()
         flight.record("engine.kv_import", request_id=req.request_id,
-                      slot=slot, pages=len(pages), prompt_len=int(ids.size))
+                      slot=slot, pages=len(pages),
+                      prompt_len=int(req.prompt.size))
         from paddle_tpu.kernels.paged_attention import import_pages
         if self._quant_kv:
             self._kc, self._vc, self._ks, self._vs = import_pages(
@@ -1897,7 +2081,210 @@ class DecodeEngine:
         self._slot_pages[slot] = pages
         metrics.counter("engine.kv_imports").inc()
         self._seed_first_token(slot, req, int(handoff.first_token))
+
+    # ------------------------------------------------------ live migration
+
+    def submit_import(self, handoff: KVHandoff, max_new_tokens=32,
+                      deadline_s=None, trace=None, cache=True,
+                      speculate=True) -> GenerateRequest:
+        """Thread-safe receive side of live migration (docs/SERVING.md
+        "Live migration"): validate the handoff HERE on the posting thread
+        (loud geometry/dtype refusal travels back to the sender), post it
+        to the import mailbox, and return the request future immediately.
+        The DRIVER applies the mailbox between fixed-shape steps
+        (`_apply_imports`) — the same discipline as cancellation — so a
+        peer's connection threads never touch device state and the
+        resumed decode is token-identical with zero recompiles
+        (tests/test_no_retrace.py). Unlike `import_request`, a full
+        engine DEFERS the placement to a later step instead of raising;
+        an engine that could never fit it answers a typed error."""
+        # double-checked like submit(): fail a draining/dead engine fast,
+        # BEFORE the O(context) blake2b pass in _build_import_request —
+        # the drain fallback chain probes peers exactly when that pass
+        # hurts most. The second check below is the authoritative one,
+        # atomic with the mailbox append.
+        with self._work:
+            self._refuse_not_accepting()
+        req = self._build_import_request(handoff, max_new_tokens,
+                                         deadline_s=deadline_s,
+                                         trace=trace, cache=cache,
+                                         speculate=speculate)
+        with self._work:
+            self._refuse_not_accepting()
+            req.trace.mark_submit()
+            flight.record("engine.migrate_in", request_id=req.request_id,
+                          context_len=int(req.prompt.size),
+                          max_new_tokens=req.max_new_tokens)
+            self._imports.append((handoff, req))
+            self._work.notify()
         return req
+
+    def _apply_imports(self):
+        """Driver-side mailbox drain, run at every step start: place each
+        posted handoff into a free slot. No slot/pages RIGHT NOW is a
+        deferral while the engine still has retiring work; on an idle
+        engine it is a typed failure (nothing will ever free capacity)."""
+        if not self._imports:
+            return
+        retry = []
+        while True:
+            with self._qlock:
+                if not self._imports:
+                    break
+                handoff, req = self._imports.popleft()
+            if req.done:
+                continue
+            if req.expired():
+                err = self._deadline_error(req)
+                self._count_reap(err)
+                req._finish(err)
+                continue
+            slots = self._free_slots()
+            need = -(-(req.prompt.size + req.max_new_tokens)
+                     // self.ecfg.page_size)
+            pages = self.allocator.alloc(need) if slots else None
+            if pages is None:
+                if self._occupied() or self._inflight or self._prefilling:
+                    retry.append((handoff, req))  # capacity will free up
+                    continue
+                req._finish(f"KV import needs a slot and {need} pages; "
+                            f"engine has {len(slots)} free slots, "
+                            f"{self.allocator.free_pages} free pages and "
+                            f"no retiring work")
+                continue
+            self._m_mig_in.inc()
+            self._place_import(req, handoff, slots[0], pages)
+        if retry:
+            with self._qlock:
+                self._imports.extend(retry)
+
+    @staticmethod
+    def _deadline_ms_left(req: GenerateRequest,
+                          now: float | None = None) -> int | None:
+        if req.deadline_t is None:
+            return None
+        now = time.monotonic() if now is None else now
+        return max(1, int((req.deadline_t - now) * 1000))
+
+    def _do_migrate_out(self):
+        """Driver-side migration export (drain(migrate=True)): harvest the
+        whole in-flight window so every delivered token is settled, then
+        export each live slot MID-DECODE as a warm :class:`MigrationItem`
+        — context = prompt + delivered tokens whose KV is resident, the
+        last sampled token riding as the seed — detaching slots and pages
+        WITHOUT finishing the request futures. Queued and chunk-prefilling
+        requests (no seeded KV worth moving) leave cold, and an un-applied
+        import mailbox is re-exported warm as-is. `take_migrated` hands
+        the items to the serving layer."""
+        self._migrate_requested = False
+        while self._inflight:
+            self._harvest_one()
+        self._g_inflight.set(0)
+        items: list[MigrationItem] = []
+        now = time.monotonic()
+        for slot in range(self.ecfg.max_slots):
+            req = self._slot_req[slot]
+            if req is None or req.done:
+                continue
+            if req.expired(now):
+                err = self._deadline_error(req)
+                self._count_reap(err)
+                self._retire(slot, error=err)
+                continue
+            left = self._deadline_ms_left(req, now)
+            if slot in self._prefilling or not req.generated:
+                # mid-chunk-prefill: the cheap move is to re-prefill on
+                # the peer (cold), not to ship a partial page set
+                item = MigrationItem(max_new_tokens=req.max_new_tokens,
+                                     prompt=req.prompt, deadline_ms=left,
+                                     request=req, cache=req.cache,
+                                     speculate=req.speculate)
+            else:
+                # warm: KV is resident for prompt + generated[:-1] (the
+                # last sampled token's KV is written by the NEXT step,
+                # which will now run on the peer)
+                ctx = int(self._lengths[slot])
+                n_src = -(-ctx // self.ecfg.page_size)
+                from paddle_tpu.kernels.paged_attention import export_pages
+                ks_np = vs_np = None
+                if self._quant_kv:
+                    k_b, v_b, ks_b, vs_b = export_pages(
+                        self._kc, self._vc, self._slot_pages[slot][:n_src],
+                        k_scales=self._ks, v_scales=self._vs)
+                    ks_np, vs_np = np.asarray(ks_b), np.asarray(vs_b)
+                else:
+                    k_b, v_b = export_pages(
+                        self._kc, self._vc, self._slot_pages[slot][:n_src])
+                context = np.concatenate(
+                    [req.prompt, np.asarray(req.generated[:-1], np.int32)])
+                handoff = KVHandoff(
+                    prompt=context, first_token=int(req.generated[-1]),
+                    k_pages=np.asarray(k_b), v_pages=np.asarray(v_b),
+                    page_size=int(self.ecfg.page_size),
+                    cache_dtype=np.dtype(self._cdtype).name,
+                    k_scales=ks_np, v_scales=vs_np)
+                # the seed counts as the peer's first emission, so the
+                # peer budget is remaining + 1 — its full answer is then
+                # exactly the uninterrupted run's sequence
+                item = MigrationItem(
+                    max_new_tokens=req.max_new_tokens
+                    - len(req.generated) + 1,
+                    handoff=handoff, deadline_ms=left, request=req,
+                    cache=req.cache, speculate=req.speculate)
+            flight.record("engine.migrate_out", request_id=req.request_id,
+                          warm=item.handoff is not None,
+                          delivered=len(req.generated))
+            self._detach_slot(slot)
+            items.append(item)
+        with self._qlock:
+            queued = list(self._queue)
+            self._queue.clear()
+            self._queue_tokens = 0
+            self._g_queue.set(0)
+            imports = list(self._imports)
+            self._imports.clear()
+        for req in queued:
+            if req.done:
+                continue
+            if req.expired(now):
+                err = self._deadline_error(req)
+                self._count_reap(err)
+                req._finish(err)
+                continue
+            items.append(MigrationItem(
+                max_new_tokens=req.max_new_tokens, prompt=req.prompt,
+                deadline_ms=self._deadline_ms_left(req, now), request=req,
+                cache=req.cache, speculate=req.speculate))
+        for handoff, req in imports:
+            # a warm import this engine never placed migrates onward as-is
+            if req.done:
+                continue
+            items.append(MigrationItem(
+                max_new_tokens=req.max_new_tokens, handoff=handoff,
+                deadline_ms=self._deadline_ms_left(req, now), request=req,
+                cache=req.cache, speculate=req.speculate))
+        self._m_mig_out.inc(len(items))
+        self._g_occupancy.set(0)
+        with self._qlock:
+            self._migrated.extend(items)
+        flight.record("engine.migrated", count=len(items))
+        self._migrate_done.set()
+
+    def take_migrated(self, timeout: float | None = None) \
+            -> list[MigrationItem]:
+        """Block until the driver has exported the in-flight work a
+        `drain(migrate=True)` requested, then hand the items (futures
+        still UNFINISHED) to the caller — the serving layer ships them to
+        peers and splices the answers into the original futures. Raises
+        ``TimeoutError`` if the driver did not reach the export inside
+        ``timeout`` (wedged step)."""
+        if not self._migrate_done.wait(timeout):
+            raise TimeoutError(
+                "migration export still pending (driver has not reached "
+                "a step boundary)")
+        with self._qlock:
+            items, self._migrated = self._migrated, []
+        return items
 
     # ------------------------------------------------------------ watchdog
 
@@ -1919,7 +2306,7 @@ class DecodeEngine:
 
     def _has_work(self) -> bool:
         with self._qlock:
-            queued = bool(self._queue)
+            queued = bool(self._queue) or bool(self._imports)
         return queued or bool(self._inflight) or bool(self._prefilling) \
             or self._occupied()
 
@@ -1943,14 +2330,26 @@ class DecodeEngine:
 
     # ---------------------------------------------------------- serve loop
 
-    def drain(self):
+    def drain(self, migrate: bool = False):
         """Refuse NEW submits while everything already accepted runs to
         completion — the first half of graceful shutdown
         (`InferenceServer.drain`, docs/SERVING.md). Unlike `abort`, nothing
         in flight is failed; callers poll `_has_work()` / watch their
-        requests to know when the engine has quiesced."""
-        with self._qlock:
+        requests to know when the engine has quiesced.
+
+        ``migrate=True`` (docs/SERVING.md "Live migration"): instead of
+        waiting out the in-flight generations, the DRIVER exports every
+        live request at its next step boundary — mid-decode slots as warm
+        KV handoffs, queued/prefilling requests cold — without finishing
+        their futures; `take_migrated` hands the items to the serving
+        layer, which ships them to a peer and answers the original
+        futures. Scale-down then costs one step + the transfer, not the
+        longest running generation."""
+        with self._work:
             self._draining = True
+            if migrate:
+                self._migrate_requested = True
+            self._work.notify()
         metrics.counter("engine.drains").inc()
 
     def abort(self, reason: str):
@@ -1964,8 +2363,25 @@ class DecodeEngine:
             self._queue_tokens = 0
             self._cancels.clear()
             self._g_queue.set(0)
+            imports = list(self._imports)
+            self._imports.clear()
+            migrated = list(self._migrated)
+            self._migrated.clear()
         for req in queued:
             req._finish(reason)
+        for _, req in imports:          # un-applied migration imports
+            req._finish(reason)
+        for item in migrated:
+            # exported but never taken (take_migrated timed out / was
+            # skipped): the futures are detached from every engine
+            # structure, so nobody else will ever answer them
+            if item.request is not None and not item.request.done:
+                item.request._finish(reason)
+        # a migrate drain waiting in take_migrated must fail FAST, not
+        # burn its whole deadline on a driver that will never reach the
+        # export (the items are drained — abort already answered every
+        # future with the typed reason)
+        self._migrate_done.set()
         self._inflight.clear()               # undelivered device tokens
         self._g_inflight.set(0)
         for slot in range(self.ecfg.max_slots):

@@ -65,10 +65,19 @@ extern "C" {
 // dtype codes match serve.py's _DTYPES table
 // (0=f32 1=f64 2=i32 3=i64 4=u8 5=bool 6=f16 7=bf16 8=i8 ...).
 
+// ABI version of this shim. v1 exported PD_RemotePredictorCreate(host,
+// port); v2 added connection auth — as PD_RemotePredictorCreateV2, NOT by
+// changing the v1 symbol's arity in place (a v1-compiled caller passing
+// two arguments into a three-argument symbol reads a garbage token
+// pointer). Loaders check this before binding the V2 surface.
+int PD_ClientABIVersion() { return 2; }
+
 // token: the 32-byte sha256 connection digest (serve.py auth_token);
 // sent in the connection hello — a wrong digest gets the socket dropped.
-void* PD_RemotePredictorCreate(const char* host, int port,
-                               const unsigned char* token) {
+// May be null: an all-zero digest is sent (the server will drop the
+// connection unless it was configured to accept it).
+void* PD_RemotePredictorCreateV2(const char* host, int port,
+                                 const unsigned char* token) {
   auto* c = new Client();
   c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (c->fd < 0) {
@@ -98,6 +107,18 @@ void* PD_RemotePredictorCreate(const char* host, int port,
     return nullptr;
   }
   return c;
+}
+
+// v1 entry point, original two-argument signature: connects with the
+// all-zero digest (the pre-auth wire hello). Kept so binaries compiled
+// against the v1 header keep loading. Binaries built during the brief
+// window when this SYMBOL took (host, port, token) in place must rebuild
+// against V2 — their third argument is ignored here (C calling
+// conventions make the call itself safe) and an authed server will drop
+// the zero-digest hello; PD_ClientABIVersion() == 2 is the load-time
+// signal that the token-taking surface is the V2 symbol.
+void* PD_RemotePredictorCreate(const char* host, int port) {
+  return PD_RemotePredictorCreateV2(host, port, nullptr);
 }
 
 int PD_RemotePredictorPing(void* h) {

@@ -15,7 +15,8 @@ Run:  python -m paddle_tpu.inference.serve --model /path/prefix --port 0
 Wire protocol (little-endian):
   hello   : u32 magic | 32-byte sha256 auth digest (once per connection)
   request : u32 magic 'PRPD' | u32 op (1=run 2=ping 3=shutdown 4=stats
-            5=generate 6=prometheus 7=cancel) | u32 n_arrays | arrays...
+            5=generate 6=prometheus 7=cancel 8=migrate) | u32 n_arrays |
+            arrays...
   array   : u8 dtype | u8 ndim | u32 dims[ndim] | u64 nbytes | bytes
   response: u32 magic | u32 status (0 ok else error) |
             ok: u32 n_arrays | arrays...   err: u32 len | utf8 message
@@ -40,6 +41,19 @@ pages come back between fixed-shape steps, the generate answers a typed
 The server also cancels on its own when it detects the GENERATE client
 disconnecting mid-request (docs/ROBUSTNESS.md "Cancellation").
 
+MIGRATE (op 8, docs/SERVING.md "Live migration"): one uint8 array — a
+``PTMG1`` blob (`engine.pack_migration`: a mid-decode KV handoff or a
+cold prompt, plus the REMAINING token budget and deadline) exported by a
+DRAINING peer replica. The request resumes in this engine
+token-identically (`DecodeEngine.submit_import` mailbox, applied between
+fixed-shape steps) and the response is the full int32 id sequence —
+context + every token, exactly what the uninterrupted run would have
+answered. The sender (`InferenceServer.drain(migrate_peers=...)`)
+splices that into the ORIGINAL request future, so the client blocked on
+the draining replica sees a normal answer: scale-down and preemption
+cost zero client-visible errors. Peers authenticate with the
+fleet-shared secret (every replica's ``--auth-name``).
+
 Auth mirrors `distributed/rpc.py` (the r3 hardening this server lacked —
 r4 advisor + verdict weak #5: anyone who could reach the port could
 SHUTDOWN it): every connection must open with a 32-byte digest of the
@@ -60,6 +74,8 @@ follows the request through the engine (docs/OBSERVABILITY.md).
 from __future__ import annotations
 
 import argparse
+import collections
+import contextlib
 import hashlib
 import hmac
 import json
@@ -82,7 +98,7 @@ from paddle_tpu.testing import faults
 
 MAGIC = 0x50445250
 (OP_RUN, OP_PING, OP_SHUTDOWN, OP_STATS, OP_GENERATE, OP_PROMETHEUS,
- OP_CANCEL) = 1, 2, 3, 4, 5, 6, 7
+ OP_CANCEL, OP_MIGRATE) = 1, 2, 3, 4, 5, 6, 7, 8
 
 
 def auth_token(secret_name: str | None = None) -> bytes:
@@ -252,8 +268,21 @@ class InferenceServer:
             basis if basis is None else str(basis))
         self._registry = None          # elastic-registry lease (drain leaves)
         self._draining = False
+        self._migrating = False    # a migrate drain's export is underway
+        # --migrate-on-drain: a bare drain() (e.g. the SIGTERM handler)
+        # live-migrates in-flight work to registry-discovered peers
+        self.migrate_on_drain = False
         self._tags: dict[bytes, str] = {}   # cancel tag -> engine req id
         self._tag_lock = threading.Lock()
+        # requests in flight to a migration peer: req id -> the open
+        # OP_MIGRATE socket (None before the first ship attempt). A
+        # cancel for an EXPORTED request — the engine no longer owns it —
+        # marks _mig_cancelled and drops the socket, so the peer's own
+        # disconnect watch cancels into ITS engine (the chain composes
+        # client -> victim -> peer -> engine, tests/test_migration.py)
+        self._mig_socks: dict[str, socket.socket | None] = {}
+        self._mig_cancelled: dict[str, str] = {}
+        self._mig_lock = threading.Lock()
         self._drain_thread = None      # set by install_sigterm_drain's handler
         self._engine_thread = None
         if engine is not None:
@@ -269,20 +298,57 @@ class InferenceServer:
         self._registry = registry
         return self
 
-    def drain(self, deadline_s=30.0):
+    def drain(self, deadline_s=30.0, migrate_peers=None):
         """Graceful shutdown (SIGTERM contract, docs/SERVING.md): refuse
         new GENERATE submits, let everything in flight finish for up to
         ``deadline_s``, deregister from the elastic registry, then stop
         the server (stragglers past the deadline are aborted by the engine
         thread's shutdown path). Returns True when all in-flight work
-        finished inside the deadline."""
+        finished inside the deadline.
+
+        ``migrate_peers`` (docs/SERVING.md "Live migration"): peer
+        replica endpoints ("host:port" iterable, or a {replica_id:
+        endpoint} mapping) sharing this replica's auth secret. When
+        given — or when ``migrate_on_drain`` is set and the registry
+        lists other alive replicas — the drain LIVE-MIGRATES instead of
+        waiting: the engine exports every in-flight request at its next
+        step boundary (mid-decode ones as warm KV handoffs), each item
+        ships to a peer over OP_MIGRATE with bounded per-peer fallback,
+        and the peer's tokens are spliced into the ORIGINAL request
+        future — the blocked client (or router) sees a normal answer,
+        zero errors. Drain wall-clock becomes one step + the transfer,
+        not the longest running generation."""
         metrics.counter("serve.drains").inc()
         self._draining = True
-        if self._engine is not None:
-            self._engine.drain()
+        peers = migrate_peers
+        if peers is None and self.migrate_on_drain:
+            peers = self._discover_peers()
+        if isinstance(peers, dict):
+            peers = list(peers.values())
+        peers = [str(p) for p in (peers or [])]
+        migrate = bool(peers) and self._engine is not None
         clean = True
         if self._engine is not None:
+            if migrate:
+                # set BEFORE the engine starts exporting: _cancel_request
+                # consults this to record export-window cancels
+                self._migrating = True
+            self._engine.drain(migrate=migrate)
             t_end = time.monotonic() + float(deadline_s)
+            if migrate:
+                try:
+                    items = self._engine.take_migrated(
+                        timeout=float(deadline_s))
+                except TimeoutError:
+                    items, clean = [], False
+                if items:
+                    clean = self._migrate_items(items, peers, t_end) \
+                        and clean
+                self._migrating = False
+                with self._mig_lock:
+                    # export-window cancels for requests that never made
+                    # it into an item (completed first, or aborted)
+                    self._mig_cancelled.clear()
             while self._engine._has_work():
                 if time.monotonic() >= t_end:
                     clean = False
@@ -302,6 +368,211 @@ class InferenceServer:
             # interpreter shutdown)
             self._engine_thread.join(timeout=30.0)
         return clean
+
+    # -------------------------------------------------------- live migration
+
+    def _discover_peers(self) -> list[str]:
+        """Registry-based peer discovery for ``migrate_on_drain``: every
+        OTHER alive replica's endpoint (own lease excluded by node id and
+        endpoint). Sorted for a deterministic fallback order."""
+        if self._registry is None:
+            return []
+        try:
+            alive = self._registry.alive_nodes()
+        except OSError:
+            return []
+        own_id = getattr(self._registry, "node_id", None)
+        own_ep = str(getattr(self._registry, "endpoint", None))
+        return [str(ep) for rid, ep in sorted(alive.items())
+                if rid != own_id and str(ep) != own_ep]
+
+    def _migrate_items(self, items, peers, t_end) -> bool:
+        """Ship each exported :class:`MigrationItem` to a peer and splice
+        the peer's answer into the ORIGINAL request future. Items ship
+        CONCURRENTLY (one slow peer must not serialize the drain) with
+        bounded per-peer fallback — each peer tried at most once per item,
+        start offset rotated by item index to spread the load. Terminal
+        typed outcomes from the peer (``DeadlineExceeded``/``Cancelled``)
+        pass through to the future verbatim; transport failures and
+        not-taking-work answers fall back to the next peer; all peers
+        dead answers ONE bounded typed error, never a hang. Fault site
+        ``serve.migrate_drop`` makes a peer attempt fail (chaos: peer
+        death mid-migration, docs/ROBUSTNESS.md)."""
+        from paddle_tpu.inference.engine import pack_migration
+        done_ok = []
+        # the cancel tag (if the client registered one) travels WITH the
+        # request, so the peer can register it too and a post-migration
+        # CANCEL still reaches the engine actually decoding
+        with self._tag_lock:
+            rev = {rid: t for t, rid in self._tags.items()}
+        with self._mig_lock:
+            for it in items:
+                it.tag = rev.get(it.request.request_id)
+                self._mig_socks.setdefault(it.request.request_id, None)
+
+        def _one(idx, item):
+            req = item.request
+            arr = np.frombuffer(pack_migration(item), np.uint8)
+            last = None
+            try:
+                for k in range(len(peers)):
+                    reason = self._mig_cancel_reason(req.request_id)
+                    if reason is not None:
+                        # cancelled while migrating (client disconnect,
+                        # wait budget, CANCEL op): terminal, no more peers
+                        req._finish(f"Cancelled: {reason}")
+                        done_ok.append(True)
+                        return
+                    ep = peers[(idx + k) % len(peers)]
+                    if faults.ENABLED and faults.fire("serve.migrate_drop"):
+                        metrics.counter("serve.migrate_drops").inc()
+                        last = f"{ep}: FaultInjected: serve.migrate_drop"
+                        continue
+                    budget = t_end - time.monotonic()
+                    if budget <= 0:
+                        last = last or "migration deadline exhausted"
+                        break
+                    try:
+                        out = self._ship_migration(
+                            ep, arr, timeout=budget,
+                            track_as=req.request_id)
+                    except (DeadlineExceeded, Cancelled) as e:
+                        # terminal per-request outcomes: the deadline is
+                        # the client's own clock and the cancel its own
+                        # doing — another peer changes neither, relay
+                        # verbatim
+                        req._finish(f"{type(e).__name__}: {e}")
+                        done_ok.append(True)
+                        return
+                    except Exception as e:  # noqa: BLE001 — classify below
+                        last = f"{ep}: {type(e).__name__}: {e}"
+                        continue
+                    out = np.asarray(out).reshape(-1)
+                    req.generated = [int(t)
+                                     for t in out[req.prompt.size:]]
+                    req._finish(None)
+                    metrics.counter("serve.migrations_out").inc()
+                    done_ok.append(True)
+                    return
+                reason = self._mig_cancel_reason(req.request_id)
+                if reason is not None:
+                    # the failed exchange WAS the cancel: _cancel_request
+                    # dropped our peer socket to stop the decode
+                    req._finish(f"Cancelled: {reason}")
+                    done_ok.append(True)
+                    return
+                metrics.counter("serve.migrate_failed").inc()
+                req._finish(
+                    f"migration failed: no peer accepted the request "
+                    f"({len(peers)} tried); last: {last}")
+            finally:
+                with self._mig_lock:
+                    self._mig_socks.pop(req.request_id, None)
+                    self._mig_cancelled.pop(req.request_id, None)
+
+        # bounded worker pool, not one thread per item: a SIGTERM with a
+        # deep queue would otherwise open len(items) simultaneous sockets
+        # against a small peer set — a thread/FD storm on the victim and
+        # a connection storm on the survivors at the exact moment the
+        # fleet is losing capacity. Items still ship concurrently (one
+        # slow peer cannot serialize the drain) at a fixed cost.
+        work = collections.deque(enumerate(items))
+
+        def _runner():
+            while True:
+                try:
+                    idx, item = work.popleft()   # GIL-atomic
+                except IndexError:
+                    return
+                _one(idx, item)
+
+        ths = [threading.Thread(target=_runner, daemon=True,
+                                name=f"pt-serve-migrate-{i}")
+               for i in range(min(len(items), 16))]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=max(0.0, t_end - time.monotonic()) + 30.0)
+        return len(done_ok) == len(items)
+
+    def _mig_cancel_reason(self, request_id: str) -> str | None:
+        with self._mig_lock:
+            return self._mig_cancelled.get(request_id)
+
+    def _ship_migration(self, endpoint: str, blob_arr, timeout: float,
+                        track_as: str | None = None):
+        """One OP_MIGRATE exchange with a peer replica on a fresh authed
+        connection (the fleet-shared secret this server was started
+        with). Returns the peer's full int32 id sequence or raises the
+        peer's typed error (`from_wire`). ``track_as`` publishes the
+        socket under the migrating request's id so `_cancel_request` can
+        drop it — the only way to stop a decode that already left for
+        the peer."""
+        host, port = endpoint.rsplit(":", 1)
+        sock = retrying_connect(host, int(port), timeout=max(1.0, timeout),
+                                attempts=2,
+                                deadline_s=min(5.0, max(0.5, timeout)))
+        if track_as is not None:
+            with self._mig_lock:
+                self._mig_socks[track_as] = sock
+        try:
+            sock.sendall(struct.pack("<I", MAGIC) + self._token)
+            sock.sendall(struct.pack("<III", MAGIC, OP_MIGRATE, 1))
+            send_arrays(sock, [blob_arr])
+            magic, status, n = struct.unpack(
+                "<III", _recv_exact(sock, 12))
+            if magic != MAGIC:
+                raise ConnectionError(
+                    f"bad magic from migration peer {endpoint} (auth "
+                    f"mismatch drops the connection — the fleet must "
+                    f"share one auth secret)")
+            if status != 0:
+                raise from_wire(
+                    _recv_exact(sock, n).decode(errors="replace"))
+            (out,) = recv_arrays(sock, n)
+            return out
+        finally:
+            sock.close()
+
+    def _migrate_in(self, arrays, trace, conn):
+        """MIGRATE op body (the RECEIVING replica): unpack the PTMG1 blob,
+        resume the request — warm handoffs through the engine's
+        `submit_import` mailbox (applied between fixed-shape steps; this
+        connection thread never touches device state), cold prompts
+        through plain `submit` — and block for the full answer exactly
+        like GENERATE does, client-disconnect watch included."""
+        if self._draining:
+            raise RuntimeError(
+                "server draining: not accepting new requests")
+        if self._engine is None:
+            raise RuntimeError("no decode engine attached "
+                               "(start with --gpt-config or engine=)")
+        if len(arrays) != 1:
+            raise ValueError(
+                f"MIGRATE wants one uint8 PTMG1 blob array, "
+                f"got {len(arrays)}")
+        from paddle_tpu.inference.engine import unpack_migration
+        item = unpack_migration(
+            np.ascontiguousarray(arrays[0], np.uint8).tobytes())
+        deadline_s = None if item.deadline_ms is None \
+            else item.deadline_ms / 1000.0
+        if item.handoff is not None:
+            req = self._engine.submit_import(
+                item.handoff, max_new_tokens=item.max_new_tokens,
+                deadline_s=deadline_s, trace=trace, cache=item.cache,
+                speculate=item.speculate)
+        else:
+            req = self._engine.submit(item.prompt, item.max_new_tokens,
+                                      trace=trace, deadline_s=deadline_s,
+                                      cache=item.cache,
+                                      speculate=item.speculate)
+        # the request's cancel tag rode the blob: register it HERE so a
+        # post-migration CANCEL (the router broadcasts to every replica)
+        # reaches the engine that now owns the decode
+        with self._tagged(item.tag, req.request_id):
+            out = self._await_result(req, conn, deadline_s)
+        metrics.counter("serve.migrations_in").inc()
+        return np.ascontiguousarray(out, np.int32)
 
     def serve_forever(self):
         while not self._stop.is_set():
@@ -365,7 +636,8 @@ class InferenceServer:
                 t0 = time.perf_counter()
                 # the request's SLO clock starts HERE, at wire accept —
                 # body receive, queue wait, prefill and decode all count
-                trace = RequestTrace() if op == OP_GENERATE else None
+                trace = RequestTrace() \
+                    if op in (OP_GENERATE, OP_MIGRATE) else None
                 try:
                     if faults.ENABLED:
                         faults.fire("serve.slow_read")   # slow client
@@ -376,6 +648,8 @@ class InferenceServer:
                         sum(a.nbytes for a in arrays))
                     if op == OP_GENERATE:
                         outs = [self._generate(arrays, trace, conn)]
+                    elif op == OP_MIGRATE:
+                        outs = [self._migrate_in(arrays, trace, conn)]
                     elif op == OP_CANCEL:
                         outs = [self._cancel_op(arrays)]
                     else:
@@ -464,22 +738,67 @@ class InferenceServer:
             tag = np.ascontiguousarray(arrays[3], np.uint8).tobytes()
         req = self._engine.submit(ids, int(np.asarray(mnt).reshape(-1)[0]),
                                   trace=trace, deadline_s=deadline_s, **kw)
+        with self._tagged(tag, req.request_id):
+            out = self._await_result(req, conn, deadline_s)
+        metrics.counter("serve.generate_requests").inc()
+        return np.ascontiguousarray(out, np.int32)
+
+    @contextlib.contextmanager
+    def _tagged(self, tag, request_id):
+        """Register a CANCEL tag for the duration of a wait — shared by
+        GENERATE and the MIGRATE receive path (a migrated request must
+        stay cancellable on the replica that now decodes it). On exit,
+        pop only OUR registration: a concurrent request reusing the tag
+        has overwritten the mapping, and deleting it here would make
+        that request uncancellable."""
         if tag is not None:
             with self._tag_lock:
-                self._tags[tag] = req.request_id
+                self._tags[tag] = request_id
         try:
-            out = self._await_result(req, conn, deadline_s)
+            yield
         finally:
             if tag is not None:
                 with self._tag_lock:
-                    # pop only OUR registration: a concurrent GENERATE
-                    # reusing the tag has overwritten the mapping, and
-                    # deleting it here would make that request
-                    # uncancellable
-                    if self._tags.get(tag) == req.request_id:
+                    if self._tags.get(tag) == request_id:
                         del self._tags[tag]
-        metrics.counter("serve.generate_requests").inc()
-        return np.ascontiguousarray(out, np.int32)
+
+    def _cancel_request(self, request_id: str, reason: str) -> bool:
+        """Cancel ``request_id`` WHEREVER it lives: the local engine, or
+        — when a migrating drain already exported it — the peer decoding
+        it, by marking it cancelled and dropping the OP_MIGRATE socket.
+        The peer's own disconnect watch turns the EOF into an engine
+        cancel, so the chain composes client -> victim -> peer -> engine
+        and a request can never outlive its client just because it
+        migrated (tests/test_migration.py)."""
+        ok = False
+        if self._engine is not None:
+            ok = bool(self._engine.cancel(request_id, reason=reason))
+        with self._mig_lock:
+            if request_id in self._mig_socks:
+                self._mig_cancelled[request_id] = reason
+                sock = self._mig_socks[request_id]
+                ok = True
+                if sock is not None:
+                    try:
+                        sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass       # exchange already over: nothing to stop
+            elif self._migrating:
+                # the EXPORT WINDOW: during a MIGRATING drain the driver
+                # detaches a request (engine.cancel misses it — or worse,
+                # answers a stale True off the slot mirror it is mid-way
+                # through detaching) before _migrate_items registers it in
+                # _mig_socks. Record the cancel UNCONDITIONALLY — even on
+                # ok=True, the same mailbox discipline as engine.cancel's
+                # _admit/_place window — so _migrate_items finishes it
+                # typed-Cancelled instead of shipping it to a peer that
+                # would decode for a gone client. Entries for requests
+                # that never migrate are swept at drain end. (A plain
+                # drain has no export window: the flag keeps a cancel
+                # racing normal completion a clean miss there.)
+                self._mig_cancelled[request_id] = reason
+                ok = True
+        return ok
 
     def _await_result(self, req, conn, deadline_s):
         """Block on the request future, but never blindly: the wait polls
@@ -503,15 +822,15 @@ class InferenceServer:
                 # read — and the router, classifying this timeout as
                 # resubmittable, would start a duplicate elsewhere while
                 # this replica still burns steps on the original
-                self._engine.cancel(req.request_id,
-                                    reason="serve wait budget exhausted")
+                self._cancel_request(req.request_id,
+                                     reason="serve wait budget exhausted")
                 raise TimeoutError("generation still running")
             if watch and not self._stop.is_set():
                 state = peek_disconnect(conn)
                 if state == "pipelined":
                     watch = False
                 elif state == "gone":
-                    self._engine.cancel(
+                    self._cancel_request(
                         req.request_id, reason="client disconnected")
                     metrics.counter("serve.disconnect_cancels").inc()
                     raise ConnectionError(
@@ -529,8 +848,8 @@ class InferenceServer:
         with self._tag_lock:
             rid = self._tags.get(tag)
         ok = False
-        if rid is not None and self._engine is not None:
-            ok = self._engine.cancel(rid, reason="CANCEL wire op")
+        if rid is not None:
+            ok = self._cancel_request(rid, reason="CANCEL wire op")
         metrics.counter("serve.cancels").inc()
         return np.asarray([1 if ok else 0], np.int32)
 
@@ -836,6 +1155,13 @@ def main(argv=None):
     ap.add_argument("--drain-deadline", type=float, default=30.0,
                     help="SIGTERM graceful-drain budget in seconds: finish "
                          "in-flight requests up to this long before exit")
+    ap.add_argument("--migrate-on-drain", action="store_true",
+                    help="SIGTERM/drain live-migrates in-flight requests "
+                         "to registry-discovered peer replicas (OP_MIGRATE "
+                         "wire op, fleet-shared auth) instead of waiting "
+                         "them out — the preemptible-VM serving contract "
+                         "(docs/SERVING.md \"Live migration\"); needs a "
+                         "registry and a fleet-shared --auth-name")
     ap.add_argument("--kv-dtype", default=None,
                     choices=["native", "f32", "bf16", "int8"],
                     help="KV page-pool storage dtype (engine servers; "
@@ -879,6 +1205,7 @@ def main(argv=None):
         engine = DecodeEngine(model, ecfg)
     srv = InferenceServer(args.model, args.host, args.port, engine=engine,
                           auth_name=args.auth_name)
+    srv.migrate_on_drain = bool(args.migrate_on_drain)
     if args.registry_dir or args.registry_addr:
         from paddle_tpu.distributed.fleet.elastic import (NodeRegistry,
                                                           TcpNodeRegistry)

@@ -23,7 +23,17 @@ Clients speak the unchanged serve wire protocol to the router
 (`RemotePredictor` works as-is); the router forwards GENERATE to a replica
 picked by policy, resubmits on replica failure, and serves its own
 STATS/PROMETHEUS from the local metrics registry.
+
+`autoscale.py` closes the elasticity loop (ROADMAP item 2): a controller
+that watches per-replica STATS + the router's outstanding view and
+spawns/drains replicas between ``min_replicas`` and ``max_replicas`` —
+scale-down drains WITH live request migration (`InferenceServer.drain
+(migrate_peers=...)`, docs/SERVING.md "Live migration"), so shrinking the
+fleet or losing a preemptible VM costs zero client-visible errors.
 """
+from paddle_tpu.serving.autoscale import (Autoscaler, AutoscalePolicy,
+                                          CallbackLauncher)
 from paddle_tpu.serving.router import POLICIES, ReplicaState, Router
 
-__all__ = ["Router", "ReplicaState", "POLICIES"]
+__all__ = ["Router", "ReplicaState", "POLICIES", "Autoscaler",
+           "AutoscalePolicy", "CallbackLauncher"]

@@ -256,13 +256,13 @@ class Router:
         # fold the registry in SYNCHRONOUSLY before listening: a
         # registry-only router must not serve its first poll_interval of
         # requests with an empty rotation
-        alive = dict(self._static)
+        reg_view = {}
         if registry is not None:
             try:
-                alive.update(registry.alive_nodes())
+                reg_view = registry.alive_nodes()
             except OSError:
                 pass               # registry not up yet: the poll catches up
-        self._sync_membership(alive)
+        self._sync_membership(reg_view)
 
         self.generated_secret = None
         if auth_name is not None:
@@ -308,37 +308,99 @@ class Router:
             return sorted(r.replica_id for r in self._replicas.values()
                           if not (healthy_only and r.draining))
 
-    def _sync_membership(self, alive: dict):
-        """Fold one registry view in: new ids join rotation (breaker
+    def replica_view(self) -> list[dict]:
+        """Point-in-time snapshot of the rotation — one dict per replica
+        with ``replica_id``/``endpoint``/``outstanding``/``breaker`` —
+        for controllers that observe the router without reaching into its
+        locking (the autoscaler, `serving/autoscale.py`)."""
+        with self._rlock:
+            return [dict(replica_id=r.replica_id, endpoint=r.endpoint,
+                         outstanding=r.outstanding, breaker=r.breaker)
+                    for r in sorted(self._replicas.values(),
+                                    key=lambda x: x.replica_id)]
+
+    def _sync_membership(self, registry_alive: dict):
+        """Fold one REGISTRY view in: new ids join rotation (breaker
         closed), missing ids (lease expired or deregistered) leave it.
+        The static set is read HERE, under `_rlock` — never from a
+        caller's snapshot — so a replica `remove_static_replica` just
+        dropped cannot be resurrected (and a freshly added one cannot be
+        transiently evicted) by a poll cycle that raced the mutation; a
+        registry lease for the SAME id still wins the endpoint (a
+        self-registering replica that restarts on a new port must be
+        followed, not pinned to its stale static entry).
         An OPEN breaker is NOT reset by the registry still vouching for
         the replica — a crashed process keeps a fresh lease until its
         TTL; re-admission is the health probe's job (open -> half_open
         after the cooldown, then a successful PING closes it)."""
         with self._rlock:
+            alive = dict(self._static)
+            alive.update(registry_alive)
             for rid, ep in alive.items():
-                r = self._replicas.get(rid)
-                if r is None:
-                    self._replicas[rid] = ReplicaState(rid, str(ep))
-                    metrics.counter("router.replica_joins").inc()
-                    flight.record("router.join", replica=rid,
-                                  endpoint=str(ep))
-                else:
-                    r.endpoint = str(ep)
+                self._join_replica(rid, str(ep))
             for rid in [rid for rid in self._replicas if rid not in alive]:
-                self._replicas.pop(rid)._g_out.set(0)
-                metrics.counter("router.replica_leaves").inc()
-                flight.record("router.leave", replica=rid)
+                self._leave_replica(self._replicas.pop(rid))
+
+    def _join_replica(self, rid: str, ep: str):
+        """Fold one replica into the rotation (or follow its endpoint) —
+        the ONE join bookkeeping path, shared by the membership poll and
+        `add_static_replica`. Caller holds ``_rlock``."""
+        r = self._replicas.get(rid)
+        if r is None:
+            self._replicas[rid] = ReplicaState(rid, ep)
+            metrics.counter("router.replica_joins").inc()
+            flight.record("router.join", replica=rid, endpoint=ep)
+        else:
+            r.endpoint = ep
+
+    @staticmethod
+    def _leave_replica(r):
+        """Leave bookkeeping for a replica already popped from the
+        rotation — the ONE leave path, shared by the membership poll and
+        `remove_static_replica`."""
+        r._g_out.set(0)
+        metrics.counter("router.replica_leaves").inc()
+        flight.record("router.leave", replica=r.replica_id)
+
+    def add_static_replica(self, replica_id: str, endpoint: str):
+        """Fold one replica into the STATIC membership at runtime (the
+        autoscaler's spawn path, `serving/autoscale.py`): it joins the
+        rotation immediately and survives registry churn like any other
+        static entry. Thread-safe; re-adding an existing id just updates
+        its endpoint. The `_static` mutation happens under `_rlock` —
+        `_sync_membership` reads `_static` under the same lock, so a poll
+        cycle can never observe (and act on) a half-applied change."""
+        rid, ep = str(replica_id), str(endpoint)
+        with self._rlock:
+            self._static[rid] = ep
+            self._join_replica(rid, ep)
+
+    def remove_static_replica(self, replica_id: str):
+        """Drop a replica from the static set AND the live rotation (the
+        autoscaler's scale-down path — called BEFORE the drain so no new
+        traffic lands on the victim while it migrates its in-flight work
+        away). Atomic with respect to the membership poll (same `_rlock`
+        discipline as `add_static_replica` — a concurrent `_sync_membership`
+        can never resurrect the victim from a stale static snapshot). A
+        registry lease for the same id re-admits it on the next poll;
+        static scale-down therefore uses launcher-owned ids that never
+        carry a lease."""
+        rid = str(replica_id)
+        with self._rlock:
+            self._static.pop(rid, None)
+            r = self._replicas.pop(rid, None)
+        if r is not None:
+            self._leave_replica(r)
 
     def _poll_loop(self):
         while not self._stop.wait(self._poll_interval):
-            alive = dict(self._static)
+            reg_view = {}
             if self._registry is not None:
                 try:
-                    alive.update(self._registry.alive_nodes())
+                    reg_view = self._registry.alive_nodes()
                 except OSError:
                     continue       # transient registry outage: hold steady
-            self._sync_membership(alive)
+            self._sync_membership(reg_view)
 
     # ------------------------------------------------------ circuit breaker
 

@@ -1,0 +1,471 @@
+"""Elastic autoscaling (serving/autoscale.py, docs/SERVING.md
+"Autoscaling"): the controller that closes the loop between the router's
+load view and the fleet size.
+
+Decision logic is tested PURELY (synthetic signals through `decide`, no
+IO, no clocks beyond cooldown monotonic reads) and the integration drill
+drives `tick()` by hand — deterministic like the chaos suites, no
+timing-dependent controller thread. The 1 -> 3 -> 1 drill under sustained
+load is the acceptance scenario: zero client-visible errors across the
+whole cycle, scale-down draining via live migration (marker ``chaos``)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import metrics
+from paddle_tpu.serving import (Autoscaler, AutoscalePolicy,
+                                CallbackLauncher, Router)
+
+pytestmark = pytest.mark.chaos
+
+FLEET_SECRET = "scale-fleet"
+
+
+def _tiny_model(seed=7):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=2, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def _replica(model, **ekw):
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.inference.serve import InferenceServer
+    ekw.setdefault("page_size", 4)
+    ekw.setdefault("max_slots", 2)
+    ekw.setdefault("min_bucket", 8)
+    srv = InferenceServer(None, engine=DecodeEngine(model,
+                                                    EngineConfig(**ekw)),
+                          auth_name=FLEET_SECRET)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _counter(name):
+    return metrics.snapshot()["counters"].get(name, 0)
+
+
+class _NullRouter:
+    """decide() is pure; observe/act never run in the policy tests."""
+
+    def replica_view(self):
+        return []
+
+
+def _scaler(policy, **kw):
+    kw.setdefault("stats_fn", lambda ep: None)
+    return Autoscaler(_NullRouter(), CallbackLauncher(
+        lambda: None, lambda *a: True), policy, **kw)
+
+
+SIG_IDLE = dict(n=2, outstanding=0, queue_depth=0, degradation=0,
+                shed_delta=0)
+SIG_HOT = dict(n=2, outstanding=20, queue_depth=8, degradation=0,
+               shed_delta=0)
+
+
+class TestPolicy:
+    def test_hysteresis_needs_consecutive_votes(self):
+        s = _scaler(AutoscalePolicy(max_replicas=4, hysteresis_ticks=3,
+                                    up_cooldown_s=0.0))
+        assert s.decide(dict(SIG_HOT)) is None
+        assert s.decide(dict(SIG_HOT)) is None
+        assert s.decide(dict(SIG_HOT)) == "up"
+
+    def test_one_calm_tick_resets_the_votes(self):
+        s = _scaler(AutoscalePolicy(max_replicas=4, hysteresis_ticks=2,
+                                    up_cooldown_s=0.0))
+        assert s.decide(dict(SIG_HOT)) is None
+        assert s.decide(dict(SIG_IDLE)) is None     # streak broken
+        assert s.decide(dict(SIG_HOT)) is None
+        assert s.decide(dict(SIG_HOT)) == "up"
+
+    def test_cooldown_blocks_back_to_back_actions(self):
+        s = _scaler(AutoscalePolicy(max_replicas=4, hysteresis_ticks=1,
+                                    up_cooldown_s=3600.0))
+        s._last_action_t = time.monotonic()         # just acted
+        assert s.decide(dict(SIG_HOT)) is None
+
+    def test_clamped_at_max_and_min(self):
+        p = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                            hysteresis_ticks=1, up_cooldown_s=0.0,
+                            down_cooldown_s=0.0)
+        s = _scaler(p)
+        assert s.decide(dict(SIG_HOT, n=2)) is None    # at max: clamped
+        assert s.decide(dict(SIG_IDLE, n=1)) is None   # at min: clamped
+        assert _scaler(p).decide(dict(SIG_HOT, n=1)) == "up"
+
+    def test_shed_and_degradation_are_up_signals(self):
+        for extra in (dict(shed_delta=3), dict(degradation=2)):
+            s = _scaler(AutoscalePolicy(max_replicas=4,
+                                        hysteresis_ticks=1,
+                                        up_cooldown_s=0.0))
+            sig = dict(SIG_IDLE, n=1, **extra)
+            assert s.decide(sig) == "up", extra
+
+    def test_down_requires_fully_quiet_fleet(self):
+        p = AutoscalePolicy(min_replicas=1, hysteresis_ticks=1,
+                            down_cooldown_s=0.0)
+        for noisy in (dict(queue_depth=1), dict(shed_delta=1),
+                      dict(degradation=1), dict(outstanding=4)):
+            s = _scaler(p)
+            # any movement vetoes the down (a shed burst may even argue up)
+            assert s.decide(dict(SIG_IDLE, **noisy)) != "down", noisy
+        s = _scaler(p)
+        assert s.decide(dict(SIG_IDLE)) == "down"
+
+
+class TestPolicyClamp:
+    def test_up_clamped_exactly_at_max(self):
+        s = _scaler(AutoscalePolicy(max_replicas=3, hysteresis_ticks=1,
+                                    up_cooldown_s=0.0))
+        assert s.decide(dict(SIG_HOT, n=3)) is None
+        assert s.decide(dict(SIG_HOT, n=2)) == "up"
+
+    def test_breaker_open_replica_still_counts_toward_max(self):
+        """The max clamp bounds the TOTAL fleet: a replica whose breaker
+        is transiently open is capacity the operator still pays for, so
+        it must not let the controller spawn past max_replicas (it
+        rejoins the moment the probe re-closes it)."""
+        s = _scaler(AutoscalePolicy(max_replicas=3, hysteresis_ticks=1,
+                                    up_cooldown_s=0.0))
+        # 3 in rotation, one breaker-open: healthy n=2 but total 3 — at max
+        assert s.decide(dict(SIG_HOT, n=2, n_total=3)) is None
+        assert s.decide(dict(SIG_HOT, n=2, n_total=2)) == "up"
+
+    def test_down_clamp_protects_the_last_healthy_replica(self):
+        """The DOWN clamp stays on the HEALTHY count: a breaker-open
+        replica padding the total must never argue for draining the last
+        replica actually serving."""
+        s = _scaler(AutoscalePolicy(min_replicas=1, hysteresis_ticks=1,
+                                    down_cooldown_s=0.0))
+        assert s.decide(dict(SIG_IDLE, n=1, n_total=2)) is None
+        assert s.decide(dict(SIG_IDLE, n=2, n_total=2)) == "down"
+
+
+class TestScalingActions:
+    def _fleet(self, model):
+        seed = _replica(model)
+        router = Router(replicas={"r0": f"127.0.0.1:{seed.port}"},
+                        replica_secret=FLEET_SECRET, auth_name="front",
+                        evict_cooldown_s=600.0)
+        threading.Thread(target=router.serve_forever, daemon=True).start()
+        return seed, router
+
+    def test_scale_up_adds_replica_to_rotation(self):
+        model = _tiny_model()
+        seed, router = self._fleet(model)
+        servers = {}
+        scaler = None
+
+        def spawn():
+            srv = _replica(model)
+            rid = scaler.next_replica_id()
+            servers[rid] = srv
+            return rid, f"127.0.0.1:{srv.port}"
+
+        def drain(rid, ep, peers):
+            return servers.pop(rid).drain(deadline_s=10.0,
+                                          migrate_peers=peers)
+
+        scaler = Autoscaler(router, CallbackLauncher(spawn, drain),
+                            AutoscalePolicy(max_replicas=2,
+                                            hysteresis_ticks=1,
+                                            up_cooldown_s=0.0,
+                                            down_cooldown_s=0.0),
+                            stats_fn=lambda ep: None)
+        with router._rlock:
+            router._replicas["r0"].outstanding = 8   # synthetic pressure
+        assert scaler.tick() == "up"
+        assert len(router.replica_ids(healthy_only=True)) == 2
+        # ...and down again once quiet; the seed replica is never drained
+        with router._rlock:
+            router._replicas["r0"].outstanding = 0
+        assert scaler.tick() == "down"
+        assert router.replica_ids(healthy_only=True) == ["r0"]
+        assert not servers, "spawned replica was not drained"
+        router.stop()
+        seed.drain(deadline_s=5.0)
+
+    def test_scale_down_never_touches_unowned_replicas(self):
+        model = _tiny_model()
+        seed, router = self._fleet(model)
+        scaler = Autoscaler(router, CallbackLauncher(
+            lambda: None, lambda *a: True),
+            AutoscalePolicy(min_replicas=0, hysteresis_ticks=1,
+                            down_cooldown_s=0.0),
+            stats_fn=lambda ep: None)
+        assert scaler.tick() is None        # idle, but r0 is not owned
+        assert router.replica_ids() == ["r0"]
+        router.stop()
+        seed.drain(deadline_s=5.0)
+
+    def test_failed_drain_retries_until_released(self):
+        """A launcher drain that RAISES (pod-delete API timeout) must not
+        leak the replica: it stays owned and parked for retry — counted
+        as an error, NOT a scale-down — and a later tick's retry releases
+        it and only then counts the scale-down."""
+        model = _tiny_model()
+        seed, router = self._fleet(model)
+        servers = {}
+        scaler = None
+        fail_next = [True]
+
+        def spawn():
+            srv = _replica(model)
+            rid = scaler.next_replica_id()
+            servers[rid] = srv
+            return rid, f"127.0.0.1:{srv.port}"
+
+        def drain(rid, ep, peers):
+            if fail_next[0]:
+                fail_next[0] = False
+                raise TimeoutError("pod delete API timed out")
+            return servers.pop(rid).drain(deadline_s=10.0,
+                                          migrate_peers=peers)
+
+        scaler = Autoscaler(router, CallbackLauncher(spawn, drain),
+                            AutoscalePolicy(max_replicas=2,
+                                            hysteresis_ticks=1,
+                                            up_cooldown_s=0.0,
+                                            down_cooldown_s=0.0),
+                            stats_fn=lambda ep: None)
+        with router._rlock:
+            router._replicas["r0"].outstanding = 8
+        assert scaler.tick() == "up"
+        with router._rlock:
+            router._replicas["r0"].outstanding = 0
+        base_down = _counter("autoscaler.scale_downs")
+        base_err = _counter("autoscaler.errors")
+        assert scaler.tick() == "down"      # rotation DID shrink...
+        # ...but the drain failed: still owned + pending, not counted
+        assert _counter("autoscaler.scale_downs") == base_down
+        assert _counter("autoscaler.errors") == base_err + 1
+        assert scaler._pending_drain and scaler._owned
+        assert servers, "replica wrongly released after a failed drain"
+        assert router.replica_ids(healthy_only=True) == ["r0"]
+        scaler.tick()                       # retry lands this time
+        assert _counter("autoscaler.scale_downs") == base_down + 1
+        assert not scaler._pending_drain and not scaler._owned
+        assert not servers, "retry did not drain the parked replica"
+        router.stop()
+        seed.drain(deadline_s=5.0)
+
+    def test_pending_drain_counts_toward_the_max_clamp(self):
+        """A replica parked for drain retry left rotation but is still
+        running and billed: it must count toward the total-capacity
+        clamp, or a failed drain plus returning pressure over-spends
+        past max_replicas."""
+        class _FakeRouter:
+            def replica_view(self):
+                return [{"replica_id": "r0", "endpoint": "127.0.0.1:9000",
+                         "breaker": "closed", "outstanding": 20}]
+
+        s = Autoscaler(_FakeRouter(), CallbackLauncher(
+            lambda: None, lambda *a: True),
+            AutoscalePolicy(max_replicas=2, hysteresis_ticks=1,
+                            up_cooldown_s=0.0),
+            stats_fn=lambda ep: None)
+        s._owned["as-1"] = "127.0.0.1:9001"
+        s._pending_drain["as-1"] = "127.0.0.1:9001"
+        sig = s.observe()
+        assert sig["n"] == 1 and sig["n_total"] == 2
+        assert s.decide(sig) is None, \
+            "spawned past max_replicas over a pending-drain replica"
+
+    def test_scale_down_guard_counts_healthy_not_total(self):
+        """scale_down() is public API: its own min_replicas guard must
+        mirror decide()'s healthy-count clamp — a breaker-open corpse
+        padding the rotation must never argue for draining the last
+        replica actually serving."""
+        class _FakeRouter:
+            def replica_view(self):
+                return [{"replica_id": "as-1",
+                         "endpoint": "127.0.0.1:9000",
+                         "breaker": "closed", "outstanding": 0},
+                        {"replica_id": "r-dead",
+                         "endpoint": "127.0.0.1:9001",
+                         "breaker": "open", "outstanding": 0}]
+
+        drained = []
+        s = Autoscaler(_FakeRouter(), CallbackLauncher(
+            lambda: None, lambda *a: drained.append(a) or True),
+            AutoscalePolicy(min_replicas=1),
+            stats_fn=lambda ep: None)
+        s._owned["as-1"] = "127.0.0.1:9000"
+        assert s.scale_down() is None
+        assert not drained, "drained the last healthy replica"
+
+    def test_scale_up_clamped_at_max_even_called_directly(self):
+        """scale_up() is public API like scale_down(): the spend clamp
+        must hold on the acting method itself, counting rotation plus
+        pending drains like decide()'s n_total."""
+        class _FakeRouter:
+            def replica_view(self):
+                return [{"replica_id": "r0", "endpoint": "e0",
+                         "breaker": "closed", "outstanding": 0}]
+
+            def add_static_replica(self, rid, ep):
+                pass
+
+        spawned = []
+        s = Autoscaler(_FakeRouter(), CallbackLauncher(
+            lambda: spawned.append(1) or ("as-1", "e1"),
+            lambda *a: True),
+            AutoscalePolicy(max_replicas=2), stats_fn=lambda ep: None)
+        s._pending_drain["as-0"] = "e9"    # still paid for
+        assert s.scale_up() is None and not spawned
+        s._pending_drain.clear()
+        assert s.scale_up() == "as-1" and spawned
+
+    def test_crashed_owned_replica_is_reaped_after_streak(self):
+        """A spawned replica that dies on its own (breaker stays open)
+        is never a scale-down victim, yet counts against max_replicas —
+        after reap_open_ticks consecutive open observations the
+        controller must remove it and have the launcher kill it, or the
+        fleet wedges below capacity forever. A breaker that re-closes
+        mid-streak resets the count: live capacity is never reaped."""
+        class _FakeRouter:
+            def __init__(self):
+                # mid-range load: neither the up nor the down signal
+                # fires, so the only mover is the reap path under test
+                self.rows = [
+                    {"replica_id": "r0", "endpoint": "e0",
+                     "breaker": "closed", "outstanding": 2},
+                    {"replica_id": "as-1", "endpoint": "e1",
+                     "breaker": "open", "outstanding": 0}]
+                self.removed = []
+
+            def replica_view(self):
+                return [dict(r) for r in self.rows]
+
+            def remove_static_replica(self, rid):
+                self.removed.append(rid)
+                self.rows = [r for r in self.rows
+                             if r["replica_id"] != rid]
+
+        drained = []
+        fr = _FakeRouter()
+        s = Autoscaler(fr, CallbackLauncher(
+            lambda: None,
+            lambda rid, ep, peers: drained.append(rid) or True),
+            AutoscalePolicy(reap_open_ticks=3),
+            stats_fn=lambda ep: None)
+        s._owned["as-1"] = "e1"
+        s.tick()
+        fr.rows[1]["breaker"] = "closed"    # transient blip re-closes
+        s.tick()
+        assert not fr.removed and s._open_streak == {}
+        fr.rows[1]["breaker"] = "open"      # now it is really dead
+        for _ in range(3):
+            assert not fr.removed
+            s.tick()
+        assert fr.removed == ["as-1"] and drained == ["as-1"]
+        assert "as-1" not in s._owned and "as-1" not in s._open_streak
+
+    def test_observe_pulls_stats_concurrently(self):
+        """Per-replica STATS pulls fan out: one dead-but-closed replica
+        must stall the tick by one probe budget, not one per corpse."""
+        class _FakeRouter:
+            def replica_view(self):
+                return [{"replica_id": f"r{i}",
+                         "endpoint": f"127.0.0.1:{9000 + i}",
+                         "breaker": "closed", "outstanding": 0}
+                        for i in range(3)]
+
+        pulls = []
+
+        def stats_fn(ep):
+            pulls.append(threading.current_thread().name)
+            time.sleep(0.2)
+            return {"gauges": {}, "counters": {}}
+
+        s = Autoscaler(_FakeRouter(), CallbackLauncher(
+            lambda: None, lambda *a: True), stats_fn=stats_fn)
+        t0 = time.monotonic()
+        sig = s.observe()
+        wall = time.monotonic() - t0
+        assert sig["n"] == 3 and len(pulls) == 3
+        assert all(n == "pt-autoscale-stats" for n in pulls), pulls
+        assert wall < 0.55, f"pulls ran serially ({wall:.2f}s for 3x0.2s)"
+
+    def test_scale_1_3_1_under_sustained_load_zero_errors(self):
+        """THE acceptance drill: sustained load scales the fleet 1 -> 3,
+        load stops, the fleet migrates its way back to 1 — zero
+        client-visible errors end to end."""
+        from paddle_tpu.inference.serve import RemotePredictor
+        model = _tiny_model()
+        seed, router = self._fleet(model)
+        servers = {}
+        scaler = None
+
+        def spawn():
+            srv = _replica(model)
+            rid = scaler.next_replica_id()
+            servers[rid] = srv
+            return rid, f"127.0.0.1:{srv.port}"
+
+        def drain(rid, ep, peers):
+            return servers.pop(rid).drain(deadline_s=30.0,
+                                          migrate_peers=peers)
+
+        scaler = Autoscaler(
+            router, CallbackLauncher(spawn, drain),
+            AutoscalePolicy(min_replicas=1, max_replicas=3,
+                            up_outstanding_per_replica=1.0,
+                            down_outstanding_per_replica=0.0,
+                            hysteresis_ticks=1, up_cooldown_s=0.0,
+                            down_cooldown_s=0.0),
+            stats_fn=lambda ep: None)
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(1, 97, 5).astype(np.int32)
+                   for _ in range(6)]
+        errs, stop_load = [], threading.Event()
+
+        def client(i):
+            try:
+                cli = RemotePredictor(port=router.port, secret="front",
+                                      timeout=120.0)
+                while not stop_load.is_set():
+                    out = cli.generate(prompts[i], max_new_tokens=16)
+                    assert out.size == prompts[i].size + 16
+                cli.close()
+            except Exception as e:  # noqa: BLE001 — the drill counts these
+                errs.append(f"{type(e).__name__}: {e}")
+
+        base_up = _counter("autoscaler.scale_ups")
+        base_down = _counter("autoscaler.scale_downs")
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(len(prompts))]
+        for t in ths:
+            t.start()
+        # drive ticks by hand until the fleet saturates at 3
+        t_end = time.monotonic() + 60
+        while len(router.replica_ids(healthy_only=True)) < 3 \
+                and time.monotonic() < t_end:
+            scaler.tick()
+            time.sleep(0.05)
+        assert len(router.replica_ids(healthy_only=True)) == 3, \
+            "fleet did not reach max_replicas under load"
+        stop_load.set()
+        for t in ths:
+            t.join(timeout=120)
+        # quiet fleet: tick back down to the seed replica
+        t_end = time.monotonic() + 60
+        while len(router.replica_ids(healthy_only=True)) > 1 \
+                and time.monotonic() < t_end:
+            scaler.tick()
+            time.sleep(0.02)
+        assert router.replica_ids(healthy_only=True) == ["r0"]
+        assert not errs, f"client errors during scale cycle: {errs[:3]}"
+        assert _counter("autoscaler.scale_ups") - base_up == 2
+        assert _counter("autoscaler.scale_downs") - base_down == 2
+        assert not servers, "a spawned replica outlived the scale-down"
+        router.stop()
+        seed.drain(deadline_s=10.0)

@@ -194,10 +194,17 @@ class TestServeOutOfProcess:
         lib = getattr(lib, "lib", lib)
         cdll = lib if isinstance(lib, ctypes.CDLL) else ctypes.CDLL(
             os.path.join(str(tmp_path / "build"), "pd_c_client.so"))
+        # r11 ABI discipline: the auth token rides the V2 symbol; the v1
+        # two-argument entry point stays exported for old binaries, and
+        # loaders gate on PD_ClientABIVersion before binding V2
+        assert cdll.PD_ClientABIVersion() == 2
         cdll.PD_RemotePredictorCreate.restype = ctypes.c_void_p
         cdll.PD_RemotePredictorCreate.argtypes = [ctypes.c_char_p,
-                                                  ctypes.c_int,
-                                                  ctypes.c_char_p]
+                                                  ctypes.c_int]
+        cdll.PD_RemotePredictorCreateV2.restype = ctypes.c_void_p
+        cdll.PD_RemotePredictorCreateV2.argtypes = [ctypes.c_char_p,
+                                                    ctypes.c_int,
+                                                    ctypes.c_char_p]
         cdll.PD_RemotePredictorRun.restype = ctypes.c_int
         cdll.PD_GetOutputData.restype = ctypes.c_void_p
         cdll.PD_GetOutputNbytes.restype = ctypes.c_int64
@@ -205,8 +212,8 @@ class TestServeOutOfProcess:
         proc, port, secret = self._start_server(prefix)
         try:
             from paddle_tpu.inference.serve import auth_token
-            h = cdll.PD_RemotePredictorCreate(b"127.0.0.1", port,
-                                              auth_token(secret))
+            h = cdll.PD_RemotePredictorCreateV2(b"127.0.0.1", port,
+                                                auth_token(secret))
             assert h, "C client failed to connect"
             h = ctypes.c_void_p(h)
             assert cdll.PD_RemotePredictorPing(h) == 1

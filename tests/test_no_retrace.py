@@ -382,3 +382,40 @@ def test_bad_step_skip_and_rollback_zero_recompiles(tmp_path):
         f"bad-step/rollback retraced the train step: {step.compile_count}")
     assert _compile_counters() == frozen, (
         "bad-step skip or checkpoint rollback recompiled after warmup")
+
+
+def test_migration_import_zero_recompiles():
+    """A live-migration import on a WARM engine compiles nothing
+    (docs/SERVING.md "Live migration"): the mailbox placement is a page
+    scatter + the same fixed-shape decode step, applied between steps —
+    exactly the cancellation discipline, so neither the export on the
+    source nor the import on the destination may touch a compile
+    counter."""
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    m = _tiny_model()
+    ekw = dict(page_size=4, max_slots=2, min_bucket=8)
+    src = DecodeEngine(m, EngineConfig(**ekw))
+    dst = DecodeEngine(m, EngineConfig(**ekw))
+    rng = np.random.RandomState(9)
+    prompt = rng.randint(0, 64, 6).astype(np.int32)
+    # warm BOTH engines through a full request (prefill bucket + decode)
+    for eng in (src, dst):
+        r = eng.submit(prompt, 4)
+        eng.run_until_idle(max_steps=40)
+        assert r.done
+
+    src.submit(prompt, 12)
+    for _ in range(3):
+        src.step()
+    frozen = _compile_counters()
+    src.drain(migrate=True)
+    src.step()
+    (item,) = src.take_migrated(timeout=10)
+    assert item.handoff is not None
+    r2 = dst.submit_import(item.handoff,
+                           max_new_tokens=item.max_new_tokens)
+    dst.run_until_idle(max_steps=60)
+    assert r2.done
+    assert _compile_counters() == frozen, (
+        "live migration compiled a program: export/import must ride the "
+        "warm fixed-shape steps")

@@ -382,6 +382,12 @@ def test_bench_smoke_emits_structured_json():
     # within the bound of f32, margin-gated top-1 agreement
     assert d["kv_quant_ok"] is True
     assert d["metrics"]["gauges"].get("engine.kv_bytes_per_token", 0) > 0
+    # r11: the smoke run exercises one LIVE MIGRATION (a mid-decode
+    # request exported as a warm KV handoff resumes on a second engine
+    # TOKEN-IDENTICAL to the uninterrupted run, docs/SERVING.md)
+    assert d["migrate_ok"] is True
+    assert d["metrics"]["counters"]["engine.migrations_out"] >= 1
+    assert d["metrics"]["counters"]["engine.migrations_in"] >= 1
 
 
 def test_bench_emission_survives_failing_platform_plugin(tmp_path):
