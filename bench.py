@@ -998,6 +998,133 @@ def bench_autoscale():
                 wall_s=wall, **delta)
 
 
+def bench_router_ha():
+    """Control-plane HA rung (docs/ROBUSTNESS.md "Control-plane HA"):
+    TWO redundant routers over a 2-replica fleet, 8 clients of sustained
+    keyed load, and one router KILLED HARD mid-run (listener + every
+    live connection). Asserted: ZERO client-visible errors, failover
+    count >= 1, and the disturbed phase's goodput within 10% of the
+    undisturbed phase — losing a router must cost a reconnect, not
+    throughput. Every resubmit rides the idempotency dedup table, so the
+    kill also can't cost duplicate generations (engine.requests is
+    pinned to the logical request count). Emits its own JSON line."""
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.inference.serve import InferenceServer, RemotePredictor
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving import Router
+
+    paddle.seed(0)
+    S, N, CLIENTS, ROUNDS = 16, 24, 8, 3
+    cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, max_position_embeddings=128,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, S).astype(np.int32)
+               for _ in range(CLIENTS)]
+
+    def make_replica():
+        eng = DecodeEngine(model, EngineConfig(
+            page_size=16, max_slots=8, max_seq_len=S + N + 16))
+        eng.warmup(prompt_lens=[S])
+        srv = InferenceServer(None, engine=eng, auth_name="bench-fleet")
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv
+
+    replicas = [make_replica(), make_replica()]
+    # prime the shared AOT programs (see bench_autoscale's note: the
+    # serve_loop thread IS the driver; blocking on the future primes)
+    replicas[0]._engine.submit(prompts[0], max_new_tokens=2)\
+        .result(timeout=300)
+    rep_map = {f"r{i}": f"127.0.0.1:{s.port}"
+               for i, s in enumerate(replicas)}
+    routers = []
+    for _ in range(2):
+        router = Router(replicas=rep_map, replica_secret="bench-fleet",
+                        auth_name="bench-router", evict_cooldown_s=600.0)
+        threading.Thread(target=router.serve_forever,
+                         daemon=True).start()
+        routers.append(router)
+    endpoints = [f"127.0.0.1:{r.port}" for r in routers]
+
+    c0 = metrics.snapshot()["counters"]
+    errs = []
+    phase_tokens = [[0] * CLIENTS, [0] * CLIENTS]
+    barrier = threading.Barrier(CLIENTS + 1)
+
+    def one_client(i):
+        try:
+            cli = RemotePredictor(endpoints=endpoints,
+                                  secret="bench-router", timeout=300.0)
+            for phase in range(2):
+                barrier.wait(timeout=600)
+                for _ in range(ROUNDS):
+                    out = cli.generate(prompts[i], max_new_tokens=N)
+                    phase_tokens[phase][i] += int(out.size) - S
+            cli.close()
+        except Exception as e:  # noqa: BLE001 — recorded, rung-failed
+            errs.append(f"client {i}: {type(e).__name__}: {e}")
+
+    ths = [threading.Thread(target=one_client, args=(i,))
+           for i in range(CLIENTS)]
+    for t in ths:
+        t.start()
+    # phase 0: undisturbed baseline
+    barrier.wait(timeout=600)
+    t0 = time.perf_counter()
+    while sum(1 for i in range(CLIENTS)
+              if phase_tokens[0][i] >= ROUNDS * N) < CLIENTS:
+        if errs:
+            break
+        time.sleep(0.05)
+    wall0 = time.perf_counter() - t0
+    if errs:
+        # a phase-0 failure leaves clients parked at the phase-1 barrier
+        # minus the dead one: abort instead of timing the barrier out
+        barrier.abort()
+        for t in ths:
+            t.join(timeout=60)
+        raise AssertionError(f"client errors in the undisturbed phase: "
+                             f"{errs[:3]}")
+    # phase 1: same load, kill the ACTIVE router (every client connected
+    # to endpoints[0]) one round in
+    barrier.wait(timeout=600)
+    t1 = time.perf_counter()
+    time.sleep(max(0.2, wall0 / (2 * ROUNDS)))
+    routers[0].stop(hard=True)
+    for t in ths:
+        t.join(timeout=600)
+    wall1 = time.perf_counter() - t1
+    for r in routers[1:]:
+        r.stop()
+    for s in replicas:
+        s.drain(deadline_s=30.0)
+    c1 = metrics.snapshot()["counters"]
+    failovers = c1.get("router.failovers", 0) - c0.get("router.failovers",
+                                                       0)
+    dup = (c1.get("engine.requests", 0) - c0.get("engine.requests", 0)
+           - 2 * CLIENTS * ROUNDS)
+    assert not errs, f"client errors across the router kill: {errs[:3]}"
+    assert failovers >= 1, "the kill produced no failover"
+    g0 = sum(phase_tokens[0]) / wall0
+    g1 = sum(phase_tokens[1]) / wall1
+    assert g1 >= 0.9 * g0, (
+        f"router kill cost goodput: disturbed {g1:.0f} tok/s vs "
+        f"undisturbed {g0:.0f} tok/s")
+    assert dup <= 0, f"{dup} duplicate generation(s) executed fleet-wide"
+    return dict(goodput_undisturbed_tok_s=g0, goodput_disturbed_tok_s=g1,
+                failovers=failovers, client_errors=len(errs),
+                duplicate_generations=max(0, dup),
+                dedup_hits=c1.get("engine.dedup_hits", 0)
+                - c0.get("engine.dedup_hits", 0),
+                dedup_replays=c1.get("engine.dedup_replays", 0)
+                - c0.get("engine.dedup_replays", 0))
+
+
 def bench_router():
     """Multi-replica serving rung (paddle_tpu/serving): 2 in-process engine
     replicas behind the router under MIXED traffic — 1 long-prefill request
@@ -1518,6 +1645,23 @@ def bench_smoke():
     router_ok = metrics.snapshot()["counters"].get("router.requests",
                                                    0) >= 1
 
+    # two-iteration soak micro drill (paddle_tpu/testing/soak.py): the
+    # deterministic chaos scenarios — slow steps + idempotency replay,
+    # transient pool pressure, wire-blob corruption refusal — with
+    # rotated orderings, pool asserted page-clean after each; a failure
+    # dumps the flight ring. Emitted as `soak_ok` (asserted in
+    # tests/test_observability.py)
+    import tempfile as _soak_tf
+    from paddle_tpu.testing import soak as _soak
+    soak_ok = _soak.run_micro(
+        iterations=2, model=model,
+        out_dir=_soak_tf.mkdtemp(prefix="bench_soak_")) == 0
+    assert soak_ok, "soak micro drill failed (see dumped flight ring)"
+    dedup_replays = metrics.snapshot()["counters"].get(
+        "engine.dedup_replays", 0)
+    assert dedup_replays >= 1, \
+        "soak micro drill exercised no idempotency replay"
+
     snap = metrics.snapshot()
     hists = snap["histograms"]
     for name in ("serve.ttft_seconds", "serve.tpot_seconds",
@@ -1530,7 +1674,7 @@ def bench_smoke():
            for short in ("ttft", "tpot", "e2e") for q in ("p50", "p99")}
     return (dt, batch * seq / dt, snap, slo, wd.dump_count == 0, router_ok,
             prefix_hits, spec_accepted, shed_count, cancelled_count,
-            resume_ok, kv_quant_ok, migrate_ok)
+            resume_ok, kv_quant_ok, migrate_ok, soak_ok, dedup_replays)
 
 
 def _retry(fn, attempts=3):
@@ -1572,7 +1716,8 @@ def main(argv=None):
         try:
             (dt, tps, snap, slo, wd_clean, router_ok, prefix_hits,
              spec_accepted, shed_count, cancelled_count,
-             resume_ok, kv_quant_ok, migrate_ok) = bench_smoke()
+             resume_ok, kv_quant_ok, migrate_ok, soak_ok,
+             dedup_replays) = bench_smoke()
             impls = {k.rsplit(".", 1)[-1]: v
                      for k, v in snap["counters"].items()
                      if k.startswith("paged_attention.impl.") and v}
@@ -1588,6 +1733,8 @@ def main(argv=None):
                    "resume_ok": resume_ok,
                    "kv_quant_ok": kv_quant_ok,
                    "migrate_ok": migrate_ok,
+                   "soak_ok": soak_ok,
+                   "dedup_replays": dedup_replays,
                    "prefill_chunks": snap["counters"].get(
                        "engine.prefill_chunks", 0),
                    "train_mfu": snap["gauges"].get("train.mfu"),
@@ -1859,6 +2006,29 @@ def main(argv=None):
               f"client_errors={asd['client_errors']}", file=sys.stderr)
     except Exception as e:
         _emit({"metric": "autoscale_goodput_tokens_per_sec", "value": 0.0,
+               "unit": "tokens/s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
+    try:
+        ha = _retry(bench_router_ha, attempts=2)
+        _emit({"metric": "router_ha_goodput_tokens_per_sec",
+               "value": round(ha["goodput_disturbed_tok_s"], 1),
+               "unit": "tokens/s", "ok": True, "platform": platform,
+               "goodput_undisturbed_tok_s": round(
+                   ha["goodput_undisturbed_tok_s"], 1),
+               "failovers": ha["failovers"],
+               "client_errors": ha["client_errors"],
+               "duplicate_generations": ha["duplicate_generations"],
+               "dedup_hits": ha["dedup_hits"],
+               "dedup_replays": ha["dedup_replays"],
+               "mix": "8 clients x 3x(16+24) keyed, 2 routers over 2 "
+                      "replicas, kill one router mid-phase"})
+        print(f"# router HA kill-one: disturbed "
+              f"{ha['goodput_disturbed_tok_s']:.0f} vs undisturbed "
+              f"{ha['goodput_undisturbed_tok_s']:.0f} tok/s, "
+              f"failovers={ha['failovers']}, 0 client errors, "
+              f"0 duplicate generations", file=sys.stderr)
+    except Exception as e:
+        _emit({"metric": "router_ha_goodput_tokens_per_sec", "value": 0.0,
                "unit": "tokens/s", "ok": False, "platform": platform,
                "backend_error": f"{type(e).__name__}: {e}"})
     try:

@@ -30,6 +30,33 @@ def _check_registry_member(node_id, endpoint):
             "register or leave — it only watches membership")
 
 
+# ------------------------------------------------------------ node roles
+#
+# Control-plane HA (docs/ROBUSTNESS.md "Control-plane HA"): ROUTERS are
+# registry citizens too, under a distinct role so nobody mistakes one for
+# an engine replica. The role rides the node ID as a reserved prefix —
+# the registry value format (endpoint string) stays untouched, so every
+# existing lease keeps working: an unprefixed id IS a replica (legacy).
+# Routers register as ``router:<id>``; `Router._sync_membership` keeps
+# them out of the replica rotation, `InferenceServer._discover_peers`
+# never migrates work to one, and `RemotePredictor` discovers them for
+# multi-router failover.
+
+ROUTER_ROLE_PREFIX = "router:"
+
+
+def router_node_id(router_id) -> str:
+    """Registry node id for a router lease: ``router:<id>``."""
+    return ROUTER_ROLE_PREFIX + str(router_id)
+
+
+def node_role(node_id) -> str:
+    """``"router"`` for router-role leases, ``"replica"`` for everything
+    else (including every pre-role lease — legacy ids are replicas)."""
+    return "router" if str(node_id).startswith(ROUTER_ROLE_PREFIX) \
+        else "replica"
+
+
 def start_heartbeat(path, interval=2.0):
     """Touch `path` every `interval` seconds from a daemon thread."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

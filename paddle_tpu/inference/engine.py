@@ -93,7 +93,8 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.inference.errors import (Cancelled, DeadlineExceeded,
-                                         Overloaded, from_wire)
+                                         HandoffCorrupt, Overloaded,
+                                         from_wire)
 from paddle_tpu.kernels.paged_attention import TRASH_PAGE
 from paddle_tpu.observability import metrics
 from paddle_tpu.observability.flight_recorder import (Watchdog,
@@ -104,7 +105,8 @@ from paddle_tpu.testing import faults
 
 __all__ = ["EngineConfig", "PageAllocator", "GenerateRequest", "DecodeEngine",
            "KVHandoff", "MigrationItem", "DeadlineExceeded", "Cancelled",
-           "Overloaded", "pack_migration", "unpack_migration"]
+           "Overloaded", "HandoffCorrupt", "pack_migration",
+           "unpack_migration"]
 
 # packed slot-state upload layout: [B, _STATE_COLS + pages_per_slot] int32,
 # ONE host->device transfer per step (engine.h2d_transfers). The
@@ -188,6 +190,16 @@ class EngineConfig:
                    construction (quantization/serving.py), dequantized at
                    use inside the same AOT programs — same program count,
                    zero extra recompiles (tests/test_no_retrace.py)
+    dedup_capacity : bound on the idempotency dedup table (docs/
+                   ROBUSTNESS.md "Control-plane HA"): requests submitted
+                   with a client-generated ``request_key`` are remembered
+                   here — a resubmit of an IN-FLIGHT key attaches to the
+                   existing request's future (``engine.dedup_hits``), a
+                   resubmit of a COMPLETED key replays the cached answer
+                   verbatim (``engine.dedup_replays``) — so an ambiguous
+                   wire death costs at most one generation fleet-wide.
+                   LRU-evicted past the bound; 0 disables dedup (every
+                   keyed submit executes — legacy at-least-once)
     """
     page_size: int = 16
     max_slots: int = 8
@@ -204,6 +216,7 @@ class EngineConfig:
     max_queue_tokens: int | None = None
     kv_dtype: str = "native"
     weight_dtype: str = "native"
+    dedup_capacity: int = 1024
 
 
 class PageAllocator:
@@ -333,7 +346,8 @@ class GenerateRequest:
 
     def __init__(self, prompt: np.ndarray, max_new_tokens: int, trace=None,
                  cache: bool = True, speculate: bool = True,
-                 deadline_s: float | None = None):
+                 deadline_s: float | None = None,
+                 request_key: bytes | None = None):
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.generated: list[int] = []
@@ -345,8 +359,39 @@ class GenerateRequest:
         self.deadline_t = None if deadline_s is None \
             else time.monotonic() + float(deadline_s)
         self.page_hashes: list[bytes] = []  # rolling full-page prompt hashes
+        # client-generated idempotency key (16 bytes on the wire): the
+        # engine's dedup table attaches resubmits of this key to THIS
+        # future instead of re-running the generation
+        self.request_key = None if request_key is None \
+            else bytes(request_key)
+        self.imported = False           # resumed from a KV handoff
+        self._waiters = 0               # live result() waiters (serve tier)
+        self._wlock = threading.Lock()
         self._done = threading.Event()
         self._error: str | None = None
+
+    def add_waiter(self):
+        """One more party is blocked on this future (a serve connection
+        thread, possibly a dedup-attached resubmit). The serving layer's
+        disconnect-cancel consults `waiters` so one client hanging up
+        cannot kill a generation another attached client still wants."""
+        with self._wlock:
+            self._waiters += 1
+
+    def remove_waiter(self) -> int:
+        """Detach one waiter; returns the REMAINING count. The decrement
+        and the read are one atomic step so an abandoning wait can decide
+        'was I the last?' without racing another waiter's exit — two
+        waits timing out in the same poll tick must elect exactly one
+        canceller, not zero."""
+        with self._wlock:
+            self._waiters = max(0, self._waiters - 1)
+            return self._waiters
+
+    @property
+    def waiters(self) -> int:
+        with self._wlock:
+            return self._waiters
 
     def expired(self, now: float | None = None) -> bool:
         return self.deadline_t is not None and \
@@ -416,6 +461,43 @@ class _DraftIndex:
         return []
 
 
+def _blob_digest(body: bytes) -> str:
+    """blake2b content checksum of a wire blob's body — the one digest
+    implementation both `KVHandoff` and the ``PTMG1`` migration blob
+    stamp into their headers and verify on unpack."""
+    return hashlib.blake2b(body, digest_size=16).hexdigest()
+
+
+def _read_blob_head(buf: bytes, magic_len: int, what: str):
+    """Parse a checksummed wire blob's ``u32 header_len | JSON header``
+    and VERIFY the header's ``sum`` digest over the body (everything past
+    the header) before any payload byte is interpreted. Returns
+    ``(head, body_offset)``. An unparseable header or a digest mismatch —
+    truncation, bit flip, torn transfer — raises the typed
+    :class:`HandoffCorrupt` refusal; a header WITHOUT ``sum`` (a
+    pre-checksum build's blob) loads unverified, the same legacy rule as
+    unstamped checkpoints."""
+    try:
+        (hlen,) = struct.unpack("<I", buf[magic_len:magic_len + 4])
+        head = json.loads(buf[magic_len + 4:magic_len + 4 + hlen].decode())
+        if not isinstance(head, dict):
+            raise ValueError(f"header is {type(head).__name__}, not object")
+    except (struct.error, ValueError) as e:
+        raise HandoffCorrupt(
+            f"{what} blob header unparseable ({type(e).__name__}: {e}) — "
+            f"truncated or corrupted transfer") from e
+    off = magic_len + 4 + hlen
+    want = head.get("sum")
+    if want is not None:
+        got = _blob_digest(buf[off:])
+        if got != want:
+            raise HandoffCorrupt(
+                f"{what} blob failed its content checksum over "
+                f"{len(buf) - off} body bytes — truncated or bit-flipped "
+                f"transfer, refusing to decode garbage context")
+    return head, off
+
+
 @dataclass
 class KVHandoff:
     """A request's paged KV state, detached from any engine — the
@@ -438,6 +520,15 @@ class KVHandoff:
     dequantizes bit-identically to where it was prefilled. A float-pool
     blob has no scales section and an int8 engine refuses it (and vice
     versa) via the dtype check in `import_request` — never a silent cast.
+
+    Wire integrity (docs/ROBUSTNESS.md "Wire integrity"): the header also
+    carries ``sum``, a blake2b content checksum of the BODY (everything
+    after the header). `unpack` verifies it FIRST — a truncated or
+    bit-flipped transfer raises a typed :class:`HandoffCorrupt` refusal
+    instead of decoding garbage context (the checkpoint checksum
+    discipline applied to the wire). Blobs from pre-checksum builds carry
+    no ``sum`` and load unverified (legacy, same rule as unstamped
+    checkpoints).
     """
     prompt: np.ndarray          # [S0] int32
     first_token: int            # sampled from the prefill's last logits
@@ -465,17 +556,17 @@ class KVHandoff:
             parts += [
                 np.ascontiguousarray(self.k_scales, np.float32).tobytes(),
                 np.ascontiguousarray(self.v_scales, np.float32).tobytes()]
+        body = b"".join(parts)
+        head["sum"] = _blob_digest(body)
         hb = json.dumps(head).encode()
-        return b"".join([self.MAGIC, struct.pack("<I", len(hb)), hb] + parts)
+        return b"".join([self.MAGIC, struct.pack("<I", len(hb)), hb, body])
 
     @classmethod
     def unpack(cls, buf: bytes) -> "KVHandoff":
         m = len(cls.MAGIC)
         if buf[:m] != cls.MAGIC:
             raise ValueError("not a KV handoff blob (bad magic)")
-        (hlen,) = struct.unpack("<I", buf[m:m + 4])
-        head = json.loads(buf[m + 4:m + 4 + hlen].decode())
-        off = m + 4 + hlen
+        head, off = _read_blob_head(buf, m, "KV handoff")
         s0 = int(head["prompt_len"])
         prompt = np.frombuffer(buf, np.int32, count=s0, offset=off).copy()
         off += 4 * s0
@@ -535,7 +626,12 @@ class MigrationItem:
     migration still reaches the engine actually decoding (serve.py).
     ``cache``/``speculate`` carry the request's per-request opt-outs: a
     ``cache=False`` submit promised its KV would never be shared, and a
-    migration must not quietly re-enroll it in the peer's prefix store."""
+    migration must not quietly re-enroll it in the peer's prefix store.
+    ``request_key`` is the request's idempotency key, if the client sent
+    one: it rides the ``PTMG1`` header so the peer registers the resumed
+    request in ITS dedup table — exactly-once survives a drain (a client
+    resubmitting the key after the migration attaches to the moved
+    request instead of re-running it)."""
     max_new_tokens: int
     handoff: KVHandoff | None = None
     prompt: np.ndarray | None = None     # cold items only
@@ -544,6 +640,7 @@ class MigrationItem:
     tag: bytes | None = None
     cache: bool = True
     speculate: bool = True
+    request_key: bytes | None = None
 
 
 MIG_MAGIC = b"PTMG1\n"
@@ -552,13 +649,19 @@ MIG_MAGIC = b"PTMG1\n"
 def pack_migration(item: MigrationItem) -> bytes:
     """Serialize a :class:`MigrationItem` for the OP_MIGRATE wire op:
     ``b"PTMG1\\n" | u32 header_len | JSON header | body`` where the body is
-    the PTKV1 handoff blob (warm) or the bare int32 prompt (cold)."""
+    the PTKV1 handoff blob (warm) or the bare int32 prompt (cold). The
+    header's ``sum`` digest covers the body, verified by
+    `unpack_migration` (docs/ROBUSTNESS.md "Wire integrity") — for a warm
+    item the inner PTKV1 blob carries its OWN checksum too, so corruption
+    is caught whichever layer unpacks first."""
     head = {"max_new_tokens": int(item.max_new_tokens),
             "deadline_ms": 0 if item.deadline_ms is None
             else int(item.deadline_ms),
             "warm": item.handoff is not None}
     if item.tag is not None:
         head["tag"] = bytes(item.tag).hex()
+    if item.request_key is not None:
+        head["key"] = bytes(item.request_key).hex()
     if not item.cache:
         head["cache"] = False
     if not item.speculate:
@@ -570,32 +673,36 @@ def pack_migration(item: MigrationItem) -> bytes:
         body = np.ascontiguousarray(item.prompt, np.int32).tobytes()
     else:
         body = item.handoff.pack()
+    head["sum"] = _blob_digest(body)
     hb = json.dumps(head).encode()
     return b"".join([MIG_MAGIC, struct.pack("<I", len(hb)), hb, body])
 
 
 def unpack_migration(buf: bytes) -> MigrationItem:
     """Wire blob -> :class:`MigrationItem` (``request`` is None — the
-    receiving engine creates its own future)."""
+    receiving engine creates its own future). Verifies the header's body
+    checksum FIRST — a damaged blob raises the typed
+    :class:`HandoffCorrupt` refusal before any payload is interpreted."""
     m = len(MIG_MAGIC)
     if buf[:m] != MIG_MAGIC:
         raise ValueError("not a migration blob (bad magic)")
-    (hlen,) = struct.unpack("<I", buf[m:m + 4])
-    head = json.loads(buf[m + 4:m + 4 + hlen].decode())
-    off = m + 4 + hlen
+    head, off = _read_blob_head(buf, m, "PTMG1 migration")
     dl = int(head.get("deadline_ms", 0)) or None
     mnt = int(head["max_new_tokens"])
     tag = bytes.fromhex(head["tag"]) if "tag" in head else None
+    key = bytes.fromhex(head["key"]) if "key" in head else None
     cache = bool(head.get("cache", True))
     speculate = bool(head.get("speculate", True))
     if head.get("warm"):
         return MigrationItem(max_new_tokens=mnt, deadline_ms=dl, tag=tag,
                              cache=cache, speculate=speculate,
+                             request_key=key,
                              handoff=KVHandoff.unpack(buf[off:]))
     s0 = int(head["prompt_len"])
     prompt = np.frombuffer(buf, np.int32, count=s0, offset=off).copy()
     return MigrationItem(max_new_tokens=mnt, deadline_ms=dl, tag=tag,
-                         cache=cache, speculate=speculate, prompt=prompt)
+                         cache=cache, speculate=speculate,
+                         request_key=key, prompt=prompt)
 
 
 class DecodeEngine:
@@ -695,6 +802,13 @@ class DecodeEngine:
         # cancellation mailbox: any thread posts request_id -> reason, the
         # driver applies it between fixed-shape steps (_reap)
         self._cancels: dict[str, str] = {}
+        # idempotency dedup table (docs/ROBUSTNESS.md "Control-plane HA"):
+        # client request_key -> GenerateRequest, LRU-bounded at
+        # ecfg.dedup_capacity. A resubmit of an IN-FLIGHT key attaches to
+        # the existing future; a COMPLETED key replays its answer (tokens
+        # or typed error) verbatim — an ambiguous wire death costs at
+        # most one generation per engine. Guarded by _qlock.
+        self._dedup: OrderedDict[bytes, GenerateRequest] = OrderedDict()
         # live-migration state (docs/SERVING.md "Live migration"): the
         # OUTBOUND side is driver-only — drain(migrate=True) posts a flag,
         # step() exports every live request into _migrated and sets the
@@ -754,6 +868,8 @@ class DecodeEngine:
         self._g_spec_rate = metrics.gauge("engine.spec_accept_rate")
         self._g_spec_tps = metrics.gauge("engine.spec_tokens_per_step")
         self._m_shed = metrics.counter("engine.shed")
+        self._m_dedup_hits = metrics.counter("engine.dedup_hits")
+        self._m_dedup_replays = metrics.counter("engine.dedup_replays")
         self._m_mig_out = metrics.counter("engine.migrations_out")
         self._m_mig_in = metrics.counter("engine.migrations_in")
         self._m_cancelled = metrics.counter("engine.cancelled")
@@ -1118,7 +1234,7 @@ class DecodeEngine:
 
     def submit(self, prompt_ids, max_new_tokens=32, trace=None,
                cache=True, speculate=True,
-               deadline_s=None) -> GenerateRequest:
+               deadline_s=None, request_key=None) -> GenerateRequest:
         """Queue one prompt (1-D or [1, S] int array). Thread-safe.
         ``trace``: a `RequestTrace` created upstream (serve's wire-accept)
         so the SLO clock starts there; default starts it here.
@@ -1132,7 +1248,21 @@ class DecodeEngine:
         harvest; docs/ROBUSTNESS.md). Raises typed ``Overloaded`` when
         the queue is past `EngineConfig.max_queue_depth` /
         ``max_queue_tokens`` — admission control fails fast so the router
-        can place the work elsewhere."""
+        can place the work elsewhere.
+
+        ``request_key`` (docs/ROBUSTNESS.md "Control-plane HA"): a
+        client-generated 16-byte idempotency key. A resubmit of a key
+        whose request is still IN FLIGHT returns the SAME
+        :class:`GenerateRequest` (the resubmit attaches to the running
+        generation instead of re-running prefill+decode —
+        ``engine.dedup_hits``); a key that already COMPLETED replays the
+        cached answer or typed error verbatim (``engine.dedup_replays``).
+        A key whose attempt was CANCELLED re-executes: the cancel meant
+        no answer was produced, and the resubmit is a live client asking
+        again. Absent key = legacy at-least-once, exactly the old
+        behavior. Dedup hits bypass admission control — attaching to
+        work already paid for costs nothing, so a draining or shedding
+        engine still answers them."""
         ids = np.asarray(
             prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids)
         ids = np.ascontiguousarray(ids).reshape(-1).astype(np.int32)
@@ -1149,8 +1279,10 @@ class DecodeEngine:
                 f"max_seq_len={self.max_seq_len}")
         if deadline_s is not None and float(deadline_s) <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        key = self._dedup_key(request_key)
         req = GenerateRequest(ids, n, trace=trace, cache=cache,
-                              speculate=speculate, deadline_s=deadline_s)
+                              speculate=speculate, deadline_s=deadline_s,
+                              request_key=key)
         # double-checked admission: the FIRST check fails a shed/dead/
         # draining submit fast, BEFORE the O(prompt) blake2b pass below —
         # admission control exists for exactly the moments that pass
@@ -1158,12 +1290,22 @@ class DecodeEngine:
         # with no lock held (never on the driver, never under _qlock),
         # and the SECOND check inside the enqueue lock re-validates
         # (state may have moved during the hash; the rare wasted hash of
-        # a late shed is the cheap side of that race).
+        # a late shed is the cheap side of that race). The dedup lookup
+        # runs BEFORE each admission check: an attach/replay must succeed
+        # on a draining or full engine.
         with self._qlock:
+            prev = self._dedup_lookup(key, ids, n)
+            if prev is not None:
+                return prev
             self._check_admission(ids.size)
         if self._prefix_enabled and req.cache:
             req.page_hashes = self._page_hashes(ids)
         with self._work:
+            # authoritative dedup check, atomic with the enqueue: two
+            # concurrent resubmits of one key must not both enqueue
+            prev = self._dedup_lookup(key, ids, n)
+            if prev is not None:
+                return prev
             self._check_admission(ids.size)
             # trace/ring entries only for ACCEPTED submits: a rejected one
             # must not leave a phantom never-retired request in a watchdog
@@ -1174,9 +1316,75 @@ class DecodeEngine:
             self._queue.append(req)
             self._queue_tokens += int(ids.size)
             self._g_queue.set(len(self._queue))
+            self._register_dedup(key, req)
             self._work.notify()
         self._m_requests.inc()
         return req
+
+    # ------------------------------------------------- idempotency dedup
+
+    def _dedup_key(self, request_key) -> bytes | None:
+        """Normalize + validate one wire request key (None passes
+        through; dedup disabled drops it)."""
+        if request_key is None or not self.ecfg.dedup_capacity:
+            return None
+        key = bytes(request_key)
+        if len(key) != 16:
+            raise ValueError(
+                f"request_key must be exactly 16 bytes, got {len(key)}")
+        return key
+
+    def _dedup_lookup(self, key: bytes | None, ids: np.ndarray | None,
+                      mnt: int | None) -> GenerateRequest | None:
+        """One dedup probe (caller holds ``_qlock``): returns the request
+        to attach to / replay, or None for a miss. A key reused for a
+        DIFFERENT prompt or budget is a client bug and refused loudly —
+        silently answering with another request's tokens would be far
+        worse than failing (skipped for migrated-in requests, whose
+        context legitimately grew past the original prompt)."""
+        if key is None:
+            return None
+        prev = self._dedup.get(key)
+        if prev is None:
+            return None
+        if ids is not None and not prev.imported and (
+                int(prev.max_new_tokens) != int(mnt)
+                or not np.array_equal(prev.prompt, ids)):
+            raise ValueError(
+                "request_key reused for a different request (prompt or "
+                "max_new_tokens mismatch) — an idempotency key names ONE "
+                "logical request")
+        if not prev.done:
+            self._dedup.move_to_end(key)
+            self._m_dedup_hits.inc()
+            # a pending disconnect-cancel for the original attempt is
+            # void: a new party just asked for this answer (the resubmit
+            # IS the evidence the client still wants it)
+            self._cancels.pop(prev.request_id, None)
+            flight.record("engine.dedup_attach",
+                          request_id=prev.request_id)
+            return prev
+        if prev._error is not None and prev._error.startswith("Cancelled"):
+            # a cancelled attempt produced no answer; the resubmit is a
+            # fresh attempt (at-most-once holds: the first never ran to
+            # completion). Drop the entry so the new request registers.
+            del self._dedup[key]
+            return None
+        self._dedup.move_to_end(key)
+        self._m_dedup_replays.inc()
+        flight.record("engine.dedup_replay", request_id=prev.request_id)
+        return prev
+
+    def _register_dedup(self, key: bytes | None, req: GenerateRequest):
+        """Remember a freshly accepted keyed request (caller holds
+        ``_qlock``); LRU-evict past the configured bound."""
+        if key is None:
+            return
+        self._dedup[key] = req
+        self._dedup.move_to_end(key)
+        cap = int(self.ecfg.dedup_capacity)
+        while len(self._dedup) > cap:
+            self._dedup.popitem(last=False)
 
     def _check_admission(self, n_tokens: int):
         """Refuse-or-pass gate for one submit. Caller holds ``_qlock``.
@@ -1976,7 +2184,8 @@ class DecodeEngine:
 
     def _build_import_request(self, handoff: KVHandoff, max_new_tokens,
                               deadline_s=None, trace=None, cache=True,
-                              speculate=True) -> GenerateRequest:
+                              speculate=True,
+                              request_key=None) -> GenerateRequest:
         """Shared validation for BOTH import paths (`import_request` and
         the migration mailbox `submit_import`): check the handoff and the
         budget on the CALLING thread — a refusal must travel back to the
@@ -1995,7 +2204,9 @@ class DecodeEngine:
                 f"prompt {ids.size} + max_new_tokens {n} exceeds engine "
                 f"max_seq_len={self.max_seq_len}")
         req = GenerateRequest(ids, n, trace=trace, cache=cache,
-                              speculate=speculate, deadline_s=deadline_s)
+                              speculate=speculate, deadline_s=deadline_s,
+                              request_key=self._dedup_key(request_key))
+        req.imported = True
         if self._prefix_enabled and req.cache:
             # imported pages are cache-eligible: _seed_first_token indexes
             # them, so a shared-prefix submit AFTER the import reuses them
@@ -2086,7 +2297,7 @@ class DecodeEngine:
 
     def submit_import(self, handoff: KVHandoff, max_new_tokens=32,
                       deadline_s=None, trace=None, cache=True,
-                      speculate=True) -> GenerateRequest:
+                      speculate=True, request_key=None) -> GenerateRequest:
         """Thread-safe receive side of live migration (docs/SERVING.md
         "Live migration"): validate the handoff HERE on the posting thread
         (loud geometry/dtype refusal travels back to the sender), post it
@@ -2108,7 +2319,8 @@ class DecodeEngine:
         req = self._build_import_request(handoff, max_new_tokens,
                                          deadline_s=deadline_s,
                                          trace=trace, cache=cache,
-                                         speculate=speculate)
+                                         speculate=speculate,
+                                         request_key=request_key)
         with self._work:
             self._refuse_not_accepting()
             req.trace.mark_submit()
@@ -2116,6 +2328,12 @@ class DecodeEngine:
                           context_len=int(req.prompt.size),
                           max_new_tokens=req.max_new_tokens)
             self._imports.append((handoff, req))
+            # the key rode the PTMG1 header: register the resumed request
+            # in THIS engine's dedup table (overwriting any stale entry —
+            # the migration is the authoritative owner of the key now),
+            # so a client resubmit after the drain attaches instead of
+            # re-running the generation
+            self._register_dedup(req.request_key, req)
             self._work.notify()
         return req
 
@@ -2198,7 +2416,8 @@ class DecodeEngine:
                 item = MigrationItem(max_new_tokens=req.max_new_tokens,
                                      prompt=req.prompt, deadline_ms=left,
                                      request=req, cache=req.cache,
-                                     speculate=req.speculate)
+                                     speculate=req.speculate,
+                                     request_key=req.request_key)
             else:
                 # warm: KV is resident for prompt + generated[:-1] (the
                 # last sampled token's KV is written by the NEXT step,
@@ -2230,7 +2449,8 @@ class DecodeEngine:
                     max_new_tokens=req.max_new_tokens
                     - len(req.generated) + 1,
                     handoff=handoff, deadline_ms=left, request=req,
-                    cache=req.cache, speculate=req.speculate)
+                    cache=req.cache, speculate=req.speculate,
+                    request_key=req.request_key)
             flight.record("engine.migrate_out", request_id=req.request_id,
                           warm=item.handoff is not None,
                           delivered=len(req.generated))
@@ -2254,7 +2474,8 @@ class DecodeEngine:
             items.append(MigrationItem(
                 max_new_tokens=req.max_new_tokens, prompt=req.prompt,
                 deadline_ms=self._deadline_ms_left(req, now), request=req,
-                cache=req.cache, speculate=req.speculate))
+                cache=req.cache, speculate=req.speculate,
+                request_key=req.request_key))
         for handoff, req in imports:
             # a warm import this engine never placed migrates onward as-is
             if req.done:
@@ -2262,7 +2483,8 @@ class DecodeEngine:
             items.append(MigrationItem(
                 max_new_tokens=req.max_new_tokens, handoff=handoff,
                 deadline_ms=self._deadline_ms_left(req, now), request=req,
-                cache=req.cache, speculate=req.speculate))
+                cache=req.cache, speculate=req.speculate,
+                request_key=req.request_key))
         self._m_mig_out.inc(len(items))
         self._g_occupancy.set(0)
         with self._qlock:

@@ -27,7 +27,8 @@ doing — another replica would change neither).
 """
 from __future__ import annotations
 
-__all__ = ["DeadlineExceeded", "Cancelled", "Overloaded", "from_wire"]
+__all__ = ["DeadlineExceeded", "Cancelled", "Overloaded", "HandoffCorrupt",
+           "from_wire"]
 
 
 class DeadlineExceeded(RuntimeError):
@@ -48,8 +49,17 @@ class Overloaded(RuntimeError):
     elsewhere/later — nothing about the request itself is wrong."""
 
 
+class HandoffCorrupt(RuntimeError):
+    """A ``PTKV1``/``PTMG1`` wire blob failed its content checksum (or is
+    structurally unparseable past a valid magic): truncated transfer, bit
+    flip, or a torn write. The import is REFUSED — a corrupted KV page
+    must never decode as garbage context (docs/ROBUSTNESS.md "Wire
+    integrity"; the wire mirror of checkpoint `CheckpointCorrupt`). Safe
+    to re-ship from the source — nothing about the request is wrong."""
+
+
 _BY_NAME = {c.__name__: c for c in (DeadlineExceeded, Cancelled,
-                                    Overloaded)}
+                                    Overloaded, HandoffCorrupt)}
 
 
 def from_wire(msg: str) -> Exception:

@@ -23,10 +23,14 @@ Wire protocol (little-endian):
 
 GENERATE (op 5, docs/SERVING.md): int32 prompt ids (1-D), int32 [1]
 max_new_tokens, then OPTIONALLY an int32 options array
-``[cache, speculate[, deadline_ms]]`` (deadline_ms > 0 bounds the request
-end to end — past it the engine answers a typed ``DeadlineExceeded``
-error, docs/ROBUSTNESS.md) and a uint8 cancel TAG (an opaque
-client-chosen id a later CANCEL op can name). The request lands in the
+``[cache, speculate[, deadline_ms[, key0..key3]]]`` (deadline_ms > 0
+bounds the request end to end — past it the engine answers a typed
+``DeadlineExceeded`` error, docs/ROBUSTNESS.md; the 7-wide shape's four
+trailing words are a client-generated 16-byte idempotency request key —
+resubmits of the same key attach to / replay the original generation
+instead of re-running it, docs/ROBUSTNESS.md "Control-plane HA") and a
+uint8 cancel TAG (an opaque client-chosen id a later CANCEL op can
+name). The request lands in the
 decode engine's scheduler queue (`inference/engine.py`); the engine
 thread batches it with whatever else is in flight (continuous batching
 over the paged KV cache) and the response is one int32 array of prompt +
@@ -91,7 +95,8 @@ import time
 import numpy as np
 
 from paddle_tpu.inference.errors import (Cancelled, DeadlineExceeded,
-                                         Overloaded, from_wire)
+                                         HandoffCorrupt, Overloaded,
+                                         from_wire)
 from paddle_tpu.observability import metrics
 from paddle_tpu.observability.tracing import RequestTrace
 from paddle_tpu.testing import faults
@@ -373,18 +378,22 @@ class InferenceServer:
 
     def _discover_peers(self) -> list[str]:
         """Registry-based peer discovery for ``migrate_on_drain``: every
-        OTHER alive replica's endpoint (own lease excluded by node id and
-        endpoint). Sorted for a deterministic fallback order."""
+        OTHER alive REPLICA's endpoint (own lease excluded by node id and
+        endpoint; router-role leases excluded by role — a router cannot
+        decode a migrated request, docs/ROBUSTNESS.md "Control-plane
+        HA"). Sorted for a deterministic fallback order."""
         if self._registry is None:
             return []
         try:
             alive = self._registry.alive_nodes()
         except OSError:
             return []
+        from paddle_tpu.distributed.fleet.elastic import node_role
         own_id = getattr(self._registry, "node_id", None)
         own_ep = str(getattr(self._registry, "endpoint", None))
         return [str(ep) for rid, ep in sorted(alive.items())
-                if rid != own_id and str(ep) != own_ep]
+                if rid != own_id and str(ep) != own_ep
+                and node_role(rid) == "replica"]
 
     def _migrate_items(self, items, peers, t_end) -> bool:
         """Ship each exported :class:`MigrationItem` to a peer and splice
@@ -413,9 +422,25 @@ class InferenceServer:
         def _one(idx, item):
             req = item.request
             arr = np.frombuffer(pack_migration(item), np.uint8)
+            if faults.ENABLED and faults.fire("serve.blob_corrupt"):
+                # wire-integrity drill (docs/ROBUSTNESS.md): flip one
+                # byte deep in the blob BODY — the peer's checksum
+                # verification must refuse it typed (HandoffCorrupt,
+                # serve.blob_corrupt_refused) and the per-peer fallback
+                # re-packs the INTACT item for the next attempt
+                arr = arr.copy()
+                arr[-max(1, arr.size // 3)] ^= 0xFF
             last = None
+            # bounded per-peer fallback, start rotated by item index; a
+            # HandoffCorrupt refusal may re-queue ONE attempt to the same
+            # peer with a freshly packed blob (the peer is healthy — the
+            # BYTES were damaged)
+            order = [peers[(idx + k) % len(peers)]
+                     for k in range(len(peers))]
+            reshipped = False
+            i = 0
             try:
-                for k in range(len(peers)):
+                while i < len(order):
                     reason = self._mig_cancel_reason(req.request_id)
                     if reason is not None:
                         # cancelled while migrating (client disconnect,
@@ -423,7 +448,8 @@ class InferenceServer:
                         req._finish(f"Cancelled: {reason}")
                         done_ok.append(True)
                         return
-                    ep = peers[(idx + k) % len(peers)]
+                    ep = order[i]
+                    i += 1
                     if faults.ENABLED and faults.fire("serve.migrate_drop"):
                         metrics.counter("serve.migrate_drops").inc()
                         last = f"{ep}: FaultInjected: serve.migrate_drop"
@@ -446,6 +472,19 @@ class InferenceServer:
                         return
                     except Exception as e:  # noqa: BLE001 — classify below
                         last = f"{ep}: {type(e).__name__}: {e}"
+                        if isinstance(e, HandoffCorrupt):
+                            # the peer refused the BLOB, not the request:
+                            # the bytes were damaged in flight (or by the
+                            # serve.blob_corrupt drill) — re-pack from
+                            # the intact in-memory item and give the SAME
+                            # peer one clean re-ship (once per item)
+                            # instead of burning a healthy peer on
+                            # damaged bytes
+                            arr = np.frombuffer(pack_migration(item),
+                                                np.uint8)
+                            if not reshipped:
+                                reshipped = True
+                                order.insert(i, ep)
                         continue
                     out = np.asarray(out).reshape(-1)
                     req.generated = [int(t)
@@ -552,20 +591,28 @@ class InferenceServer:
                 f"MIGRATE wants one uint8 PTMG1 blob array, "
                 f"got {len(arrays)}")
         from paddle_tpu.inference.engine import unpack_migration
-        item = unpack_migration(
-            np.ascontiguousarray(arrays[0], np.uint8).tobytes())
+        try:
+            item = unpack_migration(
+                np.ascontiguousarray(arrays[0], np.uint8).tobytes())
+        except HandoffCorrupt:
+            # wire integrity (docs/ROBUSTNESS.md): a truncated/bit-flipped
+            # blob is REFUSED typed — the sender falls back to re-shipping
+            # from its intact in-memory item, never to decoding garbage
+            metrics.counter("serve.blob_corrupt_refused").inc()
+            raise
         deadline_s = None if item.deadline_ms is None \
             else item.deadline_ms / 1000.0
         if item.handoff is not None:
             req = self._engine.submit_import(
                 item.handoff, max_new_tokens=item.max_new_tokens,
                 deadline_s=deadline_s, trace=trace, cache=item.cache,
-                speculate=item.speculate)
+                speculate=item.speculate, request_key=item.request_key)
         else:
             req = self._engine.submit(item.prompt, item.max_new_tokens,
                                       trace=trace, deadline_s=deadline_s,
                                       cache=item.cache,
-                                      speculate=item.speculate)
+                                      speculate=item.speculate,
+                                      request_key=item.request_key)
         # the request's cancel tag rode the blob: register it HERE so a
         # post-migration CANCEL (the router broadcasts to every replica)
         # reaches the engine that now owns the decode
@@ -648,6 +695,16 @@ class InferenceServer:
                         sum(a.nbytes for a in arrays))
                     if op == OP_GENERATE:
                         outs = [self._generate(arrays, trace, conn)]
+                        if faults.ENABLED and faults.fire("serve.ack_drop"):
+                            # the ACCEPTED-BUT-UNANSWERED window: the
+                            # generation ran to completion, the answer is
+                            # about to ship, and the connection dies —
+                            # the ambiguous failure exactly-once exists
+                            # for. The client's resubmit (same request
+                            # key) replays the cached answer instead of
+                            # re-burning the generation
+                            # (docs/ROBUSTNESS.md "Control-plane HA")
+                            return
                     elif op == OP_MIGRATE:
                         outs = [self._migrate_in(arrays, trace, conn)]
                     elif op == OP_CANCEL:
@@ -724,15 +781,23 @@ class InferenceServer:
             # (prefix-cache / n-gram-drafting participation; both default
             # on, gated by the engine-level config — docs/SERVING.md)
             # plus an optional third deadline_ms value (> 0 arms the
-            # engine's per-request deadline — docs/ROBUSTNESS.md)
+            # engine's per-request deadline — docs/ROBUSTNESS.md) and,
+            # at 7 values, a 16-byte client-generated idempotency
+            # request key as 4 trailing int32 words (exactly-once
+            # resubmission — docs/ROBUSTNESS.md "Control-plane HA"; the
+            # 2/3-wide shapes stay legacy at-least-once)
             opts = np.asarray(arrays[2]).reshape(-1)
-            if opts.size not in (2, 3):
+            if opts.size not in (2, 3, 7):
                 raise ValueError(
                     f"GENERATE options wants int32 [cache, speculate"
-                    f"[, deadline_ms]], got {opts.size} values")
+                    f"[, deadline_ms[, key0..key3]]], got {opts.size} "
+                    f"values")
             kw = dict(cache=bool(int(opts[0])), speculate=bool(int(opts[1])))
-            if opts.size == 3 and int(opts[2]) > 0:
+            if opts.size >= 3 and int(opts[2]) > 0:
                 deadline_s = int(opts[2]) / 1000.0
+            if opts.size == 7:
+                kw["request_key"] = np.ascontiguousarray(
+                    opts[3:7], np.int32).tobytes()
         tag = None
         if len(arrays) == 4:
             tag = np.ascontiguousarray(arrays[3], np.uint8).tobytes()
@@ -807,35 +872,65 @@ class InferenceServer:
         someone still wants — and (b) bound the total wait (the deadline
         plus scheduling grace when one is set, the legacy 600 s
         otherwise), so a wedged engine surfaces a typed timeout error
-        instead of an indefinite hang."""
+        instead of an indefinite hang.
+
+        Waiter accounting (docs/ROBUSTNESS.md "Control-plane HA"): every
+        wait registers on the request, and the abandon-side cancels fire
+        only when THIS wait was the LAST party attached — a dedup'd
+        resubmit (same request key through a surviving router) shares the
+        future, and the dead first connection must not kill the
+        generation its replacement is blocked on. The last-leaver
+        election is the atomic decrement in `remove_waiter` (two waits
+        abandoning in the same poll tick must elect exactly ONE
+        canceller, never zero)."""
         budget = 600.0 if deadline_s is None else float(deadline_s) + 30.0
         t_end = time.monotonic() + budget
         watch = conn is not None
-        while True:
-            try:
-                return req.result(timeout=0.2)
-            except TimeoutError:
-                pass
-            if time.monotonic() >= t_end:
-                # abandoning the wait must also abandon the WORK: without
-                # the cancel the slot keeps decoding tokens nobody will
-                # read — and the router, classifying this timeout as
-                # resubmittable, would start a duplicate elsewhere while
-                # this replica still burns steps on the original
-                self._cancel_request(req.request_id,
-                                     reason="serve wait budget exhausted")
-                raise TimeoutError("generation still running")
-            if watch and not self._stop.is_set():
-                state = peek_disconnect(conn)
-                if state == "pipelined":
-                    watch = False
-                elif state == "gone":
-                    self._cancel_request(
-                        req.request_id, reason="client disconnected")
-                    metrics.counter("serve.disconnect_cancels").inc()
-                    raise ConnectionError(
-                        "client disconnected mid-GENERATE "
-                        "(request cancelled)")
+        req.add_waiter()
+        detached = False
+        try:
+            while True:
+                try:
+                    return req.result(timeout=0.2)
+                except TimeoutError:
+                    pass
+                if time.monotonic() >= t_end:
+                    # abandoning the wait must also abandon the WORK:
+                    # without the cancel the slot keeps decoding tokens
+                    # nobody will read — and the router, classifying this
+                    # timeout as resubmittable, would start a duplicate
+                    # elsewhere while this replica still burns steps on
+                    # the original. Unless another waiter remains
+                    # attached: then the work is still wanted and only
+                    # THIS wait gives up.
+                    detached = True
+                    if req.remove_waiter() == 0:
+                        self._cancel_request(
+                            req.request_id,
+                            reason="serve wait budget exhausted")
+                    raise TimeoutError("generation still running")
+                if watch and not self._stop.is_set():
+                    state = peek_disconnect(conn)
+                    if state == "pipelined":
+                        watch = False
+                    elif state == "gone":
+                        detached = True
+                        if req.remove_waiter() == 0:
+                            self._cancel_request(
+                                req.request_id,
+                                reason="client disconnected")
+                            # counted only when the disconnect actually
+                            # cancelled: a generation deliberately kept
+                            # alive for an attached resubmit must not
+                            # show up as a cancel on the dashboard
+                            metrics.counter(
+                                "serve.disconnect_cancels").inc()
+                        raise ConnectionError(
+                            "client disconnected mid-GENERATE "
+                            "(request cancelled)")
+        finally:
+            if not detached:
+                req.remove_waiter()
 
     def _cancel_op(self, arrays):
         """CANCEL op body: map the client tag to the live engine request
@@ -881,11 +976,27 @@ class RemotePredictor:
     jitter under a hard deadline (`retrying_connect`): a replica restart
     used to surface as an instant ``ConnectionRefusedError``; now the
     client rides out up to ``retry_deadline_s`` of it. ``connect_retries=1``
-    restores the old single-attempt behavior."""
+    restores the old single-attempt behavior.
+
+    Multi-router failover (docs/ROBUSTNESS.md "Control-plane HA"): pass
+    ``endpoints=["host:port", ...]`` — several redundant routers sharing
+    one auth secret — or ``registry_dir=``/``registry_addr=`` to discover
+    router-role leases from the elastic registry. The client then (a)
+    rotates to the next endpoint whenever the current one is unreachable,
+    (b) mints a 16-byte idempotency ``request_key`` per `generate` call
+    and RESUBMITS through a surviving router when the wire dies
+    mid-request — the fleet's dedup table makes the resubmit attach to or
+    replay the original generation, never re-run it — and (c) broadcasts
+    `cancel` across every known router, so a tag registered through
+    router A is killable through router B. A single ``host``/``port``
+    client keeps the legacy at-least-once behavior exactly (no key, wire
+    errors surface to the caller) unless an explicit ``request_key`` is
+    passed."""
 
     def __init__(self, host="127.0.0.1", port=None, timeout=60.0,
                  model_prefix=None, token=None, secret=None,
-                 connect_retries=3, retry_deadline_s=10.0):
+                 connect_retries=3, retry_deadline_s=10.0,
+                 endpoints=None, registry_dir=None, registry_addr=None):
         if secret is None and model_prefix is not None \
                 and not os.environ.get("PADDLE_SERVE_TOKEN"):
             # legacy alias keeps its LEGACY semantics: the old auth_token
@@ -901,28 +1012,124 @@ class RemotePredictor:
                 "or its auth_name=), an explicit 32-byte token=, or set "
                 "PADDLE_SERVE_TOKEN on both sides — otherwise the server "
                 "silently drops the connection")
-        self._host, self._port = host, port
         self._timeout = timeout
         self._retries = max(1, int(connect_retries))
         self._retry_deadline = retry_deadline_s
         self._outs = []
         self._token_bytes = token if token is not None else auth_token(
             secret if secret is None else str(secret))
+        self._registry = None
+        if registry_dir or registry_addr:
+            from paddle_tpu.distributed.fleet.elastic import (
+                NodeRegistry, TcpNodeRegistry)
+            self._registry = NodeRegistry(registry_dir) if registry_dir \
+                else TcpNodeRegistry(registry_addr)
+        if endpoints is not None:
+            eps = [self._norm_ep(e) for e in endpoints]
+            if not eps:
+                raise ValueError("endpoints= must name >= 1 router")
+        elif self._registry is not None:
+            eps = self._discover_routers()
+        else:
+            eps = [(host, port)]
+        self._endpoints: list[tuple] = eps
+        self._ep_idx = 0
+        # idempotent failover only when the client CAN fail over: a
+        # plain host/port client keeps legacy wire semantics verbatim
+        self._ha = endpoints is not None or self._registry is not None
         self._sock = None
         self._connect()
 
-    def _connect(self):
-        self._sock = retrying_connect(
-            self._host, self._port, timeout=self._timeout,
-            attempts=self._retries, deadline_s=self._retry_deadline)
-        self._sock.sendall(struct.pack("<I", MAGIC) + self._token_bytes)
+    @staticmethod
+    def _norm_ep(ep) -> tuple:
+        if isinstance(ep, str):
+            host, _, port = ep.rpartition(":")
+            return host, int(port)
+        host, port = ep
+        return str(host), int(port)
 
-    def _reconnect(self):
+    def _discover_routers(self) -> list[tuple]:
+        """Router-role leases from the registry, sorted for a
+        deterministic failover order; waits up to ``retry_deadline_s``
+        for the first one to appear (a client may start before its
+        routers finish registering)."""
+        from paddle_tpu.distributed.fleet.elastic import node_role
+        t_end = time.monotonic() + max(0.0, float(self._retry_deadline))
+        while True:
+            try:
+                alive = self._registry.alive_nodes()
+            except OSError:
+                alive = {}
+            eps = [self._norm_ep(str(ep)) for rid, ep in
+                   sorted(alive.items()) if node_role(rid) == "router"]
+            if eps:
+                return eps
+            if time.monotonic() >= t_end:
+                raise ConnectionError(
+                    "no router-role lease in the registry (routers "
+                    "register as 'router:<id>'; replicas are not valid "
+                    "failover targets)")
+            time.sleep(0.05)
+
+    def _refresh_endpoints(self):
+        """Fold in registry churn before a failover attempt: a router
+        started after this client keeps requests flowing when the
+        original set dies. Non-raising — discovery failure keeps the
+        last known list."""
+        if self._registry is None:
+            return
+        try:
+            eps = self._discover_routers()
+        except (ConnectionError, OSError):
+            return
+        cur = self._endpoints[self._ep_idx]
+        self._endpoints = eps
+        self._ep_idx = eps.index(cur) if cur in eps else 0
+
+    def _connect(self, fast=False):
+        """Connect to the first reachable endpoint, starting at the
+        current one. ``fast`` is the mid-request failover flavor: one
+        attempt per endpoint under a short deadline — the surviving
+        deadline budget belongs to the resubmit, not to backoff."""
+        attempts = 1 if fast else self._retries
+        deadline = min(2.0, float(self._retry_deadline)) if fast \
+            else self._retry_deadline
+        n = len(self._endpoints)
+        last = None
+        for k in range(n):
+            i = (self._ep_idx + k) % n
+            host, port = self._endpoints[i]
+            try:
+                sock = retrying_connect(host, port, timeout=self._timeout,
+                                        attempts=attempts,
+                                        deadline_s=deadline)
+            except (ConnectionError, OSError) as e:
+                last = e
+                continue
+            self._ep_idx = i
+            self._sock = sock
+            self._sock.sendall(struct.pack("<I", MAGIC) + self._token_bytes)
+            return
+        raise ConnectionError(
+            f"no endpoint reachable ({n} tried): "
+            f"{type(last).__name__ if last else 'none'}: {last}")
+
+    def _reconnect(self, fast=False):
         try:
             self._sock.close()
         except OSError:
             pass
-        self._connect()
+        self._connect(fast=fast)
+
+    def _failover(self):
+        """Mid-request wire death: rotate PAST the current endpoint (it
+        just failed mid-exchange — even if still reachable, starting the
+        resubmit elsewhere spreads the retry), fold in registry churn,
+        reconnect fast. `router.failovers` counts every switch."""
+        metrics.counter("router.failovers").inc()
+        self._refresh_endpoints()
+        self._ep_idx = (self._ep_idx + 1) % len(self._endpoints)
+        self._reconnect(fast=True)
 
     def _idempotent(self, fn):
         """Run a read-only op; on a broken connection (server restarted
@@ -971,7 +1178,8 @@ class RemotePredictor:
         return self._idempotent(_do)
 
     def generate(self, prompt_ids, max_new_tokens=32, cache=None,
-                 speculate=None, deadline_s=None, tag=None):
+                 speculate=None, deadline_s=None, tag=None,
+                 request_key=None):
         """Batched server-side decode: ship the prompt, get prompt +
         generated ids back. Concurrent generate() calls from any number of
         clients share the server engine's decode batch.
@@ -989,19 +1197,74 @@ class RemotePredictor:
         connection. Server-side failures raise TYPED exceptions —
         `DeadlineExceeded` / `Cancelled` / `Overloaded` (all RuntimeError
         subclasses) — reconstructed from the one-line wire error
-        (docs/ROBUSTNESS.md)."""
+        (docs/ROBUSTNESS.md).
+
+        ``request_key`` (docs/ROBUSTNESS.md "Control-plane HA"): the
+        16-byte idempotency key riding the options array. Default None
+        mints a fresh key per call on a failover-capable client
+        (``endpoints=``/registry) and sends none on a plain host/port
+        client (legacy at-least-once); pass explicit bytes to name the
+        request yourself, or ``False`` to force legacy mode. With a key,
+        a connection that dies mid-request is RESUBMITTED — through the
+        next endpoint under the surviving deadline budget — and the
+        fleet's dedup table guarantees the retry attaches to or replays
+        the original generation instead of re-running it."""
+        key = request_key
+        if key is None and self._ha:
+            key = _secrets.token_bytes(16)
+        elif key is False:
+            key = None
+        if key is not None:
+            key = bytes(key)
+            if len(key) != 16:
+                raise ValueError(
+                    f"request_key must be 16 bytes, got {len(key)}")
         ids = np.ascontiguousarray(np.asarray(prompt_ids).reshape(-1),
                                    np.int32)
+        t_deadline = None if deadline_s is None \
+            else time.monotonic() + float(deadline_s)
+        # one attempt per endpoint plus one (the single-endpoint replay
+        # case: the same server answers the resubmit from its dedup
+        # table after e.g. an ack-window drop)
+        budget = len(self._endpoints) + 1
+        while True:
+            remaining = None
+            if t_deadline is not None:
+                remaining = t_deadline - time.monotonic()
+                if remaining <= 0:
+                    raise DeadlineExceeded(
+                        f"request deadline ({deadline_s}s) exhausted "
+                        f"before an endpoint answered")
+            try:
+                return self._generate_once(ids, max_new_tokens, cache,
+                                           speculate, remaining, tag, key)
+            except (ConnectionError, socket.timeout, OSError):
+                # wire death mid-request. Without a key this is the
+                # legacy contract: surface it (a blind resubmit could
+                # duplicate the generation). With one, fail over and
+                # resubmit — dedup makes the retry exactly-once.
+                budget -= 1
+                if key is None or budget <= 0:
+                    raise
+                self._failover()
+
+    def _generate_once(self, ids, max_new_tokens, cache, speculate,
+                       deadline_s, tag, key):
+        """One GENERATE exchange on the current connection (the wire
+        body of `generate`; deadline_s here is the REMAINING budget)."""
         arrays = [ids, np.asarray([max_new_tokens], np.int32)]
         if cache is not None or speculate is not None \
-                or deadline_s is not None or tag is not None:
+                or deadline_s is not None or tag is not None \
+                or key is not None:
             opts = [1 if cache is None else int(bool(cache)),
                     1 if speculate is None else int(bool(speculate))]
-            if deadline_s is not None or tag is not None:
+            if deadline_s is not None or tag is not None or key is not None:
                 # the tag array is positional (4th), so it forces the
-                # 3-wide options shape even with no deadline (0 = none)
+                # >= 3-wide options shape even with no deadline (0 = none)
                 opts.append(0 if deadline_s is None
                             else max(1, int(float(deadline_s) * 1000)))
+            if key is not None:
+                opts.extend(int(w) for w in np.frombuffer(key, np.int32))
             arrays.append(np.asarray(opts, np.int32))
         if tag is not None:
             arrays.append(np.frombuffer(self._tag_bytes(tag), np.uint8))
@@ -1025,21 +1288,63 @@ class RemotePredictor:
     def cancel(self, tag) -> bool:
         """Cancel a GENERATE submitted (from ANOTHER connection) with this
         ``tag``. Returns True when the tag named live work; a miss —
-        already finished, never seen — is False, not an error."""
+        already finished, never seen — is False, not an error.
+
+        On a multi-endpoint client the cancel BROADCASTS: after the
+        current connection, every other known router gets the tag on a
+        fresh probe-grade connection — the routers are independent, so
+        the one that accepted the GENERATE may not be the one this client
+        is currently talking to (docs/ROBUSTNESS.md "Control-plane HA").
+        Unreachable routers are a clean miss, never an error."""
         def _do():
-            self._sock.sendall(struct.pack("<III", MAGIC, OP_CANCEL, 1))
-            send_arrays(self._sock,
-                        [np.frombuffer(self._tag_bytes(tag), np.uint8)])
-            magic, status, n = struct.unpack(
-                "<III", _recv_exact(self._sock, 12))
-            if magic != MAGIC:
-                raise ConnectionError("bad magic in response")
-            if status != 0:
-                raise from_wire(
-                    _recv_exact(self._sock, n).decode(errors="replace"))
-            (out,) = recv_arrays(self._sock, n)
-            return bool(int(np.asarray(out).reshape(-1)[0]))
-        return self._idempotent(_do)
+            return self._cancel_exchange(self._sock, tag)
+        if len(self._endpoints) == 1:
+            return self._idempotent(_do)
+        try:
+            hit = self._idempotent(_do)
+        except (ConnectionError, socket.timeout, OSError, RuntimeError):
+            hit = False          # the fan-out below may still land it
+        cur = self._endpoints[self._ep_idx]
+        for ep in self._endpoints:
+            if ep != cur:
+                hit = self._cancel_via(ep, tag) or hit
+        return hit
+
+    def _cancel_exchange(self, sock, tag) -> bool:
+        """ONE CANCEL request/response on an authed socket — the single
+        owner of the CANCEL wire framing, shared by the current
+        connection and every broadcast arm (protocol drift in one copy
+        would silently break only the untraveled path)."""
+        sock.sendall(struct.pack("<III", MAGIC, OP_CANCEL, 1))
+        send_arrays(sock,
+                    [np.frombuffer(self._tag_bytes(tag), np.uint8)])
+        magic, status, n = struct.unpack(
+            "<III", _recv_exact(sock, 12))
+        if magic != MAGIC:
+            raise ConnectionError("bad magic in response")
+        if status != 0:
+            raise from_wire(
+                _recv_exact(sock, n).decode(errors="replace"))
+        (out,) = recv_arrays(sock, n)
+        return bool(int(np.asarray(out).reshape(-1)[0]))
+
+    def _cancel_via(self, ep, tag) -> bool:
+        """`_cancel_exchange` against ``ep`` on a fresh probe-grade authed
+        connection (broadcast arm of `cancel`); any failure is a clean
+        miss."""
+        host, port = ep
+        try:
+            sock = retrying_connect(host, port, timeout=5.0, attempts=1,
+                                    deadline_s=2.0)
+        except (ConnectionError, OSError):
+            return False
+        try:
+            sock.sendall(struct.pack("<I", MAGIC) + self._token_bytes)
+            return self._cancel_exchange(sock, tag)
+        except (OSError, ConnectionError, RuntimeError, struct.error):
+            return False
+        finally:
+            sock.close()
 
     def run(self, inputs):
         self._sock.sendall(struct.pack("<III", MAGIC, OP_RUN, len(inputs)))

@@ -265,6 +265,13 @@ def export_pages(k_pages, v_pages, page_list, k_scales=None, v_scales=None):
     decode on another; docs/SERVING.md). The page table makes the transfer a
     page-index gather, never a tensor-relayout.
 
+    Wire integrity lives one layer up (docs/ROBUSTNESS.md "Wire
+    integrity"): when these blobs travel as ``PTKV1``/``PTMG1`` bytes,
+    `engine.KVHandoff.pack` stamps a blake2b body checksum the unpack
+    side verifies BEFORE any page byte is interpreted — a truncated or
+    bit-flipped transfer is a typed ``HandoffCorrupt`` refusal, so the
+    scatter below only ever sees intact pages.
+
     k_pages/v_pages : [num_layers, num_pages, page_size, nh, dh]
     page_list       : [n] int page indices (a sequence's allocation,
                       in token order)

@@ -24,6 +24,14 @@ Clients speak the unchanged serve wire protocol to the router
 picked by policy, resubmits on replica failure, and serves its own
 STATS/PROMETHEUS from the local metrics registry.
 
+The control plane itself is redundant (docs/ROBUSTNESS.md
+"Control-plane HA"): run N routers over the same registry — each routes
+independently (soft state, no leader), registers under the distinct
+``router`` role (``--router-id``), and clients
+(`RemotePredictor(endpoints=...)` or ``registry_dir=``) fail over across
+them mid-request with exactly-once semantics via per-request idempotency
+keys and each engine's dedup table.
+
 `autoscale.py` closes the elasticity loop (ROADMAP item 2): a controller
 that watches per-replica STATS + the router's outstanding view and
 spawns/drains replicas between ``min_replicas`` and ``max_replicas`` —

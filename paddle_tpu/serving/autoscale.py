@@ -115,13 +115,22 @@ class CallbackLauncher:
 
 
 class Autoscaler:
-    """Fleet-size controller over one `Router` + one launcher.
+    """Fleet-size controller over the router tier + one launcher.
 
     >>> scaler = Autoscaler(router, launcher, AutoscalePolicy(
     ...     max_replicas=3, hysteresis_ticks=1))
     >>> scaler.start()        # or call scaler.tick() from your own loop
     ...
     >>> scaler.stop()
+
+    ``router`` is one `Router` or a LIST of redundant routers
+    (docs/ROBUSTNESS.md "Control-plane HA"): observations read from the
+    first (all routers converge on the same registry-driven view), while
+    membership mutations — spawn joins, scale-down removals, crash reaps
+    — fan out to EVERY router, so a launcher-owned static replica exists
+    in each rotation and a drain victim stops receiving traffic from the
+    whole control plane, not just one front door. Registry-registered
+    replicas need no fan-out (every router polls the registry itself).
 
     ``stats_fn(endpoint) -> dict | None`` overrides the per-replica STATS
     pull (the default opens one authed STATS exchange per healthy replica
@@ -133,7 +142,11 @@ class Autoscaler:
     def __init__(self, router, launcher, policy: AutoscalePolicy | None
                  = None, interval_s: float = 1.0, replica_secret=None,
                  stats_fn=None):
-        self._router = router
+        self._routers = list(router) if isinstance(router, (list, tuple)) \
+            else [router]
+        if not self._routers:
+            raise ValueError("need >= 1 router")
+        self._router = self._routers[0]    # the observation view
         self._launcher = launcher
         self.policy = policy or AutoscalePolicy()
         self._interval = float(interval_s)
@@ -312,7 +325,8 @@ class Autoscaler:
         rid, endpoint = spawned
         rid, endpoint = str(rid), str(endpoint)
         self._owned[rid] = endpoint
-        self._router.add_static_replica(rid, endpoint)
+        for router in self._routers:
+            router.add_static_replica(rid, endpoint)
         self._last_action_t = time.monotonic()
         self._up_votes = self._down_votes = 0
         self._m_ups.inc()
@@ -344,7 +358,8 @@ class Autoscaler:
         victim = min(owned, key=lambda r: (r["outstanding"],
                                            r["replica_id"]))
         rid = victim["replica_id"]
-        self._router.remove_static_replica(rid)
+        for router in self._routers:
+            router.remove_static_replica(rid)
         self._last_action_t = time.monotonic()
         self._up_votes = self._down_votes = 0
         self._drain_owned(rid)
@@ -395,7 +410,8 @@ class Autoscaler:
             self._open_streak[rid] = streak
             if streak >= max(1, int(self.policy.reap_open_ticks)):
                 self._open_streak.pop(rid, None)
-                self._router.remove_static_replica(rid)
+                for router in self._routers:
+                    router.remove_static_replica(rid)
                 metrics.counter("autoscaler.reaped").inc()
                 flight.record("autoscaler.reap", replica=rid,
                               endpoint=self._owned[rid])

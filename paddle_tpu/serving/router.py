@@ -41,6 +41,19 @@ unchanged. Behind the front door:
   (re)submit, and the per-attempt IO timeout is clipped to it — the
   client's deadline bounds the whole routed attempt chain, resubmits
   included (``router.deadline_exceeded`` counts budget exhaustion).
+- **Redundant routers** (docs/ROBUSTNESS.md "Control-plane HA"): N
+  routers run simultaneously over the shared registry, each routing
+  independently — routing state is SOFT (breakers/outstanding rebuild
+  from probes), so there is no leader. A router registers ITSELF under
+  the distinct ``router`` role (``--router-id`` -> node id
+  ``router:<id>``) for client discovery; router-role leases never enter
+  any replica rotation. Requests carrying an idempotency KEY route by
+  rendezvous hash — routers with the same healthy view independently
+  pick the same replica, so a failover resubmit lands on the engine
+  whose dedup table already owns the key (best-effort while breaker
+  views transiently diverge; the dedup table bounds duplicates to that
+  window) — and an ambiguous mid-wire death gets one same-replica retry
+  (``router.ack_retries``) instead of an eviction.
 
 Observability (docs/OBSERVABILITY.md): ``router.requests``,
 ``router.replica_errors``, ``router.resubmits``, ``router.no_replica``,
@@ -57,6 +70,7 @@ half-finished requests between replicas.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import os
 import secrets as _secrets
 import socket
@@ -66,6 +80,7 @@ import time
 
 import numpy as np
 
+from paddle_tpu.distributed.fleet.elastic import node_role, router_node_id
 from paddle_tpu.inference.errors import DeadlineExceeded, Overloaded
 from paddle_tpu.inference.serve import (MAGIC, OP_CANCEL, OP_GENERATE,
                                         OP_PING, OP_PROMETHEUS, OP_RUN,
@@ -76,6 +91,7 @@ from paddle_tpu.inference.serve import (MAGIC, OP_CANCEL, OP_GENERATE,
 from paddle_tpu.observability import metrics
 from paddle_tpu.observability.flight_recorder import flight
 from paddle_tpu.observability.tracing import new_request_id
+from paddle_tpu.testing import faults
 
 __all__ = ["Router", "ReplicaState", "POLICIES", "ReplicaUnavailable"]
 
@@ -280,6 +296,9 @@ class Router:
         self._sock.listen(16)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
+        self._lease = None            # router-role registry lease
+        self._conns: set[socket.socket] = set()   # live client conns
+        self._conn_lock = threading.Lock()
         # the membership poll thread ALWAYS runs: beyond registry
         # membership it is what re-admits an error-evicted replica after
         # the cooldown (static fleets included — without it an eviction
@@ -332,10 +351,14 @@ class Router:
         An OPEN breaker is NOT reset by the registry still vouching for
         the replica — a crashed process keeps a fresh lease until its
         TTL; re-admission is the health probe's job (open -> half_open
-        after the cooldown, then a successful PING closes it)."""
+        after the cooldown, then a successful PING closes it).
+        ROUTER-role leases (``router:<id>`` — this router's own siblings
+        in a redundant control plane) are NOT replicas: they share the
+        registry for client discovery and never enter the rotation."""
         with self._rlock:
             alive = dict(self._static)
-            alive.update(registry_alive)
+            alive.update({rid: ep for rid, ep in registry_alive.items()
+                          if node_role(rid) == "replica"})
             for rid, ep in alive.items():
                 self._join_replica(rid, str(ep))
             for rid in [rid for rid in self._replicas if rid not in alive]:
@@ -543,7 +566,8 @@ class Router:
 
     # -------------------------------------------------------------- routing
 
-    def _pick(self, tried: set) -> ReplicaState | None:
+    def _pick(self, tried: set,
+              key: bytes | None = None) -> ReplicaState | None:
         with self._rlock:
             cands = [r for r in self._replicas.values()
                      if r.breaker == "closed" and r.replica_id not in tried]
@@ -556,8 +580,37 @@ class Router:
                          and r.replica_id not in tried]
             if not cands:
                 return None
+            if key is not None:
+                # KEYED placement is rendezvous-hashed, not policy-picked
+                # (docs/ROBUSTNESS.md "Control-plane HA"): routers with
+                # the same healthy view independently compute the same
+                # replica for a key — a resubmit through a DIFFERENT
+                # router lands on the engine whose dedup table already
+                # holds the request, with no shared routing state (and
+                # only a transient breaker-view divergence can re-run a
+                # key elsewhere). Random 16-byte keys spread uniformly,
+                # and HRW moves only the affected keys on membership
+                # churn; the `tried` fallback order matches across
+                # routers too.
+                return max(cands, key=lambda r: self._hrw(key, r))
             cands.sort(key=lambda r: r.replica_id)
             return POLICIES[self._policy](self, cands)
+
+    @staticmethod
+    def _hrw(key: bytes, r: ReplicaState) -> tuple:
+        h = hashlib.blake2b(key + r.replica_id.encode(),
+                            digest_size=8).digest()
+        return (int.from_bytes(h, "little"), r.replica_id)
+
+    @staticmethod
+    def _request_key(arrays) -> bytes | None:
+        """The GENERATE options array's 16-byte idempotency key (the
+        7-wide options shape's trailing four int32 words), if present."""
+        if len(arrays) >= 3:
+            opts = np.asarray(arrays[2]).reshape(-1)
+            if opts.size >= 7:
+                return np.ascontiguousarray(opts[3:7], np.int32).tobytes()
+        return None
 
     def _evict(self, r: ReplicaState, reason: str):
         with self._rlock:
@@ -589,11 +642,13 @@ class Router:
         sock = retrying_connect(host, int(port), timeout=eff_timeout,
                                 attempts=2,
                                 deadline_s=self._connect_deadline)
+        sent = False
         try:
             sock.sendall(struct.pack("<I", MAGIC) + self._replica_token)
             sock.sendall(struct.pack("<III", MAGIC, op, len(arrays)))
             if arrays:
                 send_arrays(sock, arrays)
+            sent = True
             if client_conn is not None:
                 self._await_replica_or_client_gone(sock, client_conn,
                                                    eff_timeout)
@@ -609,6 +664,17 @@ class Router:
                 raise _classify_wire_error(msg)
             outs = recv_arrays(sock, n)
             return outs if op == OP_GENERATE else outs[0]
+        except (ConnectionError, socket.timeout, OSError) as e:
+            # a wire death AFTER the request was delivered is AMBIGUOUS:
+            # the replica may be running — or may already have finished —
+            # the work. `_route_generate` gives a keyed request one
+            # same-replica retry on this (the dedup table resolves the
+            # ambiguity); everything else keeps the evict+resubmit path
+            if sent and not isinstance(e, ReplicaUnavailable):
+                # a classified ReplicaUnavailable is an ANSWER (the
+                # replica refused the work) — definitive, not ambiguous
+                e._pt_ambiguous = True
+            raise
         finally:
             sock.close()
 
@@ -660,10 +726,21 @@ class Router:
         timeout to it), so resubmission can never stretch a request past
         its deadline. Raises to the client only when the budget, the
         deadline, or the healthy set is exhausted (or the request itself
-        is bad) — always one clean typed line, never a hang."""
+        is bad) — always one clean typed line, never a hang.
+
+        A request carrying an idempotency KEY routes by rendezvous hash
+        (`_pick`), forwards the CLIENT's key on every attempt (never a
+        per-attempt identity), and treats an ambiguous mid-wire death —
+        the request was delivered, the answer never arrived — as ONE
+        free same-replica retry: the replica's dedup table attaches or
+        replays, so the ambiguity costs zero duplicate generations and
+        no eviction (docs/ROBUSTNESS.md "Control-plane HA")."""
         rid_req = new_request_id()
         budget = self._max_resubmits
         tried: set[str] = set()
+        key = self._request_key(arrays)
+        retried_same: set[str] = set()
+        forced: ReplicaState | None = None
         t0 = time.perf_counter()
         deadline_ms = self._deadline_ms(arrays)
         t_deadline = None if deadline_ms is None \
@@ -693,7 +770,8 @@ class Router:
                 # engine answers DeadlineExceeded first; the clip only
                 # catches a wedged replica
                 timeout = min(self._request_timeout, remaining + 10.0)
-            r = self._pick(tried)
+            r, forced = forced if forced is not None \
+                else self._pick(tried, key=key), None
             if r is None:
                 if overloads and not others:
                     # every reachable replica answered a typed shed:
@@ -717,6 +795,22 @@ class Router:
                     OSError) as e:
                 last_err = f"{r.replica_id}: {type(e).__name__}: {e}"
                 metrics.counter("router.replica_errors").inc()
+                if key is not None and getattr(e, "_pt_ambiguous", False) \
+                        and r.replica_id not in retried_same:
+                    # AMBIGUOUS wire death on a KEYED request: the replica
+                    # got the request and may be decoding (or done) — a
+                    # resubmit elsewhere would duplicate the generation.
+                    # Retry the SAME replica once, free of eviction and
+                    # resubmit budget: its dedup table attaches/replays.
+                    # A replica that is actually dead fails the retry's
+                    # CONNECT (unambiguous) and takes the normal
+                    # evict+resubmit path below.
+                    retried_same.add(r.replica_id)
+                    forced = r
+                    metrics.counter("router.ack_retries").inc()
+                    flight.record("router.ack_retry",
+                                  replica=r.replica_id, error=last_err)
+                    continue
                 if isinstance(e, ReplicaUnavailable) \
                         and str(e).startswith("Overloaded"):
                     overloads += 1     # healthy replica, full queue: no
@@ -803,6 +897,16 @@ class Router:
 
     # ------------------------------------------------------------ wire side
 
+    def attach_registry(self, lease):
+        """Hold the ROUTER-ROLE registry lease this router registered
+        under (node id ``router:<id>``, `elastic.router_node_id`):
+        clients discover the redundant router set from these leases
+        (`RemotePredictor(registry_dir=...)`), sibling routers and the
+        replicas' peer discovery skip them by role. `stop()` deregisters
+        so a cleanly stopped router leaves the failover set."""
+        self._lease = lease
+        return self
+
     def serve_forever(self):
         while not self._stop.is_set():
             try:
@@ -812,16 +916,40 @@ class Router:
                 continue
             except OSError:
                 break
+            with self._conn_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._client_loop, args=(conn,),
                              daemon=True).start()
         self._sock.close()
 
-    def stop(self):
+    def stop(self, hard=False):
+        """Stop accepting. ``hard=True`` additionally severs every LIVE
+        client connection — the router-kill drill's process-death
+        equivalent: blocked clients see EOF and fail over to a surviving
+        router (docs/ROBUSTNESS.md "Control-plane HA")."""
         self._stop.set()
         try:
             self._sock.close()
         except OSError:
             pass
+        if self._lease is not None:
+            try:
+                self._lease.leave()
+            except OSError:
+                pass
+            self._lease = None
+        if hard:
+            with self._conn_lock:
+                conns = list(self._conns)
+            for c in conns:
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    c.close()
+                except OSError:
+                    pass
 
     def _client_loop(self, conn):
         """Same protocol discipline as `InferenceServer._client_loop`:
@@ -873,6 +1001,15 @@ class Router:
                     conn.sendall(struct.pack("<III", MAGIC, 0, 0))
                     self.stop()
                     return
+                if faults.ENABLED and op == OP_GENERATE \
+                        and faults.fire("router.crash"):
+                    # deterministic router death at request accept
+                    # (testing/faults.py): the listener closes, every
+                    # live client connection severs, and this request is
+                    # never forwarded — clients must fail over to a
+                    # surviving router (docs/ROBUSTNESS.md)
+                    self.stop(hard=True)
+                    return
                 try:
                     arrays = recv_arrays(conn, n)
                     if op == OP_RUN:
@@ -905,6 +1042,8 @@ class Router:
                         pass    # client already gone
                     return
         finally:
+            with self._conn_lock:
+                self._conns.discard(conn)
             conn.close()
 
     @staticmethod
@@ -942,6 +1081,15 @@ def main(argv=None):
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="also serve GET /metrics (Prometheus text) from "
                          "a stdlib HTTP endpoint on this port")
+    ap.add_argument("--router-id", default=None,
+                    help="register THIS router in the registry under the "
+                         "'router' role (node id router:<id>) so clients "
+                         "discover the redundant router set "
+                         "(RemotePredictor registry_dir=/registry_addr=); "
+                         "default: watch-only, no self-registration")
+    ap.add_argument("--advertise", default=None,
+                    help="endpoint to publish with --router-id (default "
+                         "<host>:<bound port>)")
     args = ap.parse_args(argv)
     replicas = {}
     for spec in args.replica:
@@ -958,12 +1106,27 @@ def main(argv=None):
         registry = TcpNodeRegistry(args.registry_addr)
     if registry is None and not replicas:
         ap.error("need --registry-dir, --registry-addr, or --replica")
+    if args.router_id is not None and registry is None:
+        ap.error("--router-id needs --registry-dir or --registry-addr "
+                 "(the router role is a registry lease)")
     router = Router(registry=registry, replicas=replicas,
                     policy=args.policy, host=args.host, port=args.port,
                     auth_name=args.auth_name,
                     replica_secret=args.replica_secret,
                     poll_interval_s=args.poll_interval,
                     max_resubmits=args.max_resubmits)
+    if args.router_id is not None:
+        from paddle_tpu.distributed.fleet.elastic import (NodeRegistry,
+                                                          TcpNodeRegistry)
+        nid = router_node_id(args.router_id)
+        endpoint = args.advertise or f"{args.host}:{router.port}"
+        if args.registry_dir:
+            lease = NodeRegistry(args.registry_dir, nid, endpoint)
+        else:
+            lease = TcpNodeRegistry(args.registry_addr, nid, endpoint)
+        lease.register()
+        router.attach_registry(lease)
+        print(f"REGISTERED {nid} {endpoint}", flush=True)
     from paddle_tpu.inference.serve import install_sigusr1_dump
     install_sigusr1_dump()
     print(f"LISTENING {router.port}", flush=True)

@@ -95,8 +95,13 @@ class TestVisionOps:
 
 
 class TestModelZoo:
-    @pytest.mark.parametrize("name", ["vgg11", "mobilenet_v1", "mobilenet_v2",
-                                      "alexnet", "squeezenet1_1"])
+    # mobilenet_v2 is the wall-audited redundant parametrization (PR 12,
+    # ~9 s): mobilenet_v1 keeps the family's forward-shape pin in tier-1,
+    # nightly --runslow covers v2
+    @pytest.mark.parametrize("name", [
+        "vgg11", "mobilenet_v1",
+        pytest.param("mobilenet_v2", marks=pytest.mark.slow),
+        "alexnet", "squeezenet1_1"])
     def test_forward_shapes(self, name):
         import paddle_tpu.vision.models as M
         paddle.seed(0)

@@ -1,0 +1,721 @@
+"""Control-plane high availability (docs/ROBUSTNESS.md "Control-plane
+HA"): redundant routers, idempotent exactly-once requests, wire-blob
+integrity.
+
+The contracts under test:
+
+- **Idempotent dedup** (`DecodeEngine.submit(request_key=)`): a resubmit
+  of an in-flight key ATTACHES to the running request (one generation,
+  ``engine.dedup_hits``); a completed key REPLAYS tokens or the typed
+  error byte-identically (``engine.dedup_replays``); a cancelled key
+  re-executes; the table is LRU-bounded; keys ride the ``PTMG1``
+  migration header so dedup survives a drain.
+- **Wire integrity**: ``PTKV1``/``PTMG1`` blobs carry a blake2b body
+  checksum — truncation or a bit flip is a typed ``HandoffCorrupt``
+  refusal, never garbage context; the ``serve.blob_corrupt`` fault site
+  drives the refusal + clean re-ship end to end.
+- **Router HA**: routers are registry citizens under the ``router`` role
+  (never routed to as replicas, never migration peers); keyed requests
+  place by rendezvous hash so every router picks the same replica;
+  `RemotePredictor` fails over across routers mid-request with
+  exactly-once semantics (the ``serve.ack_drop`` ambiguous-failure drill
+  and the router-kill drill), and CANCEL lands through a router other
+  than the one that accepted the request.
+
+Deterministic like the chaos suite: no random kills, faults fire exact
+counts at named sites (marker ``chaos``)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import metrics
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.chaos
+
+FLEET_SECRET = "cp-fleet"
+FRONT_SECRET = "cp-front"
+
+KEY_A = bytes(range(16))
+KEY_B = bytes(range(16, 32))
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=2, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def _engine(model, **ekw):
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    ekw.setdefault("page_size", 4)
+    ekw.setdefault("max_slots", 4)
+    ekw.setdefault("min_bucket", 8)
+    return DecodeEngine(model, EngineConfig(**ekw))
+
+
+def _replica(model, **ekw):
+    from paddle_tpu.inference.serve import InferenceServer
+    srv = InferenceServer(None, engine=_engine(model, **ekw),
+                          auth_name=FLEET_SECRET)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _router(**kw):
+    from paddle_tpu.serving import Router
+    kw.setdefault("replica_secret", FLEET_SECRET)
+    kw.setdefault("auth_name", FRONT_SECRET)
+    router = Router(**kw)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    return router
+
+
+def _fast_ref(model, prompt, n):
+    ids = paddle.Tensor(np.asarray(prompt)[None].astype(np.int32),
+                        _internal=True)
+    return np.asarray(model.fast_generate(ids, max_new_tokens=n).numpy())[0]
+
+
+def _counter(name):
+    return metrics.snapshot()["counters"].get(name, 0)
+
+
+def _wait_for(pred, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.02)
+
+
+def _stop_server(srv):
+    srv._stop.set()
+    if srv._engine_thread is not None:
+        srv._engine_thread.join(timeout=30)
+    srv._sock.close()
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.disarm()
+
+
+# ------------------------------------------------------- engine-level dedup
+
+
+class TestEngineDedup:
+    def test_completed_key_replays_byte_identical(self, model):
+        eng = _engine(model)
+        p = np.arange(5, dtype=np.int32)
+        base = _counter("engine.requests")
+        r1 = eng.submit(p, max_new_tokens=6, request_key=KEY_A)
+        eng.run_until_idle()
+        out1 = r1.result(timeout=30)
+        r2 = eng.submit(p, max_new_tokens=6, request_key=KEY_A)
+        assert r2 is r1, "completed key must replay the SAME request"
+        np.testing.assert_array_equal(r2.result(timeout=1), out1)
+        # exactly ONE generation executed; the resubmit was a replay
+        assert _counter("engine.requests") - base == 1
+        assert _counter("engine.dedup_replays") >= 1
+
+    def test_in_flight_key_attaches_single_generation(self, model):
+        eng = _engine(model)
+        p = np.arange(6, dtype=np.int32)
+        base_req = _counter("engine.requests")
+        base_hit = _counter("engine.dedup_hits")
+        r1 = eng.submit(p, max_new_tokens=8, request_key=KEY_B)
+        r2 = eng.submit(p, max_new_tokens=8, request_key=KEY_B)
+        assert r2 is r1, "in-flight key must attach, not re-run"
+        eng.run_until_idle()
+        np.testing.assert_array_equal(r1.result(timeout=30),
+                                      _fast_ref(model, p, 8))
+        assert _counter("engine.requests") - base_req == 1
+        assert _counter("engine.dedup_hits") - base_hit == 1
+
+    def test_key_reuse_for_different_request_refused(self, model):
+        eng = _engine(model)
+        p = np.arange(5, dtype=np.int32)
+        eng.submit(p, max_new_tokens=4, request_key=KEY_A)
+        with pytest.raises(ValueError, match="request_key reused"):
+            eng.submit(p + 1, max_new_tokens=4, request_key=KEY_A)
+        with pytest.raises(ValueError, match="request_key reused"):
+            eng.submit(p, max_new_tokens=5, request_key=KEY_A)
+        # a malformed key is refused before it can poison the table
+        with pytest.raises(ValueError, match="16 bytes"):
+            eng.submit(p, max_new_tokens=4, request_key=b"short")
+        eng.run_until_idle()
+
+    def test_cancelled_key_reexecutes(self, model):
+        """A cancel means no answer was produced — the resubmit is a
+        fresh attempt, not a replay of the Cancelled error."""
+        from paddle_tpu.inference.errors import Cancelled
+        eng = _engine(model)
+        p = np.arange(4, dtype=np.int32)
+        r1 = eng.submit(p, max_new_tokens=6, request_key=KEY_A)
+        assert eng.cancel(r1.request_id) is True
+        eng.run_until_idle()
+        with pytest.raises(Cancelled):
+            r1.result(timeout=10)
+        r2 = eng.submit(p, max_new_tokens=6, request_key=KEY_A)
+        assert r2 is not r1
+        eng.run_until_idle()
+        np.testing.assert_array_equal(r2.result(timeout=30),
+                                      _fast_ref(model, p, 6))
+
+    def test_typed_error_replays_verbatim(self, model):
+        """'tokens or the typed error, verbatim': a DeadlineExceeded
+        outcome replays with the identical message."""
+        from paddle_tpu.inference.errors import DeadlineExceeded
+        eng = _engine(model)
+        p = np.arange(4, dtype=np.int32)
+        r1 = eng.submit(p, max_new_tokens=6, request_key=KEY_B,
+                        deadline_s=0.01)
+        time.sleep(0.05)
+        eng.run_until_idle()
+        with pytest.raises(DeadlineExceeded) as e1:
+            r1.result(timeout=10)
+        base = _counter("engine.dedup_replays")
+        r2 = eng.submit(p, max_new_tokens=6, request_key=KEY_B)
+        assert r2 is r1
+        with pytest.raises(DeadlineExceeded) as e2:
+            r2.result(timeout=1)
+        assert str(e1.value) == str(e2.value)
+        assert _counter("engine.dedup_replays") - base == 1
+
+    def test_lru_bound_evicts_oldest_key(self, model):
+        eng = _engine(model, dedup_capacity=2)
+        p = np.arange(4, dtype=np.int32)
+        keys = [bytes([i] * 16) for i in range(3)]
+        reqs = [eng.submit(p, max_new_tokens=2, request_key=k)
+                for k in keys]
+        eng.run_until_idle()
+        base = _counter("engine.requests")
+        # keys[0] was LRU-evicted by keys[2]: its resubmit re-executes
+        r = eng.submit(p, max_new_tokens=2, request_key=keys[0])
+        assert r is not reqs[0]
+        # keys[2] is still cached: replay
+        assert eng.submit(p, max_new_tokens=2,
+                          request_key=keys[2]) is reqs[2]
+        eng.run_until_idle()
+        assert _counter("engine.requests") - base == 1
+
+    def test_dedup_disabled_executes_every_submit(self, model):
+        eng = _engine(model, dedup_capacity=0)
+        p = np.arange(4, dtype=np.int32)
+        r1 = eng.submit(p, max_new_tokens=2, request_key=KEY_A)
+        eng.run_until_idle()
+        r2 = eng.submit(p, max_new_tokens=2, request_key=KEY_A)
+        assert r2 is not r1
+        eng.run_until_idle()
+        np.testing.assert_array_equal(r1.result(timeout=10),
+                                      r2.result(timeout=10))
+
+    def test_key_rides_migration_and_dedups_on_the_peer(self, model):
+        """Exactly-once survives a drain: the key travels in the PTMG1
+        header, the peer registers the resumed request, and a client
+        resubmit on the peer ATTACHES instead of re-running."""
+        from paddle_tpu.inference.engine import (pack_migration,
+                                                 unpack_migration)
+        src, dst = _engine(model), _engine(model)
+        p = np.arange(3, 9, dtype=np.int32)
+        ref = _fast_ref(model, p, 12)
+        req = src.submit(p, max_new_tokens=12, request_key=KEY_A)
+        for _ in range(4):
+            src.step()
+        assert not req.done
+        src.drain(migrate=True)
+        src.step()
+        (item,) = src.take_migrated(timeout=10)
+        assert item.request_key == KEY_A
+        # wire round trip preserves the key
+        item2 = unpack_migration(pack_migration(item))
+        assert item2.request_key == KEY_A
+        moved = dst.submit_import(item2.handoff,
+                                  max_new_tokens=item2.max_new_tokens,
+                                  request_key=item2.request_key)
+        base_hit = _counter("engine.dedup_hits")
+        resub = dst.submit(p, max_new_tokens=12, request_key=KEY_A)
+        assert resub is moved, "post-migration resubmit must attach"
+        assert _counter("engine.dedup_hits") - base_hit == 1
+        dst.run_until_idle()
+        np.testing.assert_array_equal(moved.result(timeout=30), ref)
+
+
+# --------------------------------------------------------- wire integrity
+
+
+def _strip_sum(blob: bytes, magic: bytes) -> bytes:
+    """Rebuild a blob as a pre-checksum build would have written it (no
+    ``sum`` header field) — the legacy-compat fixture."""
+    import json
+    import struct
+    m = len(magic)
+    (hlen,) = struct.unpack("<I", blob[m:m + 4])
+    head = json.loads(blob[m + 4:m + 4 + hlen].decode())
+    head.pop("sum", None)
+    hb = json.dumps(head).encode()
+    return b"".join([magic, struct.pack("<I", len(hb)), hb,
+                     blob[m + 4 + hlen:]])
+
+
+class TestWireIntegrity:
+    def test_ptkv1_checksum_bitflip_and_truncation_refused(self, model):
+        from paddle_tpu.inference.engine import KVHandoff
+        from paddle_tpu.inference.errors import HandoffCorrupt
+        eng = _engine(model)
+        h = eng.prefill_export(np.arange(6, dtype=np.int32))
+        blob = h.pack()
+        h2 = KVHandoff.unpack(blob)     # clean round trip
+        np.testing.assert_array_equal(h2.k_pages, h.k_pages)
+        np.testing.assert_array_equal(h2.v_pages, h.v_pages)
+        flipped = bytearray(blob)
+        flipped[-7] ^= 0x10             # one bit, deep in the v pages
+        with pytest.raises(HandoffCorrupt, match="checksum"):
+            KVHandoff.unpack(bytes(flipped))
+        with pytest.raises(HandoffCorrupt, match="checksum"):
+            KVHandoff.unpack(blob[:len(blob) // 2])   # truncated body
+        with pytest.raises(HandoffCorrupt, match="unparseable"):
+            KVHandoff.unpack(blob[:8])                # truncated header
+        # a non-blob is a ValueError (wrong thing), not corruption
+        with pytest.raises(ValueError, match="bad magic"):
+            KVHandoff.unpack(b"not a blob at all")
+
+    def test_ptmg1_checksum_both_directions(self, model):
+        from paddle_tpu.inference.engine import (MigrationItem,
+                                                 pack_migration,
+                                                 unpack_migration)
+        from paddle_tpu.inference.errors import HandoffCorrupt
+        eng = _engine(model)
+        h = eng.prefill_export(np.arange(5, dtype=np.int32))
+        for item in (MigrationItem(max_new_tokens=4, handoff=h,
+                                   tag=b"t", request_key=KEY_A),
+                     MigrationItem(max_new_tokens=4,
+                                   prompt=np.arange(5, dtype=np.int32),
+                                   request_key=KEY_B)):
+            blob = pack_migration(item)
+            it2 = unpack_migration(blob)      # clean round trip
+            assert it2.request_key == item.request_key
+            assert it2.tag == item.tag
+            bad = bytearray(blob)
+            bad[-3] ^= 0x01
+            with pytest.raises(HandoffCorrupt):
+                unpack_migration(bytes(bad))
+            with pytest.raises(HandoffCorrupt):
+                unpack_migration(blob[:len(blob) - 2])
+
+    def test_legacy_blob_without_sum_still_loads(self, model):
+        """Pre-checksum blobs (no ``sum`` header) load unverified — the
+        same legacy rule as unstamped checkpoints."""
+        from paddle_tpu.inference.engine import (KVHandoff, MigrationItem,
+                                                 pack_migration,
+                                                 unpack_migration)
+        eng = _engine(model)
+        h = eng.prefill_export(np.arange(6, dtype=np.int32))
+        legacy = _strip_sum(h.pack(), KVHandoff.MAGIC)
+        h2 = KVHandoff.unpack(legacy)
+        np.testing.assert_array_equal(h2.k_pages, h.k_pages)
+        mig = pack_migration(MigrationItem(
+            max_new_tokens=4, prompt=np.arange(5, dtype=np.int32)))
+        it = unpack_migration(_strip_sum(mig, b"PTMG1\n"))
+        assert it.max_new_tokens == 4
+
+    def test_blob_corrupt_fault_refused_typed_then_reshipped(self, model):
+        """The `serve.blob_corrupt` drill end to end: the first ship
+        attempt carries a flipped byte, the peer REFUSES it typed
+        (serve.blob_corrupt_refused) — and the sender re-packs the
+        intact item and the migration still completes token-identically,
+        zero client errors."""
+        from paddle_tpu.inference.serve import RemotePredictor
+        prompt = np.arange(3, 9, dtype=np.int32)
+        ref = _fast_ref(model, prompt, 16)
+        a, b = _replica(model), _replica(model)
+        outs = {}
+
+        def client():
+            cli = RemotePredictor(port=a.port, secret=FLEET_SECRET)
+            outs["x"] = cli.generate(prompt, max_new_tokens=16)
+            cli.close()
+
+        t = threading.Thread(target=client)
+        t.start()
+        base_ref = _counter("serve.blob_corrupt_refused")
+        base_out = _counter("serve.migrations_out")
+        with faults.scoped("engine.step_delay", times=-1, delay_s=0.01):
+            _wait_for(lambda: any(
+                r is not None and len(r.generated) >= 2
+                for r in a._engine._slot_req), msg="mid-decode on A")
+            with faults.scoped("serve.blob_corrupt", times=1):
+                clean = a.drain(migrate_peers=[f"127.0.0.1:{b.port}"])
+        t.join(timeout=60)
+        assert clean is True
+        np.testing.assert_array_equal(outs["x"], ref)
+        assert _counter("serve.blob_corrupt_refused") == base_ref + 1
+        assert _counter("serve.migrations_out") == base_out + 1
+        _stop_server(b)
+
+
+# ------------------------------------------------------------- router HA
+
+
+class TestRouterRoles:
+    def test_router_lease_never_enters_replica_rotation(self, model,
+                                                        tmp_path):
+        """Routers and replicas share one registry under distinct roles:
+        a sibling router's lease must not be routed to as a replica, and
+        a draining replica must not pick a router as a migration peer."""
+        from paddle_tpu.distributed.fleet.elastic import (NodeRegistry,
+                                                          node_role,
+                                                          router_node_id)
+        assert node_role(router_node_id("x")) == "router"
+        assert node_role("replica-123") == "replica"
+        assert node_role("legacy-id") == "replica"
+        s0 = _replica(model)
+        reg_rep = NodeRegistry(str(tmp_path), "r0",
+                               f"127.0.0.1:{s0.port}", ttl=30.0,
+                               heartbeat_interval=0.1).register()
+        router = _router(registry=NodeRegistry(str(tmp_path)),
+                         poll_interval_s=0.05)
+        lease = NodeRegistry(str(tmp_path), router_node_id("ra"),
+                             f"127.0.0.1:{router.port}", ttl=30.0,
+                             heartbeat_interval=0.1).register()
+        router.attach_registry(lease)
+        _wait_for(lambda: "r0" in router.replica_ids(), msg="r0 join")
+        time.sleep(0.2)     # a few poll cycles with both leases live
+        assert router.replica_ids() == ["r0"], \
+            "router-role lease leaked into the replica rotation"
+        # peer discovery from the replica side skips the router too
+        s0.attach_registry(reg_rep)
+        assert s0._discover_peers() == []
+        # a stopped router deregisters its lease
+        router.stop()
+        _wait_for(lambda: router_node_id("ra") not in
+                  NodeRegistry(str(tmp_path)).alive_nodes(),
+                  msg="router lease removal")
+        _stop_server(s0)
+
+    def test_client_discovers_routers_from_registry(self, model,
+                                                    tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import (NodeRegistry,
+                                                          router_node_id)
+        from paddle_tpu.inference.serve import RemotePredictor
+        s0 = _replica(model)
+        NodeRegistry(str(tmp_path), "r0", f"127.0.0.1:{s0.port}",
+                     ttl=30.0, heartbeat_interval=0.1).register()
+        router = _router(registry=NodeRegistry(str(tmp_path)),
+                         poll_interval_s=0.05)
+        NodeRegistry(str(tmp_path), router_node_id("ra"),
+                     f"127.0.0.1:{router.port}", ttl=30.0,
+                     heartbeat_interval=0.1).register()
+        _wait_for(lambda: "r0" in router.replica_ids(), msg="r0 join")
+        cli = RemotePredictor(registry_dir=str(tmp_path),
+                              secret=FRONT_SECRET)
+        # discovery found the ROUTER lease, not the replica's
+        assert cli._endpoints == [("127.0.0.1", router.port)]
+        p = np.arange(5, dtype=np.int32)
+        np.testing.assert_array_equal(
+            cli.generate(p, max_new_tokens=4), _fast_ref(model, p, 4))
+        cli.close()
+        router.stop()
+        _stop_server(s0)
+
+    def test_keyed_placement_is_identical_across_routers(self):
+        """Rendezvous hashing: every router independently picks the same
+        replica for a key, and the fallback order matches too."""
+        from paddle_tpu.serving.router import ReplicaState, Router
+        reps = {f"r{i}": f"h:{i}" for i in range(4)}
+        ra, rb = Router.__new__(Router), Router.__new__(Router)
+        for r in (ra, rb):
+            r._rlock = threading.Lock()
+            r._rr = -1
+            r._policy = "round_robin"
+            r._replicas = {k: ReplicaState(k, v) for k, v in reps.items()}
+        for key in (KEY_A, KEY_B, b"\x00" * 16):
+            assert ra._pick(set(), key=key).replica_id \
+                == rb._pick(set(), key=key).replica_id
+            first = ra._pick(set(), key=key).replica_id
+            # deterministic fallback: excluding the winner yields the
+            # same second choice on both routers
+            assert ra._pick({first}, key=key).replica_id \
+                == rb._pick({first}, key=key).replica_id
+        # distinct keys spread (not all on one replica)
+        picks = {ra._pick(set(), key=bytes([i]) * 16).replica_id
+                 for i in range(16)}
+        assert len(picks) > 1
+
+
+class TestExactlyOnce:
+    def test_ack_drop_resubmit_replays_single_generation(self, model):
+        """THE ambiguous-failure drill: the connection dies in the
+        accepted-but-unanswered window (`serve.ack_drop`). The client's
+        keyed resubmit reaches the same engine and REPLAYS the cached
+        answer — exactly one generation executed, byte-identical
+        tokens."""
+        from paddle_tpu.inference.serve import RemotePredictor
+        srv = _replica(model)
+        p = np.arange(6, dtype=np.int32)
+        cli = RemotePredictor(endpoints=[f"127.0.0.1:{srv.port}"],
+                              secret=FLEET_SECRET)
+        base_req = _counter("engine.requests")
+        base_rep = _counter("engine.dedup_replays")
+        base_fo = _counter("router.failovers")
+        with faults.scoped("serve.ack_drop", times=1):
+            out = cli.generate(p, max_new_tokens=6)
+        np.testing.assert_array_equal(out, _fast_ref(model, p, 6))
+        assert _counter("engine.requests") - base_req == 1, \
+            "the resubmit re-ran the generation"
+        assert _counter("engine.dedup_replays") - base_rep == 1
+        assert _counter("router.failovers") - base_fo == 1
+        cli.close()
+        _stop_server(srv)
+
+    def test_ack_drop_through_router_retries_same_replica(self, model):
+        """The ROUTER side of the ambiguous window: a keyed request whose
+        replica connection dies after delivery gets ONE same-replica
+        retry (router.ack_retries) — no eviction, no duplicate — and the
+        dedup table answers it."""
+        from paddle_tpu.inference.serve import RemotePredictor
+        srv = _replica(model)
+        router = _router(replicas={"r0": f"127.0.0.1:{srv.port}"})
+        p = np.arange(5, dtype=np.int32)
+        cli = RemotePredictor(endpoints=[f"127.0.0.1:{router.port}"],
+                              secret=FRONT_SECRET)
+        base_req = _counter("engine.requests")
+        base_retry = _counter("router.ack_retries")
+        with faults.scoped("serve.ack_drop", times=1):
+            out = cli.generate(p, max_new_tokens=6)
+        np.testing.assert_array_equal(out, _fast_ref(model, p, 6))
+        assert _counter("engine.requests") - base_req == 1
+        assert _counter("router.ack_retries") - base_retry == 1
+        assert "r0" in router.replica_ids(healthy_only=True), \
+            "ambiguous retry must not evict the replica"
+        cli.close()
+        router.stop()
+        _stop_server(srv)
+
+    def test_legacy_keyless_client_keeps_at_least_once(self, model):
+        """Back-compat: a plain host/port client sends no key and
+        surfaces the wire error itself — the pre-HA contract, verbatim."""
+        from paddle_tpu.inference.serve import RemotePredictor
+        srv = _replica(model)
+        cli = RemotePredictor(port=srv.port, secret=FLEET_SECRET)
+        with faults.scoped("serve.ack_drop", times=1):
+            with pytest.raises((ConnectionError, OSError)):
+                cli.generate(np.arange(4, dtype=np.int32),
+                             max_new_tokens=2)
+        cli.close()
+        _stop_server(srv)
+
+
+class TestRouterFailoverDrill:
+    def test_kill_active_router_with_8_in_flight(self, model):
+        """THE router-kill drill: 8 keyed requests in flight through
+        router A; A dies hard (listener + every live connection). Every
+        client fails over to router B and completes token-identically —
+        zero client errors, zero duplicate generations (each resubmit
+        attached to or replayed the original: engine.requests moved by
+        exactly 8, dedup accounting covers all resubmits)."""
+        from paddle_tpu.inference.serve import RemotePredictor
+        s0 = _replica(model, max_slots=8)
+        s1 = _replica(model, max_slots=8)
+        reps = {"r0": f"127.0.0.1:{s0.port}", "r1": f"127.0.0.1:{s1.port}"}
+        ra, rb = _router(replicas=reps), _router(replicas=reps)
+        outs, errs = {}, []
+
+        def one(i, prompt, n):
+            try:
+                cli = RemotePredictor(
+                    endpoints=[f"127.0.0.1:{ra.port}",
+                               f"127.0.0.1:{rb.port}"],
+                    secret=FRONT_SECRET)
+                outs[i] = (prompt, n, cli.generate(prompt,
+                                                   max_new_tokens=n))
+                cli.close()
+            except Exception as e:  # noqa: BLE001 — recorded, test-failed
+                errs.append((i, repr(e)))
+
+        base_req = _counter("engine.requests")
+        base_hit = _counter("engine.dedup_hits")
+        base_rep = _counter("engine.dedup_replays")
+        base_fo = _counter("router.failovers")
+        # slowed steps pin every request MID-decode when A dies
+        faults.arm("engine.step_delay", times=-1, delay_s=0.05)
+        ths = [threading.Thread(
+            target=one, args=(i, (np.arange(4 + i) % 97).astype(np.int32),
+                              8)) for i in range(8)]
+        for t in ths:
+            t.start()
+        _wait_for(lambda: _counter("router.requests") >= 0 and sum(
+            1 for r in (s0._engine._slot_req + s1._engine._slot_req)
+            if r is not None) >= 4, msg="requests in flight")
+        ra.stop(hard=True)        # the active router dies
+        for t in ths:
+            t.join(timeout=120)
+        faults.disarm("engine.step_delay")
+        assert not errs, f"client-visible errors: {errs}"
+        for i, (prompt, n, out) in outs.items():
+            np.testing.assert_array_equal(out, _fast_ref(model, prompt, n))
+        fo = _counter("router.failovers") - base_fo
+        assert fo >= 8, f"expected >= 8 failovers, saw {fo}"
+        # ZERO duplicate generations fleet-wide: 8 logical requests, 8
+        # executions; every failover resubmit hit the dedup table
+        assert _counter("engine.requests") - base_req == 8
+        dedup = (_counter("engine.dedup_hits") - base_hit
+                 + _counter("engine.dedup_replays") - base_rep)
+        assert dedup >= 8, f"resubmits bypassed dedup: {dedup}"
+        rb.stop()
+        _stop_server(s0), _stop_server(s1)
+
+    def test_cancel_lands_through_a_different_router(self, model):
+        """A tag registered through router A is killable through router
+        B: the routers are independent and each broadcasts CANCEL to
+        every replica."""
+        from paddle_tpu.inference.errors import Cancelled
+        from paddle_tpu.inference.serve import RemotePredictor
+        srv = _replica(model)
+        reps = {"r0": f"127.0.0.1:{srv.port}"}
+        ra, rb = _router(replicas=reps), _router(replicas=reps)
+        p = np.arange(5, dtype=np.int32)
+        res = {}
+
+        def gen():
+            cli = RemotePredictor(port=ra.port, secret=FRONT_SECRET)
+            try:
+                cli.generate(p, max_new_tokens=48, tag=b"cp-tag")
+                res["out"] = "finished"
+            except Cancelled:
+                res["out"] = "cancelled"
+            finally:
+                cli.close()
+
+        faults.arm("engine.step_delay", times=-1, delay_s=0.05)
+        t = threading.Thread(target=gen)
+        t.start()
+        _wait_for(lambda: srv._tags, msg="tag registration on replica")
+        # the cancel goes through ROUTER B — a client that only knows
+        # the standby can still kill work accepted by A
+        canceller = RemotePredictor(port=rb.port, secret=FRONT_SECRET)
+        assert canceller.cancel(b"cp-tag") is True
+        canceller.close()
+        t.join(timeout=60)
+        faults.disarm("engine.step_delay")
+        assert res["out"] == "cancelled"
+        ra.stop(), rb.stop()
+        _stop_server(srv)
+
+    def test_client_cancel_broadcasts_across_routers(self, model):
+        """The multi-endpoint client's own cancel() fans out: even when
+        its CURRENT endpoint is the standby, the broadcast reaches the
+        fleet and the generate dies typed."""
+        from paddle_tpu.inference.errors import Cancelled
+        from paddle_tpu.inference.serve import RemotePredictor
+        srv = _replica(model)
+        reps = {"r0": f"127.0.0.1:{srv.port}"}
+        ra, rb = _router(replicas=reps), _router(replicas=reps)
+        p = np.arange(5, dtype=np.int32)
+        res = {}
+
+        def gen():
+            cli = RemotePredictor(port=ra.port, secret=FRONT_SECRET)
+            try:
+                cli.generate(p, max_new_tokens=48, tag=b"bc-tag")
+                res["out"] = "finished"
+            except Cancelled:
+                res["out"] = "cancelled"
+            finally:
+                cli.close()
+
+        faults.arm("engine.step_delay", times=-1, delay_s=0.05)
+        t = threading.Thread(target=gen)
+        t.start()
+        _wait_for(lambda: srv._tags, msg="tag registration on replica")
+        canceller = RemotePredictor(
+            endpoints=[f"127.0.0.1:{rb.port}", f"127.0.0.1:{ra.port}"],
+            secret=FRONT_SECRET)
+        assert canceller.cancel(b"bc-tag") is True
+        canceller.close()
+        t.join(timeout=60)
+        faults.disarm("engine.step_delay")
+        assert res["out"] == "cancelled"
+        ra.stop(), rb.stop()
+        _stop_server(srv)
+
+    def test_connect_failover_rotates_past_dead_endpoint(self, model):
+        """Construction against [dead, live] endpoints connects to the
+        live one — the rotation is transparent."""
+        import socket as _socket
+        from paddle_tpu.inference.serve import RemotePredictor
+        srv = _replica(model)
+        probe = _socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        cli = RemotePredictor(
+            endpoints=[f"127.0.0.1:{dead_port}",
+                       f"127.0.0.1:{srv.port}"],
+            secret=FLEET_SECRET, connect_retries=1, retry_deadline_s=2.0)
+        p = np.arange(4, dtype=np.int32)
+        np.testing.assert_array_equal(
+            cli.generate(p, max_new_tokens=4), _fast_ref(model, p, 4))
+        cli.close()
+        _stop_server(srv)
+
+    def test_router_crash_fault_site(self, model):
+        """`router.crash` (testing/faults.py): deterministic router death
+        at request accept — the request is never forwarded, the client
+        fails over and completes through the standby."""
+        from paddle_tpu.inference.serve import RemotePredictor
+        srv = _replica(model)
+        reps = {"r0": f"127.0.0.1:{srv.port}"}
+        ra, rb = _router(replicas=reps), _router(replicas=reps)
+        p = np.arange(6, dtype=np.int32)
+        cli = RemotePredictor(
+            endpoints=[f"127.0.0.1:{ra.port}", f"127.0.0.1:{rb.port}"],
+            secret=FRONT_SECRET)
+        base_hit = _counter("engine.dedup_hits")
+        base_req = _counter("engine.requests")
+        with faults.scoped("router.crash", times=1):
+            out = cli.generate(p, max_new_tokens=6)
+        np.testing.assert_array_equal(out, _fast_ref(model, p, 6))
+        assert ra._stop.is_set(), "router.crash must stop the router"
+        # the request never reached an engine through A: exactly one
+        # execution, no dedup needed
+        assert _counter("engine.requests") - base_req == 1
+        assert _counter("engine.dedup_hits") - base_hit == 0
+        cli.close()
+        rb.stop()
+        _stop_server(srv)
+
+
+class TestSoakHarness:
+    def test_rotation_and_ring_dump(self, tmp_path):
+        """`python -m paddle_tpu.testing.soak` satellites: the per-
+        iteration suite rotation and the first-failure flight-ring dump
+        (the post-mortem a flaky CI retry throws away)."""
+        import json
+
+        from paddle_tpu.observability.flight_recorder import flight
+        from paddle_tpu.testing import soak
+        suites = ["a", "b", "c"]
+        assert soak.rotated(suites, 0) == ["a", "b", "c"]
+        assert soak.rotated(suites, 1) == ["b", "c", "a"]
+        assert soak.rotated(suites, 2) == ["c", "a", "b"]
+        assert soak.rotated(suites, 3) == ["a", "b", "c"]
+        assert soak.rotated([], 5) == []
+        flight.record("soak.test_marker", n=1)
+        path = soak.dump_ring(str(tmp_path), label="cp_test")
+        with open(path) as f:
+            dump = json.load(f)
+        assert dump["label"] == "cp_test"
+        assert any(ev.get("kind") == "soak.test_marker"
+                   for ev in dump["flight"])
+        assert "counters" in dump["metrics"]

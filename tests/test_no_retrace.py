@@ -419,3 +419,32 @@ def test_migration_import_zero_recompiles():
     assert _compile_counters() == frozen, (
         "live migration compiled a program: export/import must ride the "
         "warm fixed-shape steps")
+
+
+def test_dedup_attach_and_replay_zero_recompiles():
+    """Idempotency dedup (docs/ROBUSTNESS.md "Control-plane HA") touches
+    no programs: an in-flight attach returns the existing future before
+    any device work, and a completed-key replay answers straight from
+    the table — neither may touch a compile counter (the acceptance pin
+    for the exactly-once tentpole)."""
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    m = _tiny_model()
+    eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                       min_bucket=8))
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, 64, 6).astype(np.int32)
+    key = bytes(range(16))
+    r1 = eng.submit(prompt, 6, request_key=key)
+    for _ in range(2):
+        eng.step()
+    frozen = _compile_counters()
+    attach = eng.submit(prompt, 6, request_key=key)   # in-flight attach
+    assert attach is r1
+    eng.run_until_idle(max_steps=40)
+    replay = eng.submit(prompt, 6, request_key=key)   # completed replay
+    assert replay is r1
+    np.testing.assert_array_equal(replay.result(timeout=10),
+                                  r1.result(timeout=10))
+    assert _compile_counters() == frozen, (
+        "dedup attach/replay compiled a program: the table must answer "
+        "without touching the device")

@@ -388,8 +388,21 @@ def test_bench_smoke_emits_structured_json():
     assert d["migrate_ok"] is True
     assert d["metrics"]["counters"]["engine.migrations_out"] >= 1
     assert d["metrics"]["counters"]["engine.migrations_in"] >= 1
+    # r12: the smoke run drives a 2-iteration soak micro drill
+    # (paddle_tpu/testing/soak.py — rotated fault orderings, typed
+    # outcomes, page-clean pool) which includes an idempotency-dedup
+    # REPLAY (docs/ROBUSTNESS.md "Control-plane HA")
+    assert d["soak_ok"] is True
+    assert d["dedup_replays"] >= 1
+    assert d["metrics"]["counters"]["engine.dedup_replays"] >= 1
 
 
+@pytest.mark.slow      # tier-1 wall audit (PR 12): ~19 s — a SECOND full
+#   bench --smoke subprocess run whose pin is only the _init_backend
+#   configured->CPU fallback emission shape; the sibling smoke test above
+#   exercises the same emission machinery every tier-1 run and
+#   test_scan_train's dead-backend subprocess covers the failure-emission
+#   path. Nightly --runslow keeps the fallback drill.
 def test_bench_emission_survives_failing_platform_plugin(tmp_path):
     """r6 satellite (BENCH_r05 gap): a CONFIGURED platform whose plugin
     fails to initialize must ride `_init_backend`'s configured -> CPU
