@@ -1125,6 +1125,146 @@ def bench_router_ha():
                 - c0.get("engine.dedup_replays", 0))
 
 
+def bench_disagg():
+    """Disaggregated serving rung (docs/SERVING.md "Disaggregated
+    serving"): 1 prefill worker + 2 decode replicas vs 3 symmetric
+    replicas at EQUAL host count, on the mixed long+short workload plus
+    a shared-prefix phase. Reports fleet TTFT p99 (serve.ttft_seconds),
+    decode-stall p99 (serve.tpot_seconds — the prefill worker serves no
+    decode, so the histogram is decode-tier cadence by construction),
+    aggregate tok/s, and the shared-prefix phase's TOTAL fleet prefill
+    tokens — the disaggregated fleet must prefill the shared system
+    prompt exactly ONCE (asserted), where the symmetric fleet re-prefills
+    it once per replica its requests land on. Emits its own JSON line."""
+    import threading
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.inference.serve import InferenceServer, RemotePredictor
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving import Router
+
+    paddle.seed(0)
+    cfg = GPTConfig(hidden_size=256, num_layers=4, num_heads=4,
+                    intermediate_size=1024, max_position_embeddings=512,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    PS, CHUNK = 16, 64
+    S_SHORT, N_SHORT, NSHORTS = 8, 24, 8
+    S_LONG, N_LONG = 256, 8
+    SYS = rng.randint(0, cfg.vocab_size, 2 * PS).astype(np.int32)
+    TAIL, NSHARED = 16, 8
+    shared = [np.concatenate([SYS, rng.randint(0, cfg.vocab_size, TAIL)
+                              .astype(np.int32)]) for _ in range(NSHARED)]
+    shorts = [rng.randint(0, cfg.vocab_size, S_SHORT).astype(np.int32)
+              for _ in range(NSHORTS)]
+    long_p = rng.randint(0, cfg.vocab_size, S_LONG).astype(np.int32)
+
+    def run_fleet(roles):
+        """roles: {replica_id: role}; equal host count across fleets."""
+        servers, engines = [], []
+        for rid, role in roles.items():
+            eng = DecodeEngine(model, EngineConfig(
+                page_size=PS, max_slots=NSHORTS + 1,
+                max_seq_len=S_LONG + 64, prefill_chunk_tokens=CHUNK))
+            eng.warmup(prompt_lens=[S_SHORT, S_LONG, SYS.size + TAIL])
+            srv = InferenceServer(None, engine=eng,
+                                  auth_name="bench-fleet", role=role)
+            threading.Thread(target=srv.serve_forever,
+                             daemon=True).start()
+            servers.append((rid, srv))
+            engines.append(eng)
+        router = Router(
+            replicas={rid: f"127.0.0.1:{srv.port}"
+                      for rid, srv in servers},
+            replica_secret="bench-fleet", auth_name="bench-disagg",
+            page_size=PS, connect_deadline_s=1.0, evict_cooldown_s=600.0)
+        threading.Thread(target=router.serve_forever, daemon=True).start()
+
+        def gen(p, n):
+            cli = RemotePredictor(port=router.port, secret="bench-disagg")
+            try:
+                return cli.generate(p, max_new_tokens=n)
+            finally:
+                cli.close()
+
+        # prime every program on every engine through the router with
+        # NON-shared prompts (the shared-prefix accounting below must
+        # start from a cold fleet cache for the system prompt)
+        for _ in range(len(servers)):
+            gen(shorts[0], 2)
+            gen(long_p, 2)
+        metrics.reset()
+        # ---- shared-prefix phase (sequential, deterministic routing)
+        for p in shared:
+            out = gen(p, 4)
+            assert out.size == p.size + 4, out.shape
+        shared_prefill_tokens = metrics.snapshot()["counters"].get(
+            "engine.prefill_tokens", 0)
+        # ---- mixed long+short phase (concurrent)
+        metrics.reset()
+        outs, errs = {}, []
+
+        def one(key, p, n):
+            try:
+                outs[key] = gen(p, n)
+            except Exception as e:  # noqa: BLE001 — recorded, rung-failed
+                errs.append((key, f"{type(e).__name__}: {e}"))
+
+        t0 = time.perf_counter()
+        ths = [threading.Thread(target=one, args=(i, p, N_SHORT))
+               for i, p in enumerate(shorts)]
+        for t in ths:
+            t.start()
+        ttft = metrics.histogram("serve.ttft_seconds")
+        t_wait = time.monotonic() + 300
+        while ttft.count < NSHORTS and time.monotonic() < t_wait:
+            time.sleep(0.01)
+        tl = threading.Thread(target=one, args=("long", long_p, N_LONG))
+        tl.start()
+        ths.append(tl)
+        for t in ths:
+            t.join(timeout=600)
+        wall = time.perf_counter() - t0
+        snap = metrics.snapshot()
+        missing = [k for k in list(range(NSHORTS)) + ["long"]
+                   if k not in outs]
+        router.stop()
+        for _, s in servers:
+            s.drain(deadline_s=10.0)
+        for _, s in servers:
+            if s._engine_thread is not None:
+                s._engine_thread.join(timeout=15)
+        if errs or missing:
+            raise RuntimeError(f"client-visible failures: errs={errs} "
+                               f"missing={missing}")
+        h = snap["histograms"]
+        return dict(
+            tok_s=(NSHORTS * N_SHORT + N_LONG) / wall,
+            ttft_p99=h.get("serve.ttft_seconds", {}).get("p99"),
+            decode_stall_p99=h.get("serve.tpot_seconds", {}).get("p99"),
+            shared_prefill_tokens=shared_prefill_tokens,
+            disagg_requests=snap["counters"].get(
+                "router.disagg_requests", 0))
+
+    # equal host count: 1 prefill + 2 decode vs 3 symmetric
+    dis = run_fleet({"prefill:p0": "prefill", "decode:d0": "decode",
+                     "decode:d1": "decode"})
+    sym = run_fleet({"r0": "both", "r1": "both", "r2": "both"})
+    # once-per-fleet: the disagg fleet prefills the shared system prompt
+    # exactly once — the first shared request pays SYS+TAIL, every later
+    # one only its tail (affinity pins them to the one prefill worker)
+    once = (SYS.size + TAIL) + (NSHARED - 1) * TAIL
+    assert dis["shared_prefill_tokens"] == once, (
+        dis["shared_prefill_tokens"], once)
+    assert dis["disagg_requests"] >= NSHORTS + 1
+    return dis, sym, once, \
+        f"1x({S_LONG}+{N_LONG}) long + {NSHORTS}x({S_SHORT}+{N_SHORT}) " \
+        f"short; shared phase {NSHARED}x({SYS.size}-tok sys + {TAIL} tail)"
+
+
 def bench_router():
     """Multi-replica serving rung (paddle_tpu/serving): 2 in-process engine
     replicas behind the router under MIXED traffic — 1 long-prefill request
@@ -1645,6 +1785,47 @@ def bench_smoke():
     router_ok = metrics.snapshot()["counters"].get("router.requests",
                                                    0) >= 1
 
+    # one DISAGGREGATED request (docs/SERVING.md "Disaggregated
+    # serving"): a prefill-role worker streams PTKS1 page records through
+    # the router to a decode-role replica, which admits the slot on the
+    # final record and answers token-identically to the symmetric route —
+    # and compiles ZERO prefill programs (the disaggregation no-retrace
+    # pin). Emitted as `disagg_ok` (asserted in test_observability.py)
+    d_prompt = ids[0, :5].astype(np.int32)
+    d_ref = np.asarray(model.fast_generate(
+        paddle.Tensor(d_prompt[None], _internal=True),
+        max_new_tokens=2).numpy())[0]
+    pf_eng = DecodeEngine(model, EngineConfig(page_size=2, max_slots=2,
+                                              min_bucket=4,
+                                              prefill_chunk_tokens=2))
+    dc_eng = DecodeEngine(model, EngineConfig(page_size=2, max_slots=2,
+                                              min_bucket=4))
+    pf_srv = InferenceServer(None, engine=pf_eng, auth_name="bench-fleet",
+                             role="prefill")
+    dc_srv = InferenceServer(None, engine=dc_eng, auth_name="bench-fleet",
+                             role="decode")
+    threading.Thread(target=pf_srv.serve_forever, daemon=True).start()
+    threading.Thread(target=dc_srv.serve_forever, daemon=True).start()
+    d_router = Router(replicas={"prefill:p0": f"127.0.0.1:{pf_srv.port}",
+                                "decode:d0": f"127.0.0.1:{dc_srv.port}"},
+                      replica_secret="bench-fleet",
+                      auth_name="bench-disagg", page_size=2)
+    threading.Thread(target=d_router.serve_forever, daemon=True).start()
+    d_cli = RemotePredictor(port=d_router.port, secret="bench-disagg")
+    d_out = d_cli.generate(d_prompt, max_new_tokens=2)
+    d_cli.close()
+    d_router.stop()
+    snapd = metrics.snapshot()["counters"]
+    disagg_ok = bool(np.array_equal(d_out, d_ref)) \
+        and snapd.get("router.disagg_requests", 0) >= 1 \
+        and snapd.get("serve.prefill_streams", 0) >= 1 \
+        and snapd.get("serve.kv_stream_in", 0) >= 1 \
+        and not any(k[0] in ("prefill", "prefill_chunk")
+                    for k in dc_eng._programs)
+    assert disagg_ok, (d_out, d_ref, dict(snapd))
+    pf_srv.drain(deadline_s=10.0)
+    dc_srv.drain(deadline_s=10.0)
+
     # two-iteration soak micro drill (paddle_tpu/testing/soak.py): the
     # deterministic chaos scenarios — slow steps + idempotency replay,
     # transient pool pressure, wire-blob corruption refusal — with
@@ -1674,7 +1855,8 @@ def bench_smoke():
            for short in ("ttft", "tpot", "e2e") for q in ("p50", "p99")}
     return (dt, batch * seq / dt, snap, slo, wd.dump_count == 0, router_ok,
             prefix_hits, spec_accepted, shed_count, cancelled_count,
-            resume_ok, kv_quant_ok, migrate_ok, soak_ok, dedup_replays)
+            resume_ok, kv_quant_ok, migrate_ok, soak_ok, dedup_replays,
+            disagg_ok)
 
 
 def _retry(fn, attempts=3):
@@ -1717,7 +1899,7 @@ def main(argv=None):
             (dt, tps, snap, slo, wd_clean, router_ok, prefix_hits,
              spec_accepted, shed_count, cancelled_count,
              resume_ok, kv_quant_ok, migrate_ok, soak_ok,
-             dedup_replays) = bench_smoke()
+             dedup_replays, disagg_ok) = bench_smoke()
             impls = {k.rsplit(".", 1)[-1]: v
                      for k, v in snap["counters"].items()
                      if k.startswith("paged_attention.impl.") and v}
@@ -1734,6 +1916,7 @@ def main(argv=None):
                    "kv_quant_ok": kv_quant_ok,
                    "migrate_ok": migrate_ok,
                    "soak_ok": soak_ok,
+                   "disagg_ok": disagg_ok,
                    "dedup_replays": dedup_replays,
                    "prefill_chunks": snap["counters"].get(
                        "engine.prefill_chunks", 0),
@@ -2029,6 +2212,34 @@ def main(argv=None):
               f"0 duplicate generations", file=sys.stderr)
     except Exception as e:
         _emit({"metric": "router_ha_goodput_tokens_per_sec", "value": 0.0,
+               "unit": "tokens/s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
+    try:
+        # second-to-last: like bench_router below it resets the metrics
+        # registry per phase, so every other rung must already have read it
+        dis, sym, once, dmix = _retry(bench_disagg, attempts=2)
+        _emit({"metric": "disagg_fleet_tokens_per_sec",
+               "value": round(dis["tok_s"], 1), "unit": "tokens/s",
+               "ok": True, "platform": platform,
+               "ttft_p99": dis["ttft_p99"],
+               "decode_stall_p99": dis["decode_stall_p99"],
+               "shared_prefill_tokens": dis["shared_prefill_tokens"],
+               "shared_prefill_tokens_once": once,
+               "disagg_requests": dis["disagg_requests"],
+               "symmetric": {
+                   "tok_s": round(sym["tok_s"], 1),
+                   "ttft_p99": sym["ttft_p99"],
+                   "decode_stall_p99": sym["decode_stall_p99"],
+                   "shared_prefill_tokens": sym["shared_prefill_tokens"]},
+               "mix": dmix})
+        print(f"# disagg 1p+2d: {dis['tok_s']:.0f} tok/s, "
+              f"ttft_p99={dis['ttft_p99']:.3f}s, shared-prefix prefill "
+              f"{dis['shared_prefill_tokens']} tok (once-per-fleet={once})"
+              f" vs symmetric 3x: {sym['tok_s']:.0f} tok/s, "
+              f"ttft_p99={sym['ttft_p99']:.3f}s, shared-prefix prefill "
+              f"{sym['shared_prefill_tokens']} tok", file=sys.stderr)
+    except Exception as e:
+        _emit({"metric": "disagg_fleet_tokens_per_sec", "value": 0.0,
                "unit": "tokens/s", "ok": False, "platform": platform,
                "backend_error": f"{type(e).__name__}: {e}"})
     try:

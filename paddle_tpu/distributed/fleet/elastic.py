@@ -17,6 +17,7 @@ dependency; the launch controller is the restart authority).
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 
@@ -32,29 +33,52 @@ def _check_registry_member(node_id, endpoint):
 
 # ------------------------------------------------------------ node roles
 #
-# Control-plane HA (docs/ROBUSTNESS.md "Control-plane HA"): ROUTERS are
-# registry citizens too, under a distinct role so nobody mistakes one for
-# an engine replica. The role rides the node ID as a reserved prefix —
-# the registry value format (endpoint string) stays untouched, so every
-# existing lease keeps working: an unprefixed id IS a replica (legacy).
-# Routers register as ``router:<id>``; `Router._sync_membership` keeps
-# them out of the replica rotation, `InferenceServer._discover_peers`
-# never migrates work to one, and `RemotePredictor` discovers them for
-# multi-router failover.
+# Control-plane HA + disaggregated serving (docs/ROBUSTNESS.md
+# "Control-plane HA", docs/SERVING.md "Disaggregated serving"): routers
+# AND tiered replicas are registry citizens under distinct roles so
+# nobody mistakes one for a plain engine replica. The role rides the
+# node ID as a ``<role>:`` prefix — the registry value format (endpoint
+# string) stays untouched, so every existing lease keeps working: an
+# UNPREFIXED id IS a replica (legacy, test-pinned). One parser serves
+# every role: ``router:<id>`` (control plane, never in any replica
+# rotation), ``prefill:<id>`` / ``decode:<id>`` (the disaggregated
+# serving tiers), and any future role a subsystem mints via
+# `role_node_id` — `node_role` returns the prefix verbatim.
 
 ROUTER_ROLE_PREFIX = "router:"
+
+# a role token is a short lowercase word; anything else before a ":" is
+# part of a legacy replica id (e.g. an id that embeds an endpoint), not
+# a role — the conservative parse keeps every pre-role lease a replica
+_ROLE_RE = re.compile(r"^[a-z][a-z0-9_-]{0,31}$")
+
+
+def role_node_id(role, node_id) -> str:
+    """Registry node id for a ``role`` lease: ``<role>:<id>``. The role
+    must be a valid role token (lowercase word) — a typo'd role would
+    otherwise silently parse back as a legacy replica."""
+    role = str(role)
+    if not _ROLE_RE.match(role):
+        raise ValueError(f"invalid role token {role!r} "
+                         f"(want a short lowercase word)")
+    return f"{role}:{node_id}"
 
 
 def router_node_id(router_id) -> str:
     """Registry node id for a router lease: ``router:<id>``."""
-    return ROUTER_ROLE_PREFIX + str(router_id)
+    return role_node_id("router", router_id)
 
 
 def node_role(node_id) -> str:
-    """``"router"`` for router-role leases, ``"replica"`` for everything
-    else (including every pre-role lease — legacy ids are replicas)."""
-    return "router" if str(node_id).startswith(ROUTER_ROLE_PREFIX) \
-        else "replica"
+    """The ``<role>:``-prefixed lease's role (``"router"``,
+    ``"prefill"``, ``"decode"``, ...); ``"replica"`` for everything else
+    — including every pre-role lease and any id whose colon prefix is
+    not a role token (legacy ids are replicas, test-pinned)."""
+    s = str(node_id)
+    head, sep, _rest = s.partition(":")
+    if sep and _ROLE_RE.match(head):
+        return head
+    return "replica"
 
 
 def start_heartbeat(path, interval=2.0):

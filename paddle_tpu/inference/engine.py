@@ -82,6 +82,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import queue as _queue
 import struct
 import threading
 import time
@@ -774,6 +775,10 @@ class DecodeEngine:
             + (nh * 4 if self._quant_kv else 0))
         metrics.gauge("engine.kv_bytes_per_token").set(
             self.kv_bytes_per_token)
+        # published for the router's fleet prefix directory: affinity
+        # hashing needs the fleet's page size (docs/SERVING.md
+        # "Disaggregated serving")
+        metrics.gauge("engine.page_size").set(ps)
         # host-side mirrors of the per-slot state, fused into ONE packed
         # int32 upload per step; sampled tokens live on device and only the
         # _tokens column is consulted for freshly admitted slots
@@ -820,6 +825,13 @@ class DecodeEngine:
         self._migrated: list[MigrationItem] = []
         self._migrate_done = threading.Event()
         self._imports: deque = deque()
+        # prefill-stream mailbox (docs/SERVING.md "Disaggregated
+        # serving"): submit_prefill_stream posts (ids, cache, sink) from
+        # any thread; the DRIVER runs the chunked prefill between
+        # fixed-shape steps and streams PTKS1 records into the sink —
+        # the same mailbox discipline as cancellation and imports, so a
+        # prefill worker's connection threads never touch device state
+        self._prefill_jobs: deque = deque()
         self._deg = 0                 # applied degradation level (driver)
         # chunked-prefill progress: slot -> {"req", "done", "t0"}; slots
         # here are occupied (slot_req set, pages held) but NOT decode-active
@@ -862,6 +874,7 @@ class DecodeEngine:
         self._m_prefix_reused = metrics.counter("engine.prefix_pages_reused")
         self._m_prefix_evict = metrics.counter("engine.prefix_evictions")
         self._g_prefix_pages = metrics.gauge("engine.prefix_pages")
+        self._g_prefix_bytes = metrics.gauge("engine.prefix_store_bytes")
         self._m_spec_steps = metrics.counter("engine.spec_steps")
         self._m_spec_drafted = metrics.counter("engine.spec_drafted")
         self._m_spec_accepted = metrics.counter("engine.spec_accepted")
@@ -1144,14 +1157,30 @@ class DecodeEngine:
         """Rolling hash over the prompt's FULL token pages: ``h_i =
         H(h_{i-1} | page_i tokens)``. Chained keys mean a page is only
         reusable when every page before it matches too — a lookup walks the
-        chain from page 0 and stops at the first miss."""
-        ps = self.ecfg.page_size
-        out, h = [], b"pt-prefix-v1"
-        for i in range(ids.size // ps):
-            h = hashlib.blake2b(h + ids[i * ps:(i + 1) * ps].tobytes(),
-                                digest_size=16).digest()
-            out.append(h)
-        return out
+        chain from page 0 and stops at the first miss. The ONE
+        implementation lives in `serving/disagg.py` — the router's fleet
+        prefix directory keys on the same hashes (docs/SERVING.md
+        "Disaggregated serving")."""
+        from paddle_tpu.serving.disagg import prompt_page_hashes
+        return prompt_page_hashes(ids, self.ecfg.page_size)
+
+    def prefix_hashes(self) -> list[str]:
+        """Hex digests of every page the prefix store currently indexes —
+        the serve STATS payload exports these so the router's fleet
+        directory can key shared-prefix traffic onto this replica.
+        Thread-safe snapshot (a concurrent driver mutation just means
+        the list is a step stale — the directory is best-effort)."""
+        return [h.hex() for h in list(self._prefix_pages)]
+
+    def _update_prefix_gauges(self):
+        """The prefix store's observable size: indexed page count plus
+        the bytes those pages pin in the pool
+        (``engine.prefix_store_bytes`` — the fleet directory's capacity
+        yardstick, docs/OBSERVABILITY.md)."""
+        n = len(self._page_hash)
+        self._g_prefix_pages.set(n)
+        self._g_prefix_bytes.set(
+            n * self.ecfg.page_size * self.kv_bytes_per_token)
 
     def _retain_page(self, page: int) -> bool:
         """Allocator retain hook: a refcount-0 page the prefix store still
@@ -1165,7 +1194,7 @@ class DecodeEngine:
             if h is not None and self._prefix_pages.get(h) == page:
                 del self._prefix_pages[h]
             self._prefix_idle.pop(page, None)
-            self._g_prefix_pages.set(len(self._page_hash))
+            self._update_prefix_gauges()
             return False
         if page in self._page_hash:
             self._prefix_idle[page] = None        # most-recently idled last
@@ -1185,7 +1214,7 @@ class DecodeEngine:
                 del self._prefix_pages[h]
             out.append(page)
             self._m_prefix_evict.inc()
-        self._g_prefix_pages.set(len(self._page_hash))
+        self._update_prefix_gauges()
         return out
 
     def _flush_prefix(self):
@@ -1200,7 +1229,7 @@ class DecodeEngine:
         self._page_hash.clear()
         if idle:
             self.allocator.reclaim(idle)
-        self._g_prefix_pages.set(0)
+        self._update_prefix_gauges()
 
     def _prefix_lookup(self, hashes: list[bytes]) -> list[int]:
         """Longest cached prefix: pages for the leading run of hash hits."""
@@ -1228,7 +1257,7 @@ class DecodeEngine:
                 continue
             self._prefix_pages[h] = p
             self._page_hash[p] = h
-        self._g_prefix_pages.set(len(self._page_hash))
+        self._update_prefix_gauges()
 
     # ------------------------------------------------------------ admission
 
@@ -2013,6 +2042,7 @@ class DecodeEngine:
         if self._migrate_requested:
             self._do_migrate_out()
         self._apply_imports()
+        self._apply_prefill_jobs()
         self._apply_degradation()
         self._admit()
         # capacity tripwire: a token at pos >= slot_capacity would spill to
@@ -2056,7 +2086,8 @@ class DecodeEngine:
             harvested += self._harvest_one()
         elif not chunked:
             with self._qlock:
-                return bool(self._queue) or bool(self._imports)
+                return bool(self._queue) or bool(self._imports) \
+                    or bool(self._prefill_jobs)
         dt = time.perf_counter() - t_step
         self._h_step.observe(dt)
         self._h_host.observe((dt - self._blocked_s) * 1e3)
@@ -2149,6 +2180,158 @@ class DecodeEngine:
                          v_pages=v_np, page_size=int(self.ecfg.page_size),
                          cache_dtype=np.dtype(self._cdtype).name,
                          k_scales=ks_np, v_scales=vs_np)
+
+    # ------------------------------------------------- prefill page stream
+
+    def submit_prefill_stream(self, prompt_ids, cache: bool = True):
+        """Thread-safe send side of DISAGGREGATED prefill (docs/
+        SERVING.md "Disaggregated serving"): post one prompt to the
+        prefill-job mailbox and return a queue the DRIVER fills as its
+        chunked prefill runs — ``("count", n_records)`` first, then one
+        ``("rec", bytes)`` per PTKS1 stream record AS EACH CHUNK'S PAGES
+        COMPLETE (header, page batches, final record with the seed
+        token), then ``("done", None)``; any failure ends the stream
+        with ``("err", "<Type>: <msg>")`` instead. The serving layer
+        relays records to the chosen decode replica as they land, so the
+        wire transfer overlaps the prefill compute.
+
+        The prefix cache applies exactly as in `prefill_export`: cached
+        leading pages are attached (and exported — the decode replica
+        does not share this store) without re-running their prefill, so
+        a fleet-shared prompt costs this worker only its uncached tail;
+        ``cache=False`` keeps the prompt out of the store entirely."""
+        ids = np.asarray(
+            prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids)
+        ids = np.ascontiguousarray(ids).reshape(-1).astype(np.int32)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        if ids.size >= self.max_seq_len:
+            raise ValueError(
+                f"prompt {ids.size} leaves no room to decode within "
+                f"max_seq_len={self.max_seq_len}")
+        sink: _queue.Queue = _queue.Queue()
+        with self._work:
+            self._refuse_not_accepting()
+            self._prefill_jobs.append((ids, bool(cache), sink))
+            self._work.notify()
+        return sink
+
+    def _apply_prefill_jobs(self):
+        """Driver-side mailbox drain (every step start): run each posted
+        prefill-stream job to completion, streaming records into its
+        sink. A job failure travels to the waiting connection thread as
+        a terminal ``("err", ...)`` item — never onto the driver."""
+        if not self._prefill_jobs:
+            return False
+        ran = False
+        while True:
+            with self._qlock:
+                if not self._prefill_jobs:
+                    break
+                ids, cache, sink = self._prefill_jobs.popleft()
+            ran = True
+            try:
+                self._run_prefill_stream(ids, cache, sink)
+                sink.put(("done", None))
+            except Exception as e:  # noqa: BLE001 — surface to the sender
+                sink.put(("err", f"{type(e).__name__}: {e}"))
+        return ran
+
+    def _run_prefill_stream(self, ids: np.ndarray, cache: bool, sink):
+        """Driver-thread body of one prefill-stream job: chunked prefill
+        with a PTKS1 record emitted as each chunk completes its pages.
+        Pages are borrowed from the pool for the duration and freed
+        before returning (the freshly prefilled ones stay indexed in the
+        prefix store, like `prefill_export`)."""
+        from paddle_tpu.kernels.paged_attention import export_pages
+        from paddle_tpu.serving.disagg import (pack_stream_final,
+                                               pack_stream_header,
+                                               pack_stream_pages)
+        ps = self.ecfg.page_size
+        s0 = int(ids.size)
+        n_src = -(-s0 // ps)
+        shared: list[int] = []
+        hashes: list[bytes] = []
+        if self._prefix_enabled and cache:
+            hashes = self._page_hashes(ids)
+            shared = self._prefix_lookup(hashes)
+            shared = shared[:(s0 - 1) // ps]
+            if shared:
+                self._attach_prefix(shared)
+        pages = self.allocator.alloc(n_src - len(shared))
+        if pages is None:
+            if shared:
+                self.allocator.free(shared)
+            raise RuntimeError(
+                f"prefill stream needs {n_src} pages "
+                f"({len(shared)} cached), "
+                f"{self.allocator.free_pages} free")
+        if self._prefix_enabled and cache:
+            (self._m_prefix_hit if shared else self._m_prefix_miss).inc()
+            self._m_prefix_reused.inc(len(shared))
+        all_pages = shared + pages
+        row = np.full(self.pages_per_slot, TRASH_PAGE, np.int32)
+        row[:n_src] = all_pages
+        start = len(shared) * ps
+        c = int(self.ecfg.prefill_chunk_tokens) \
+            if self.ecfg.prefill_chunk_tokens is not None \
+            else self.bucket_for(s0 - start)
+        # the record plan is fixed before any device work: one page batch
+        # for the cached prefix (already resident), one per chunk that
+        # COMPLETES >= 1 page, and the final record carrying the tail
+        chunk_starts = list(range(start, s0, c))
+        batches, cursor = [], len(shared)
+        for a in chunk_starts:
+            done_pages = min(a + c, s0) // ps
+            batches.append((cursor, done_pages - cursor))
+            cursor = done_pages
+        n_records = 2 + (1 if shared else 0) \
+            + sum(1 for _, n in batches if n > 0)
+        sink.put(("count", n_records))
+
+        def _blobs(p0, n):
+            page_ids = all_pages[p0:p0 + n]
+            if self._quant_kv:
+                kb, vb, ksb, vsb = export_pages(
+                    self._kc, self._vc, page_ids,
+                    k_scales=self._ks, v_scales=self._vs)
+                return (np.asarray(kb), np.asarray(vb),
+                        np.asarray(ksb), np.asarray(vsb))
+            kb, vb = export_pages(self._kc, self._vc, page_ids)
+            return np.asarray(kb), np.asarray(vb), None, None
+
+        try:
+            seq = 0
+            sink.put(("rec", pack_stream_header(
+                seq, ids, ps, np.dtype(self._cdtype).name,
+                [self._nl, ps, self._nh, self._dh], n_src, n_records,
+                self._quant_kv)))
+            seq += 1
+            if shared:
+                sink.put(("rec",
+                          pack_stream_pages(seq, 0,
+                                            *_blobs(0, len(shared)))))
+                seq += 1
+            tok = None
+            for a, (p0, n) in zip(chunk_starts, batches):
+                tok = self._run_chunk(ids, a, row, c)
+                if n > 0:
+                    sink.put(("rec",
+                              pack_stream_pages(seq, p0, *_blobs(p0, n))))
+                    seq += 1
+            tb = time.perf_counter()
+            first = int(tok)          # the stream's only token readback
+            self._blocked_s += time.perf_counter() - tb
+            self._m_d2h.inc()
+            sink.put(("rec", pack_stream_final(
+                seq, first, cursor, *_blobs(cursor, n_src - cursor))))
+            if self._prefix_enabled and cache:
+                self._register_prefix(hashes, all_pages)
+        finally:
+            self.allocator.free(all_pages)
+        metrics.counter("engine.kv_stream_exports").inc()
+        flight.record("engine.prefill_stream", prompt_len=s0,
+                      records=n_records, cached_pages=len(shared))
 
     def import_request(self, handoff: KVHandoff, max_new_tokens=32,
                        trace=None, cache=True,
@@ -2528,7 +2711,8 @@ class DecodeEngine:
 
     def _has_work(self) -> bool:
         with self._qlock:
-            queued = bool(self._queue) or bool(self._imports)
+            queued = bool(self._queue) or bool(self._imports) \
+                or bool(self._prefill_jobs)
         return queued or bool(self._inflight) or bool(self._prefilling) \
             or self._occupied()
 
@@ -2589,10 +2773,14 @@ class DecodeEngine:
             self._imports.clear()
             migrated = list(self._migrated)
             self._migrated.clear()
+            prefill_jobs = list(self._prefill_jobs)
+            self._prefill_jobs.clear()
         for req in queued:
             req._finish(reason)
         for _, req in imports:          # un-applied migration imports
             req._finish(reason)
+        for _, _, sink in prefill_jobs:  # un-run prefill-stream jobs
+            sink.put(("err", reason))
         for item in migrated:
             # exported but never taken (take_migrated timed out / was
             # skipped): the futures are detached from every engine

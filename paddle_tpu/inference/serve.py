@@ -15,8 +15,8 @@ Run:  python -m paddle_tpu.inference.serve --model /path/prefix --port 0
 Wire protocol (little-endian):
   hello   : u32 magic | 32-byte sha256 auth digest (once per connection)
   request : u32 magic 'PRPD' | u32 op (1=run 2=ping 3=shutdown 4=stats
-            5=generate 6=prometheus 7=cancel 8=migrate) | u32 n_arrays |
-            arrays...
+            5=generate 6=prometheus 7=cancel 8=migrate 9=prefill
+            10=kv_stream) | u32 n_arrays | arrays...
   array   : u8 dtype | u8 ndim | u32 dims[ndim] | u64 nbytes | bytes
   response: u32 magic | u32 status (0 ok else error) |
             ok: u32 n_arrays | arrays...   err: u32 len | utf8 message
@@ -57,6 +57,19 @@ splices that into the ORIGINAL request future, so the client blocked on
 the draining replica sees a normal answer: scale-down and preemption
 cost zero client-visible errors. Peers authenticate with the
 fleet-shared secret (every replica's ``--auth-name``).
+
+PREFILL (op 9) / KV_STREAM (op 10, docs/SERVING.md "Disaggregated
+serving"): the two halves of the prefill-tier flow. PREFILL (prefill
+workers, ``--role prefill``) takes a prompt and STREAMS back ``PTKS1``
+page records as the engine's chunked prefill produces them — header,
+per-chunk page batches, final record with the seed token, every record
+blake2b-checksummed. KV_STREAM (decode replicas, ``--role decode``)
+takes the relayed records plus the request options (budget, deadline,
+cancel tag, idempotency key), admits the slot the moment the final
+record lands, and answers the full id sequence exactly like GENERATE —
+the decode engine never compiles a prefill program. The router drives
+the pair and falls back to a symmetric GENERATE when a prefill worker
+dies mid-stream.
 
 Auth mirrors `distributed/rpc.py` (the r3 hardening this server lacked —
 r4 advisor + verdict weak #5: anyone who could reach the port could
@@ -103,7 +116,15 @@ from paddle_tpu.testing import faults
 
 MAGIC = 0x50445250
 (OP_RUN, OP_PING, OP_SHUTDOWN, OP_STATS, OP_GENERATE, OP_PROMETHEUS,
- OP_CANCEL, OP_MIGRATE) = 1, 2, 3, 4, 5, 6, 7, 8
+ OP_CANCEL, OP_MIGRATE, OP_PREFILL, OP_KV_STREAM) = \
+    1, 2, 3, 4, 5, 6, 7, 8, 9, 10
+
+# replica tiers (docs/SERVING.md "Disaggregated serving"): "both" is the
+# legacy symmetric replica; a "prefill" worker serves OP_PREFILL only
+# (never GENERATE/MIGRATE — it must not decode) and a "decode" replica
+# never serves OP_PREFILL (it must never compile a prefill program in
+# disaggregated operation — the no-retrace pin, tests/test_disagg.py)
+REPLICA_ROLES = ("both", "prefill", "decode")
 
 
 def auth_token(secret_name: str | None = None) -> bytes:
@@ -244,9 +265,13 @@ class InferenceServer:
     recompute and use to SHUTDOWN the server (r5 advisor)."""
 
     def __init__(self, model_prefix, host="127.0.0.1", port=0, config=None,
-                 engine=None, auth_name=None):
+                 engine=None, auth_name=None, role="both"):
         if model_prefix is None and engine is None:
             raise ValueError("need a model_prefix, an engine, or both")
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"role must be one of {REPLICA_ROLES}, got {role!r}")
+        self.role = role
         self.generated_secret = None
         if auth_name is not None:
             basis = auth_name            # explicit beats the env var
@@ -391,9 +416,15 @@ class InferenceServer:
         from paddle_tpu.distributed.fleet.elastic import node_role
         own_id = getattr(self._registry, "node_id", None)
         own_ep = str(getattr(self._registry, "endpoint", None))
+        # exclude the KNOWN non-decoding roles only: routers cannot
+        # decode at all, and a prefill-tier worker refuses MIGRATE by
+        # contract. A NEGATIVE filter on purpose — an unknown role
+        # (including a legacy id whose colon prefix merely parses as
+        # one, e.g. "east-1:replica-3") keeps its PR-12 behavior as a
+        # decode-capable migration peer
         return [str(ep) for rid, ep in sorted(alive.items())
                 if rid != own_id and str(ep) != own_ep
-                and node_role(rid) == "replica"]
+                and node_role(rid) not in ("router", "prefill")]
 
     def _migrate_items(self, items, peers, t_end) -> bool:
         """Ship each exported :class:`MigrationItem` to a peer and splice
@@ -586,6 +617,10 @@ class InferenceServer:
         if self._engine is None:
             raise RuntimeError("no decode engine attached "
                                "(start with --gpt-config or engine=)")
+        if self.role == "prefill":
+            raise RuntimeError(
+                "prefill-role replica does not decode: MIGRATE needs a "
+                "decode-capable tier (role=both|decode)")
         if len(arrays) != 1:
             raise ValueError(
                 f"MIGRATE wants one uint8 PTMG1 blob array, "
@@ -619,6 +654,159 @@ class InferenceServer:
         with self._tagged(item.tag, req.request_id):
             out = self._await_result(req, conn, deadline_s)
         metrics.counter("serve.migrations_in").inc()
+        return np.ascontiguousarray(out, np.int32)
+
+    # ------------------------------------------------ disaggregated serving
+
+    def _stats_extra(self) -> dict:
+        """Disaggregation extras riding the STATS payload: this
+        replica's ``role`` plus the engine's prefix-store export —
+        page size and the rolling page hashes it currently indexes —
+        the data source of the router's fleet prefix directory
+        (docs/SERVING.md "Disaggregated serving")."""
+        extra: dict = {"role": self.role}
+        if self._engine is not None:
+            extra["prefix"] = {
+                "page_size": int(self._engine.ecfg.page_size)}
+            if self.role == "prefill":
+                # the hash list is the fleet directory's data source and
+                # only prefill workers are affinity targets — exporting a
+                # decode replica's (potentially large) store every STATS
+                # pull would be recurring wire bytes nobody reads
+                hashes = self._engine.prefix_hashes()
+                metrics.gauge("engine.prefix_exported_hashes").set(
+                    len(hashes))
+                extra["prefix"]["hashes"] = hashes
+        return extra
+
+    def _prefill_stream(self, arrays, conn) -> bool:
+        """OP_PREFILL body (the PREFILL-WORKER side of disaggregation,
+        docs/SERVING.md "Disaggregated serving"): run the engine's
+        chunked prefill for one prompt and stream the resulting PTKS1
+        records back AS THEY ARE PRODUCED — response header first (the
+        record count is known once the prefix-cache lookup fixes the
+        chunk plan), then one uint8 array per record. The engine does
+        the device work on ITS driver thread (`submit_prefill_stream`
+        mailbox); this connection thread only relays.
+
+        Returns False when the stream died AFTER the response header
+        went out (engine failure mid-prefill, receiver gone, or the
+        ``serve.stream_drop`` fault drill) — the caller drops the
+        connection, and the router's fallback re-runs the prefill
+        symmetrically on the decode replica. Failures BEFORE the header
+        raise and travel back as a normal typed wire error."""
+        if self._draining:
+            raise RuntimeError(
+                "server draining: not accepting new requests")
+        if self._engine is None:
+            raise RuntimeError("no decode engine attached "
+                               "(start with --gpt-config or engine=)")
+        if self.role == "decode":
+            raise RuntimeError(
+                "decode-role replica serves no PREFILL (its engine must "
+                "never compile a prefill program — the disaggregation "
+                "no-retrace pin)")
+        if len(arrays) not in (1, 2):
+            raise ValueError(
+                f"PREFILL wants [prompt_ids[, options]], got "
+                f"{len(arrays)} arrays")
+        cache = True
+        if len(arrays) == 2:
+            opts = np.asarray(arrays[1]).reshape(-1)
+            if opts.size != 1:
+                raise ValueError(
+                    f"PREFILL options wants int32 [cache], got "
+                    f"{opts.size} values")
+            cache = bool(int(opts[0]))
+        sink = self._engine.submit_prefill_stream(arrays[0], cache=cache)
+        kind, val = sink.get(timeout=600.0)
+        if kind == "err":
+            raise from_wire(val)
+        n_records = int(val)
+        conn.sendall(struct.pack("<III", MAGIC, 0, n_records))
+        for _ in range(n_records):
+            kind, val = sink.get(timeout=600.0)
+            if kind != "rec":
+                # the engine died mid-stream with the header already out:
+                # the response is unfinishable — drop the connection so
+                # the router's fallback takes over
+                metrics.counter("serve.prefill_stream_errors").inc()
+                return False
+            if faults.ENABLED and faults.fire("serve.stream_drop"):
+                # deterministic mid-stream worker death (testing/
+                # faults.py): the receiver sees the stream end early and
+                # must discard the partial pages cleanly
+                metrics.counter("serve.stream_drops").inc()
+                return False
+            try:
+                send_arrays(conn, [np.frombuffer(val, np.uint8)])
+            except OSError:
+                return False          # receiver gone mid-stream
+        metrics.counter("serve.prefill_streams").inc()
+        return True
+
+    def _kv_stream_in(self, arrays, trace, conn):
+        """OP_KV_STREAM body (the DECODE-REPLICA side): assemble the
+        relayed PTKS1 records — every record checksum-verified, a
+        damaged or short stream refused typed BEFORE any page is
+        adopted, so a partial stream leaves this pool at baseline — and
+        the moment the final record lands, admit the slot through the
+        engine's import mailbox and decode to completion. Wire shape:
+        ``[options int32 [max_new_tokens, cache, speculate, deadline_ms
+        [, key0..key3]], tag uint8 (may be empty), record uint8 ...]``.
+        The response is the full int32 id sequence, exactly what a
+        symmetric GENERATE would answer — deadlines, the cancel tag, and
+        the idempotency key all ride the options so the whole
+        request-control surface survives disaggregation."""
+        if self._draining:
+            raise RuntimeError(
+                "server draining: not accepting new requests")
+        if self._engine is None:
+            raise RuntimeError("no decode engine attached "
+                               "(start with --gpt-config or engine=)")
+        if self.role == "prefill":
+            raise RuntimeError(
+                "prefill-role replica does not decode (KV_STREAM needs "
+                "role=both|decode)")
+        if len(arrays) < 3:
+            raise ValueError(
+                f"KV_STREAM wants [options, tag, record...], got "
+                f"{len(arrays)} arrays")
+        opts = np.asarray(arrays[0]).reshape(-1)
+        if opts.size not in (4, 8):
+            raise ValueError(
+                f"KV_STREAM options wants int32 [max_new_tokens, cache, "
+                f"speculate, deadline_ms[, key0..key3]], got {opts.size} "
+                f"values")
+        mnt = int(opts[0])
+        cache, speculate = bool(int(opts[1])), bool(int(opts[2]))
+        deadline_s = int(opts[3]) / 1000.0 if int(opts[3]) > 0 else None
+        key = np.ascontiguousarray(opts[4:8], np.int32).tobytes() \
+            if opts.size == 8 else None
+        tag = np.ascontiguousarray(arrays[1], np.uint8).tobytes() or None
+        from paddle_tpu.serving.disagg import KVStreamAssembler
+        asm = KVStreamAssembler()
+        handoff = None
+        try:
+            for rec in arrays[2:]:
+                handoff = asm.feed(
+                    np.ascontiguousarray(rec, np.uint8).tobytes())
+            if handoff is None:
+                raise HandoffCorrupt(
+                    "KV stream ended without a final record")
+        except HandoffCorrupt:
+            # same refusal discipline as OP_MIGRATE blob damage: typed,
+            # counted, and nothing was adopted (docs/ROBUSTNESS.md
+            # "Wire integrity")
+            metrics.counter("serve.blob_corrupt_refused").inc()
+            raise
+        req = self._engine.submit_import(
+            handoff, max_new_tokens=mnt, deadline_s=deadline_s,
+            trace=trace, cache=cache, speculate=speculate,
+            request_key=key)
+        with self._tagged(tag, req.request_id):
+            out = self._await_result(req, conn, deadline_s)
+        metrics.counter("serve.kv_stream_in").inc()
         return np.ascontiguousarray(out, np.int32)
 
     def serve_forever(self):
@@ -664,9 +852,11 @@ class InferenceServer:
                 if op == OP_STATS:
                     # stats endpoint: the process metrics snapshot as one
                     # uint8 JSON array — same array framing as every other
-                    # response, so any wire client can read it
+                    # response, so any wire client can read it. Engine
+                    # servers also export their role and prefix-store
+                    # hashes (the router directory's data source)
                     conn.sendall(struct.pack("<III", MAGIC, 0, 1))
-                    send_arrays(conn, [stats_payload()])
+                    send_arrays(conn, [stats_payload(self._stats_extra())])
                     continue
                 if op == OP_PROMETHEUS:
                     # same framing, Prometheus text exposition body: wire
@@ -684,7 +874,8 @@ class InferenceServer:
                 # the request's SLO clock starts HERE, at wire accept —
                 # body receive, queue wait, prefill and decode all count
                 trace = RequestTrace() \
-                    if op in (OP_GENERATE, OP_MIGRATE) else None
+                    if op in (OP_GENERATE, OP_MIGRATE, OP_KV_STREAM) \
+                    else None
                 try:
                     if faults.ENABLED:
                         faults.fire("serve.slow_read")   # slow client
@@ -693,6 +884,18 @@ class InferenceServer:
                     arrays = recv_arrays(conn, n)
                     metrics.counter("serve.request_bytes").inc(
                         sum(a.nbytes for a in arrays))
+                    if op == OP_PREFILL:
+                        # streaming response: the body sends its own
+                        # header + one array per PTKS1 record AS THE
+                        # ENGINE PRODUCES THEM (the whole point — the
+                        # wire transfer overlaps the prefill compute).
+                        # False = the stream died after the header went
+                        # out (fault drill or engine failure): the
+                        # response is unfinishable, drop the connection
+                        # — the router falls back to symmetric prefill
+                        if not self._prefill_stream(arrays, conn):
+                            return
+                        continue
                     if op == OP_GENERATE:
                         outs = [self._generate(arrays, trace, conn)]
                         if faults.ENABLED and faults.fire("serve.ack_drop"):
@@ -707,6 +910,8 @@ class InferenceServer:
                             return
                     elif op == OP_MIGRATE:
                         outs = [self._migrate_in(arrays, trace, conn)]
+                    elif op == OP_KV_STREAM:
+                        outs = [self._kv_stream_in(arrays, trace, conn)]
                     elif op == OP_CANCEL:
                         outs = [self._cancel_op(arrays)]
                     else:
@@ -769,6 +974,10 @@ class InferenceServer:
         if self._engine is None:
             raise RuntimeError("no decode engine attached "
                                "(start with --gpt-config or engine=)")
+        if self.role == "prefill":
+            raise RuntimeError(
+                "prefill-role replica does not decode: GENERATE needs a "
+                "decode-capable tier (role=both|decode)")
         if len(arrays) not in (2, 3, 4):
             raise ValueError(
                 f"GENERATE wants [prompt_ids, max_new_tokens[, options[, "
@@ -954,11 +1163,17 @@ class InferenceServer:
         conn.sendall(struct.pack("<III", MAGIC, 1, len(raw)) + raw)
 
 
-def stats_payload() -> np.ndarray:
+def stats_payload(extra: dict | None = None) -> np.ndarray:
     """The serve stats response body: the process metrics snapshot (request
     counts, latency histogram, and every other subsystem's metrics — one
-    process, one registry) serialized as a uint8 JSON array."""
-    raw = json.dumps(metrics.snapshot()).encode()
+    process, one registry) serialized as a uint8 JSON array. ``extra``
+    merges additional top-level keys in — the engine server adds its
+    ``role`` and the prefix-store export the router's fleet directory
+    feeds on (docs/SERVING.md "Disaggregated serving")."""
+    snap = metrics.snapshot()
+    if extra:
+        snap = dict(snap, **extra)
+    raw = json.dumps(snap).encode()
     return np.frombuffer(raw, dtype=np.uint8).copy()
 
 
@@ -1454,6 +1669,16 @@ def main(argv=None):
                          "with (needs PADDLE_ELASTIC_TOKEN)")
     ap.add_argument("--replica-id", default=None,
                     help="registry node id (default replica-<pid>)")
+    ap.add_argument("--role", default="both",
+                    choices=list(REPLICA_ROLES),
+                    help="disaggregated-serving tier (docs/SERVING.md "
+                         "\"Disaggregated serving\"): 'prefill' serves "
+                         "only the PREFILL page-stream op, 'decode' "
+                         "never compiles a prefill program (GENERATE/"
+                         "MIGRATE/KV_STREAM only); registry lease id "
+                         "gains the '<role>:' prefix so the router "
+                         "routes by tier. Default 'both' = the legacy "
+                         "symmetric replica")
     ap.add_argument("--advertise", default=None,
                     help="endpoint to publish in the registry (default "
                          "<host>:<bound port>)")
@@ -1509,12 +1734,18 @@ def main(argv=None):
             model.set_state_dict(paddle.load(weights))
         engine = DecodeEngine(model, ecfg)
     srv = InferenceServer(args.model, args.host, args.port, engine=engine,
-                          auth_name=args.auth_name)
+                          auth_name=args.auth_name, role=args.role)
     srv.migrate_on_drain = bool(args.migrate_on_drain)
     if args.registry_dir or args.registry_addr:
         from paddle_tpu.distributed.fleet.elastic import (NodeRegistry,
-                                                          TcpNodeRegistry)
+                                                          TcpNodeRegistry,
+                                                          role_node_id)
         rid = args.replica_id or f"replica-{os.getpid()}"
+        if args.role != "both":
+            # the tier rides the lease id ('prefill:<id>'/'decode:<id>')
+            # so the router classifies the replica without extra state;
+            # unprefixed ids stay the legacy symmetric tier
+            rid = role_node_id(args.role, rid)
         endpoint = args.advertise or f"{args.host}:{srv.port}"
         if args.registry_dir:
             registry = NodeRegistry(args.registry_dir, rid, endpoint)

@@ -41,7 +41,10 @@ fleet or losing a preemptible VM costs zero client-visible errors.
 """
 from paddle_tpu.serving.autoscale import (Autoscaler, AutoscalePolicy,
                                           CallbackLauncher)
+from paddle_tpu.serving.disagg import (KVStreamAssembler, PrefixDirectory,
+                                       prompt_page_hashes, stream_records)
 from paddle_tpu.serving.router import POLICIES, ReplicaState, Router
 
 __all__ = ["Router", "ReplicaState", "POLICIES", "Autoscaler",
-           "AutoscalePolicy", "CallbackLauncher"]
+           "AutoscalePolicy", "CallbackLauncher", "KVStreamAssembler",
+           "PrefixDirectory", "prompt_page_hashes", "stream_records"]

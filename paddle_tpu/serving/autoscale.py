@@ -371,8 +371,13 @@ class Autoscaler:
         peer set. Success (clean or not) releases ownership and counts
         the scale-down; a raise parks the replica in the retry set."""
         endpoint = self._owned[rid]
+        # decode-capable peers only: a prefill-tier worker refuses
+        # OP_MIGRATE typed (it must never decode — docs/SERVING.md
+        # "Disaggregated serving"), so offering one just burns a
+        # fallback attempt at the worst moment
         peers = [r["endpoint"] for r in self._router.replica_view()
-                 if r["replica_id"] != rid and r["breaker"] == "closed"]
+                 if r["replica_id"] != rid and r["breaker"] == "closed"
+                 and r.get("role", "both") != "prefill"]
         try:
             clean = self._launcher.drain(rid, endpoint, peers)
         except Exception:  # noqa: BLE001 — launcher failure must not leak

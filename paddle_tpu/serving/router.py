@@ -62,10 +62,28 @@ per-replica ``router.replica_requests{replica=..}`` counters and
 histogram, and a ``router.forward`` span per routed request tagged with
 the replica id — one Perfetto filter shows which replica served a request.
 
+- **Disaggregated serving** (docs/SERVING.md "Disaggregated serving"):
+  replicas declare a tier via their lease role (``prefill:<id>`` /
+  ``decode:<id>``; unprefixed = legacy symmetric). With both tiers
+  healthy the router drives GENERATE two-phase: OP_PREFILL to a prefill
+  worker picked with CACHE AFFINITY — a fleet-wide prefix directory
+  (`serving/disagg.py` PrefixDirectory, keyed by the engines' rolling
+  page hashes, fed from their STATS prefix exports and the router's own
+  routing, invalidated on eviction/refresh/membership churn) biases
+  shared-prefix traffic to the worker already holding the longest
+  prefix, so a system prompt is prefilled once per FLEET — and the
+  worker's PTKS1 page records relay to the decode replica's OP_KV_STREAM
+  as they are produced. The decode replica admits the slot when the
+  final record lands and answers the full sequence, token-identical to a
+  symmetric route; it never compiles a prefill program. Deadlines,
+  cancel tags and idempotency keys ride the stream options; a prefill
+  worker dying mid-stream falls back to one symmetric attempt
+  (``router.disagg_fallbacks``) with the partial pages discarded
+  cleanly.
+
 The router is deliberately stateless about request CONTENT: GENERATE in,
-int32 ids out. The page-granular KV handoff (`inference/engine.py`
-KVHandoff) is the primitive a later prefill-tier router will ride to move
-half-finished requests between replicas.
+int32 ids out (the disaggregated flow relays opaque checksummed page
+records — it still never interprets them).
 """
 from __future__ import annotations
 
@@ -83,11 +101,12 @@ import numpy as np
 from paddle_tpu.distributed.fleet.elastic import node_role, router_node_id
 from paddle_tpu.inference.errors import DeadlineExceeded, Overloaded
 from paddle_tpu.inference.serve import (MAGIC, OP_CANCEL, OP_GENERATE,
-                                        OP_PING, OP_PROMETHEUS, OP_RUN,
-                                        OP_SHUTDOWN, OP_STATS, _recv_exact,
-                                        auth_token, recv_arrays,
-                                        retrying_connect, send_arrays,
-                                        stats_payload)
+                                        OP_KV_STREAM, OP_PING, OP_PREFILL,
+                                        OP_PROMETHEUS, OP_RUN, OP_SHUTDOWN,
+                                        OP_STATS, _recv_exact, auth_token,
+                                        recv_arrays, retrying_connect,
+                                        send_arrays, stats_payload)
+from paddle_tpu.serving.disagg import PrefixDirectory, prompt_page_hashes
 from paddle_tpu.observability import metrics
 from paddle_tpu.observability.flight_recorder import flight
 from paddle_tpu.observability.tracing import new_request_id
@@ -161,11 +180,18 @@ class ReplicaState:
 
     __slots__ = ("replica_id", "endpoint", "outstanding", "errors",
                  "breaker", "consec_fail", "probe_at", "evicted_at",
-                 "stats", "stats_at", "_g_out")
+                 "stats", "stats_at", "role", "_g_out")
 
     def __init__(self, replica_id: str, endpoint: str):
         self.replica_id = replica_id
         self.endpoint = endpoint
+        # disaggregation tier (docs/SERVING.md "Disaggregated serving"):
+        # parsed from the lease id's role prefix ('prefill:'/'decode:');
+        # an unprefixed legacy id is the symmetric 'both' tier. The
+        # replica's own STATS role export refines this (static fleets
+        # whose ids carry no prefix still classify).
+        role = node_role(replica_id)
+        self.role = role if role in ("prefill", "decode") else "both"
         self.outstanding = 0
         self.errors = 0
         self.breaker = "closed"
@@ -243,7 +269,8 @@ class Router:
                  stats_interval_s=5.0, max_resubmits=2,
                  evict_cooldown_s=5.0, connect_deadline_s=5.0,
                  request_timeout_s=600.0, breaker_threshold=3,
-                 health_interval_s=None):
+                 health_interval_s=None, page_size=None,
+                 directory_capacity=4096):
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown policy {policy!r}; have {sorted(POLICIES)}")
@@ -265,6 +292,13 @@ class Router:
                                       else health_interval_s)
         self._replica_token = auth_token(
             None if replica_secret is None else str(replica_secret))
+        # fleet prefix directory (docs/SERVING.md "Disaggregated
+        # serving"): rolling page hash -> prefill replica, fed by the
+        # replicas' STATS prefix exports and the router's own routing;
+        # `page_size` keys the prompt hashing — None learns it from the
+        # first engine STATS pull (affinity is policy-pick until then)
+        self._directory = PrefixDirectory(capacity=directory_capacity)
+        self._page_size = None if page_size is None else int(page_size)
         self._rr = -1
         self._rlock = threading.Lock()
         self._replicas: dict[str, ReplicaState] = {}
@@ -313,12 +347,13 @@ class Router:
         self._probe_thread = threading.Thread(
             target=self._probe_loop, daemon=True, name="pt-router-health")
         self._probe_thread.start()
-        self._stats_thread = None
-        if self._policy == "slo_aware":
-            self._stats_thread = threading.Thread(
-                target=self._stats_loop, daemon=True,
-                name="pt-router-stats")
-            self._stats_thread.start()
+        # the STATS thread ALWAYS runs now (it used to be slo_aware-only):
+        # beyond SLO ranking it is the fleet prefix directory's data feed
+        # and how a static replica's role/page_size are learned — a
+        # disaggregated fleet without it would never build affinity
+        self._stats_thread = threading.Thread(
+            target=self._stats_loop, daemon=True, name="pt-router-stats")
+        self._stats_thread.start()
 
     # ----------------------------------------------------------- membership
 
@@ -334,7 +369,8 @@ class Router:
         locking (the autoscaler, `serving/autoscale.py`)."""
         with self._rlock:
             return [dict(replica_id=r.replica_id, endpoint=r.endpoint,
-                         outstanding=r.outstanding, breaker=r.breaker)
+                         outstanding=r.outstanding, breaker=r.breaker,
+                         role=r.role)
                     for r in sorted(self._replicas.values(),
                                     key=lambda x: x.replica_id)]
 
@@ -357,8 +393,12 @@ class Router:
         registry for client discovery and never enter the rotation."""
         with self._rlock:
             alive = dict(self._static)
+            # every non-router role joins the rotation — legacy replicas
+            # ('both'), prefill workers and decode replicas alike; the
+            # tier decides WHICH traffic they get (`_pick` keeps pure
+            # prefill workers out of GENERATE placement)
             alive.update({rid: ep for rid, ep in registry_alive.items()
-                          if node_role(rid) == "replica"})
+                          if node_role(rid) != "router"})
             for rid, ep in alive.items():
                 self._join_replica(rid, str(ep))
             for rid in [rid for rid in self._replicas if rid not in alive]:
@@ -376,12 +416,14 @@ class Router:
         else:
             r.endpoint = ep
 
-    @staticmethod
-    def _leave_replica(r):
+    def _leave_replica(self, r):
         """Leave bookkeeping for a replica already popped from the
         rotation — the ONE leave path, shared by the membership poll and
-        `remove_static_replica`."""
+        `remove_static_replica`. Membership churn invalidates the
+        replica's fleet-directory entries: affinity must never bias a
+        route toward a corpse."""
         r._g_out.set(0)
+        self._directory.invalidate(r.replica_id)
         metrics.counter("router.replica_leaves").inc()
         flight.record("router.leave", replica=r.replica_id)
 
@@ -562,21 +604,46 @@ class Router:
                 import json
                 r.stats = json.loads(snap.tobytes().decode())
             except (OSError, ConnectionError, ValueError):
-                pass
+                continue
+            # disaggregation extras (docs/SERVING.md "Disaggregated
+            # serving"): the replica's self-declared role (refines the
+            # lease-prefix classification — static fleets with
+            # unprefixed ids still tier), the fleet page size, and —
+            # for prefill workers — the prefix-store hashes that FEED
+            # the fleet directory (replace() also drops entries the
+            # store evicted or flushed: stale affinity self-heals)
+            role = r.stats.get("role")
+            if role in ("both", "prefill", "decode"):
+                r.role = role
+            pre = r.stats.get("prefix") or {}
+            if self._page_size is None and pre.get("page_size"):
+                self._page_size = int(pre["page_size"])
+            if r.role == "prefill" and "hashes" in pre:
+                try:
+                    self._directory.replace(
+                        r.replica_id,
+                        [bytes.fromhex(h) for h in pre["hashes"]])
+                except ValueError:
+                    pass       # malformed export: keep the old view
 
     # -------------------------------------------------------------- routing
 
     def _pick(self, tried: set,
               key: bytes | None = None) -> ReplicaState | None:
         with self._rlock:
+            # pure prefill workers never take GENERATE traffic — a
+            # decode on one would compile decode programs and break the
+            # tier contract (decode and legacy 'both' replicas both can)
             cands = [r for r in self._replicas.values()
-                     if r.breaker == "closed" and r.replica_id not in tried]
+                     if r.breaker == "closed" and r.role != "prefill"
+                     and r.replica_id not in tried]
             if not cands:
                 # no closed replica left: a HALF-OPEN one may carry trial
                 # traffic — its success re-closes the breaker, its failure
                 # re-opens it (the request still has its resubmit budget)
                 cands = [r for r in self._replicas.values()
                          if r.breaker == "half_open"
+                         and r.role != "prefill"
                          and r.replica_id not in tried]
             if not cands:
                 return None
@@ -747,6 +814,18 @@ class Router:
             else time.monotonic() + deadline_ms / 1000.0
         last_err = None
         overloads = others = 0
+        if self._disagg_ready():
+            # two-phase flow first (docs/SERVING.md "Disaggregated
+            # serving"); a None return — the prefill tier failed or died
+            # mid-stream — falls back to the symmetric loop below, which
+            # prefills on the decode-capable replica itself. Terminal
+            # outcomes raise straight through.
+            outs = self._route_disagg(arrays, conn, key, t_deadline,
+                                      deadline_ms, rid_req, t0)
+            if outs is not None:
+                return outs
+            metrics.counter("router.disagg_fallbacks").inc()
+            flight.record("router.disagg_fallback", request_id=rid_req)
         while True:
             fwd, timeout = arrays, None
             if t_deadline is not None:
@@ -858,6 +937,288 @@ class Router:
                              args={"request_id": rid_req,
                                    "replica": r.replica_id})
             return outs
+
+    # ------------------------------------------------ disaggregated routing
+
+    def _disagg_ready(self) -> bool:
+        """The two-phase flow needs BOTH tiers healthy: >= 1 closed
+        prefill worker and >= 1 closed decode-capable replica. Anything
+        less routes symmetric — disaggregation is an optimization, never
+        an availability dependency."""
+        with self._rlock:
+            has_p = any(r.breaker == "closed" and r.role == "prefill"
+                        for r in self._replicas.values())
+            has_d = any(r.breaker == "closed"
+                        and r.role in ("decode", "both")
+                        for r in self._replicas.values())
+        return has_p and has_d
+
+    def _pick_prefill(self, hashes):
+        """``(replica, affinity_hit)``: the prefill worker for this
+        prompt. The fleet directory biases shared-prefix traffic to the
+        worker whose store already holds the longest prefix (the prompt
+        then prefills only its uncached tail — a system prompt costs the
+        FLEET one prefill); a miss falls back to the placement policy.
+        Fault site ``router.stale_directory`` forces a deliberately
+        stale affinity route (deterministic staleness drill: the worker
+        just prefills the whole prompt — correctness never depended on
+        the directory)."""
+        with self._rlock:
+            cands = [r for r in self._replicas.values()
+                     if r.breaker == "closed" and r.role == "prefill"]
+            if not cands:
+                return None, False
+            cands.sort(key=lambda r: r.replica_id)
+            if faults.ENABLED and faults.fire("router.stale_directory"):
+                metrics.counter("router.stale_affinity").inc()
+                return cands[-1], True
+            if hashes:
+                rid, depth = self._directory.lookup(hashes)
+                if rid is not None:
+                    for r in cands:
+                        if r.replica_id == rid:
+                            flight.record("router.affinity",
+                                          replica=rid, depth=depth)
+                            return r, True
+            return POLICIES[self._policy](self, cands), False
+
+    def _pick_decode(self, key):
+        """The decode replica for a disaggregated request: dedicated
+        decode tier first, legacy 'both' replicas as the fallback pool.
+        Keyed requests keep their rendezvous-hash placement so a
+        failover resubmit lands on the engine whose dedup table owns the
+        key (docs/ROBUSTNESS.md "Control-plane HA")."""
+        with self._rlock:
+            cands = [r for r in self._replicas.values()
+                     if r.breaker == "closed" and r.role == "decode"]
+            if not cands:
+                cands = [r for r in self._replicas.values()
+                         if r.breaker == "closed" and r.role == "both"]
+            if not cands:
+                return None
+            if key is not None:
+                return max(cands, key=lambda r: self._hrw(key, r))
+            cands.sort(key=lambda r: r.replica_id)
+            return POLICIES[self._policy](self, cands)
+
+    def _open_replica(self, r: ReplicaState, timeout):
+        """Fresh authed replica connection (the disagg exchanges manage
+        their own sockets — one prefill stream feeds one decode stream,
+        so the request/response isolation of `_replica_op` does not
+        fit)."""
+        host, port = r.endpoint.rsplit(":", 1)
+        sock = retrying_connect(host, int(port), timeout=timeout,
+                                attempts=2,
+                                deadline_s=self._connect_deadline)
+        sock.sendall(struct.pack("<I", MAGIC) + self._replica_token)
+        return sock
+
+    def _route_disagg(self, arrays, conn, key, t_deadline, deadline_ms,
+                      rid_req, t0):
+        """One two-phase GENERATE (docs/SERVING.md "Disaggregated
+        serving"): OP_PREFILL to the affinity-picked prefill worker,
+        whose PTKS1 page records RELAY to the chosen decode replica's
+        OP_KV_STREAM as they are produced — the decode replica admits
+        the slot the moment the final record lands and answers the full
+        sequence, token-identical to a symmetric route. Deadlines
+        forward as remaining budget, the cancel tag and idempotency key
+        ride the stream options, and the client-disconnect watch covers
+        the prefill wait, the record relay AND the decode wait (a
+        client hanging up mid-prefill drops both sockets — the fleet
+        stops paying immediately). One honest window: a CANCEL by tag
+        that arrives while the prefill is still streaming is a clean
+        miss — the tag registers on the decode replica with the stream
+        options — so the request runs to completion; the disconnect
+        chain is what bounds an abandoned client's cost.
+
+        Returns the response arrays, or None to FALL BACK to symmetric
+        routing (prefill worker dead/mid-stream death/no tier capacity)
+        — the decode side discards a partial stream with its pool
+        untouched, and the caller re-runs the prompt as a plain
+        GENERATE. Terminal per-request outcomes (validation errors,
+        DeadlineExceeded, Cancelled, client disconnect) raise through
+        verbatim; they would be identical on any route."""
+        prompt = np.ascontiguousarray(np.asarray(arrays[0]).reshape(-1),
+                                      np.int32)
+        mnt = int(np.asarray(arrays[1]).reshape(-1)[0])
+        cache, spec = 1, 1
+        if len(arrays) >= 3:
+            opts = np.asarray(arrays[2]).reshape(-1)
+            cache, spec = int(opts[0]), int(opts[1])
+        tag = np.ascontiguousarray(arrays[3], np.uint8).reshape(-1) \
+            if len(arrays) == 4 else np.zeros(0, np.uint8)
+        hashes = prompt_page_hashes(prompt, self._page_size) \
+            if (self._page_size and cache) else []
+        pre, hit = self._pick_prefill(hashes)
+        dec = self._pick_decode(key)
+        if pre is None or dec is None:
+            return None
+        metrics.counter("router.disagg_requests").inc()
+        (metrics.counter("router.affinity_hits") if hit
+         else metrics.counter("router.affinity_misses")).inc()
+        timeout = self._request_timeout
+        remaining_ms = 0
+        if t_deadline is not None:
+            remaining = t_deadline - time.monotonic()
+            if remaining <= 0:
+                metrics.counter("router.deadline_exceeded").inc()
+                raise DeadlineExceeded(
+                    f"request deadline ({deadline_ms} ms) exhausted "
+                    f"before the prefill tier was reached")
+            remaining_ms = max(1, int(remaining * 1000))
+            timeout = min(self._request_timeout, remaining + 10.0)
+        opts_kv = [mnt, cache, spec, remaining_ms]
+        if key is not None:
+            opts_kv += [int(w) for w in np.frombuffer(key, np.int32)]
+        # 1. start the prefill stream
+        psock = None
+        try:
+            psock = self._open_replica(pre, timeout)
+            psock.settimeout(timeout)
+            psock.sendall(struct.pack("<III", MAGIC, OP_PREFILL, 2))
+            send_arrays(psock, [prompt, np.asarray([cache], np.int32)])
+            if conn is not None:
+                # watch the CLIENT while the worker plans the stream —
+                # same disconnect chain as the decode wait
+                self._await_replica_or_client_gone(psock, conn, timeout)
+            magic, status, n_records = struct.unpack(
+                "<III", _recv_exact(psock, 12))
+            if magic != MAGIC:
+                raise ConnectionError(
+                    f"bad magic from prefill worker {pre.replica_id}")
+            if status != 0:
+                msg = _recv_exact(psock, n_records).decode(
+                    errors="replace")
+                raise _classify_wire_error(msg)
+        except (_ReplicaAppError, _ClientDisconnected):
+            if psock is not None:
+                psock.close()
+            raise                    # identical on any route / nobody left
+        except (ReplicaUnavailable, ConnectionError, socket.timeout,
+                OSError) as e:
+            if psock is not None:
+                psock.close()
+            metrics.counter("router.replica_errors").inc()
+            if _should_evict(e):
+                self._evict(pre, f"prefill: {type(e).__name__}: {e}")
+            return None
+        # 2. relay records to the decode replica as they are produced,
+        #    then await its answer (client-disconnect watched)
+        dsock = None
+        with self._rlock:
+            dec.outstanding += 1
+            dec._g_out.set(dec.outstanding)
+        try:
+            try:
+                dsock = self._open_replica(dec, timeout)
+                dsock.settimeout(timeout)
+                dsock.sendall(struct.pack("<III", MAGIC, OP_KV_STREAM,
+                                          2 + int(n_records)))
+                send_arrays(dsock, [np.asarray(opts_kv, np.int32), tag])
+            except (ConnectionError, socket.timeout, OSError) as e:
+                metrics.counter("router.replica_errors").inc()
+                if _should_evict(e):
+                    self._evict(dec, f"decode: {type(e).__name__}: {e}")
+                return None
+            try:
+                for _ in range(int(n_records)):
+                    try:
+                        # the client-disconnect watch covers the RELAY
+                        # too: a client hanging up 100 ms into a 30 s
+                        # prefill must stop the fleet paying for it —
+                        # dropping both sockets cancels the decode side
+                        # (its disconnect watch) and orphans the prefill
+                        # stream. _ClientDisconnected is not a wire
+                        # error and propagates past the except below.
+                        if conn is not None:
+                            self._await_replica_or_client_gone(
+                                psock, conn, timeout)
+                        (rec,) = recv_arrays(psock, 1)
+                    except (ConnectionError, socket.timeout, OSError,
+                            struct.error) as e:
+                        # MID-STREAM prefill-worker death: drop both
+                        # sockets — the decode replica discards the
+                        # partial stream with its pool at baseline —
+                        # and fall back to symmetric prefill
+                        metrics.counter("router.replica_errors").inc()
+                        metrics.counter("router.stream_aborts").inc()
+                        flight.record("router.stream_abort",
+                                      request_id=rid_req,
+                                      prefill=pre.replica_id,
+                                      error=f"{type(e).__name__}: {e}")
+                        self._evict(pre, f"prefill stream died: "
+                                         f"{type(e).__name__}: {e}")
+                        return None
+                    try:
+                        send_arrays(dsock, [rec])
+                    except (ConnectionError, socket.timeout, OSError) \
+                            as e:
+                        # the DECODE wire died under the relay: that is
+                        # the decode replica's failure, not the prefill
+                        # worker's — evict the right breaker
+                        metrics.counter("router.replica_errors").inc()
+                        metrics.counter("router.stream_aborts").inc()
+                        flight.record("router.stream_abort",
+                                      request_id=rid_req,
+                                      decode=dec.replica_id,
+                                      error=f"{type(e).__name__}: {e}")
+                        self._evict(dec, f"decode stream died: "
+                                         f"{type(e).__name__}: {e}")
+                        return None
+            finally:
+                psock.close()
+                psock = None
+            try:
+                if conn is not None:
+                    self._await_replica_or_client_gone(dsock, conn,
+                                                       timeout)
+                magic, status, n = struct.unpack(
+                    "<III", _recv_exact(dsock, 12))
+                if magic != MAGIC:
+                    raise ConnectionError(
+                        f"bad magic from decode replica "
+                        f"{dec.replica_id}")
+                if status != 0:
+                    msg = _recv_exact(dsock, n).decode(errors="replace")
+                    raise _classify_wire_error(msg)
+                outs = recv_arrays(dsock, n)
+            except _ReplicaAppError:
+                raise      # DeadlineExceeded/Cancelled/validation: relay
+            except (ReplicaUnavailable, ConnectionError, socket.timeout,
+                    OSError) as e:
+                metrics.counter("router.replica_errors").inc()
+                if _should_evict(e):
+                    self._evict(dec, f"decode: {type(e).__name__}: {e}")
+                return None
+        finally:
+            if psock is not None:
+                psock.close()
+            if dsock is not None:
+                dsock.close()
+            with self._rlock:
+                dec.outstanding -= 1
+                dec._g_out.set(dec.outstanding)
+        # success bookkeeping: the worker's store now holds this
+        # prompt's pages — register them so the NEXT shared-prefix
+        # request routes with affinity even before the STATS pull
+        if hashes:
+            self._directory.register(hashes, pre.replica_id)
+        with self._rlock:
+            for r in (pre, dec):
+                r.consec_fail = 0
+                if r.breaker == "half_open":
+                    r.breaker = "closed"
+                    metrics.counter("router.breaker_close").inc()
+        dt = time.perf_counter() - t0
+        metrics.counter("router.requests").inc()
+        metrics.counter("router.replica_requests",
+                        replica=dec.replica_id).inc()
+        metrics.histogram("router.request_seconds").observe(dt)
+        metrics.add_span("router.forward", t0, dt, cat="router",
+                         args={"request_id": rid_req,
+                               "replica": dec.replica_id,
+                               "prefill": pre.replica_id})
+        return outs
 
     def _route_cancel(self, arrays) -> np.ndarray:
         """CANCEL op: the router is stateless about which replica holds a
@@ -1078,6 +1439,10 @@ def main(argv=None):
                          "PADDLE_SERVE_TOKEN")
     ap.add_argument("--poll-interval", type=float, default=1.0)
     ap.add_argument("--max-resubmits", type=int, default=2)
+    ap.add_argument("--page-size", type=int, default=None,
+                    help="fleet KV page size, keys the prefix-affinity "
+                         "directory's prompt hashing (default: learned "
+                         "from the first engine STATS pull)")
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="also serve GET /metrics (Prometheus text) from "
                          "a stdlib HTTP endpoint on this port")
@@ -1114,7 +1479,8 @@ def main(argv=None):
                     auth_name=args.auth_name,
                     replica_secret=args.replica_secret,
                     poll_interval_s=args.poll_interval,
-                    max_resubmits=args.max_resubmits)
+                    max_resubmits=args.max_resubmits,
+                    page_size=args.page_size)
     if args.router_id is not None:
         from paddle_tpu.distributed.fleet.elastic import (NodeRegistry,
                                                           TcpNodeRegistry)

@@ -42,6 +42,16 @@ Sites currently wired (the catalog lives in docs/ROBUSTNESS.md):
                           reading a request body (slow-client simulation)
 ``serve.socket_drop``     serve's client loop drops the connection before
                           answering (network partition mid-request)
+``serve.stream_drop``     serve's OP_PREFILL record loop drops the
+                          connection MID-STREAM (prefill-worker death in
+                          disaggregated serving; the router must fall
+                          back to symmetric prefill and the decode side
+                          must discard the partial pages cleanly)
+``router.stale_directory``  the router's prefix-affinity lookup routes on
+                          a deliberately STALE directory entry (fleet
+                          directory staleness drill: the worker just
+                          prefills the whole prompt — affinity is an
+                          optimization, never a correctness dependency)
 ``train.step_nan``        `ScanTrainStep.step` feeds a NaN through the
                           program's finite-reduce INPUT — the bad-step skip
                           path runs in the warm program (no recompile)

@@ -16,12 +16,13 @@ a flaky CI retry throws away.
 Two layers:
 
 - `run()` — pytest over the chaos suites (serving chaos, train chaos,
-  migration, control-plane HA), suite order rotated per iteration.
+  migration, control-plane HA, disaggregated serving), suite order
+  rotated per iteration.
 - `run_micro()` — a self-contained pytest-free micro-drill (used by
   ``bench --smoke`` at 2 iterations, key ``soak_ok``): one tiny engine
   per iteration driven through a rotated ordering of fault scenarios
-  (slow steps, transient pool pressure, wire-blob corruption), asserting
-  typed outcomes and a page-clean pool each time.
+  (slow steps, transient pool pressure, wire-blob corruption, page-stream
+  corruption), asserting typed outcomes and a page-clean pool each time.
 
 Both dump the ring via `dump_ring()` on first failure and stop — a soak
 failure is a real bug with a fresh post-mortem, not a statistic.
@@ -42,6 +43,7 @@ CHAOS_SUITES = (
     "tests/test_train_chaos.py",
     "tests/test_migration.py",
     "tests/test_control_plane.py",
+    "tests/test_disagg.py",
 )
 
 
@@ -142,7 +144,31 @@ def _micro_scenarios():
             return
         raise AssertionError("corrupt blob was not refused")
 
-    return [slow_steps, pool_pressure, blob_corrupt]
+    def stream_corrupt(eng):
+        # the disaggregated page stream: a clean record sequence
+        # assembles bit-identical, and a bit flip in a MID-STREAM chunk
+        # refuses typed before any page is adopted
+        from paddle_tpu.inference.errors import HandoffCorrupt
+        from paddle_tpu.serving.disagg import (KVStreamAssembler,
+                                               stream_records)
+        h = eng.prefill_export(np.arange(6, dtype=np.int32))
+        recs = stream_records(h, pages_per_batch=1)
+        asm = KVStreamAssembler()
+        out = None
+        for r in recs:
+            out = asm.feed(r)
+        assert out is not None and np.array_equal(out.prompt, h.prompt)
+        asm2 = KVStreamAssembler()
+        asm2.feed(recs[0])
+        bad = bytearray(recs[1])
+        bad[-5] ^= 0x04
+        try:
+            asm2.feed(bytes(bad))
+        except HandoffCorrupt:
+            return
+        raise AssertionError("corrupt stream record was not refused")
+
+    return [slow_steps, pool_pressure, blob_corrupt, stream_corrupt]
 
 
 def run_micro(iterations: int = 2, model=None, out_dir: str = ".") -> int:

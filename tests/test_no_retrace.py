@@ -421,6 +421,51 @@ def test_migration_import_zero_recompiles():
         "warm fixed-shape steps")
 
 
+def test_disagg_decode_replica_never_compiles_prefill():
+    """Disaggregated-serving no-retrace pin (docs/SERVING.md
+    "Disaggregated serving"): a decode-tier engine fed only by KV page
+    streams compiles its decode step ONCE and never anything
+    prefill-shaped — and once warm, further stream imports are
+    zero-recompile (the same mailbox discipline as migration)."""
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.serving.disagg import KVStreamAssembler
+    m = _tiny_model()
+    ekw = dict(page_size=4, max_slots=2, min_bucket=8)
+    src = DecodeEngine(m, EngineConfig(prefill_chunk_tokens=4, **ekw))
+    dst = DecodeEngine(m, EngineConfig(**ekw))
+    rng = np.random.RandomState(3)
+
+    def stream_once(prompt, n):
+        sink = src.submit_prefill_stream(prompt)
+        src.step()
+        asm, h = KVStreamAssembler(), None
+        while True:
+            kind, val = sink.get(timeout=10)
+            if kind in ("done", "err"):
+                assert kind == "done", val
+                break
+            if kind == "rec":
+                h = asm.feed(val)
+        r = dst.submit_import(h, max_new_tokens=n)
+        dst.run_until_idle(max_steps=60)
+        assert r.done
+        return r
+
+    stream_once(rng.randint(0, 64, 10).astype(np.int32), 4)   # warm
+    assert not any(k[0] in ("prefill", "prefill_chunk")
+                   for k in dst._programs), (
+        "decode-tier engine compiled a prefill program: the stream "
+        "import path must be a page scatter + the warm decode step")
+    frozen = _compile_counters()
+    # churn: different prompt lengths, a second in-flight import
+    stream_once(rng.randint(0, 64, 7).astype(np.int32), 5)
+    stream_once(rng.randint(0, 64, 13).astype(np.int32), 3)
+    assert _compile_counters() == frozen, (
+        "a warm stream import compiled a program")
+    assert not any(k[0] in ("prefill", "prefill_chunk")
+                   for k in dst._programs)
+
+
 def test_dedup_attach_and_replay_zero_recompiles():
     """Idempotency dedup (docs/ROBUSTNESS.md "Control-plane HA") touches
     no programs: an in-flight attach returns the existing future before

@@ -395,6 +395,16 @@ def test_bench_smoke_emits_structured_json():
     assert d["soak_ok"] is True
     assert d["dedup_replays"] >= 1
     assert d["metrics"]["counters"]["engine.dedup_replays"] >= 1
+    # r13: the smoke run routes one DISAGGREGATED request — a prefill
+    # worker streams PTKS1 page records through the router to a decode
+    # replica (token-identical to the symmetric route, and the decode
+    # engine compiled zero prefill programs; docs/SERVING.md
+    # "Disaggregated serving")
+    assert d["disagg_ok"] is True
+    assert d["metrics"]["counters"]["router.disagg_requests"] >= 1
+    assert d["metrics"]["counters"]["serve.prefill_streams"] >= 1
+    assert d["metrics"]["counters"]["serve.kv_stream_in"] >= 1
+    assert d["metrics"]["counters"]["engine.kv_stream_exports"] >= 1
 
 
 @pytest.mark.slow      # tier-1 wall audit (PR 12): ~19 s — a SECOND full
