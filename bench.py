@@ -402,6 +402,79 @@ def bench_train_ft():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_train_elastic():
+    """Elastic multi-host restart rung (paddle_tpu/train/elastic.py,
+    docs/ROBUSTNESS.md "Multi-host training"): a REAL 4-process training
+    fleet (tiny GPT, CPU children, eager KV grad-allreduce); rank 3
+    SIGKILLs itself mid-run via the ``train.peer_dead`` fault site;
+    every survivor must exit typed PeerLost (rc 23) within the liveness
+    deadline; the ElasticController reforms at dp2 and resumes from the
+    last fleet-complete checkpoint with exactly one post-reform compile.
+
+    Metric: ``elastic_resume_wall_s`` — wall clock from the victim's
+    last completed step to the reformed fleet's FIRST post-resume step
+    (detection deadline + typed exits + relaunch + restore + the one
+    compile)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.train.elastic import (EXIT_PEER_LOST,
+                                          ElasticController,
+                                          spawn_local_fleet)
+
+    work = tempfile.mkdtemp(prefix="bench_elastic_")
+    root, logs = os.path.join(work, "ckpt"), os.path.join(work, "logs")
+    until, deadline_s = 12, 6.0
+
+    def spawn(world, attempt):
+        def env_for(rank):
+            if attempt == 0 and rank == 3:
+                return {"PADDLE_FAULTS": "train.peer_dead:times=6"}
+            return {}
+        return spawn_local_fleet(world, root=root, until_step=until,
+                                 log_dir=logs, every=2,
+                                 deadline_s=deadline_s,
+                                 env_for_rank=env_for, attempt=attempt)
+
+    def step_times(path):
+        out = {}
+        for line in open(path):
+            if line.startswith("STEP "):
+                parts = line.split()
+                out[int(parts[1])] = float(parts[-1].split("=")[1])
+        return out
+
+    try:
+        ctl = ElasticController(spawn, world_size=4,
+                                allowed_sizes=(1, 2, 4), max_restarts=2,
+                                settle_s=60)
+        rc = ctl.run()
+        assert rc == 0, f"controller failed: {ctl.attempts}"
+        w0, rcs0 = ctl.attempts[0]
+        assert w0 == 4 and sorted(rcs0) == [-9, EXIT_PEER_LOST,
+                                            EXIT_PEER_LOST,
+                                            EXIT_PEER_LOST], rcs0
+        w1, rcs1 = ctl.attempts[1]
+        assert (w1, rcs1) == (2, [0, 0]), ctl.attempts[1]
+        victim_last = max(step_times(
+            os.path.join(logs, "rank3.a0.log")).values())
+        resumed = step_times(os.path.join(logs, "rank0.a1.log"))
+        first_resumed_step = min(resumed)
+        done = next(line for line in open(os.path.join(logs,
+                                                       "rank0.a1.log"))
+                    if line.startswith("DONE"))
+        assert "compiles=1" in done, done
+        return {"elastic_resume_wall_s": resumed[first_resumed_step]
+                - victim_last,
+                "detect_deadline_s": deadline_s,
+                "survivor_rcs": sorted(rcs0),
+                "resumed_world": w1,
+                "resumed_at_step": first_resumed_step,
+                "until_step": until}
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_decode():
     """Autoregressive decode rung: GPT-2s fast_generate (single compiled
     program: static KV cache + lax.scan; see models/gpt.py). B=8 prompts
@@ -1634,6 +1707,22 @@ def bench_smoke():
     assert snapc0.get("train.checkpoints", 0) >= 1
     assert snapc0.get("train.resumes", 0) >= 1
 
+    # one typed PeerLost (paddle_tpu/distributed/liveness.py): a 2-rank
+    # heartbeat board whose peer went silent past the deadline must
+    # convert the would-be-infinite collective wait into the typed error
+    # the elastic controller keys on — the SAME shared drill the soak
+    # micro scenario runs, emitted as `peer_lost_typed_ok` (asserted in
+    # tests/test_observability.py)
+    from paddle_tpu.testing.soak import peer_lost_drill
+    _pl_dir = _tf.mkdtemp(prefix="bench_pl_")
+    try:
+        peer_lost_typed_ok = peer_lost_drill(_pl_dir)
+        assert peer_lost_typed_ok
+        assert metrics.snapshot()["counters"].get("train.peer_lost",
+                                                  0) >= 1
+    finally:
+        _sh.rmtree(_pl_dir, ignore_errors=True)
+
     # batched-engine decode on the same tiny model, now under a stall
     # WATCHDOG and with enough concurrent requests to land real SLO
     # observations: keeps the decode engine (paged KV cache + bucketed
@@ -1856,7 +1945,7 @@ def bench_smoke():
     return (dt, batch * seq / dt, snap, slo, wd.dump_count == 0, router_ok,
             prefix_hits, spec_accepted, shed_count, cancelled_count,
             resume_ok, kv_quant_ok, migrate_ok, soak_ok, dedup_replays,
-            disagg_ok)
+            disagg_ok, peer_lost_typed_ok)
 
 
 def _retry(fn, attempts=3):
@@ -1899,7 +1988,7 @@ def main(argv=None):
             (dt, tps, snap, slo, wd_clean, router_ok, prefix_hits,
              spec_accepted, shed_count, cancelled_count,
              resume_ok, kv_quant_ok, migrate_ok, soak_ok,
-             dedup_replays, disagg_ok) = bench_smoke()
+             dedup_replays, disagg_ok, peer_lost_typed_ok) = bench_smoke()
             impls = {k.rsplit(".", 1)[-1]: v
                      for k, v in snap["counters"].items()
                      if k.startswith("paged_attention.impl.") and v}
@@ -1917,6 +2006,7 @@ def main(argv=None):
                    "migrate_ok": migrate_ok,
                    "soak_ok": soak_ok,
                    "disagg_ok": disagg_ok,
+                   "peer_lost_typed_ok": peer_lost_typed_ok,
                    "dedup_replays": dedup_replays,
                    "prefill_chunks": snap["counters"].get(
                        "engine.prefill_chunks", 0),
@@ -2024,6 +2114,27 @@ def main(argv=None):
     except Exception as e:
         _emit({"metric": "train_ft_step_stall_ratio_p99", "value": 0.0,
                "unit": "x", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
+    try:
+        el = _retry(bench_train_elastic, attempts=2)
+        _emit({"metric": "elastic_resume_wall_s",
+               "value": round(el["elastic_resume_wall_s"], 3), "unit": "s",
+               "ok": True, "platform": platform,
+               "detect_deadline_s": el["detect_deadline_s"],
+               "survivor_rcs": el["survivor_rcs"],
+               "resumed_world": el["resumed_world"],
+               "resumed_at_step": el["resumed_at_step"],
+               "mix": "kill 1-of-4 mid-step (train.peer_dead) -> typed "
+                      "PeerLost on every survivor -> relaunch at dp2 from "
+                      "the fleet-complete checkpoint"})
+        print(f"# train_elastic kill-1-of-4: resume wall "
+              f"{el['elastic_resume_wall_s']:.1f}s (deadline "
+              f"{el['detect_deadline_s']}s), survivors {el['survivor_rcs']}"
+              f", resumed dp{el['resumed_world']} at step "
+              f"{el['resumed_at_step']}", file=sys.stderr)
+    except Exception as e:
+        _emit({"metric": "elastic_resume_wall_s", "value": 0.0, "unit": "s",
+               "ok": False, "platform": platform,
                "backend_error": f"{type(e).__name__}: {e}"})
     try:
         eng_tps, seq_tps = _retry(bench_engine_decode)

@@ -91,15 +91,50 @@ def _slices_to_json(idx, shape):
     return out
 
 
-def save_sharded(state_dict, path):
+def _is_literal(value) -> bool:
+    """True for the non-tensor metadata entries (global_step, cursors...)
+    that the index stores as JSON literals — the ONE predicate both
+    save_sharded and async_save's partition filter use."""
+    return isinstance(value, (int, float, str, bool, type(None))) or (
+        isinstance(value, (list, tuple)) and all(
+            isinstance(v, (int, float, str, bool)) for v in value))
+
+
+def shard_owner(key: str, world: int) -> int:
+    """Stable owner rank for a checkpoint leaf under key-partitioned
+    multi-host saves: a content hash of the RAW key mod world, so every
+    rank computes the same partition with zero coordination. Literal
+    (metadata) entries are always rank 0's."""
+    h = hashlib.blake2b(key.encode(), digest_size=8).digest()
+    return int.from_bytes(h, "big") % max(1, int(world))
+
+
+def save_sharded(state_dict, path, partition=None):
     """Save a (possibly nested) state_dict of Tensors shard-by-shard.
 
     Every process writes its own addressable shards plus a per-process partial
     index ``index.p<pid>.json``; loaders merge ALL partial indexes, so
     multi-host saves need no cross-process gather or barrier. Writes publish
-    atomically (tmp + rename)."""
+    atomically (tmp + rename).
+
+    ``partition=(rank, world)``: key-partitioned multi-host mode for fleets
+    whose per-process state is fully REPLICATED (eager data-parallel — each
+    process holds the whole array): rank r writes only the leaves
+    :func:`shard_owner` assigns it (literals go to rank 0), so the fleet
+    writes each byte once and the merged indexes cover the full state only
+    when EVERY rank's shards landed — a missing rank makes the checkpoint
+    structurally incomplete, which is exactly what the manager's
+    pre-COMPLETE barrier turns into "complete or invisible" fleet-wide
+    (docs/ROBUSTNESS.md "Multi-host training")."""
     os.makedirs(path, exist_ok=True)
-    pid = jax.process_index()
+    if partition is not None:
+        rank, world = int(partition[0]), int(partition[1])
+        if not (0 <= rank < world):
+            raise ValueError(f"partition rank {rank} outside world {world}")
+        pid = rank
+    else:
+        rank = world = None
+        pid = jax.process_index()
     index = {_META_KEY: {"version": CKPT_FORMAT_VERSION}}
     nwritten = 0
 
@@ -108,6 +143,13 @@ def save_sharded(state_dict, path):
         # shard files must leave the checkpoint INVISIBLE (no index, no
         # LATEST), and a torn write must be refused at load by checksum
         nonlocal nwritten
+        from paddle_tpu.distributed import liveness
+        mon = liveness.current()
+        if mon is not None:
+            # a rank actively writing shards is ALIVE: renew the heartbeat
+            # per shard so a slow shared-filesystem write never reads as a
+            # dead peer to ranks already waiting at the publication barrier
+            mon.rebeat()
         if faults.ENABLED and nwritten > 0 \
                 and faults.fire("ckpt.crash_between_shards"):
             raise faults.FaultInjected(
@@ -120,13 +162,15 @@ def save_sharded(state_dict, path):
                 f.truncate(max(1, os.path.getsize(fpath) // 2))
 
     for key, value in _flatten(state_dict).items():
-        if isinstance(value, (int, float, str, bool, type(None))) or (
-                isinstance(value, (list, tuple)) and all(
-                    isinstance(v, (int, float, str, bool)) for v in value)):
+        if _is_literal(value):
+            if world is not None and rank != 0:
+                continue               # literals are rank 0's
             # non-tensor metadata (global_step, key manifests...): JSON literal
             index[key] = {"literal": value if not isinstance(value, tuple)
                           else list(value)}
             continue
+        if world is not None and shard_owner(key, world) != rank:
+            continue                   # another rank writes this leaf
         arr = value._data if isinstance(value, Tensor) else value
         if isinstance(arr, np.ndarray):
             # pre-snapshotted host array (async_save): one full-shape shard
@@ -181,16 +225,18 @@ class _SaveThread(threading.Thread):
     the next save, so at most one checkpoint interval passes between a
     write failing and the training loop hearing about it."""
 
-    def __init__(self, snapshot, path, on_complete=None):
+    def __init__(self, snapshot, path, on_complete=None, partition=None):
         super().__init__(daemon=True, name="pt-ckpt-save")
         self._snapshot = snapshot
         self._path = path
         self._on_complete = on_complete
+        self._partition = partition
         self.exc = None
 
     def run(self):
         try:
-            save_sharded(self._snapshot, self._path)
+            save_sharded(self._snapshot, self._path,
+                         partition=self._partition)
             if self._on_complete is not None:
                 self._on_complete(self._path)
         except BaseException as e:   # noqa: BLE001 — stored, re-raised on join
@@ -205,7 +251,7 @@ class _SaveThread(threading.Thread):
     wait = join
 
 
-def async_save(state_dict, path, on_complete=None):
+def async_save(state_dict, path, on_complete=None, partition=None):
     """Copy values to HOST on the calling thread (compiled train steps donate
     the device buffers — a reference would race the next step's in-place
     update), then write in the background. join()/wait() re-raises write
@@ -213,15 +259,21 @@ def async_save(state_dict, path, on_complete=None):
     step-stall `bench_train_ft` measures. ``on_complete(path)`` runs on the
     writer thread after a fully successful save (the manager's hook for the
     COMPLETE marker + LATEST pointer); its errors propagate like write
-    errors."""
+    errors. ``partition=(rank, world)`` snapshots (and writes) only this
+    rank's key-partition — see :func:`save_sharded`."""
     snapshot = {}
     for key, value in _flatten(state_dict).items():
+        if partition is not None:
+            rank, world = int(partition[0]), int(partition[1])
+            owner = 0 if _is_literal(value) else shard_owner(key, world)
+            if owner != rank:
+                continue               # unowned: don't even snapshot it
         arr = value._data if isinstance(value, Tensor) else value
         if hasattr(arr, "addressable_shards"):
             arr = np.asarray(arr)      # synchronous host copy
         snapshot[key] = arr
 
-    t = _SaveThread(snapshot, path, on_complete)
+    t = _SaveThread(snapshot, path, on_complete, partition=partition)
     t.start()
     return t
 

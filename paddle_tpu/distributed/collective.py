@@ -171,27 +171,58 @@ def _kv_allgather(arr):
     peer's — the TCPStore-analog correctness path for backends that cannot
     compile multiprocess programs. O(P·data) through the coordinator, so it
     is a fallback, not the fast path."""
+    from paddle_tpu.distributed import liveness
     from paddle_tpu.distributed.parallel import get_rank
+    from paddle_tpu.testing import faults
+    if faults.ENABLED:
+        # train.collective_stall chaos site (docs/ROBUSTNESS.md): the armed
+        # rank sleeps delay_s BEFORE publishing its contribution — from the
+        # peers' side indistinguishable from a wedged rank, which is exactly
+        # what their liveness monitors must convert into typed PeerLost
+        faults.fire("train.collective_stall")
     client = _kv_client()
     np_arr = np.ascontiguousarray(np.asarray(arr))
     seq = _ag_seq[0]
     _ag_seq[0] += 1
     me = get_rank()
-    client.key_value_set_bytes(f"ptpu_ag/{seq}/{me}", np_arr.tobytes())
+    # payload + readiness marker (liveness.set_with_marker): guarded
+    # waiters poll the ASCII marker instead of ever letting a blocking
+    # read expire (this jaxlib SEGVs on expiring cross-process gets)
+    liveness.set_with_marker(client, f"ptpu_ag/{seq}/{me}",
+                             np_arr.tobytes())
     parts = []
     for r in range(jax.process_count()):
         if r == me:
             parts.append(np_arr)
             continue
-        raw = client.blocking_key_value_get_bytes(f"ptpu_ag/{seq}/{r}",
-                                                  60_000)
+        # liveness-guarded read (distributed/liveness.py): with a monitor
+        # installed, a peer that died mid-step converts this would-be-60s
+        # opaque wait into a typed PeerLost within the liveness deadline
+        raw = liveness.guarded_get_bytes(
+            client, f"ptpu_ag/{seq}/{r}", 60_000,
+            what=f"allgather seq {seq}")
         parts.append(np.frombuffer(bytes(raw), dtype=np_arr.dtype)
                      .reshape(np_arr.shape))
     try:
         # peers have all read by the barrier: own key is safe to delete, so
         # a long eager loop doesn't grow the coordination service unboundedly
-        client.wait_at_barrier(f"ptpu_ag_done/{seq}", 60_000)
-        client.key_value_delete(f"ptpu_ag/{seq}/{me}")
+        if liveness.current() is not None:
+            # polling barrier: composes with the liveness guard (a peer
+            # dying RIGHT HERE still resolves typed, not as a wedged
+            # wait_at_barrier); superseded barrier tags from two
+            # generations back are provably unread — rank 0 sweeps them
+            liveness.kv_barrier(client, f"ag_done/{seq}", rank=me,
+                                world=jax.process_count(),
+                                timeout_ms=60_000)
+            liveness.clear_with_marker(client, f"ptpu_ag/{seq}/{me}")
+            if me == 0 and seq >= 2:
+                liveness.kv_barrier_cleanup(client, f"ag_done/{seq - 2}")
+        else:
+            client.wait_at_barrier(f"ptpu_ag_done/{seq}", 60_000)
+            client.key_value_delete(f"ptpu_ag/{seq}/{me}")
+            client.key_value_delete(f"ptpu_mk/ptpu_ag/{seq}/{me}")
+    except liveness.PeerLost:
+        raise
     except Exception:  # noqa: BLE001 — cleanup is best-effort
         pass
     return np.stack(parts)
@@ -420,9 +451,10 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
             if r == rank:
                 tensor._write(chunk._data)
             else:
+                from paddle_tpu.distributed import liveness
                 n, key = _p2p_peek_key(src, r)
-                _kv_client().key_value_set_bytes(
-                    key, np.ascontiguousarray(
+                liveness.set_with_marker(
+                    _kv_client(), key, np.ascontiguousarray(
                         np.asarray(chunk._data)).tobytes())
                 _p2p_advance(src, r, n)
     else:
@@ -487,24 +519,24 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     # pairwise exchange through the KV transport: O(data/P) per peer instead
     # of the former allgather-everything emulation
     client = _kv_client()
+    from paddle_tpu.distributed import liveness
     for r in range(world):
         if r == rank:
             continue
         n, key = _p2p_peek_key(rank, r)
-        client.key_value_set_bytes(
-            key, np.ascontiguousarray(np.asarray(ts[r]._data)).tobytes())
+        liveness.set_with_marker(
+            client, key,
+            np.ascontiguousarray(np.asarray(ts[r]._data)).tobytes())
         _p2p_advance(rank, r, n)
     for r in range(world):
         if r == rank:
             out_tensor_list.append(Tensor(ts[rank]._data, _internal=True))
             continue
         n, key = _p2p_peek_key(r, rank)
-        raw = client.blocking_key_value_get_bytes(key, 120_000)
+        raw = liveness.guarded_get_bytes(client, key, 120_000,
+                                         what=f"alltoall from rank {r}")
         _p2p_advance(r, rank, n)
-        try:
-            client.key_value_delete(key)
-        except Exception:
-            pass
+        liveness.clear_with_marker(client, key)
         arr = np.frombuffer(raw, dtype=np.dtype(str(ts[r]._data.dtype))
                             ).reshape(ts[r].shape)
         out_tensor_list.append(Tensor(jnp.asarray(arr), _internal=True))
@@ -588,10 +620,11 @@ def send(tensor, dst=0, group=None, sync_op=True):
     if not _multiprocess():
         raise RuntimeError("send() with world_size 1 has no peer")
     _note_collective("send", "eager", t._data)
+    from paddle_tpu.distributed import liveness
     from paddle_tpu.distributed.parallel import get_rank
     arr = np.ascontiguousarray(np.asarray(t._data))
     n, key = _p2p_peek_key(get_rank(), dst)
-    _kv_client().key_value_set_bytes(key, arr.tobytes())
+    liveness.set_with_marker(_kv_client(), key, arr.tobytes())
     _p2p_advance(get_rank(), dst, n)
 
 
@@ -607,17 +640,16 @@ def recv(tensor, src=0, group=None, sync_op=True):
     if not _multiprocess():
         raise RuntimeError("recv() with world_size 1 has no peer")
     _note_collective("recv", "eager", t._data)
+    from paddle_tpu.distributed import liveness
     from paddle_tpu.distributed.parallel import get_rank
     n, key = _p2p_peek_key(src, get_rank())
     client = _kv_client()
-    raw = client.blocking_key_value_get_bytes(key, 120_000)
+    raw = liveness.guarded_get_bytes(client, key, 120_000,
+                                     what=f"recv from rank {src}")
     _p2p_advance(src, get_rank(), n)
-    # free the coordinator's copy — otherwise every payload ever sent
-    # accumulates in the coordination service
-    try:
-        client.key_value_delete(key)
-    except Exception:
-        pass
+    # free the coordinator's copy (payload + readiness marker) — otherwise
+    # every payload ever sent accumulates in the coordination service
+    liveness.clear_with_marker(client, key)
     arr = np.frombuffer(raw, dtype=np.dtype(str(t._data.dtype))).reshape(
         t.shape)
     t._write(jnp.asarray(arr))

@@ -112,6 +112,24 @@ def barrier(group=None):
         import jax.numpy as jnp
         jnp.zeros(()).block_until_ready()
         return
+    from paddle_tpu.distributed import liveness
+    if liveness.current() is not None:
+        # liveness-guarded fleet (elastic training): the polling barrier
+        # converts a dead peer into typed PeerLost instead of wedging in
+        # wait_at_barrier (whose expiry this jaxlib cannot survive)
+        from paddle_tpu.distributed.collective import _kv_client
+        _barrier_seq[0] += 1
+        client = _kv_client()
+        liveness.kv_barrier(client, f"pbar/{_barrier_seq[0]}",
+                            rank=get_rank(), world=jax.process_count(),
+                            timeout_ms=60_000)
+        if get_rank() == 0 and _barrier_seq[0] >= 3:
+            # two-generations-back sweep (same deferral contract as the
+            # allgather barriers): seq N completing proves everyone is
+            # fully past seq N-2's listing loop
+            liveness.kv_barrier_cleanup(client,
+                                        f"pbar/{_barrier_seq[0] - 2}")
+        return
     from jax.experimental import multihost_utils
     try:
         multihost_utils.sync_global_devices("paddle_tpu_barrier")

@@ -26,6 +26,15 @@ from paddle_tpu.observability import metrics as _metrics
 # training loop would see per next(loader) — the pipeline-health number
 _M_BATCHES = _metrics.counter("dataloader.batches")
 _M_FETCH_S = _metrics.histogram("dataloader.fetch_seconds")
+_M_STALL_RETRIES = _metrics.counter("dataloader.stall_retries")
+
+
+class DataLoaderStalled(RuntimeError):
+    """The worker fetch pipeline produced NOTHING for ``stall_timeout``
+    seconds twice in a row (one bounded retry re-enqueued the in-flight
+    batches in between): a wedged worker pool must surface as a typed
+    error at the training loop, never hang ``fit()`` forever
+    (docs/ROBUSTNESS.md "Fault sites": ``loader.stall``)."""
 
 
 class Dataset:
@@ -328,7 +337,7 @@ class DataLoader:
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
                  worker_init_fn=None, persistent_workers=False,
-                 shm_slot_bytes=64 << 20):
+                 shm_slot_bytes=64 << 20, stall_timeout=300.0):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -336,6 +345,13 @@ class DataLoader:
         self.use_shared_memory = use_shared_memory
         self.shm_slot_bytes = shm_slot_bytes
         self.timeout = timeout
+        # worker-fetch stall ladder (docs/ROBUSTNESS.md): no batch for
+        # this long -> ONE bounded retry (re-enqueue the in-flight batch
+        # indices), a second silent window -> typed DataLoaderStalled.
+        # 0/None disables. Distinct from ``timeout`` (a hard overall
+        # deadline the caller opted into): the stall ladder is ON by
+        # default because the alternative is fit() hanging forever.
+        self.stall_timeout = stall_timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self.batch_size = batch_size
         self.drop_last = drop_last
@@ -481,12 +497,54 @@ class DataLoader:
             w.start()
             workers.append(w)
 
+        # stall ladder state (docs/ROBUSTNESS.md "Fault sites",
+        # ``loader.stall``): shared between get_result and the consumer
+        # loop below via closure
+        stall = {"last": time.monotonic(), "retried": False}
+
+        def _on_stall(why):
+            """One bounded retry: re-enqueue every in-flight batch index
+            (a recovered/other worker picks them up; duplicate deliveries
+            are discarded by seq), then typed failure on the second
+            silent window."""
+            from paddle_tpu.observability.flight_recorder import flight
+            if stall["retried"]:
+                raise DataLoaderStalled(
+                    f"DataLoader worker fetch produced nothing for "
+                    f"{self.stall_timeout}s twice in a row ({why}); "
+                    f"one retry already re-enqueued the in-flight "
+                    f"batches — the worker pool is wedged")
+            stall["retried"] = True
+            stall["last"] = time.monotonic()
+            pend = [i for i in range(next_yield, next_send)
+                    if i not in reorder]
+            _M_STALL_RETRIES.inc()
+            flight.record("dataloader.stall_retry", pending=len(pend),
+                          why=str(why))
+            for i in pend:
+                index_queue.put((i, batches[i]))
+
         def get_result():
             # bounded waits so a crashed worker pool raises instead of
             # hanging the consumer forever (e.g. spawn bootstrap failures)
+            from paddle_tpu.testing import faults
+            # the stall window measures silence WHILE FETCHING: reset at
+            # entry so time the consumer spent suspended between next()
+            # calls (a long eval, a synchronous fleet checkpoint) never
+            # counts as a worker stall
+            stall["last"] = time.monotonic()
             deadline = (time.monotonic() + self.timeout) if self.timeout \
                 else None
             while True:
+                if faults.ENABLED and faults.fire("loader.stall"):
+                    # deterministic stand-in for a silent stall_timeout
+                    # window: drive the SAME ladder the timer would
+                    # (times=1 exercises the retry; times=2 burns both
+                    # charges before any delivery -> the typed raise)
+                    _on_stall("injected via loader.stall")
+                if self.stall_timeout and \
+                        time.monotonic() - stall["last"] > self.stall_timeout:
+                    _on_stall(f"no batch for {self.stall_timeout}s")
                 if deadline is not None:
                     left = deadline - time.monotonic()
                     if left <= 0:
@@ -537,8 +595,18 @@ class DataLoader:
                 if next_yield >= n:
                     break
                 seq, data, err = get_result()
+                # ANY delivery (duplicates included) proves the pipeline
+                # is alive again: re-arm the retry so "twice" means twice
+                # IN A ROW, not twice per epoch — a transient hiccup at
+                # hour 1 must not arm hour 5's into a typed failure
+                stall["retried"] = False
                 if err is not None:
                     raise err
+                if seq < next_yield or seq in reorder:
+                    # duplicate delivery: the stall retry re-enqueued an
+                    # in-flight batch whose ORIGINAL then also arrived —
+                    # it was already accounted, drop this copy
+                    continue
                 inflight -= 1
                 if next_send < n:
                     index_queue.put((next_send, batches[next_send]))

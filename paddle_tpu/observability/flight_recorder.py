@@ -36,7 +36,7 @@ import time
 
 from paddle_tpu.observability import metrics
 
-__all__ = ["FlightRecorder", "Watchdog", "flight"]
+__all__ = ["FlightRecorder", "Watchdog", "flight", "dump_ring"]
 
 _EVENTS = 2048          # default ring capacity
 
@@ -72,6 +72,26 @@ flight = FlightRecorder()
 
 def _default_dump_dir():
     return os.environ.get("PADDLE_WATCHDOG_DIR") or tempfile.gettempdir()
+
+
+def dump_ring(label, out_dir=None, recorder=None, **extra) -> str:
+    """Write the flight ring + the metrics snapshot (+ any ``extra``
+    JSON-serializable context) to a post-mortem JSON file and return its
+    path — the shared artifact writer behind the soak harness's
+    first-failure dump and the liveness monitor's PeerLost dump
+    (`distributed/liveness.py`); the watchdog keeps its own richer
+    payload (per-request traces, stall metadata). ``PADDLE_WATCHDOG_DIR``
+    picks the directory like the watchdog's."""
+    out_dir = out_dir or _default_dump_dir()
+    os.makedirs(out_dir, exist_ok=True)
+    rec = recorder if recorder is not None else flight
+    path = os.path.join(
+        out_dir, f"{label}_{os.getpid()}_{int(time.time())}.json")
+    with open(path, "w") as f:
+        json.dump({"label": str(label), **extra,
+                   "events": rec.events(),
+                   "metrics": metrics.snapshot()}, f, indent=1)
+    return path
 
 
 def default_deadline(fallback: float = 300.0) -> float:

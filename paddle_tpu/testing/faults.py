@@ -61,6 +61,25 @@ Sites currently wired (the catalog lives in docs/ROBUSTNESS.md):
 ``ckpt.crash_between_shards``  `save_sharded` dies between shard files (the
                           checkpoint must stay INVISIBLE: no index, no
                           COMPLETE, LATEST untouched)
+``ckpt.barrier_timeout``  the multi-host checkpoint publication barrier
+                          times out (a peer died between its shard writes
+                          and COMPLETE): every survivor raises typed
+                          `PeerLost`, the checkpoint stays invisible
+                          fleet-wide (`train/fault_tolerance.py`)
+``train.peer_dead``       the armed elastic-training rank SIGKILLs itself
+                          at the ``times``-th step boundary (deterministic
+                          spot reclaim; survivors must detect via
+                          heartbeats — `train/elastic.py`)
+``train.collective_stall``  a rank stalls ``delay_s`` INSIDE the eager KV
+                          allgather before publishing its contribution
+                          (wedged-peer simulation: its heartbeat goes
+                          stale and survivors raise typed `PeerLost`)
+``loader.stall``          `DataLoader`'s worker fetch behaves as if the
+                          stall window elapsed: first fire re-enqueues the
+                          in-flight batches (one bounded retry); a second
+                          fire WITHOUT a delivery in between raises typed
+                          `DataLoaderStalled` (any delivery re-arms the
+                          retry — "twice" means twice in a row)
 ========================  ====================================================
 """
 from __future__ import annotations
@@ -71,7 +90,7 @@ import time
 from contextlib import contextmanager
 
 __all__ = ["ENABLED", "FaultInjected", "arm", "disarm", "fire", "fired",
-           "scoped", "arm_from_env"]
+           "remaining", "scoped", "arm_from_env"]
 
 # fast-path flag: call sites guard on this BEFORE calling fire(), so a
 # production process with no faults armed never takes the lock below
@@ -146,6 +165,16 @@ def fired(site: str) -> int:
     """Lifetime fire count for ``site`` (0 if it never fired)."""
     with _lock:
         return _fired.get(site, 0)
+
+
+def remaining(site: str):
+    """Charges left on an ARMED site (−1 = unlimited), or None when the
+    site is not armed. Lets a call site act on the LAST charge — e.g.
+    ``train.peer_dead:times=k`` kills its rank at the k-th step boundary
+    (`train/elastic.py`), not the first."""
+    with _lock:
+        f = _armed.get(site)
+        return None if f is None else f.times
 
 
 @contextmanager

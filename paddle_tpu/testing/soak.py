@@ -16,13 +16,14 @@ a flaky CI retry throws away.
 Two layers:
 
 - `run()` — pytest over the chaos suites (serving chaos, train chaos,
-  migration, control-plane HA, disaggregated serving), suite order
-  rotated per iteration.
+  elastic multi-host training, migration, control-plane HA,
+  disaggregated serving), suite order rotated per iteration.
 - `run_micro()` — a self-contained pytest-free micro-drill (used by
   ``bench --smoke`` at 2 iterations, key ``soak_ok``): one tiny engine
   per iteration driven through a rotated ordering of fault scenarios
   (slow steps, transient pool pressure, wire-blob corruption, page-stream
-  corruption), asserting typed outcomes and a page-clean pool each time.
+  corruption, peer-death liveness), asserting typed outcomes and a
+  page-clean pool each time.
 
 Both dump the ring via `dump_ring()` on first failure and stop — a soak
 failure is a real bug with a fresh post-mortem, not a statistic.
@@ -30,17 +31,16 @@ failure is a real bug with a fresh post-mortem, not a statistic.
 from __future__ import annotations
 
 import argparse
-import json
 import os
-import time
 
-__all__ = ["CHAOS_SUITES", "rotated", "dump_ring", "run", "run_micro",
-           "main"]
+__all__ = ["CHAOS_SUITES", "rotated", "dump_ring", "peer_lost_drill",
+           "run", "run_micro", "main"]
 
 # the chaos suites, in their canonical order (rotation starts here)
 CHAOS_SUITES = (
     "tests/test_chaos.py",
     "tests/test_train_chaos.py",
+    "tests/test_train_elastic.py",
     "tests/test_migration.py",
     "tests/test_control_plane.py",
     "tests/test_disagg.py",
@@ -58,18 +58,12 @@ def rotated(seq, i: int) -> list:
 
 def dump_ring(out_dir: str = ".", label: str = "soak") -> str:
     """Write the flight-recorder ring + the metrics snapshot to a JSON
-    post-mortem file and return its path (the same artifact shape the
-    watchdog dumps, `observability/flight_recorder.py`)."""
-    from paddle_tpu.observability import metrics
-    from paddle_tpu.observability.flight_recorder import flight
-    os.makedirs(out_dir, exist_ok=True)
-    path = os.path.join(
-        out_dir, f"{label}_failure_{int(time.time())}_{os.getpid()}.json")
-    with open(path, "w") as f:
-        json.dump({"label": label,
-                   "flight": flight.events(),
-                   "metrics": metrics.snapshot()}, f, indent=1)
-    return path
+    post-mortem file and return its path. Delegates to the shared
+    artifact writer (`observability/flight_recorder.py:dump_ring`), so
+    the soak dump, the watchdog dump, and the liveness PeerLost dump all
+    share one shape: {label, events, metrics}."""
+    from paddle_tpu.observability.flight_recorder import dump_ring as _dump
+    return _dump(label, out_dir=out_dir)
 
 
 def run(iterations: int = 3, suites=None, out_dir: str = ".",
@@ -93,6 +87,34 @@ def run(iterations: int = 3, suites=None, out_dir: str = ".",
             return int(rc) or 1
     print(f"SOAK OK: {iterations} iteration(s)", flush=True)
     return 0
+
+
+def peer_lost_drill(out_dir=None) -> bool:
+    """One typed-PeerLost conversion on a 2-rank heartbeat board: the
+    peer beats once, goes silent past the deadline, and ``check()`` must
+    raise typed `PeerLost` (docs/ROBUSTNESS.md "Multi-host training").
+    Returns True when the typed error fired. The ONE implementation the
+    micro-drill scenario and ``bench --smoke``'s ``peer_lost_typed_ok``
+    key both run — the contract cannot drift between them."""
+    import json
+    import tempfile
+    import time
+
+    from paddle_tpu.distributed.liveness import LivenessMonitor, PeerLost
+    d = out_dir or tempfile.mkdtemp(prefix="peer_lost_drill_")
+    mon = LivenessMonitor(d, rank=0, world=2, deadline_s=0.02)
+    # the beat lands AFTER the monitor's birth (pre-birth beats read as
+    # stale files from a previous incarnation and fall under grace)
+    with open(os.path.join(d, "hb-1.json"), "w") as f:
+        json.dump({"rank": 1, "step": 3, "t": time.time()}, f)
+    mon.beat(4)
+    mon.check()                         # fresh peer: healthy
+    time.sleep(0.06)                    # peer goes silent past deadline
+    try:
+        mon.check(context="peer-lost drill")
+    except PeerLost:
+        return True
+    return False
 
 
 # ------------------------------------------------------------ micro drill
@@ -168,7 +190,16 @@ def _micro_scenarios():
             return
         raise AssertionError("corrupt stream record was not refused")
 
-    return [slow_steps, pool_pressure, blob_corrupt, stream_corrupt]
+    def peer_death(eng):
+        # the multi-host liveness contract, engine-free: a 2-rank
+        # heartbeat board whose peer went silent past the deadline must
+        # raise typed PeerLost (never hang) — the shared drill bench
+        # --smoke's `peer_lost_typed_ok` also runs
+        del eng
+        assert peer_lost_drill(), "silent peer was not typed PeerLost"
+
+    return [slow_steps, pool_pressure, blob_corrupt, stream_corrupt,
+            peer_death]
 
 
 def run_micro(iterations: int = 2, model=None, out_dir: str = ".") -> int:

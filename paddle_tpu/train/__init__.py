@@ -9,13 +9,27 @@ buffer donation, in-program bad-step skip. Engine
 fault_tolerance.py — preemption-safe checkpointing around the step:
 durable checksummed checkpoints with a crash-consistent LATEST pointer,
 SIGTERM -> drain -> checkpoint -> exit, kill -9 resume with bit-identical
-loss trajectory, and the consecutive-bad-step rollback ladder.
+loss trajectory, and the consecutive-bad-step rollback ladder. On a
+multi-host fleet: per-rank key-partitioned shard writes published behind
+a coordination-KV barrier (complete-or-invisible fleet-wide).
+
+elastic.py — elastic multi-host training: the FleetReducer (cross-process
+grad averaging + the SIGTERM stop vote), per-step liveness heartbeats
+converting dead-peer collective hangs into typed PeerLost on every
+survivor, and the ElasticController that relaunches the fleet at the
+surviving world size from the last fleet-complete checkpoint.
 """
 from paddle_tpu.train.scan_step import ScanTrainStep, ScanUnsupported
 from paddle_tpu.train.fault_tolerance import (CheckpointCorrupt,
                                               CheckpointIncomplete,
                                               CheckpointManager,
                                               TooManyBadSteps)
+from paddle_tpu.train.elastic import (EXIT_PEER_LOST, ElasticController,
+                                      FleetReducer, PeerLost,
+                                      elastic_worker_main,
+                                      run_elastic_worker)
 
 __all__ = ["ScanTrainStep", "ScanUnsupported", "CheckpointManager",
-           "TooManyBadSteps", "CheckpointCorrupt", "CheckpointIncomplete"]
+           "TooManyBadSteps", "CheckpointCorrupt", "CheckpointIncomplete",
+           "PeerLost", "FleetReducer", "ElasticController",
+           "run_elastic_worker", "elastic_worker_main", "EXIT_PEER_LOST"]

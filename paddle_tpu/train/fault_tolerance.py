@@ -56,11 +56,13 @@ from paddle_tpu.distributed.checkpoint import (CheckpointCorrupt,
                                                CheckpointIncomplete,
                                                async_save, load_sharded,
                                                save_sharded)
+from paddle_tpu.distributed.liveness import PeerLost
 from paddle_tpu.observability import metrics
 from paddle_tpu.observability.flight_recorder import flight
+from paddle_tpu.testing import faults
 
 __all__ = ["CheckpointManager", "TooManyBadSteps", "CheckpointCorrupt",
-           "CheckpointIncomplete"]
+           "CheckpointIncomplete", "PeerLost"]
 
 # `step-<n>` plus optional rewrite generation `-r<k>`: re-saving at an
 # unchanged step number (resume -> cursor-only advance -> finalize) writes
@@ -86,22 +88,24 @@ class CheckpointManager:
                           explicit `save()` calls)
     keep                : retention — newest N complete checkpoints survive
     max_consecutive_bad : bad-step ladder threshold (0 disables rollback)
-    use_async           : background writes by default; `save(sync=True)`
-                          and the SIGTERM path force synchronous
+    use_async           : background writes by default; `save(sync=True)`,
+                          the SIGTERM path, and EVERY multihost save
+                          force synchronous
+    world               : (rank, size) — auto-detected from the launch
+                          env / jax runtime. size > 1 turns on the fleet
+                          publication protocol (key-partitioned shard
+                          writes, pre-COMPLETE barrier, rank-0 publish;
+                          docs/ROBUSTNESS.md "Multi-host training");
+                          root must then be a SHARED filesystem
+    barrier             : injectable rendezvous ``fn(tag)`` (tests);
+                          None = the coordination-service KV barrier
+    barrier_timeout_s   : barrier wait bound — past it the save raises
+                          typed PeerLost and stays invisible
     """
 
     def __init__(self, root, step=None, *, every=0, keep=3,
-                 max_consecutive_bad=3, use_async=True):
-        if jax.process_count() > 1:
-            # save_sharded itself writes per-process shard files fine, but
-            # the publication protocol (COMPLETE -> LATEST -> prune) needs
-            # a cross-process barrier before the marker lands, or rank 0
-            # could publish while rank 1's shards are still in flight —
-            # refuse loudly rather than break "complete or invisible"
-            raise NotImplementedError(
-                "CheckpointManager is single-controller; multi-host "
-                "publication needs a barrier before COMPLETE/LATEST "
-                "(coordination-service KV is the substrate — not wired)")
+                 max_consecutive_bad=3, use_async=True, world=None,
+                 barrier=None, barrier_timeout_s=120.0):
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
         self._step = step
@@ -109,17 +113,101 @@ class CheckpointManager:
         self.keep = max(1, int(keep))
         self.max_consecutive_bad = int(max_consecutive_bad)
         self.use_async = bool(use_async)
+        # multi-host publication (docs/ROBUSTNESS.md "Multi-host
+        # training"): world=(rank, size) — auto-detected from the launch
+        # env / jax runtime. Each rank writes its key-partition of the
+        # state (distributed/checkpoint.py shard_owner); a pre-COMPLETE
+        # barrier over the coordination-service KV orders every rank's
+        # shards BEFORE rank 0 publishes COMPLETE -> LATEST, so "complete
+        # or invisible" holds fleet-wide: a rank that dies mid-save stalls
+        # the barrier, which resolves as typed PeerLost on every survivor
+        # and the checkpoint stays invisible. The root must be a shared
+        # filesystem (the same constraint as the registry's NodeRegistry).
+        if world is None:
+            rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                      jax.process_index()))
+            size = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                      jax.process_count()))
+            world = (rank, size)
+        self._rank, self._world_size = int(world[0]), int(world[1])
+        self._barrier_fn = barrier          # injectable (tests); None = KV
+        self.barrier_timeout_s = float(barrier_timeout_s)
+        self._save_seq = 0                  # lockstep save counter (dir
+        #                                     rendezvous key sequencing)
+        self._kv_garbage = []               # superseded barrier tags / dir
+        #                                     keys, cleaned after the NEXT
+        #                                     save's first barrier
         self._lock = threading.Lock()   # LATEST/prune vs writer thread
         self._pending = None            # (thread, dir) of in-flight async
         self._stop = threading.Event()
         self._resumed_from = None       # never pruned while we depend on it
         self._last_saved = -1
 
+    @property
+    def multihost(self):
+        return self._world_size > 1
+
     def bind(self, step):
         """Attach the ScanTrainStep (hapi's Model.fit creates the step
         itself, so its manager is constructed unbound)."""
         self._step = step
         return self
+
+    # ---------------------------------------------------- fleet rendezvous
+    def _barrier(self, tag):
+        """One fleet rendezvous (multihost only): every rank arrives or
+        the wait resolves as typed PeerLost — a barrier that cannot
+        complete means a peer died between its shard writes and
+        publication, and the checkpoint must stay invisible. The
+        ``ckpt.barrier_timeout`` chaos site forces exactly that outcome
+        deterministically."""
+        if faults.ENABLED and faults.fire("ckpt.barrier_timeout"):
+            metrics.counter("train.peer_lost").inc()
+            raise PeerLost(
+                f"checkpoint barrier {tag!r} timed out (injected via "
+                "ckpt.barrier_timeout) — a peer never arrived; the "
+                "checkpoint stays unpublished")
+        t0 = time.perf_counter()
+        try:
+            if self._barrier_fn is not None:
+                self._barrier_fn(tag)
+            else:
+                from paddle_tpu.distributed import liveness
+                from paddle_tpu.distributed.collective import _kv_client
+                liveness.kv_barrier(
+                    _kv_client(), tag, rank=self._rank,
+                    world=self._world_size,
+                    timeout_ms=int(self.barrier_timeout_s * 1e3))
+        except PeerLost:
+            raise
+        except Exception as e:  # noqa: BLE001 — classify timeout as typed
+            from paddle_tpu.distributed.liveness import is_timeout
+            if is_timeout(e):
+                metrics.counter("train.peer_lost").inc()
+                raise PeerLost(
+                    f"checkpoint barrier {tag!r} timed out after "
+                    f"{self.barrier_timeout_s}s — a peer never arrived "
+                    f"({e})") from e
+            raise
+        metrics.histogram("train.barrier_seconds").observe(
+            time.perf_counter() - t0)
+
+    def _drain_kv_garbage(self):
+        """Rank 0 deletes KV keys from the PREVIOUS save — provably
+        unread once the current save's first barrier has completed (see
+        liveness.kv_barrier's deferral contract)."""
+        if self._rank != 0 or self._barrier_fn is not None:
+            return
+        with self._lock:
+            garbage, self._kv_garbage = list(self._kv_garbage), []
+        from paddle_tpu.distributed import liveness
+        from paddle_tpu.distributed.collective import _kv_client
+        client = _kv_client()
+        for kind, val in garbage:
+            if kind == "bar":
+                liveness.kv_barrier_cleanup(client, val)
+            else:
+                liveness.clear_with_marker(client, val)
 
     # ------------------------------------------------------------ directory
     def _dir(self, n):
@@ -186,11 +274,9 @@ class CheckpointManager:
             meta["data_cursor"] = data_cursor
         return {"params": s._params, "opt": s._opt_state, "meta": meta}
 
-    def _finalize(self, path):
-        """Publish a fully-written checkpoint: COMPLETE marker, atomic
-        LATEST move-forward, retention. Runs on the WRITER thread for
-        async saves — everything here happens after the last shard byte
-        landed, which is the whole crash-consistency protocol."""
+    def _publish(self, path):
+        """COMPLETE marker + atomic LATEST move-forward + retention — the
+        single-writer half of publication (rank 0 in a fleet)."""
         with open(os.path.join(path, "COMPLETE"), "w") as f:
             f.write("ok\n")
         n = self._step_of(path)
@@ -202,9 +288,39 @@ class CheckpointManager:
                     f.write(os.path.basename(path) + "\n")
                 os.replace(tmp, os.path.join(self.root, "LATEST"))
             self._prune(protect=path)
+
+    def _finalize(self, path):
+        """Publish a fully-written checkpoint: COMPLETE marker, atomic
+        LATEST move-forward, retention. Runs on the WRITER thread for
+        async saves — everything here happens after the last shard byte
+        landed, which is the whole crash-consistency protocol.
+
+        Multihost: a pre-COMPLETE barrier orders EVERY rank's shards
+        before rank 0 publishes, and a post-publication barrier keeps any
+        rank from racing ahead of the visible LATEST — either barrier
+        failing (a dead peer, ``ckpt.barrier_timeout``) raises typed
+        PeerLost with the checkpoint still invisible."""
+        base = os.path.basename(path)
+        if self.multihost:
+            self._barrier(f"{base}/shards")
+            # every rank is past the previous save's barriers now — its
+            # KV keys are provably unread and safe to delete
+            self._drain_kv_garbage()
+            if self._rank == 0:
+                self._publish(path)
+            self._barrier(f"{base}/pub")
+            if self._rank == 0:
+                # only rank 0 drains the list — other ranks appending
+                # would just grow dead weight forever
+                with self._lock:
+                    self._kv_garbage += [("bar", f"{base}/shards"),
+                                         ("bar", f"{base}/pub")]
+        else:
+            self._publish(path)
+        n = self._step_of(path)
         metrics.counter("train.checkpoints").inc()
-        flight.record("train.checkpoint_complete", step=n,
-                      path=os.path.basename(path))
+        flight.record("train.checkpoint_complete", step=n, path=base,
+                      rank=self._rank)
 
     def _prune(self, protect=None):
         """Keep the newest ``keep`` COMPLETE checkpoints. Never removes the
@@ -232,32 +348,97 @@ class CheckpointManager:
             if self._is_complete(p) or n < newest_done:
                 shutil.rmtree(p, ignore_errors=True)
 
+    def _choose_dir(self, n):
+        """The save target for step ``n`` — `step-<n>` or a fresh
+        ``-r<k>`` rewrite generation when the dir already exists. In a
+        fleet the choice must be AGREED (two ranks scanning a shared dir
+        mid-save would split the checkpoint across generations), so rank
+        0 decides and publishes the basename under a sequenced KV key —
+        the save counter advances in lockstep on every rank."""
+        d = self._dir(n)
+
+        def _occupied(p):
+            if not self.multihost:
+                return os.path.isdir(p)
+            # fleet rule: a dir only counts as a PRIOR save once it wears
+            # COMPLETE or the deciding rank's own index — another rank's
+            # in-flight partial (it chose this name for the SAME save)
+            # must not push the decider onto a fresh generation
+            return os.path.exists(os.path.join(p, "COMPLETE")) \
+                or os.path.exists(os.path.join(p, "index.p0.json"))
+
+        def _scan():
+            out = d
+            if _occupied(out):
+                k = 1
+                while _occupied(f"{out}-r{k}"):
+                    k += 1
+                out = f"{out}-r{k}"
+            return out
+
+        if not self.multihost or self._barrier_fn is not None:
+            # single host, or an injected-barrier harness (one process
+            # emulating ranks): the local scan is already deterministic
+            return _scan()
+        from paddle_tpu.distributed import liveness
+        from paddle_tpu.distributed.collective import _kv_client
+        client = _kv_client()
+        key = f"ptpu_ckpt_dir/{self._save_seq}"
+        if self._rank == 0:
+            d = _scan()
+            if os.path.isdir(d):
+                # exists but wears neither COMPLETE nor a rank-0 index: a
+                # crash leftover, invisible by protocol — wipe it BEFORE
+                # publishing the name, or its stale partial indexes
+                # (possibly from a LARGER world) would merge into the
+                # checkpoint this save is about to publish and overwrite
+                # fresh shards with old-trajectory values. Safe exactly
+                # because no rank writes before the rendezvous resolves.
+                shutil.rmtree(d, ignore_errors=True)
+            liveness.set_with_marker(client, key,
+                                     os.path.basename(d).encode())
+        else:
+            raw = liveness.guarded_get_bytes(
+                client, key, int(self.barrier_timeout_s * 1e3),
+                what=f"checkpoint dir rendezvous {self._save_seq}")
+            d = os.path.join(self.root, bytes(raw).decode())
+        if self._rank == 0:             # rank 0 owns the KV cleanup
+            with self._lock:
+                self._kv_garbage.append(("key", key))
+        return d
+
     def save(self, *, data_cursor=None, sync=None):
         """Write a checkpoint of the bound step's CURRENT state. Joins any
         outstanding async write first (propagating its failure). Async
         saves return after the host snapshot — `train.checkpoint_seconds`
-        observes exactly that blocking stall."""
+        observes exactly that blocking stall. NEVER degrades an existing
+        dir: a re-save at an unchanged step writes a fresh ``-r<k>``
+        generation beside it; LATEST re-points only once the new one is
+        COMPLETE, so a crash mid-rewrite leaves the old checkpoint fully
+        durable. In a fleet every rank must call save at the same step
+        (the training loop is lockstep by construction)."""
         self.wait()
         n = int(self._step.opt._global_step)
-        d = self._dir(n)
-        if os.path.isdir(d):
-            # re-save at an unchanged step (resume then cursor-only
-            # advance): NEVER degrade the existing dir — write a fresh
-            # generation beside it; LATEST re-points only once the new
-            # one is COMPLETE, so a crash mid-rewrite leaves the old
-            # checkpoint fully durable
-            k = 1
-            while os.path.isdir(f"{d}-r{k}"):
-                k += 1
-            d = f"{d}-r{k}"
+        self._save_seq += 1
+        d = self._choose_dir(n)
+        part = (self._rank, self._world_size) if self.multihost else None
         use_async = self.use_async if sync is None else not sync
+        if self.multihost:
+            # fleet saves are SYNCHRONOUS: the publication barrier is a
+            # rendezvous every rank must reach at the same save, and this
+            # jaxlib's coordination client is not safe for concurrent use
+            # from a writer thread racing the step loop's own KV
+            # collectives (observed SEGV) — the whole fleet pauses at the
+            # boundary together, so there is nothing to overlap anyway
+            use_async = False
         t0 = time.perf_counter()
         state = self._state(data_cursor)
         if use_async:
-            th = async_save(state, d, on_complete=self._finalize)
+            th = async_save(state, d, on_complete=self._finalize,
+                            partition=part)
             self._pending = (th, d)
         else:
-            save_sharded(state, d)
+            save_sharded(state, d, partition=part)
             self._finalize(d)
         stall = time.perf_counter() - t0
         metrics.histogram("train.checkpoint_seconds").observe(stall)

@@ -85,10 +85,22 @@ class ScanTrainStep:
     microbatches: default split of each step's batch (scan + f32 grad
                   accumulation, single optimizer apply)
     zero1       : True / False / "auto" (on when the mesh's dp axis > 1)
+    grad_reducer: optional ``(loss, grads) -> (loss, grads)`` host hook
+                  for CROSS-PROCESS data parallelism (multi-host fleets
+                  whose jaxlib cannot compile one program over all
+                  processes — `train/elastic.py` FleetReducer averages
+                  through the coordination-service KV). When set the step
+                  SPLITS into two programs: a grads program (loss +
+                  pre-clip f32 grads out), the reducer on the host, then
+                  a donated apply program (finite-check + clip + fused
+                  update over the REDUCED values, so every rank skips or
+                  applies identically). None (the default) keeps the
+                  single fused program — bit-identical to before.
     """
 
     def __init__(self, model, optimizer, *, microbatches=1, zero1="auto",
-                 mesh=None, axis="dp", use_loss_mask=False, seed=0):
+                 mesh=None, axis="dp", use_loss_mask=False, seed=0,
+                 grad_reducer=None):
         from paddle_tpu.models.gpt import GPTForCausalLM
         from paddle_tpu.nn.clip import ClipGradByGlobalNorm
         if not isinstance(model, GPTForCausalLM):
@@ -130,6 +142,7 @@ class ScanTrainStep:
         self.bad_steps = 0
         self.consecutive_bad_steps = 0
         self.last_step_ok = True
+        self._grad_reducer = grad_reducer
         self.refresh_from_model()
         if self.mesh is not None:
             # pin the output placements to the input placements: params and
@@ -138,10 +151,22 @@ class ScanTrainStep:
             # program compiles exactly once on the mesh
             repl = NamedSharding(self.mesh, PartitionSpec())
             out_sh = (repl, repl, self._param_sh, self._state_sh)
-            self._jit = jax.jit(self._make_step_fn(),
-                                donate_argnums=(0, 1), out_shardings=out_sh)
         else:
-            self._jit = jax.jit(self._make_step_fn(), donate_argnums=(0, 1))
+            out_sh = None
+        if grad_reducer is None:
+            self._jit = jax.jit(self._make_step_fn(), donate_argnums=(0, 1),
+                                **({"out_shardings": out_sh}
+                                   if out_sh is not None else {}))
+            self._jit_grads = self._jit_apply = None
+        else:
+            # split pipeline: grads out (params NOT donated — the apply
+            # still reads them), host reduce, donated apply. Two programs,
+            # each compiling exactly once (test_no_retrace pin).
+            self._jit = None
+            self._jit_grads = jax.jit(self._make_grads_fn())
+            self._jit_apply = jax.jit(
+                self._make_apply_fn(), donate_argnums=(0, 1),
+                **({"out_shardings": out_sh} if out_sh is not None else {}))
 
     # ------------------------------------------------------------- state io
 
@@ -282,11 +307,14 @@ class ScanTrainStep:
 
     # ------------------------------------------------------------- the step
 
-    def _make_step_fn(self):
+    def _make_grads_fn(self):
+        """(params, xs, ys, ms, key_data, poison) -> (loss, f32 grads) —
+        the forward/backward half: scan over layers, microbatch
+        accumulation, NO optimizer math. Standalone program in reducer
+        mode; inlined by `_make_step_fn` for the fused single-program
+        path (identical op sequence either way)."""
         from paddle_tpu.models.gpt import scan_loss
         cfg, mesh = self.cfg, self.mesh
-        names, update = self._state_names, self._update
-        meta, clip_norm = self._meta, self._clip_norm
         use_mask = self.use_loss_mask
 
         def loss_fn(params, x, y, m, key):
@@ -329,15 +357,28 @@ class ScanTrainStep:
             return lsum * inv, jax.tree_util.tree_map(
                 lambda a: a * inv, gsum)
 
-        def step_fn(params, opt_state, xs, ys, ms, lr, t, key_data, poison):
+        def grads_fn(params, xs, ys, ms, key_data, poison):
             key = jax.random.wrap_key_data(key_data)
             mkeys = jax.random.split(key, xs.shape[0])
             loss, grads = grads_of(params, xs, ys, ms if use_mask else None,
                                    mkeys)
             # poison: 0.0 normally, NaN when the train.step_nan fault is
-            # armed — rides the loss into the finite reduce below so chaos
-            # tests drive the skip path through the SAME compiled program
-            loss = loss + poison
+            # armed — rides the loss into the finite reduce so chaos tests
+            # drive the skip path through the SAME compiled program(s). In
+            # reducer mode the poisoned loss travels THROUGH the reduce,
+            # so one rank's injected NaN skips the step on every rank.
+            return loss + poison, grads
+
+        return grads_fn
+
+    def _make_apply_fn(self):
+        """(params, opt_state, loss, grads, lr, t) -> (loss, ok,
+        new_params, new_state) — the optimizer half: all-finite reduce,
+        global-norm clip, fused update, in-program bad-step skip."""
+        names, update = self._state_names, self._update
+        meta, clip_norm = self._meta, self._clip_norm
+
+        def apply_fn(params, opt_state, loss, grads, lr, t):
             # all-finite reduce over loss + raw (pre-clip) grads: one
             # non-finite value anywhere makes ok False and the apply below
             # becomes the identity — the step is SKIPPED in-program, no
@@ -390,6 +431,19 @@ class ScanTrainStep:
                 new_state[grp][k] = out
             return loss, ok, new_params, new_state
 
+        return apply_fn
+
+    def _make_step_fn(self):
+        """The fused single-program path: grads half composed with apply
+        half inside ONE donated program — the exact op sequence the
+        pre-split implementation traced, so losses stay bit-identical."""
+        grads_fn = self._make_grads_fn()
+        apply_fn = self._make_apply_fn()
+
+        def step_fn(params, opt_state, xs, ys, ms, lr, t, key_data, poison):
+            loss, grads = grads_fn(params, xs, ys, ms, key_data, poison)
+            return apply_fn(params, opt_state, loss, grads, lr, t)
+
         return step_fn
 
     def step(self, x, y, loss_mask=None, microbatches=None):
@@ -436,9 +490,25 @@ class ScanTrainStep:
         t0 = time.perf_counter()
         from jax.experimental import disable_x64
         with disable_x64():
-            loss, ok, self._params, self._opt_state = self._jit(
-                self._params, self._opt_state, xs, ys, ms, lr, t,
-                jax.random.key_data(sub), poison)
+            if self._grad_reducer is None:
+                loss, ok, self._params, self._opt_state = self._jit(
+                    self._params, self._opt_state, xs, ys, ms, lr, t,
+                    jax.random.key_data(sub), poison)
+            else:
+                # split pipeline (cross-process dp): local grads program,
+                # host-side reduce over the fleet (the reducer raises
+                # typed PeerLost when a peer dies mid-step), donated
+                # apply over the REDUCED loss+grads — ok/skip decisions
+                # are computed from identical values on every rank
+                g_loss, grads = self._jit_grads(
+                    self._params, xs, ys, ms,
+                    jax.random.key_data(sub), poison)
+                g_loss, grads = self._grad_reducer(g_loss, grads)
+                grads = jax.tree_util.tree_map(
+                    lambda a: jnp.asarray(a, jnp.float32), grads)
+                loss, ok, self._params, self._opt_state = self._jit_apply(
+                    self._params, self._opt_state,
+                    jnp.asarray(g_loss, jnp.float32), grads, lr, t)
         lossf = float(loss)                        # sync: real device time
         okb = bool(ok)
         dt = time.perf_counter() - t0
@@ -503,7 +573,11 @@ class ScanTrainStep:
 
     def _cache_size(self):
         try:
-            return self._jit._cache_size()
+            if self._jit is not None:
+                return self._jit._cache_size()
+            # split (reducer) mode: compile accounting covers BOTH programs
+            return (self._jit_grads._cache_size()
+                    + self._jit_apply._cache_size())
         except Exception:  # noqa: BLE001 — jax internals moved
             return -1
 

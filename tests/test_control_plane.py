@@ -715,7 +715,9 @@ class TestSoakHarness:
         path = soak.dump_ring(str(tmp_path), label="cp_test")
         with open(path) as f:
             dump = json.load(f)
+        # ONE artifact shape across soak and the liveness PeerLost dump
+        # (flight_recorder.dump_ring): {label, events, metrics}
         assert dump["label"] == "cp_test"
         assert any(ev.get("kind") == "soak.test_marker"
-                   for ev in dump["flight"])
+                   for ev in dump["events"])
         assert "counters" in dump["metrics"]

@@ -493,3 +493,35 @@ def test_dedup_attach_and_replay_zero_recompiles():
     assert _compile_counters() == frozen, (
         "dedup attach/replay compiled a program: the table must answer "
         "without touching the device")
+
+
+def test_elastic_split_step_compiles_once_then_never():
+    """The elastic split train step (paddle_tpu/train/elastic.py: local
+    grads program -> host fleet reduce -> donated apply program) compiles
+    each of its TWO programs exactly once; batch-content churn and stop-
+    vote churn through the reducer never retrace — the 'zero recompiles
+    after the one post-reform compile' half of the elastic-restart
+    contract, pinned without spawning a fleet."""
+    from paddle_tpu.train import FleetReducer, ScanTrainStep
+    m = _tiny_model()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    reducer = FleetReducer()          # world-1 degenerate fleet
+    step = ScanTrainStep(m, opt, microbatches=2, grad_reducer=reducer)
+    rng = np.random.RandomState(3)
+
+    def batch():
+        ids = rng.randint(0, 64, (4, 9))
+        return ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int64)
+
+    step.step(*batch())               # the ONE compile step (both programs)
+    assert step.compile_count == 1
+    frozen = _compile_counters()
+    for i in range(4):
+        reducer.request_stop = bool(i % 2)   # stop-vote churn rides the
+        step.step(*batch())                  # reduce payload, not a shape
+    assert step.compile_count == 1, (
+        f"split step recompiled: {step.compile_count}")
+    assert _compile_counters() == frozen, (
+        "jit.compile_count grew on batch/stop-vote churn through the "
+        "split grads/apply pipeline")

@@ -388,6 +388,11 @@ def test_bench_smoke_emits_structured_json():
     assert d["migrate_ok"] is True
     assert d["metrics"]["counters"]["engine.migrations_out"] >= 1
     assert d["metrics"]["counters"]["engine.migrations_in"] >= 1
+    # r14: the smoke run drives one typed PeerLost through the liveness
+    # monitor (a silent peer past the heartbeat deadline — the collective
+    # hang watchdog of docs/ROBUSTNESS.md "Multi-host training")
+    assert d["peer_lost_typed_ok"] is True
+    assert d["metrics"]["counters"]["train.peer_lost"] >= 1
     # r12: the smoke run drives a 2-iteration soak micro drill
     # (paddle_tpu/testing/soak.py — rotated fault orderings, typed
     # outcomes, page-clean pool) which includes an idempotency-dedup

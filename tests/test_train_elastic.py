@@ -1,0 +1,677 @@
+"""Elastic multi-host training chaos suite (docs/ROBUSTNESS.md
+"Multi-host training").
+
+The contract under test: distributed training terminates in bounded time
+with a checkpoint or a TYPED error, exactly like serving requests do —
+(1) per-rank heartbeats + liveness-guarded collective waits convert a
+dead peer into `PeerLost` on every survivor within the deadline, (2) the
+multi-host CheckpointManager publishes COMPLETE/LATEST only after EVERY
+rank's key-partitioned shards landed (fleet-wide complete-or-invisible,
+barrier-ordered), and (3) the ElasticController reforms the fleet at the
+surviving world size and resumes from the last fleet-complete checkpoint
+with a bit-identical loss trajectory and one post-reform compile.
+
+Tier-1 runs the cheap in-process pins (fake KV client, stub barrier,
+world-emulating managers, fake controller procs, the split-step parity
+sibling, the loader stall ladder); the REAL multi-process kill -9 /
+SIGTERM drills are slow-marked (tests/test_wall_budget.py pins the
+split)."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import liveness
+from paddle_tpu.distributed.checkpoint import shard_owner, load_sharded
+from paddle_tpu.distributed.liveness import (LivenessMonitor, PeerLost,
+                                             guarded_get_bytes, kv_barrier,
+                                             kv_barrier_cleanup,
+                                             set_with_marker)
+from paddle_tpu.observability import metrics
+from paddle_tpu.testing import faults
+from paddle_tpu.train import (EXIT_PEER_LOST, CheckpointManager,
+                              CheckpointIncomplete, ElasticController,
+                              FleetReducer, ScanTrainStep)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.disarm()
+    liveness.uninstall()
+
+
+def _counter(name):
+    return metrics.snapshot()["counters"].get(name, 0)
+
+
+def _tiny_step(seed=5, reducer=None):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+                    intermediate_size=32, max_position_embeddings=8,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    return ScanTrainStep(m, opt, microbatches=1, grad_reducer=reducer)
+
+
+def _batch(i, b=2, s=8, vocab=64):
+    rng = np.random.RandomState(1000 + i)
+    ids = rng.randint(0, vocab, (b, s + 1))
+    return ids[:, :-1].astype(np.int32), ids[:, 1:].astype(np.int32)
+
+
+# ------------------------------------------------------- liveness monitor
+
+
+def _fake_peer_beat(d, rank, step, t=None):
+    with open(os.path.join(d, f"hb-{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "step": step,
+                   "t": time.time() if t is None else t}, f)
+
+
+def test_monitor_silent_peer_is_typed_peer_lost(tmp_path):
+    """A peer whose heartbeat aged past the deadline raises typed
+    PeerLost naming it, counts train.peer_lost, and dumps the flight
+    ring to a post-mortem JSON."""
+    d = str(tmp_path / "hb")
+    mon = LivenessMonitor(d, rank=0, world=3, deadline_s=0.05)
+    _fake_peer_beat(d, 1, step=7)
+    _fake_peer_beat(d, 2, step=7)
+    mon.beat(8)
+    mon.check()                              # everyone fresh: healthy
+    time.sleep(0.12)
+    _fake_peer_beat(d, 2, step=8)            # rank 2 keeps beating
+    lost0 = _counter("train.peer_lost")
+    with pytest.raises(PeerLost, match=r"peer\(s\) \[1\] silent"):
+        mon.check(context="unit")
+    assert _counter("train.peer_lost") == lost0 + 1
+    # the raiser published its own tombstone for the fast cascade
+    assert os.path.exists(os.path.join(d, "lost-0.json"))
+
+
+def test_monitor_cascade_via_tombstone(tmp_path):
+    """A peer's PeerLost tombstone cascades IMMEDIATELY — a survivor must
+    not wait out its own full deadline once the first detector has
+    spoken (the staggered-exit hard-kill lesson)."""
+    d = str(tmp_path / "hb")
+    mon = LivenessMonitor(d, rank=1, world=3, deadline_s=30.0)
+    _fake_peer_beat(d, 0, step=4)
+    _fake_peer_beat(d, 2, step=4)
+    mon.beat(4)
+    mon.check()
+    with open(os.path.join(d, "lost-0.json"), "w") as f:
+        json.dump({"rank": 0, "silent": [2], "t": time.time()}, f)
+    with pytest.raises(PeerLost, match=r"reported PeerLost"):
+        mon.check()
+
+
+def test_monitor_grace_window_covers_slow_starts(tmp_path):
+    """A peer with NO heartbeat file yet is only lost after the startup
+    grace window — fresh processes need import/compile time."""
+    d = str(tmp_path / "hb")
+    mon = LivenessMonitor(d, rank=0, world=2, deadline_s=0.05, grace_s=30.0)
+    mon.beat(0)
+    time.sleep(0.12)
+    mon.check()                              # no file, within grace: fine
+    mon2 = LivenessMonitor(d, rank=0, world=2, deadline_s=0.05,
+                           grace_s=0.01)
+    time.sleep(0.05)
+    with pytest.raises(PeerLost):
+        mon2.check()
+
+
+def test_monitor_ignores_previous_incarnation_files(tmp_path):
+    """A relaunched fleet reusing the heartbeat dir: heartbeats AND
+    tombstones from before the monitor's birth read as absent (grace-
+    governed) — attempt 0's corpse files must never insta-kill attempt 1
+    into a guaranteed-unrecoverable restart loop."""
+    d = str(tmp_path / "hb")
+    os.makedirs(d)
+    with open(os.path.join(d, "hb-1.json"), "w") as f:
+        json.dump({"rank": 1, "step": 5, "t": time.time() - 0.05}, f)
+    with open(os.path.join(d, "lost-1.json"), "w") as f:
+        json.dump({"rank": 1, "silent": [0], "t": time.time() - 0.05}, f)
+    time.sleep(0.02)
+    mon = LivenessMonitor(d, rank=0, world=2, deadline_s=0.01, grace_s=60)
+    mon.beat(0)
+    mon.check()                 # both leftovers ignored: healthy
+    # a FRESH beat that then goes silent still detects normally
+    _fake_peer_beat(d, 1, step=0)
+    time.sleep(0.05)
+    with pytest.raises(PeerLost, match="silent"):
+        mon.check()
+
+
+def test_rebeat_keeps_waiting_rank_alive(tmp_path):
+    """rebeat() renews the heartbeat at the SAME step: a rank alive but
+    blocked on a dead peer must not read as dead to other survivors."""
+    d = str(tmp_path / "hb")
+    mon = LivenessMonitor(d, rank=0, world=2, deadline_s=10.0)
+    mon.beat(3)
+    t1 = json.load(open(os.path.join(d, "hb-0.json")))["t"]
+    time.sleep(0.02)
+    mon.rebeat()
+    info = json.load(open(os.path.join(d, "hb-0.json")))
+    assert info["t"] > t1 and info["step"] == 3
+
+
+# --------------------------------------------- guarded KV reads + barrier
+
+
+class _FakeKV:
+    """Dict-backed stand-in for the coordination-service client — the
+    marker/listing surface the guarded reads use."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_set_bytes(self, k, v):
+        if k in self.kv:
+            raise RuntimeError(f"ALREADY_EXISTS: {k}")
+        self.kv[k] = bytes(v)
+
+    def blocking_key_value_get_bytes(self, k, timeout_ms):
+        if k in self.kv:
+            return self.kv[k]
+        raise RuntimeError(f"DEADLINE_EXCEEDED: GetKeyValue({k})")
+
+    def key_value_dir_get(self, prefix):
+        return [(k, v.decode()) for k, v in sorted(self.kv.items())
+                if k.startswith(prefix.rstrip("/") + "/")]
+
+    def key_value_delete(self, k):
+        if k.endswith("/"):
+            for kk in [x for x in self.kv if x.startswith(k)]:
+                del self.kv[kk]
+        else:
+            self.kv.pop(k, None)
+
+
+def test_guarded_get_marker_protocol(tmp_path):
+    """set_with_marker publishes payload then ASCII marker; a guarded
+    read returns the payload once the marker is present, raises typed
+    PeerLost when the writer is silent past the deadline, and plain
+    TimeoutError when the fleet is healthy but the value never comes."""
+    d = str(tmp_path / "hb")
+    kv = _FakeKV()
+    mon = LivenessMonitor(d, rank=0, world=2, deadline_s=5.0)
+    _fake_peer_beat(d, 1, step=0)
+    mon.beat(0)
+    set_with_marker(kv, "data/k1", b"payload")
+    assert guarded_get_bytes(kv, "data/k1", 1000, monitor=mon) == b"payload"
+    # healthy peer (fresh heartbeat) but the value never comes: bounded
+    # TimeoutError, not a hang and not a false PeerLost
+    t0 = time.time()
+    with pytest.raises(TimeoutError):
+        guarded_get_bytes(kv, "data/k2", 600, monitor=mon)
+    assert time.time() - t0 < 10
+    # silent peer: typed PeerLost well before the transport timeout (the
+    # peer's beat predates mon_fast's birth, so it reads as ABSENT — a
+    # tiny grace window converts absent-past-grace into the typed error)
+    mon_fast = LivenessMonitor(d, rank=0, world=2, deadline_s=0.05,
+                               grace_s=0.01)
+    mon_fast.last_step = 0
+    time.sleep(0.12)
+    with pytest.raises(PeerLost):
+        guarded_get_bytes(kv, "data/k2", 60_000, monitor=mon_fast)
+
+
+def test_guarded_get_without_monitor_is_plain_blocking(tmp_path):
+    """No monitor installed: byte-for-byte the pre-guard behavior (one
+    blocking call, marker ignored) — single-host paths unchanged."""
+    kv = _FakeKV()
+    kv.kv["raw/k"] = b"v"               # payload WITHOUT marker
+    assert guarded_get_bytes(kv, "raw/k", 100) == b"v"
+
+
+def test_kv_barrier_polls_and_cleans(tmp_path):
+    """The polling barrier returns once every rank's arrival key is
+    listed, raises typed PeerLost via the monitor when one never
+    arrives, and kv_barrier_cleanup sweeps a superseded tag."""
+    kv = _FakeKV()
+    kv.key_value_set_bytes("ptpu_bar/t1/1", b"1")   # peer already arrived
+    kv_barrier(kv, "t1", rank=0, world=2, timeout_ms=2000)
+    assert "ptpu_bar/t1/0" in kv.kv
+    kv_barrier_cleanup(kv, "t1")
+    assert not [k for k in kv.kv if k.startswith("ptpu_bar/t1/")]
+    # a never-arriving peer whose heartbeat goes silent: typed (the
+    # fresh beat ages past the deadline across the barrier's polls)
+    d = str(tmp_path / "hb")
+    mon = LivenessMonitor(d, rank=0, world=2, deadline_s=0.05)
+    mon.beat(0)
+    _fake_peer_beat(d, 1, step=0)
+    with pytest.raises(PeerLost):
+        kv_barrier(kv, "t2", rank=0, world=2, timeout_ms=60_000,
+                   monitor=mon)
+
+
+# ------------------------------------- multi-host checkpoint publication
+
+
+def test_multihost_partitioned_save_is_complete_only_with_all_ranks(
+        tmp_path):
+    """Each rank writes only its key-partition; the merged indexes cover
+    the full state only when EVERY rank's shards landed — and restore
+    refuses a checkpoint missing a rank's partition with typed
+    CheckpointIncomplete."""
+    root = str(tmp_path / "ck")
+    step = _tiny_step()
+    step.step(*_batch(0))
+    barrier_tags = []
+    mgr1 = CheckpointManager(root, step, world=(1, 2),
+                             barrier=barrier_tags.append)
+    mgr0 = CheckpointManager(root, step, world=(0, 2),
+                             barrier=barrier_tags.append)
+    # rank 1 first: shards land, NOTHING published (rank 1 never writes
+    # COMPLETE/LATEST)
+    mgr1.save(data_cursor=1)
+    assert mgr1.latest() is None
+    # rank 0: shards + barrier + publication
+    mgr0.save(data_cursor=1)
+    lat = mgr0.latest()
+    assert lat is not None
+    assert os.path.exists(os.path.join(lat[1], "COMPLETE"))
+    assert [t for t in barrier_tags if t.endswith("/shards")]
+    # partition is real: each rank's partial index holds only its keys
+    for pid in (0, 1):
+        idx = json.load(open(os.path.join(lat[1], f"index.p{pid}.json")))
+        keys = [k for k in idx if k != "__ckpt_meta__"
+                and "literal" not in idx[k]]
+        assert keys, f"rank {pid} wrote no array leaves"
+        assert all(shard_owner(k, 2) == pid for k in keys)
+    # full restore round-trips through the merged indexes
+    step2 = _tiny_step(seed=99)
+    info = CheckpointManager(root, step2, world=(0, 1)).restore(require=True)
+    assert info["data_cursor"] == 1
+    np.testing.assert_array_equal(
+        np.asarray(step2._params["top"]["gpt.wte.weight"]),
+        np.asarray(step._params["top"]["gpt.wte.weight"]))
+    # drop rank 1's index: the checkpoint is structurally incomplete
+    os.remove(os.path.join(lat[1], "index.p1.json"))
+    with pytest.raises((CheckpointIncomplete,)):
+        CheckpointManager(root, _tiny_step(seed=7),
+                          world=(0, 1)).restore(require=True)
+
+
+def test_multihost_barrier_timeout_leaves_checkpoint_invisible(tmp_path):
+    """ckpt.barrier_timeout (a peer died between its shard writes and
+    publication): the save raises typed PeerLost and NO COMPLETE/LATEST
+    appears — complete-or-invisible holds fleet-wide."""
+    root = str(tmp_path / "bt")
+    step = _tiny_step()
+    step.step(*_batch(0))
+    mgr = CheckpointManager(root, step, world=(0, 2), barrier=lambda t: None)
+    lost0 = _counter("train.peer_lost")
+    with faults.scoped("ckpt.barrier_timeout", times=1):
+        with pytest.raises(PeerLost, match="barrier"):
+            mgr.save(data_cursor=1)
+    assert _counter("train.peer_lost") == lost0 + 1
+    assert mgr.latest() is None
+    assert not os.path.exists(os.path.join(root, "LATEST"))
+    assert not any(os.path.exists(os.path.join(root, n, "COMPLETE"))
+                   for n in os.listdir(root)
+                   if os.path.isdir(os.path.join(root, n)))
+    # the fleet recovers: the next save publishes normally
+    mgr.save(data_cursor=1)
+    assert mgr.latest() is not None
+
+
+def test_multihost_crash_between_shards_stays_invisible(tmp_path):
+    """A rank dying between its OWN shard files (ckpt.crash_between_
+    shards) never reaches the barrier — the checkpoint stays invisible
+    on the publishing side too (rank 0 would wait at the barrier; here
+    the single emulated rank raises before publication)."""
+    root = str(tmp_path / "cb")
+    step = _tiny_step()
+    step.step(*_batch(0))
+    mgr = CheckpointManager(root, step, world=(0, 2), barrier=lambda t: None)
+    with faults.scoped("ckpt.crash_between_shards", times=1):
+        with pytest.raises(faults.FaultInjected):
+            mgr.save(data_cursor=1)
+    assert mgr.latest() is None
+    assert not os.path.exists(os.path.join(root, "LATEST"))
+
+
+def test_multihost_forces_synchronous_saves(tmp_path):
+    """Fleet saves are synchronous regardless of use_async: the
+    publication barrier is a rendezvous the step loop must not race (and
+    this jaxlib's KV client is not concurrency-safe — observed SEGV)."""
+    root = str(tmp_path / "sy")
+    step = _tiny_step()
+    step.step(*_batch(0))
+    mgr = CheckpointManager(root, step, world=(0, 1), use_async=True)
+    assert not mgr.multihost            # world 1: plain single-host
+    mgr2 = CheckpointManager(root, step, world=(0, 2), use_async=True,
+                             barrier=lambda t: None)
+    mgr2.save(data_cursor=1)
+    assert mgr2._pending is None, "multihost save went async"
+
+
+# ------------------------------------------------------ fleet grad reduce
+
+
+def test_fleet_reducer_world1_identity_and_stop_vote():
+    """Degenerate 1-rank fleet: the reducer is an identity on loss/grads
+    (mean over one row) and the stop vote reflects the local flag."""
+    red = FleetReducer()
+    loss = np.float32(2.5)
+    grads = {"blocks": {"w": np.ones((2, 3), np.float32) * 4},
+             "top": {"b": np.arange(3, dtype=np.float32)}}
+    out_loss, out = red(loss, grads)
+    assert float(out_loss) == 2.5 and not red.fleet_stop
+    np.testing.assert_array_equal(out["blocks"]["w"], grads["blocks"]["w"])
+    np.testing.assert_array_equal(out["top"]["b"], grads["top"]["b"])
+    red.request_stop = True
+    red(loss, grads)
+    assert red.fleet_stop
+
+
+def test_fleet_reducer_means_ranks_and_ors_stop(monkeypatch):
+    """Cross-rank semantics without a fleet: patch the allgather to
+    return a crafted 2-rank stack — grads/loss must rank-mean in f32,
+    the stop flag must OR."""
+    import jax
+    from paddle_tpu.distributed import collective
+    captured = {}
+
+    def fake_allgather(flat):
+        captured["flat"] = np.asarray(flat)
+        other = np.asarray(flat).copy()
+        other[:-1] = other[:-1] + 1.0          # peer's grads/loss differ
+        other[-1] = 1.0                        # peer votes STOP
+        return np.stack([np.asarray(flat), other])
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(collective, "_proc_allgather", fake_allgather)
+    red = FleetReducer()
+    grads = {"blocks": {"w": np.full((2, 2), 2.0, np.float32)},
+             "top": {"b": np.zeros(3, np.float32)}}
+    out_loss, out = red(np.float32(3.0), grads)
+    assert red.fleet_stop                      # peer's vote propagated
+    assert float(out_loss) == pytest.approx(3.5)
+    np.testing.assert_allclose(out["blocks"]["w"], 2.5)
+    np.testing.assert_allclose(out["top"]["b"], 0.5)
+    # stop flag rode the payload: last element of the packed vector
+    assert captured["flat"][-1] == 0.0
+
+
+def test_split_step_bit_identical_to_fused():
+    """THE cheap parity sibling for the elastic drill: the split
+    grads/apply pipeline with an identity reducer produces losses
+    BIT-IDENTICAL (repr-equal) to the fused single-program step — the
+    determinism the resume-parity acceptance rests on."""
+    fused = _tiny_step()
+    ref = [fused.step(*_batch(i)) for i in range(3)]
+    split = _tiny_step(reducer=FleetReducer())
+    got = [split.step(*_batch(i)) for i in range(3)]
+    assert [repr(a) for a in ref] == [repr(b) for b in got]
+    assert split.compile_count == 1
+
+
+# -------------------------------------------------------- the controller
+
+
+class _FakeProc:
+    def __init__(self, rc):
+        self._rc = rc
+        self.killed = False
+
+    def poll(self):
+        return self._rc
+
+    def wait(self, timeout=None):
+        return self._rc
+
+    def kill(self):
+        self.killed = True
+        self._rc = -9
+
+
+def test_controller_decides_next_world():
+    ctl = ElasticController(lambda w, a: [], world_size=4,
+                            allowed_sizes=(1, 2, 4), min_world=1)
+    assert ctl.decide_next_world([23, 23, 23, -9]) == 2
+    assert ctl.decide_next_world([23, -9, -9, -9]) == 1
+    assert ctl.decide_next_world([-9, -9, -9, -9]) == 0
+    ctl2 = ElasticController(lambda w, a: [], world_size=4,
+                             allowed_sizes=(2, 4), min_world=2)
+    assert ctl2.decide_next_world([23, -9, -9, -9]) == 0   # min_world bites
+
+
+def test_controller_relaunches_at_surviving_world():
+    """Attempt 0 loses a rank (-9) with three typed survivors; the
+    controller relaunches at the largest allowed size <= survivors and
+    counts train.elastic_restarts."""
+    script = {0: [EXIT_PEER_LOST, EXIT_PEER_LOST, EXIT_PEER_LOST, -9],
+              1: [0, 0]}
+    seen = []
+
+    def spawn(world, attempt):
+        seen.append((world, attempt))
+        return [_FakeProc(rc) for rc in script[attempt]]
+
+    r0 = _counter("train.elastic_restarts")
+    ctl = ElasticController(spawn, world_size=4, allowed_sizes=(1, 2, 4),
+                            max_restarts=2, settle_s=1.0, poll_s=0.01)
+    assert ctl.run() == 0
+    assert seen == [(4, 0), (2, 1)]
+    assert ctl.attempts[0][0] == 4 and ctl.attempts[1][0] == 2
+    assert _counter("train.elastic_restarts") == r0 + 1
+
+
+def test_controller_gives_up_past_restart_budget():
+    def spawn(world, attempt):
+        return [_FakeProc(EXIT_PEER_LOST), _FakeProc(-9)]
+
+    ctl = ElasticController(spawn, world_size=2, allowed_sizes=(1, 2),
+                            max_restarts=1, settle_s=1.0, poll_s=0.01)
+    assert ctl.run() == 1
+    assert len(ctl.attempts) == 2       # initial + one restart, then stop
+
+
+def test_controller_kills_stragglers_after_settle():
+    """A survivor that NEVER detects the death is killed after settle_s
+    — the controller must not inherit the hang it exists to break."""
+    class _Hung(_FakeProc):
+        def __init__(self):
+            super().__init__(None)
+
+        def poll(self):
+            return self._rc
+
+    hung = _Hung()
+
+    def spawn(world, attempt):
+        return [_FakeProc(-9), hung]
+
+    ctl = ElasticController(spawn, world_size=2, allowed_sizes=(1, 2),
+                            max_restarts=0, settle_s=0.1, poll_s=0.01)
+    assert ctl.run() == 1
+    assert hung.killed
+
+
+# --------------------------------------------------- loader stall ladder
+
+
+class _RowsDs:
+    """Module-level so it pickles into spawn workers."""
+
+    def __init__(self, n=64):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return (np.full((3,), i, np.float32), np.array([i], np.int64))
+
+
+def test_loader_stall_retries_once_then_delivers():
+    """One injected stall (loader.stall): the ladder re-enqueues the
+    in-flight batches and the epoch still delivers every sample exactly
+    once, counting dataloader.stall_retries."""
+    from paddle_tpu.io import DataLoader
+    r0 = _counter("dataloader.stall_retries")
+    faults.arm("loader.stall", times=1)
+    dl = DataLoader(_RowsDs(), batch_size=8, num_workers=2, shuffle=False,
+                    use_shared_memory=True)
+    xs = [np.asarray(x._data)[:, 0] for x, _ in dl]
+    flat = sorted(np.concatenate(xs).tolist())
+    assert flat == list(range(64)), "stall retry dropped or duped a batch"
+    assert _counter("dataloader.stall_retries") == r0 + 1
+
+
+class _WedgedDs(_RowsDs):
+    """Every item takes a minute: the worker pool is alive but will
+    never deliver within the test's stall windows."""
+
+    def __getitem__(self, i):
+        time.sleep(60)
+        return super().__getitem__(i)
+
+
+def test_loader_stall_twice_in_a_row_is_typed():
+    """A genuinely wedged worker pool: the first silent window spends
+    the one bounded retry, the second IN A ROW (no delivery between)
+    surfaces as typed DataLoaderStalled instead of hanging fit()
+    forever."""
+    from paddle_tpu.io import DataLoader, DataLoaderStalled
+    dl = DataLoader(_WedgedDs(8), batch_size=2, num_workers=2,
+                    shuffle=False, use_shared_memory=True,
+                    stall_timeout=0.1)
+    t0 = time.time()
+    with pytest.raises(DataLoaderStalled, match="twice"):
+        list(dl)
+    assert time.time() - t0 < 60, "typed failure was not bounded"
+
+
+# --------------------------------------- REAL multi-process drills (slow)
+
+
+def _losses_of(path):
+    out = {}
+    for line in open(path):
+        if line.startswith("STEP "):
+            parts = line.split()
+            out[int(parts[1])] = parts[2]
+    return out
+
+
+@pytest.mark.slow          # tier-1 wall audit: the 4-process kill -9 +
+#   relaunch drill costs ~40 s of subprocess compiles; every invariant
+#   stays pinned tier-1 by cheap siblings — typed detection
+#   (test_monitor_silent_peer_is_typed_peer_lost + the guarded-get /
+#   barrier units), publication (test_multihost_partitioned_save_...),
+#   restart policy (test_controller_relaunches_at_surviving_world),
+#   parity (test_split_step_bit_identical_to_fused), retrace
+#   (test_no_retrace.py::test_elastic_split_step_compiles_once_then_never)
+#   — and bench --smoke emits peer_lost_typed_ok.
+@pytest.mark.timeout(600)
+def test_kill9_one_of_four_relaunches_at_dp2_bit_identical(tmp_path):
+    """THE acceptance drill: kill -9 one of 4 training processes
+    mid-step -> every survivor exits typed PeerLost (rc 23) within the
+    deadline -> the controller relaunches at dp2 from the last
+    fleet-complete checkpoint -> the loss trajectory is bit-identical
+    (repr-equal, stronger than the float-ulp bound) to an uninterrupted
+    dp2 run resumed from the same checkpoint, with exactly ONE
+    post-reform compile."""
+    import shutil
+
+    from paddle_tpu.train.elastic import spawn_local_fleet
+
+    root, logs = str(tmp_path / "ckpt"), str(tmp_path / "logs")
+    ref_root = str(tmp_path / "ckpt_ref")
+    until = 12
+    copied = {}
+
+    def spawn(world, attempt):
+        if attempt == 1 and not copied:
+            # snapshot the state the relaunch resumes from, for the
+            # uninterrupted-dp2 reference below
+            shutil.copytree(root, ref_root,
+                            ignore=shutil.ignore_patterns("hb*"))
+            copied["done"] = True
+
+        def env_for(rank):
+            if attempt == 0 and rank == 3:
+                # rank 3 SIGKILLs itself at its 6th step boundary —
+                # deterministically mid-run, past the step-4 checkpoint
+                return {"PADDLE_FAULTS": "train.peer_dead:times=6"}
+            return {}
+
+        return spawn_local_fleet(world, root=root, until_step=until,
+                                 log_dir=logs, every=2, deadline_s=6,
+                                 registry_dir=str(tmp_path / "reg"),
+                                 env_for_rank=env_for, attempt=attempt)
+
+    ctl = ElasticController(spawn, world_size=4, allowed_sizes=(1, 2, 4),
+                            max_restarts=2, settle_s=40,
+                            registry_dir=str(tmp_path / "reg"))
+    assert ctl.run() == 0, ctl.attempts
+    world0, rcs0 = ctl.attempts[0]
+    assert world0 == 4 and sorted(rcs0) == [-9, 23, 23, 23], (
+        f"survivors did not ALL exit typed: {rcs0}")
+    world1, rcs1 = ctl.attempts[1]
+    assert world1 == 2 and rcs1 == [0, 0]
+    for r in (0, 1):
+        assert "PeerLost" in open(f"{logs}/rank{r}.a0.log").read()
+
+    # uninterrupted dp2 reference from the SAME checkpoint
+    ref = spawn_local_fleet(2, root=ref_root, until_step=until,
+                            log_dir=str(tmp_path / "logs_ref"),
+                            every=2, deadline_s=6)
+    assert [p.wait(timeout=240) for p in ref] == [0, 0]
+    got = _losses_of(f"{logs}/rank0.a1.log")
+    want = _losses_of(str(tmp_path / "logs_ref" / "rank0.a0.log"))
+    assert got and got == want, f"trajectory diverged: {got} vs {want}"
+    done = next(line for line in open(f"{logs}/rank0.a1.log")
+                if line.startswith("DONE"))
+    assert "compiles=1" in done, done     # ONE post-reform compile
+
+
+@pytest.mark.slow          # see the audit note above; the coordinated-
+#   SIGTERM invariant keeps its cheap siblings in tier-1 (the stop-vote
+#   churn in the no-retrace pin + test_fleet_reducer_means_ranks_and_
+#   ors_stop) and PR 9's single-host SIGTERM drill still runs.
+@pytest.mark.timeout(420)
+def test_sigterm_any_rank_drains_whole_fleet_to_complete_checkpoint(
+        tmp_path):
+    """SIGTERM on ANY rank (here rank 1): the stop vote rides the next
+    gradient reduce, every rank stops at the SAME step boundary, the
+    fleet writes one barrier-published final checkpoint, and every rank
+    exits rc=0 — the multi-host mirror of serve's fleet drain."""
+    import signal
+
+    from paddle_tpu.train.elastic import spawn_local_fleet
+
+    root, logs = str(tmp_path / "ckpt"), str(tmp_path / "logs")
+    procs = spawn_local_fleet(2, root=root, until_step=10_000,
+                              log_dir=logs, every=2, deadline_s=8)
+    log1 = f"{logs}/rank1.a0.log"
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        txt = open(log1).read() if os.path.exists(log1) else ""
+        if sum(1 for line in txt.splitlines()
+               if line.startswith("STEP ")) >= 3:
+            procs[1].send_signal(signal.SIGTERM)
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("rank 1 never reached step 3")
+    assert [p.wait(timeout=180) for p in procs] == [0, 0]
+    latest = open(os.path.join(root, "LATEST")).read().strip()
+    assert os.path.exists(os.path.join(root, latest, "COMPLETE"))
+    loaded = load_sharded(os.path.join(root, latest))    # full verification
+    assert int(loaded["meta/global_step"]) >= 3
+    assert any(k.startswith("opt/") for k in loaded)
+    for r in (0, 1):
+        tail = open(f"{logs}/rank{r}.a0.log").read()
+        assert "stopped=True" in tail, f"rank {r} did not drain: {tail[-200:]}"
