@@ -59,6 +59,40 @@ def _init_backend():
         return None, f"{err}; cpu re-init failed: {type(e2).__name__}: {e2}"
 
 
+def _preflight(platform):
+    """Backend PREFLIGHT, run once BEFORE the ladder: `_init_backend` only
+    proves the platform plugin constructs — BENCH_r05's death shape was a
+    backend that initialized and then wedged on first USE, killing the
+    run with no parseable artifact (`parsed:null`). The preflight
+    EXECUTES one tiny op on the selected backend; on failure it re-inits
+    CPU in-process and re-probes, so the ladder runs its CPU rungs with
+    the original failure recorded in ``backend_error`` instead of dying.
+    Returns (platform|None, error|None); None platform means even CPU is
+    dead (caller re-execs the clean child). Fault site ``bench.preflight``
+    (PADDLE_FAULTS) drives the subprocess regression test."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.testing import faults
+
+    def probe():
+        if faults.ENABLED:
+            faults.fire("bench.preflight")   # armed with exc=: raises
+        jax.block_until_ready(jnp.zeros((2, 2)) + 1.0)
+
+    try:
+        probe()
+        return platform, None
+    except Exception as e:  # noqa: BLE001 — any first-use failure
+        err = f"preflight: {type(e).__name__}: {e}"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        probe()
+        return jax.default_backend(), err
+    except Exception as e2:  # noqa: BLE001
+        return None, f"{err}; cpu preflight failed: " \
+                     f"{type(e2).__name__}: {e2}"
+
+
 def _reexec_cpu_child(backend_error):
     """Last resort: this interpreter's jax is wedged beyond re-init — run the
     same bench invocation in a fresh CPU-pinned child and forward its output."""
@@ -611,6 +645,110 @@ def bench_paged_kernel():
             _i, q_, k_, v_, pt, pos))
         times[impl] = _measure(step, (q, kp, vp))
     return times
+
+
+def bench_prefill_kernel():
+    """Ragged PREFILL kernel microbench (registry op `prefill_attention`):
+    ONE prefill chunk's attention, xla gather reference vs the authored
+    Pallas ragged prefill kernel, GPT-2s serving geometry (12 heads,
+    dh=64, 16-token pages, 16-page slots, 64-token chunks) over a ragged
+    1-4-page context mix — per call the chunk sits at a different
+    absolute ``start``, so the length-aware stop is what's measured.
+    Pallas timed only on real TPU (interpret mode is a parity tool).
+    Emits its own structured JSON line."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels import paged_attention as pa
+    from paddle_tpu.kernels.autotune import _measure
+
+    nh, dh, ps, maxp, c = 12, 64, 16, 16, 64
+    num_pages = 1 + maxp
+    rng = np.random.RandomState(0)
+    kp = jnp.asarray(rng.randn(num_pages, ps, nh, dh).astype(np.float32))
+    vp = jnp.asarray(rng.randn(num_pages, ps, nh, dh).astype(np.float32))
+    row = jnp.asarray(1 + np.arange(maxp, dtype=np.int32))
+    # ragged mix: the chunk lands after 0, 1, 2, 3 pages of prior context
+    # (the prefix-cache / chunked-prefill shapes)
+    starts = [0, ps, 2 * ps, 3 * ps]
+    qs = [jnp.asarray(rng.randn(1, c, nh, dh).astype(np.float32))
+          for _ in starts]
+
+    times = {}
+    impls = ["xla", "pallas"] if _platform() == "tpu" else ["xla"]
+    for impl in impls:
+        total = 0.0
+        for q, start in zip(qs, starts):
+            step = jax.jit(
+                lambda q_, k_, v_, _i=impl, _s=start: pa._prefill_impl_call(
+                    _i, q_, k_, v_, row, jnp.int32(_s), jnp.int32(c)))
+            total += _measure(step, (q, kp, vp))
+        times[impl] = total / len(starts)
+    return times
+
+
+def bench_fused_sampler():
+    """Fused on-device sampler rung (kernels/sampling.py): 8 concurrent
+    sampled requests through a sampling engine vs the same 8 greedy, with
+    the de-sync contract ASSERTED — d2h transfers during the sampled run
+    stay token-harvest-only (one per decode step + one per prefill) and
+    `engine.logits_readback` stays 0. One request is parity-checked
+    bit-identical against `fast_generate`'s host sampler at the shared
+    seed. Emits its own structured JSON line."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.models.gpt import gpt2_small
+    from paddle_tpu.observability import metrics
+
+    paddle.seed(0)
+    model = gpt2_small(num_layers=2, hidden_size=256, num_heads=4,
+                       intermediate_size=512, vocab_size=1024,
+                       max_position_embeddings=512, hidden_dropout=0.0,
+                       attention_dropout=0.0)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 1024, 32 + 4 * i).astype(np.int32)
+               for i in range(8)]
+    n_new = 32
+
+    # bit-parity: one request vs the host sampler's key discipline
+    ref = np.asarray(model.fast_generate(
+        paddle.Tensor(prompts[0][None], _internal=True),
+        max_new_tokens=n_new, temperature=0.8, top_k=20, seed=11)
+        .numpy())[0]
+
+    def run(sampling):
+        eng = DecodeEngine(model, EngineConfig(
+            page_size=16, max_slots=8, min_bucket=32, sampling=sampling,
+            prefix_cache=False))
+        eng.warmup(prompt_lens=[len(p) for p in prompts])
+        c0 = metrics.snapshot()["counters"]
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=n_new,
+                           **(dict(temperature=0.8, top_k=20, seed=11)
+                              if sampling else {}))
+                for p in prompts]
+        eng.run_until_idle(max_steps=512)
+        outs = [r.result(timeout=120) for r in reqs]
+        dt = time.perf_counter() - t0
+        c1 = metrics.snapshot()["counters"]
+        delta = {k: c1.get(k, 0) - c0.get(k, 0)
+                 for k in ("engine.d2h_transfers", "engine.steps",
+                           "engine.requests", "engine.logits_readback")}
+        return outs, 8 * n_new / dt, delta
+
+    outs_s, tps_sampled, d_s = run(True)
+    outs_g, tps_greedy, d_g = run(False)
+    assert np.array_equal(outs_s[0], ref), \
+        "fused sampler diverged from the host sampler's key chain"
+    # the de-sync contract: readbacks are token harvests only — one per
+    # step + one per request's prefill — sampling adds ZERO
+    assert d_s["engine.logits_readback"] == 0, d_s
+    d2h_budget = d_s["engine.steps"] + d_s["engine.requests"]
+    assert d_s["engine.d2h_transfers"] <= d2h_budget, (d_s, d2h_budget)
+    return {"sampled_tok_s": tps_sampled, "greedy_tok_s": tps_greedy,
+            "d2h_per_step": d_s["engine.d2h_transfers"]
+            / max(d_s["engine.steps"], 1),
+            "logits_readback": d_s["engine.logits_readback"],
+            "parity": True}
 
 
 def bench_prefix_cache():
@@ -1781,6 +1919,36 @@ def bench_smoke():
     spec_accepted = snapc.get("engine.spec_accepted", 0)
     assert spec_accepted >= 0
 
+    # one FUSED-SAMPLER decode (kernels/sampling.py, r15): a sampled
+    # request through a sampling engine must be BIT-IDENTICAL to
+    # fast_generate's host sampler at the shared seed, with zero logits
+    # readbacks — emitted as `fused_sampler_ok` (asserted in
+    # tests/test_observability.py)
+    fs_prompt = ids[0, :4].astype(np.int32)
+    fs_ref = np.asarray(model.fast_generate(
+        paddle.Tensor(fs_prompt[None], _internal=True), max_new_tokens=3,
+        temperature=0.8, top_k=5, seed=9).numpy())[0]
+    fs_eng = DecodeEngine(model, EngineConfig(page_size=2, max_slots=2,
+                                              min_bucket=4, sampling=True))
+    fs_req = fs_eng.submit(fs_prompt, max_new_tokens=3, temperature=0.8,
+                           top_k=5, seed=9)
+    fs_eng.run_until_idle(max_steps=32)
+    fused_sampler_ok = bool(np.array_equal(fs_req.result(timeout=30),
+                                           fs_ref))
+    assert fused_sampler_ok, (fs_req.result(timeout=1), fs_ref)
+    snapf = metrics.snapshot()["counters"]
+    assert snapf.get("engine.logits_readback", 0) == 0, \
+        "an engine path read logits back to the host"
+    # the kernel registry dispatched every kernel selection this smoke
+    # made (flash/paged/prefill/fused-ce/fused-sampling all route through
+    # kernels/registry.py — the ONE dispatch layer)
+    kd = {k: v for k, v in snapf.items()
+          if k.startswith("kernel.dispatch.") and v}
+    for op in ("paged_attention", "prefill_attention", "fused_sampling",
+               "fused_ce"):
+        assert any(k.startswith(f"kernel.dispatch.{op}.") for k in kd), \
+            f"registry dispatch never fired for {op}: {sorted(kd)}"
+
     # one int8-KV decode step (docs/QUANTIZATION.md): the quantized engine
     # decodes through the same AOT discipline, and the parity key
     # `kv_quant_ok` pins the documented contract via the SAME helper
@@ -1945,7 +2113,7 @@ def bench_smoke():
     return (dt, batch * seq / dt, snap, slo, wd.dump_count == 0, router_ok,
             prefix_hits, spec_accepted, shed_count, cancelled_count,
             resume_ok, kv_quant_ok, migrate_ok, soak_ok, dedup_replays,
-            disagg_ok, peer_lost_typed_ok)
+            disagg_ok, peer_lost_typed_ok, fused_sampler_ok)
 
 
 def _retry(fn, attempts=3):
@@ -1967,12 +2135,28 @@ def main(argv=None):
     ap.add_argument("--smoke", action="store_true",
                     help="1 tiny CPU-OK train step + metrics snapshot; "
                          "always exits 0 with a parseable JSON line")
+    ap.add_argument("--preflight-only", action="store_true",
+                    help="run the backend preflight (init + one executed "
+                         "op, CPU fallback) and emit its JSON record "
+                         "without the ladder — the CI probe for the "
+                         "BENCH_r05 dead-backend shape")
     args = ap.parse_args(argv)
 
     platform, backend_error = _init_backend()
+    if platform is not None:
+        # PREFLIGHT: execute one op before committing to the ladder — an
+        # initialized-but-wedged backend falls back to CPU rungs with the
+        # original failure recorded, instead of the parsed:null death
+        platform, pf_error = _preflight(platform)
+        backend_error = backend_error or pf_error
     # a CPU child inherits the parent's original failure for the artifact
     backend_error = backend_error or \
         os.environ.get("PTPU_BENCH_BACKEND_ERROR") or None
+    if args.preflight_only:
+        _emit({"metric": "bench_preflight", "value": 1.0 if platform else 0.0,
+               "unit": "ok", "ok": platform is not None,
+               "platform": platform, "backend_error": backend_error})
+        return
     if platform is None:
         if not os.environ.get("PTPU_BENCH_CHILD"):
             sys.exit(_reexec_cpu_child(backend_error))
@@ -1988,7 +2172,8 @@ def main(argv=None):
             (dt, tps, snap, slo, wd_clean, router_ok, prefix_hits,
              spec_accepted, shed_count, cancelled_count,
              resume_ok, kv_quant_ok, migrate_ok, soak_ok,
-             dedup_replays, disagg_ok, peer_lost_typed_ok) = bench_smoke()
+             dedup_replays, disagg_ok, peer_lost_typed_ok,
+             fused_sampler_ok) = bench_smoke()
             impls = {k.rsplit(".", 1)[-1]: v
                      for k, v in snap["counters"].items()
                      if k.startswith("paged_attention.impl.") and v}
@@ -2007,6 +2192,9 @@ def main(argv=None):
                    "soak_ok": soak_ok,
                    "disagg_ok": disagg_ok,
                    "peer_lost_typed_ok": peer_lost_typed_ok,
+                   "fused_sampler_ok": fused_sampler_ok,
+                   "logits_readback": snap["counters"].get(
+                       "engine.logits_readback", 0),
                    "dedup_replays": dedup_replays,
                    "prefill_chunks": snap["counters"].get(
                        "engine.prefill_chunks", 0),
@@ -2164,6 +2352,37 @@ def main(argv=None):
     except Exception as e:
         _emit({"metric": "paged_attention_step_seconds", "value": 0.0,
                "unit": "s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
+    try:
+        ptimes = _retry(bench_prefill_kernel)
+        _emit({"metric": "prefill_attention_chunk_seconds",
+               "value": round(min(ptimes.values()), 6), "unit": "s",
+               "ok": True, "platform": platform,
+               "impl_seconds": {k: round(v, 6) for k, v in ptimes.items()},
+               "geometry": "h12 dh64 page16 x16pages, 64-token chunk, "
+                           "ragged 1-4-page context mix"})
+    except Exception as e:
+        _emit({"metric": "prefill_attention_chunk_seconds", "value": 0.0,
+               "unit": "s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
+    try:
+        fs = _retry(bench_fused_sampler)
+        _emit({"metric": "fused_sampler_tokens_per_sec",
+               "value": round(fs["sampled_tok_s"], 1), "unit": "tokens/s",
+               "ok": True, "platform": platform,
+               "greedy_tokens_per_sec": round(fs["greedy_tok_s"], 1),
+               "d2h_per_step": round(fs["d2h_per_step"], 3),
+               "logits_readback": fs["logits_readback"],
+               "parity": fs["parity"],
+               "mix": "8x(32-60 prompt + 32 new), temp 0.8 top_k 20 vs "
+                      "greedy"})
+        print(f"# fused_sampler: sampled {fs['sampled_tok_s']:.0f} tok/s "
+              f"vs greedy {fs['greedy_tok_s']:.0f} tok/s, d2h/step="
+              f"{fs['d2h_per_step']:.2f}, logits_readback=0, bit-parity "
+              f"vs fast_generate", file=sys.stderr)
+    except Exception as e:
+        _emit({"metric": "fused_sampler_tokens_per_sec", "value": 0.0,
+               "unit": "tokens/s", "ok": False, "platform": platform,
                "backend_error": f"{type(e).__name__}: {e}"})
     try:
         on, off, pstats = _retry(bench_prefix_cache)

@@ -140,6 +140,15 @@ define_flag("tpu_paged_impl", "auto",
             "(authored ragged paged-attention kernel, kernels/pallas/"
             "paged_attention.py — page loop bounded by each sequence's true "
             "length; interpret mode off-TPU, parity tests only)")
+define_flag("tpu_prefill_impl", "auto",
+            "ragged prefill-attention backend (chunked prefill + prefix "
+            "tails + the PTKS1 prefill-worker stream): auto (measured "
+            "per-signature selection via the kernel registry, "
+            "kernels/registry.py) | xla (paged gather + absolute-position "
+            "masked softmax, traffic scales with pool capacity) | pallas "
+            "(authored ragged prefill kernel, kernels/pallas/"
+            "prefill_attention.py — page loop bounded by each request's "
+            "true context; interpret mode off-TPU, parity tests only)")
 define_flag("autotune_verbose", False,
             "log kernel autotune decisions with measured timings")
 define_flag("dy2static_max_trip_count", 0,

@@ -4,6 +4,8 @@ FusedMultiHeadAttention, FusedFeedForward, FusedMultiTransformer).
 On TPU "fused" means: one traced region XLA/Pallas fuses — attention goes through
 the flash-attention kernel, the MLP is a single jit region.
 """
+import functools
+
 from paddle_tpu.nn.layer import Layer
 from paddle_tpu.nn.layers.transformer import MultiHeadAttention
 from paddle_tpu.nn.layers.common import Linear, Dropout
@@ -96,6 +98,7 @@ class FusedLayerNorm(Layer):
         super().__init__()
         import numpy as _np
         from paddle_tpu.core.tensor import Parameter
+        from paddle_tpu.kernels import registry
         if isinstance(normalized_shape, int):
             normalized_shape = (normalized_shape,)
         if len(normalized_shape) != 1:
@@ -104,6 +107,13 @@ class FusedLayerNorm(Layer):
         self.epsilon = epsilon
         self.weight = Parameter(_np.ones(d, _np.float32))
         self.bias = Parameter(_np.zeros(d, _np.float32))
+        # registry-routed (kernels/registry.py): one pallas impl today —
+        # interpret mode off-TPU inside the kernel. Resolved ONCE at
+        # layer construction (forward runs EAGERLY per call — a
+        # per-forward dispatch would count thousands of times per step
+        # and drown the 'which kernel serves traffic' snapshot); a
+        # future xla candidate lands as a registry drop-in here
+        self._ln_impl = registry.dispatch("fused_layernorm")
 
     def forward(self, x):
         from paddle_tpu.core.autograd import apply
@@ -115,6 +125,15 @@ class FusedLayerNorm(Layer):
             x, self.weight, self.bias, op_name="fused_layer_norm")
 
 
+@functools.lru_cache(maxsize=1)
+def _rope_impl() -> str:
+    """Resolve (and count) the rope impl ONCE per process — the
+    functional runs eagerly per call, so an uncached dispatch would
+    count per invocation instead of per selection."""
+    from paddle_tpu.kernels import registry
+    return registry.dispatch("fused_rope")
+
+
 def fused_rotary_position_embedding(q, k, cos, sin, name=None):
     """Fused rope over the authored Pallas kernel
     (`paddle_tpu/kernels/pallas/rotary.py`; ref newer-branch `fused_rope`).
@@ -122,6 +141,7 @@ def fused_rotary_position_embedding(q, k, cos, sin, name=None):
     from paddle_tpu.core.autograd import apply
     from paddle_tpu.kernels.pallas import apply_rotary_emb
     from paddle_tpu.ops.common import ensure_tensor
+    _rope_impl()
     q, k = ensure_tensor(q), ensure_tensor(k)
     cos, sin = ensure_tensor(cos), ensure_tensor(sin)
     return apply(lambda a, b, c, s: apply_rotary_emb(a, b, c, s),

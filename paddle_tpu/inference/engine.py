@@ -191,6 +191,20 @@ class EngineConfig:
                    construction (quantization/serving.py), dequantized at
                    use inside the same AOT programs — same program count,
                    zero extra recompiles (tests/test_no_retrace.py)
+    sampling     : enable the FUSED ON-DEVICE SAMPLER (kernels/
+                   sampling.py, registry op `fused_sampling`): every step
+                   program applies temperature/top-k + the categorical
+                   draw to the logits ON DEVICE with per-slot PRNG key
+                   chains, so `submit(..., temperature=, top_k=, seed=)`
+                   samples with ZERO extra host round-trips —
+                   `engine.d2h_transfers` stays token-harvest-only and
+                   `engine.logits_readback` pins to 0. Per-slot params
+                   ride the packed state upload (one warm program for
+                   every request's knobs); greedy requests on a sampling
+                   engine run the argmax arm bit-identically to a
+                   non-sampling engine. Default off: the greedy-only
+                   program shapes stay byte-identical to every prior
+                   round
     dedup_capacity : bound on the idempotency dedup table (docs/
                    ROBUSTNESS.md "Control-plane HA"): requests submitted
                    with a client-generated ``request_key`` are remembered
@@ -217,6 +231,7 @@ class EngineConfig:
     max_queue_tokens: int | None = None
     kv_dtype: str = "native"
     weight_dtype: str = "native"
+    sampling: bool = False
     dedup_capacity: int = 1024
 
 
@@ -348,7 +363,8 @@ class GenerateRequest:
     def __init__(self, prompt: np.ndarray, max_new_tokens: int, trace=None,
                  cache: bool = True, speculate: bool = True,
                  deadline_s: float | None = None,
-                 request_key: bytes | None = None):
+                 request_key: bytes | None = None,
+                 temperature: float = 1.0, top_k: int = 0, seed: int = 0):
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.generated: list[int] = []
@@ -356,6 +372,13 @@ class GenerateRequest:
         self.trace = trace if trace is not None else RequestTrace()
         self.cache = bool(cache)          # prefix-cache participation
         self.speculate = bool(speculate)  # n-gram drafting participation
+        # fused on-device sampling params (EngineConfig.sampling): the
+        # defaults are the greedy arm — bit-identical to a non-sampling
+        # engine, key chain never advances
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self._seed_key = None    # lazily materialized PRNGKey(seed) words
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.deadline_t = None if deadline_s is None \
             else time.monotonic() + float(deadline_s)
@@ -539,6 +562,13 @@ class KVHandoff:
     cache_dtype: str            # numpy dtype name of the pool
     k_scales: np.ndarray | None = None   # [nl, n_pages, page_size, nh] f32
     v_scales: np.ndarray | None = None   # (int8 pools only)
+    # fused-sampler state for a SAMPLED request's handoff
+    # (EngineConfig.sampling): {"temperature": f, "top_k": i, "key":
+    # [k0, k1]} — the per-slot PRNG chain AS ADVANCED so far, so decode on
+    # the importing engine continues the bit-identical sampled sequence.
+    # None (incl. every legacy blob) = greedy. A sampled handoff into a
+    # non-sampling engine is a loud refusal (`_check_handoff`).
+    sample: dict | None = None
 
     MAGIC = b"PTKV1\n"
 
@@ -548,6 +578,8 @@ class KVHandoff:
             "first_token": int(self.first_token),
             "prompt_len": int(self.prompt.size),
             "pages_shape": [int(d) for d in self.k_pages.shape]}
+        if self.sample is not None:
+            head["sample"] = self.sample
         parts = [
             np.ascontiguousarray(self.prompt, np.int32).tobytes(),
             np.ascontiguousarray(self.k_pages).tobytes(),
@@ -602,7 +634,8 @@ class KVHandoff:
                                offset=off).reshape(sshape).copy()
         return cls(prompt=prompt, first_token=int(head["first_token"]),
                    k_pages=k, v_pages=v, page_size=int(head["page_size"]),
-                   cache_dtype=head["dtype"], k_scales=ks, v_scales=vs)
+                   cache_dtype=head["dtype"], k_scales=ks, v_scales=vs,
+                   sample=head.get("sample"))
 
 
 @dataclass
@@ -642,6 +675,11 @@ class MigrationItem:
     cache: bool = True
     speculate: bool = True
     request_key: bytes | None = None
+    # COLD sampled items re-enter a peer through plain submit, so the
+    # sampler restarts from scratch: {"temperature": f, "top_k": i,
+    # "seed": i}. WARM items carry their advanced chain inside
+    # ``handoff.sample`` instead. None = greedy (every legacy blob).
+    sample: dict | None = None
 
 
 MIG_MAGIC = b"PTMG1\n"
@@ -667,6 +705,8 @@ def pack_migration(item: MigrationItem) -> bytes:
         head["cache"] = False
     if not item.speculate:
         head["speculate"] = False
+    if item.sample is not None:
+        head["sample"] = item.sample
     if item.handoff is None:
         if item.prompt is None:
             raise ValueError("cold migration item has no prompt")
@@ -694,16 +734,17 @@ def unpack_migration(buf: bytes) -> MigrationItem:
     key = bytes.fromhex(head["key"]) if "key" in head else None
     cache = bool(head.get("cache", True))
     speculate = bool(head.get("speculate", True))
+    sample = head.get("sample")
     if head.get("warm"):
         return MigrationItem(max_new_tokens=mnt, deadline_ms=dl, tag=tag,
                              cache=cache, speculate=speculate,
-                             request_key=key,
+                             request_key=key, sample=sample,
                              handoff=KVHandoff.unpack(buf[off:]))
     s0 = int(head["prompt_len"])
     prompt = np.frombuffer(buf, np.int32, count=s0, offset=off).copy()
     return MigrationItem(max_new_tokens=mnt, deadline_ms=dl, tag=tag,
                          cache=cache, speculate=speculate,
-                         request_key=key, prompt=prompt)
+                         request_key=key, prompt=prompt, sample=sample)
 
 
 class DecodeEngine:
@@ -794,6 +835,17 @@ class DecodeEngine:
         # device-resident sampled-token chain + deferred-readback fifo of
         # (device tokens, [(slot, request)] snapshot, dispatch t0)
         self._tok_dev = jnp.zeros(B, jnp.int32)
+        # fused on-device sampling (EngineConfig.sampling): per-slot
+        # (temperature, top_k) host mirrors ride the packed upload, the
+        # PRNG key chains live ON DEVICE ([B+1, 2] uint32 — row B is the
+        # scratch row slotless prefills write, prefill_export/stream) and
+        # are threaded through every step program exactly like _tok_dev,
+        # so sampled decode reads back TOKENS only
+        self._sampling = bool(ecfg.sampling)
+        self._temps = np.ones(B, np.float32)
+        self._topks = np.zeros(B, np.int32)
+        self._keys_dev = jnp.zeros((B + 1, 2), jnp.uint32) \
+            if self._sampling else None
         self._inflight: deque = deque()
         self._blocked_s = 0.0                 # device-wait within this step
 
@@ -867,6 +919,10 @@ class DecodeEngine:
         self._m_requests = metrics.counter("engine.requests")
         self._m_h2d = metrics.counter("engine.h2d_transfers")
         self._m_d2h = metrics.counter("engine.d2h_transfers")
+        # pinned-to-zero proof of the fused sampler: NO engine path reads
+        # logits back to the host (sampling included) — the counter exists
+        # so tests/bench can assert the absence (docs/OBSERVABILITY.md)
+        self._m_logits_rb = metrics.counter("engine.logits_readback")
         self._m_chunks = metrics.counter("engine.prefill_chunks")
         self._m_prefill_tokens = metrics.counter("engine.prefill_tokens")
         self._m_prefix_hit = metrics.counter("engine.prefix_hit")
@@ -929,36 +985,68 @@ class DecodeEngine:
         # tpu_flash_impl in the jit ProgramCache)
         impl_flag = flag_value("tpu_paged_impl")
 
-        def step_fn(params, kc, vc, tokens, slot_state, *scales):
+        sampling = self._sampling
+
+        def step_fn(params, kc, vc, tokens, *rest):
             # slot_state: the ONE fused upload — [B, 3 + maxp] int32 of
             # (fresh token id, length, flags, page-table row); `tokens` is
             # the previous step's on-device output, overridden only for
             # slots the host admitted since the last dispatch. ``scales``
-            # is (k_scale, v_scale) on an int8-KV engine, else empty.
+            # is (k_scale, v_scale) on an int8-KV engine, else empty. On a
+            # SAMPLING engine the upload carries two more trailing columns
+            # (temperature bits, top_k) and the [B+1, 2] uint32 key-chain
+            # buffer rides between `tokens` and the upload — tokens AND
+            # keys stay on device step to step.
+            if sampling:
+                keys, slot_state, *scales = rest
+            else:
+                keys = None
+                slot_state, *scales = rest
             flags = slot_state[:, _COL_FLAGS]
             active = (flags & _FLAG_ACTIVE) != 0
             fresh = (flags & _FLAG_FRESH) != 0
             toks = jnp.where(fresh, slot_state[:, _COL_TOKEN], tokens)
             cache = dict(k_pages=kc, v_pages=vc,
-                         page_table=slot_state[:, _STATE_COLS:],
+                         page_table=slot_state[:,
+                                               _STATE_COLS:_STATE_COLS
+                                               + maxp],
                          lengths=slot_state[:, _COL_LENGTH])
             if scales:
                 cache.update(k_scale=scales[0], v_scale=scales[1])
             logits, cache = gpt_mod.decode_step(params, toks, cache,
                                                 active, cfg=cfg)
-            nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
-            nxt = jnp.where(active, nxt, toks)
-            out = (nxt, cache["k_pages"], cache["v_pages"])
+            if sampling:
+                from paddle_tpu.kernels.sampling import fused_sample
+                temps = jax.lax.bitcast_convert_type(
+                    slot_state[:, _STATE_COLS + maxp], jnp.float32)
+                topks = slot_state[:, _STATE_COLS + maxp + 1]
+                nxt, new_keys = fused_sample(logits, keys[:B], temps,
+                                             topks)
+                nxt = jnp.where(active, nxt.astype(toks.dtype), toks)
+                keys = keys.at[:B].set(
+                    jnp.where(active[:, None], new_keys, keys[:B]))
+                out = (nxt, keys, cache["k_pages"], cache["v_pages"])
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
+                nxt = jnp.where(active, nxt, toks)
+                out = (nxt, cache["k_pages"], cache["v_pages"])
             if scales:
                 out += (cache["k_scale"], cache["v_scale"])
             return out
 
         def build():
-            donate = ((1, 2) + ((5, 6) if self._quant_kv else ())) \
-                if self._donate else ()
-            args = [self._params, self._kc, self._vc,
-                    jnp.zeros(B, jnp.int32),
-                    jnp.zeros((B, _STATE_COLS + maxp), jnp.int32)]
+            if sampling:
+                donate = ((1, 2, 4) + ((6, 7) if self._quant_kv else ())) \
+                    if self._donate else ()
+                args = [self._params, self._kc, self._vc,
+                        jnp.zeros(B, jnp.int32), self._keys_dev,
+                        jnp.zeros((B, _STATE_COLS + maxp + 2), jnp.int32)]
+            else:
+                donate = ((1, 2) + ((5, 6) if self._quant_kv else ())) \
+                    if self._donate else ()
+                args = [self._params, self._kc, self._vc,
+                        jnp.zeros(B, jnp.int32),
+                        jnp.zeros((B, _STATE_COLS + maxp), jnp.int32)]
             args += self._scale_args()
             return jax.jit(step_fn, donate_argnums=donate).lower(
                 *args).compile()
@@ -982,36 +1070,72 @@ class DecodeEngine:
 
     def _prefill_exe(self, bucket: int):
         from paddle_tpu.models import gpt as gpt_mod
+        from paddle_tpu.framework.flags import flag_value
         cfg = self.cfg
         maxp = self.pages_per_slot
+        # the prefill-attention impl is baked into the traced program
+        # (kernels/registry.py) — the flag keys the cache like
+        # tpu_paged_impl keys the decode program
+        impl_flag = flag_value("tpu_prefill_impl")
 
-        def prefill_fn(params, kc, vc, packed, *scales):
-            # packed [bucket + 1 + maxp] int32: ids | true length | page row
-            # — one fused upload per admission
+        sampling = self._sampling
+
+        def prefill_fn(params, kc, vc, *rest):
+            # packed [bucket + 1 + maxp] int32: ids | true length | page
+            # row — one fused upload per admission. A SAMPLING engine
+            # appends [slot, key0, key1, temperature bits, top_k]: the
+            # first token samples through the fused sampler from the
+            # request's seed key and the advanced chain lands in the
+            # on-device key buffer at `slot` (row B = scratch for
+            # slotless export/stream prefills) — no key readback, the
+            # decode step picks the chain up where prefill left it.
+            if sampling:
+                keys, packed, *scales = rest
+            else:
+                keys = None
+                packed, *scales = rest
             ids = packed[:bucket]
             length = packed[bucket]
-            row = packed[bucket + 1:]
+            row = packed[bucket + 1:bucket + 1 + maxp]
             if scales:
                 logits, kc, vc, ks, vs = gpt_mod.prefill_step(
                     params, ids, length, row, kc, vc, cfg=cfg,
                     k_scale=scales[0], v_scale=scales[1])
+            else:
+                logits, kc, vc = gpt_mod.prefill_step(
+                    params, ids, length, row, kc, vc, cfg=cfg)
+            if sampling:
+                from paddle_tpu.kernels.sampling import sample_one
+                tail = packed[bucket + 1 + maxp:]
+                kseed = jax.lax.bitcast_convert_type(tail[1:3], jnp.uint32)
+                temp = jax.lax.bitcast_convert_type(tail[3], jnp.float32)
+                tok, new_key = sample_one(logits, kseed, temp, tail[4])
+                tok = tok.astype(ids.dtype)
+                keys = keys.at[tail[0]].set(new_key)
+                out = (tok, keys, kc, vc)
+            else:
                 tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)
-                return tok, kc, vc, ks, vs
-            logits, kc, vc = gpt_mod.prefill_step(
-                params, ids, length, row, kc, vc, cfg=cfg)
-            tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)
-            return tok, kc, vc
+                out = (tok, kc, vc)
+            if scales:
+                out += (ks, vs)
+            return out
 
         def build():
-            donate = ((1, 2) + ((4, 5) if self._quant_kv else ())) \
-                if self._donate else ()
-            args = [self._params, self._kc, self._vc,
-                    jnp.zeros(bucket + 1 + maxp, jnp.int32)]
+            if sampling:
+                donate = ((1, 2, 3) + ((5, 6) if self._quant_kv else ())) \
+                    if self._donate else ()
+                args = [self._params, self._kc, self._vc, self._keys_dev,
+                        jnp.zeros(bucket + 1 + maxp + 5, jnp.int32)]
+            else:
+                donate = ((1, 2) + ((4, 5) if self._quant_kv else ())) \
+                    if self._donate else ()
+                args = [self._params, self._kc, self._vc,
+                        jnp.zeros(bucket + 1 + maxp, jnp.int32)]
             args += self._scale_args()
             return jax.jit(prefill_fn, donate_argnums=donate).lower(
                 *args).compile()
 
-        return self._compiled(("prefill", bucket), build)
+        return self._compiled(("prefill", bucket, impl_flag), build)
 
     def _prefill_chunk_exe(self, c: int | None = None):
         """The chunk program serves two callers with one shape family:
@@ -1020,39 +1144,76 @@ class DecodeEngine:
         'prefill a window starting at an absolute position', which is
         exactly `prefill_chunk_step`'s contract."""
         from paddle_tpu.models import gpt as gpt_mod
+        from paddle_tpu.framework.flags import flag_value
         cfg = self.cfg
         maxp = self.pages_per_slot
         c = int(self.ecfg.prefill_chunk_tokens) if c is None else int(c)
+        impl_flag = flag_value("tpu_prefill_impl")   # keys the cache (see
+        #                                              _prefill_exe)
 
-        def chunk_fn(params, kc, vc, packed, *scales):
+        sampling = self._sampling
+
+        def chunk_fn(params, kc, vc, *rest):
             # packed [c + 2 + maxp] int32: chunk ids | start | valid | page
             # row — one fused upload per chunk, no readback until the final
-            # chunk's sampled token
+            # chunk's sampled token. A SAMPLING engine appends [slot, key0,
+            # key1, temperature bits, top_k, final]: only the FINAL chunk
+            # samples (and advances the chain at `slot`) — intermediate
+            # chunks leave tok at the argmax arm and the chain untouched,
+            # so the chain advances exactly once per emitted token.
+            if sampling:
+                keys, packed, *scales = rest
+            else:
+                keys = None
+                packed, *scales = rest
             ids = packed[:c]
             start = packed[c]
             valid = packed[c + 1]
-            row = packed[c + 2:]
+            row = packed[c + 2:c + 2 + maxp]
             if scales:
                 logits, kc, vc, ks, vs = gpt_mod.prefill_chunk_step(
                     params, ids, start, valid, row, kc, vc, cfg=cfg,
                     k_scale=scales[0], v_scale=scales[1])
+            else:
+                logits, kc, vc = gpt_mod.prefill_chunk_step(
+                    params, ids, start, valid, row, kc, vc, cfg=cfg)
+            if sampling:
+                from paddle_tpu.kernels.sampling import sample_one
+                tail = packed[c + 2 + maxp:]
+                kseed = jax.lax.bitcast_convert_type(tail[1:3], jnp.uint32)
+                temp = jax.lax.bitcast_convert_type(tail[3], jnp.float32)
+                tok_s, new_key = sample_one(logits, kseed, temp, tail[4])
+                final = tail[5] != 0
+                tok = jnp.where(final, tok_s.astype(ids.dtype),
+                                jnp.argmax(logits, axis=-1)
+                                .astype(ids.dtype))
+                slot = tail[0]
+                keys = keys.at[slot].set(
+                    jnp.where(final, new_key, keys[slot]))
+                out = (tok, keys, kc, vc)
+            else:
                 tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)
-                return tok, kc, vc, ks, vs
-            logits, kc, vc = gpt_mod.prefill_chunk_step(
-                params, ids, start, valid, row, kc, vc, cfg=cfg)
-            tok = jnp.argmax(logits, axis=-1).astype(ids.dtype)
-            return tok, kc, vc
+                out = (tok, kc, vc)
+            if scales:
+                out += (ks, vs)
+            return out
 
         def build():
-            donate = ((1, 2) + ((4, 5) if self._quant_kv else ())) \
-                if self._donate else ()
-            args = [self._params, self._kc, self._vc,
-                    jnp.zeros(c + 2 + maxp, jnp.int32)]
+            if sampling:
+                donate = ((1, 2, 3) + ((5, 6) if self._quant_kv else ())) \
+                    if self._donate else ()
+                args = [self._params, self._kc, self._vc, self._keys_dev,
+                        jnp.zeros(c + 2 + maxp + 6, jnp.int32)]
+            else:
+                donate = ((1, 2) + ((4, 5) if self._quant_kv else ())) \
+                    if self._donate else ()
+                args = [self._params, self._kc, self._vc,
+                        jnp.zeros(c + 2 + maxp, jnp.int32)]
             args += self._scale_args()
             return jax.jit(chunk_fn, donate_argnums=donate).lower(
                 *args).compile()
 
-        return self._compiled(("prefill_chunk", c), build)
+        return self._compiled(("prefill_chunk", c, impl_flag), build)
 
     def _verify_exe(self):
         """The speculative k-token verify step: ONE AOT program regardless
@@ -1063,9 +1224,20 @@ class DecodeEngine:
         B, maxp = self.ecfg.max_slots, self.pages_per_slot
         K = self._spec_k
 
-        def step_fn(params, kc, vc, tokens, slot_state, *scales):
+        sampling = self._sampling
+
+        def step_fn(params, kc, vc, tokens, *rest):
             # slot_state: [B, 4 + K + maxp] int32 — (fresh token, length,
-            # flags, draft_len, K drafted tokens, page-table row)
+            # flags, draft_len, K drafted tokens, page-table row); a
+            # SAMPLING engine appends (temperature bits, top_k) columns
+            # and threads the on-device key buffer like _decode_exe —
+            # verify_step's fused sample_state path advances each slot's
+            # chain by exactly its n_emitted splits
+            if sampling:
+                keys, slot_state, *scales = rest
+            else:
+                keys = None
+                slot_state, *scales = rest
             flags = slot_state[:, _COL_FLAGS]
             active = (flags & _FLAG_ACTIVE) != 0
             fresh = (flags & _FLAG_FRESH) != 0
@@ -1074,27 +1246,47 @@ class DecodeEngine:
             drafts = slot_state[:, _SPEC_COLS:_SPEC_COLS + K]
             tok_seq = jnp.concatenate([tok0[:, None], drafts], axis=1)
             cache = dict(k_pages=kc, v_pages=vc,
-                         page_table=slot_state[:, _SPEC_COLS + K:],
+                         page_table=slot_state[:,
+                                               _SPEC_COLS + K:
+                                               _SPEC_COLS + K + maxp],
                          lengths=slot_state[:, _COL_LENGTH])
             if scales:
                 cache.update(k_scale=scales[0], v_scale=scales[1])
-            emitted, n_emitted, cache = gpt_mod.verify_step(
-                params, tok_seq, draft_len, cache, active, cfg=cfg)
+            if sampling:
+                temps = jax.lax.bitcast_convert_type(
+                    slot_state[:, _SPEC_COLS + K + maxp], jnp.float32)
+                topks = slot_state[:, _SPEC_COLS + K + maxp + 1]
+                emitted, n_emitted, cache, new_keys = gpt_mod.verify_step(
+                    params, tok_seq, draft_len, cache, active, cfg=cfg,
+                    sample_state=(keys[:B], temps, topks))
+                keys = keys.at[:B].set(new_keys)
+            else:
+                emitted, n_emitted, cache = gpt_mod.verify_step(
+                    params, tok_seq, draft_len, cache, active, cfg=cfg)
             nxt = jnp.take_along_axis(
                 emitted, jnp.maximum(n_emitted - 1, 0)[:, None], axis=1)[:, 0]
             nxt = jnp.where(active, nxt, tok0)
-            out = (emitted, n_emitted, nxt, cache["k_pages"],
-                   cache["v_pages"])
+            out = (emitted, n_emitted, nxt) \
+                + ((keys,) if sampling else ()) \
+                + (cache["k_pages"], cache["v_pages"])
             if scales:
                 out += (cache["k_scale"], cache["v_scale"])
             return out
 
         def build():
-            donate = ((1, 2) + ((5, 6) if self._quant_kv else ())) \
-                if self._donate else ()
-            args = [self._params, self._kc, self._vc,
-                    jnp.zeros(B, jnp.int32),
-                    jnp.zeros((B, _SPEC_COLS + K + maxp), jnp.int32)]
+            if sampling:
+                donate = ((1, 2, 4) + ((6, 7) if self._quant_kv else ())) \
+                    if self._donate else ()
+                args = [self._params, self._kc, self._vc,
+                        jnp.zeros(B, jnp.int32), self._keys_dev,
+                        jnp.zeros((B, _SPEC_COLS + K + maxp + 2),
+                                  jnp.int32)]
+            else:
+                donate = ((1, 2) + ((5, 6) if self._quant_kv else ())) \
+                    if self._donate else ()
+                args = [self._params, self._kc, self._vc,
+                        jnp.zeros(B, jnp.int32),
+                        jnp.zeros((B, _SPEC_COLS + K + maxp), jnp.int32)]
             args += self._scale_args()
             return jax.jit(step_fn, donate_argnums=donate).lower(
                 *args).compile()
@@ -1263,7 +1455,8 @@ class DecodeEngine:
 
     def submit(self, prompt_ids, max_new_tokens=32, trace=None,
                cache=True, speculate=True,
-               deadline_s=None, request_key=None) -> GenerateRequest:
+               deadline_s=None, request_key=None,
+               temperature=1.0, top_k=0, seed=0) -> GenerateRequest:
         """Queue one prompt (1-D or [1, S] int array). Thread-safe.
         ``trace``: a `RequestTrace` created upstream (serve's wire-accept)
         so the SLO clock starts there; default starts it here.
@@ -1291,7 +1484,17 @@ class DecodeEngine:
         again. Absent key = legacy at-least-once, exactly the old
         behavior. Dedup hits bypass admission control — attaching to
         work already paid for costs nothing, so a draining or shedding
-        engine still answers them."""
+        engine still answers them.
+
+        ``temperature``/``top_k``/``seed`` (``EngineConfig.sampling``):
+        the fused on-device sampler's per-request knobs — the SAME
+        semantics and key discipline as ``fast_generate`` (temperature
+        before the top-k mask, one key split from ``PRNGKey(seed)`` per
+        sampled token), bit-identical output for a shared seed at B=1.
+        Non-greedy params on an engine built without ``sampling=True``
+        are a loud ValueError — there is no host-sampled fallback (that
+        fallback would be a per-step logits readback, exactly what the
+        fused sampler exists to kill)."""
         ids = np.asarray(
             prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids)
         ids = np.ascontiguousarray(ids).reshape(-1).astype(np.int32)
@@ -1308,10 +1511,12 @@ class DecodeEngine:
                 f"max_seq_len={self.max_seq_len}")
         if deadline_s is not None and float(deadline_s) <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        self._check_sample_params(temperature, top_k)
         key = self._dedup_key(request_key)
         req = GenerateRequest(ids, n, trace=trace, cache=cache,
                               speculate=speculate, deadline_s=deadline_s,
-                              request_key=key)
+                              request_key=key, temperature=temperature,
+                              top_k=top_k, seed=seed)
         # double-checked admission: the FIRST check fails a shed/dead/
         # draining submit fast, BEFORE the O(prompt) blake2b pass below —
         # admission control exists for exactly the moments that pass
@@ -1322,8 +1527,9 @@ class DecodeEngine:
         # a late shed is the cheap side of that race). The dedup lookup
         # runs BEFORE each admission check: an attach/replay must succeed
         # on a draining or full engine.
+        smp = (float(temperature), int(top_k), int(seed))
         with self._qlock:
-            prev = self._dedup_lookup(key, ids, n)
+            prev = self._dedup_lookup(key, ids, n, sample=smp)
             if prev is not None:
                 return prev
             self._check_admission(ids.size)
@@ -1332,7 +1538,7 @@ class DecodeEngine:
         with self._work:
             # authoritative dedup check, atomic with the enqueue: two
             # concurrent resubmits of one key must not both enqueue
-            prev = self._dedup_lookup(key, ids, n)
+            prev = self._dedup_lookup(key, ids, n, sample=smp)
             if prev is not None:
                 return prev
             self._check_admission(ids.size)
@@ -1350,6 +1556,21 @@ class DecodeEngine:
         self._m_requests.inc()
         return req
 
+    def _check_sample_params(self, temperature, top_k):
+        """Typed refusal for sampling params the engine cannot honor —
+        a silent greedy fallback would return wrong-distribution tokens."""
+        t, k = float(temperature), int(top_k)
+        if t <= 0:
+            raise ValueError(f"temperature must be > 0, got {temperature}")
+        if k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if (t != 1.0 or k != 0) and not self._sampling:
+            raise ValueError(
+                "sampled generation (temperature/top_k) needs "
+                "EngineConfig(sampling=True): the fused on-device sampler "
+                "is compiled into the step programs, not a per-step host "
+                "round-trip")
+
     # ------------------------------------------------- idempotency dedup
 
     def _dedup_key(self, request_key) -> bytes | None:
@@ -1364,13 +1585,16 @@ class DecodeEngine:
         return key
 
     def _dedup_lookup(self, key: bytes | None, ids: np.ndarray | None,
-                      mnt: int | None) -> GenerateRequest | None:
+                      mnt: int | None,
+                      sample: tuple | None = None) -> GenerateRequest | None:
         """One dedup probe (caller holds ``_qlock``): returns the request
         to attach to / replay, or None for a miss. A key reused for a
-        DIFFERENT prompt or budget is a client bug and refused loudly —
-        silently answering with another request's tokens would be far
-        worse than failing (skipped for migrated-in requests, whose
-        context legitimately grew past the original prompt)."""
+        DIFFERENT prompt, budget, or sampling params — ``sample`` is the
+        submit's (temperature, top_k, seed) — is a client bug and refused
+        loudly: silently answering with another DISTRIBUTION's tokens
+        would be far worse than failing (skipped for migrated-in
+        requests, whose context legitimately grew past the original
+        prompt and whose seed did not travel)."""
         if key is None:
             return None
         prev = self._dedup.get(key)
@@ -1378,11 +1602,14 @@ class DecodeEngine:
             return None
         if ids is not None and not prev.imported and (
                 int(prev.max_new_tokens) != int(mnt)
-                or not np.array_equal(prev.prompt, ids)):
+                or not np.array_equal(prev.prompt, ids)
+                or (sample is not None
+                    and sample != (prev.temperature, prev.top_k,
+                                   prev.seed))):
             raise ValueError(
-                "request_key reused for a different request (prompt or "
-                "max_new_tokens mismatch) — an idempotency key names ONE "
-                "logical request")
+                "request_key reused for a different request (prompt, "
+                "max_new_tokens, or temperature/top_k/seed mismatch) — "
+                "an idempotency key names ONE logical request")
         if not prev.done:
             self._dedup.move_to_end(key)
             self._m_dedup_hits.inc()
@@ -1694,6 +1921,9 @@ class DecodeEngine:
         self._page_table[slot] = row
         self._slot_req[slot] = req
         self._slot_pages[slot] = pages
+        if self._sampling:
+            self._temps[slot] = req.temperature
+            self._topks[slot] = req.top_k
         if self._use_chunked(req.prompt.size - cached):
             # decode-priority chunked prefill: the slot holds its pages but
             # stays decode-inactive; step() runs ONE chunk per step after
@@ -1705,18 +1935,46 @@ class DecodeEngine:
                                       "t0": time.perf_counter()}
             return
         t0 = time.perf_counter()
-        first = self._run_prefill(req.prompt, row, start=cached)
+        first = self._run_prefill(req.prompt, row, start=cached,
+                                  slot=slot, req=req)
         self._h_prefill.observe(time.perf_counter() - t0)
         self._seed_first_token(slot, req, first)
 
+    def _sample_tail(self, slot, req, final=None) -> np.ndarray:
+        """The trailing ints a SAMPLING engine's prefill uploads carry:
+        [slot, key0, key1, temperature bits, top_k(, final)]. ``slot``
+        None routes the chain write to the scratch row B (slotless
+        export/stream prefills); ``req`` None (or a greedy request) rides
+        the argmax arm with a frozen zero key. The PRNGKey(seed)
+        materialization (a tiny device round trip) happens once per
+        REQUEST, cached — and only for the upload that consumes it (the
+        one-shot / FINAL chunk): intermediate chunks never sample, so
+        their tails ship zero key words."""
+        tail = np.zeros(5 if final is None else 6, np.int32)
+        tail[0] = self.ecfg.max_slots if slot is None else int(slot)
+        if req is not None:
+            if final is None or final:
+                if req._seed_key is None:
+                    req._seed_key = np.asarray(
+                        jax.random.PRNGKey(int(req.seed)), np.uint32)
+                tail[1:3] = req._seed_key.view(np.int32)
+            tail[3] = np.float32(req.temperature).view(np.int32)
+            tail[4] = int(req.top_k)
+        else:
+            tail[3] = np.float32(1.0).view(np.int32)
+        if final is not None:
+            tail[5] = 1 if final else 0
+        return tail
+
     def _run_prefill(self, ids: np.ndarray, row: np.ndarray,
-                     start: int = 0) -> int:
+                     start: int = 0, slot=None, req=None) -> int:
         """Fill ``row``'s pages with the prompt's KV from position
         ``start`` on (0 = whole prompt; a prefix-cache hit passes the
         cached token count) — one-shot bucketed, back-to-back chunks, or a
         bucketed TAIL chunk — and return the sampled first token. Shared by
-        `_place` and `prefill_export` (which has no slot to interleave
-        around, so its chunks run consecutively)."""
+        `_place` (which passes ``slot``/``req`` so a sampling engine seeds
+        the slot's key chain) and `prefill_export` (which has no slot to
+        interleave around, so its chunks run consecutively)."""
         s0 = ids.size
         maxp = self.pages_per_slot
         if start or self._use_chunked(s0):
@@ -1730,19 +1988,29 @@ class DecodeEngine:
                 else self.bucket_for(s0 - start)
             tok = None
             for done in range(start, s0, c):
-                tok = self._run_chunk(ids, done, row, c)
+                tok = self._run_chunk(ids, done, row, c, slot=slot,
+                                      req=req, final=done + c >= s0)
         else:
             bucket = self.bucket_for(s0)
-            packed = np.zeros(bucket + 1 + maxp, np.int32)
+            x = 5 if self._sampling else 0
+            packed = np.zeros(bucket + 1 + maxp + x, np.int32)
             packed[:s0] = ids
             packed[bucket] = s0
-            packed[bucket + 1:] = row
+            packed[bucket + 1:bucket + 1 + maxp] = row
+            if self._sampling:
+                packed[bucket + 1 + maxp:] = self._sample_tail(slot, req)
             exe = self._prefill_exe(bucket)
             self._m_h2d.inc()
             self._m_prefill_tokens.inc(s0)
-            tok = self._adopt_pools(
-                exe(self._params, self._kc, self._vc,
-                    jax.device_put(packed), *self._scale_args()))
+            if self._sampling:
+                tok, self._keys_dev = self._adopt_pools(
+                    exe(self._params, self._kc, self._vc, self._keys_dev,
+                        jax.device_put(packed), *self._scale_args()),
+                    n_lead=2)
+            else:
+                tok = self._adopt_pools(
+                    exe(self._params, self._kc, self._vc,
+                        jax.device_put(packed), *self._scale_args()))
         tb = time.perf_counter()
         first = int(tok)                     # sampled-token readback
         self._blocked_s += time.perf_counter() - tb
@@ -1750,26 +2018,37 @@ class DecodeEngine:
         return first
 
     def _run_chunk(self, ids: np.ndarray, done: int, row: np.ndarray,
-                   c: int | None = None):
+                   c: int | None = None, slot=None, req=None,
+                   final: bool = False):
         """Pack and enqueue ONE prefill chunk (``ids[done:done+c]`` against
         page ``row``) — the single owner of the packed chunk layout for
         the interleaved (`_advance_prefill`), back-to-back
         (`_run_prefill`), and prefix-tail paths. Returns the chunk
         program's on-device sampled token (meaningful only for the final
-        chunk; no readback here)."""
+        chunk; no readback here). On a sampling engine the FINAL chunk
+        samples through the fused sampler and seeds ``slot``'s key chain."""
         c = int(self.ecfg.prefill_chunk_tokens) if c is None else int(c)
         chunk = ids[done:done + c]
-        packed = np.zeros(c + 2 + self.pages_per_slot, np.int32)
+        x = 6 if self._sampling else 0
+        packed = np.zeros(c + 2 + self.pages_per_slot + x, np.int32)
         packed[:chunk.size] = chunk
         packed[c] = done
         packed[c + 1] = chunk.size
-        packed[c + 2:] = row
+        packed[c + 2:c + 2 + self.pages_per_slot] = row
+        if self._sampling:
+            packed[c + 2 + self.pages_per_slot:] = \
+                self._sample_tail(slot, req, final=final)
         exe = self._prefill_chunk_exe(c)
         self._m_h2d.inc()
         self._m_prefill_tokens.inc(int(chunk.size))
-        tok = self._adopt_pools(
-            exe(self._params, self._kc, self._vc, jax.device_put(packed),
-                *self._scale_args()))
+        if self._sampling:
+            tok, self._keys_dev = self._adopt_pools(
+                exe(self._params, self._kc, self._vc, self._keys_dev,
+                    jax.device_put(packed), *self._scale_args()), n_lead=2)
+        else:
+            tok = self._adopt_pools(
+                exe(self._params, self._kc, self._vc,
+                    jax.device_put(packed), *self._scale_args()))
         self._m_chunks.inc()
         return tok
 
@@ -1817,7 +2096,9 @@ class DecodeEngine:
         req = st["req"]
         c = int(self.ecfg.prefill_chunk_tokens)
         done = st["done"]
-        tok = self._run_chunk(req.prompt, done, self._page_table[slot])
+        tok = self._run_chunk(req.prompt, done, self._page_table[slot],
+                              slot=slot, req=req,
+                              final=done + c >= req.prompt.size)
         st["done"] = min(done + c, req.prompt.size)
         if st["done"] >= req.prompt.size:
             del self._prefilling[slot]
@@ -1845,6 +2126,9 @@ class DecodeEngine:
         self._budget[slot] = 0
         self._page_table[slot] = TRASH_PAGE
         self._lengths[slot] = 0
+        if self._sampling:
+            self._temps[slot] = 1.0     # greedy defaults; the stale key
+            self._topks[slot] = 0       # row is re-seeded at next prefill
 
     def _retire(self, slot: int, error: str | None = None):
         req = self._slot_req[slot]
@@ -1858,24 +2142,34 @@ class DecodeEngine:
 
     def _packed_state(self) -> np.ndarray:
         B, maxp = self.ecfg.max_slots, self.pages_per_slot
-        packed = np.empty((B, _STATE_COLS + maxp), np.int32)
+        x = 2 if self._sampling else 0   # trailing (temp bits, top_k)
+        packed = np.empty((B, _STATE_COLS + maxp + x), np.int32)
         packed[:, _COL_TOKEN] = self._tokens
         packed[:, _COL_LENGTH] = self._lengths
         packed[:, _COL_FLAGS] = (self._active.astype(np.int32) * _FLAG_ACTIVE
                                  | self._fresh.astype(np.int32) * _FLAG_FRESH)
-        packed[:, _STATE_COLS:] = self._page_table
+        packed[:, _STATE_COLS:_STATE_COLS + maxp] = self._page_table
+        if self._sampling:
+            packed[:, _STATE_COLS + maxp] = self._temps.view(np.int32)
+            packed[:, _STATE_COLS + maxp + 1] = self._topks
         return packed
 
     def _dispatch(self):
         """Enqueue ONE fixed-shape decode step: one fused host->device
-        upload, no readback — tokens stay on device for the next step."""
+        upload, no readback — tokens (and, on a sampling engine, the
+        per-slot PRNG key chains) stay on device for the next step."""
         exe = self._decode_exe()
         self._m_h2d.inc()
         state = jax.device_put(self._packed_state())
         t0 = time.perf_counter()
-        self._tok_dev = self._adopt_pools(
-            exe(self._params, self._kc, self._vc, self._tok_dev, state,
-                *self._scale_args()))
+        if self._sampling:
+            self._tok_dev, self._keys_dev = self._adopt_pools(
+                exe(self._params, self._kc, self._vc, self._tok_dev,
+                    self._keys_dev, state, *self._scale_args()), n_lead=2)
+        else:
+            self._tok_dev = self._adopt_pools(
+                exe(self._params, self._kc, self._vc, self._tok_dev, state,
+                    *self._scale_args()))
         snapshot = [(int(i), self._slot_req[i])
                     for i in np.flatnonzero(self._active)]
         self._inflight.append((self._tok_dev, snapshot, t0))
@@ -1896,14 +2190,18 @@ class DecodeEngine:
     def _packed_spec_state(self, drafts: np.ndarray,
                            draft_lens: np.ndarray) -> np.ndarray:
         B, maxp, K = self.ecfg.max_slots, self.pages_per_slot, self._spec_k
-        packed = np.empty((B, _SPEC_COLS + K + maxp), np.int32)
+        x = 2 if self._sampling else 0   # trailing (temp bits, top_k)
+        packed = np.empty((B, _SPEC_COLS + K + maxp + x), np.int32)
         packed[:, _COL_TOKEN] = self._tokens
         packed[:, _COL_LENGTH] = self._lengths
         packed[:, _COL_FLAGS] = (self._active.astype(np.int32) * _FLAG_ACTIVE
                                  | self._fresh.astype(np.int32) * _FLAG_FRESH)
         packed[:, _COL_DRAFT] = draft_lens
         packed[:, _SPEC_COLS:_SPEC_COLS + K] = drafts
-        packed[:, _SPEC_COLS + K:] = self._page_table
+        packed[:, _SPEC_COLS + K:_SPEC_COLS + K + maxp] = self._page_table
+        if self._sampling:
+            packed[:, _SPEC_COLS + K + maxp] = self._temps.view(np.int32)
+            packed[:, _SPEC_COLS + K + maxp + 1] = self._topks
         return packed
 
     def _dispatch_spec(self):
@@ -1934,9 +2232,15 @@ class DecodeEngine:
         self._m_h2d.inc()
         state = jax.device_put(self._packed_spec_state(drafts, draft_lens))
         t0 = time.perf_counter()
-        emitted_dev, n_emit_dev, self._tok_dev = self._adopt_pools(
-            exe(self._params, self._kc, self._vc, self._tok_dev, state,
-                *self._scale_args()), n_lead=3)
+        if self._sampling:
+            (emitted_dev, n_emit_dev, self._tok_dev,
+             self._keys_dev) = self._adopt_pools(
+                exe(self._params, self._kc, self._vc, self._tok_dev,
+                    self._keys_dev, state, *self._scale_args()), n_lead=4)
+        else:
+            emitted_dev, n_emit_dev, self._tok_dev = self._adopt_pools(
+                exe(self._params, self._kc, self._vc, self._tok_dev, state,
+                    *self._scale_args()), n_lead=3)
         snapshot = [(int(i), self._slot_req[i])
                     for i in np.flatnonzero(self._active)]
         self._fresh[:] = False
@@ -2386,9 +2690,12 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt {ids.size} + max_new_tokens {n} exceeds engine "
                 f"max_seq_len={self.max_seq_len}")
+        smp = handoff.sample or {}
         req = GenerateRequest(ids, n, trace=trace, cache=cache,
                               speculate=speculate, deadline_s=deadline_s,
-                              request_key=self._dedup_key(request_key))
+                              request_key=self._dedup_key(request_key),
+                              temperature=smp.get("temperature", 1.0),
+                              top_k=smp.get("top_k", 0))
         req.imported = True
         if self._prefix_enabled and req.cache:
             # imported pages are cache-eligible: _seed_first_token indexes
@@ -2422,6 +2729,11 @@ class DecodeEngine:
                 f"engine {np.dtype(self._cdtype).name} — a silent cast "
                 f"would break bit-identical decode (kv_dtype must match "
                 f"across a handoff)")
+        if handoff.sample is not None and not self._sampling:
+            raise ValueError(
+                "handoff carries fused-sampler state but this engine was "
+                "built without EngineConfig(sampling=True) — a greedy "
+                "resume would silently change the request's distribution")
         if self._quant_kv and handoff.k_scales is None:
             raise ValueError(
                 "int8 KV handoff is missing its scale blobs — refusing a "
@@ -2473,6 +2785,15 @@ class DecodeEngine:
         self._page_table[slot] = row
         self._slot_req[slot] = req
         self._slot_pages[slot] = pages
+        if self._sampling:
+            self._temps[slot] = req.temperature
+            self._topks[slot] = req.top_k
+            if handoff.sample is not None:
+                # resume the ADVANCED chain exactly where the exporter
+                # left it (host write outside the step loop — imports are
+                # admission-rate events, never per-step)
+                self._keys_dev = self._keys_dev.at[slot].set(
+                    jnp.asarray(handoff.sample["key"], jnp.uint32))
         metrics.counter("engine.kv_imports").inc()
         self._seed_first_token(slot, req, int(handoff.first_token))
 
@@ -2560,6 +2881,17 @@ class DecodeEngine:
                 self._imports.extend(retry)
 
     @staticmethod
+    def _cold_sample(req: GenerateRequest) -> dict | None:
+        """A COLD migration item's sampler params ({"temperature",
+        "top_k", "seed"}): the peer restarts the chain from the seed —
+        nothing was sampled yet, so the restarted sequence is the
+        uninterrupted one. None for greedy requests."""
+        if req.temperature != 1.0 or req.top_k != 0:
+            return {"temperature": float(req.temperature),
+                    "top_k": int(req.top_k), "seed": int(req.seed)}
+        return None
+
+    @staticmethod
     def _deadline_ms_left(req: GenerateRequest,
                           now: float | None = None) -> int | None:
         if req.deadline_t is None:
@@ -2600,7 +2932,8 @@ class DecodeEngine:
                                      prompt=req.prompt, deadline_ms=left,
                                      request=req, cache=req.cache,
                                      speculate=req.speculate,
-                                     request_key=req.request_key)
+                                     request_key=req.request_key,
+                                     sample=self._cold_sample(req))
             else:
                 # warm: KV is resident for prompt + generated[:-1] (the
                 # last sampled token's KV is written by the NEXT step,
@@ -2625,6 +2958,17 @@ class DecodeEngine:
                     page_size=int(self.ecfg.page_size),
                     cache_dtype=np.dtype(self._cdtype).name,
                     k_scales=ks_np, v_scales=vs_np)
+                if self._sampling and (req.temperature != 1.0
+                                       or req.top_k != 0):
+                    # the slot's ADVANCED chain rides the handoff: decode
+                    # on the peer continues the bit-identical sampled
+                    # sequence (the readback is migration-time only,
+                    # never on the step loop)
+                    krow = np.asarray(self._keys_dev)[slot]
+                    handoff.sample = {
+                        "temperature": float(req.temperature),
+                        "top_k": int(req.top_k),
+                        "key": [int(krow[0]), int(krow[1])]}
                 # the seed counts as the peer's first emission, so the
                 # peer budget is remaining + 1 — its full answer is then
                 # exactly the uninterrupted run's sequence
@@ -2658,7 +3002,8 @@ class DecodeEngine:
                 max_new_tokens=req.max_new_tokens, prompt=req.prompt,
                 deadline_ms=self._deadline_ms_left(req, now), request=req,
                 cache=req.cache, speculate=req.speculate,
-                request_key=req.request_key))
+                request_key=req.request_key,
+                sample=self._cold_sample(req)))
         for handoff, req in imports:
             # a warm import this engine never placed migrates onward as-is
             if req.done:

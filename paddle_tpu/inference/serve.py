@@ -643,11 +643,14 @@ class InferenceServer:
                 deadline_s=deadline_s, trace=trace, cache=item.cache,
                 speculate=item.speculate, request_key=item.request_key)
         else:
-            req = self._engine.submit(item.prompt, item.max_new_tokens,
-                                      trace=trace, deadline_s=deadline_s,
-                                      cache=item.cache,
-                                      speculate=item.speculate,
-                                      request_key=item.request_key)
+            smp = item.sample or {}     # a COLD sampled item restarts its
+            req = self._engine.submit(  # chain from the original seed
+                item.prompt, item.max_new_tokens,
+                trace=trace, deadline_s=deadline_s,
+                cache=item.cache, speculate=item.speculate,
+                request_key=item.request_key,
+                temperature=smp.get("temperature", 1.0),
+                top_k=smp.get("top_k", 0), seed=smp.get("seed", 0))
         # the request's cancel tag rode the blob: register it HERE so a
         # post-migration CANCEL (the router broadcasts to every replica)
         # reaches the engine that now owns the decode

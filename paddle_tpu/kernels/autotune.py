@@ -1,120 +1,69 @@
-"""Runtime kernel autotuning (ref: `paddle/phi/kernels/autotune/` —
-cache.h's AutoTuneCache + auto_tune_base.h's measured selection).
+"""Measured kernel selection — the op ADAPTERS over `kernels/registry.py`
+(ref: `paddle/phi/kernels/autotune/` — cache.h's AutoTuneCache +
+auto_tune_base.h's measured selection).
+
+The registry owns dispatch, the winner table, persistence, and the
+``kernel.dispatch.*`` counters; this module keeps what is genuinely
+measurement-domain:
+
+- the backend probe (`_backend_kind` — by NAME, never by executing an op:
+  the experimental 'axon' tunnel reports platform "tpu" but could not
+  historically lower Mosaic, and executing an unsupported op there poisons
+  the device stream; whether a tunnel CAN lower is re-probed once per
+  process by `kernels/pallas/_compat.py::mosaic_supported`, so the Pallas
+  candidates activate the day the tunnel supports them);
+- the wall-clock measurement harness (`_measure`/`_sync` — best-of-reps
+  with a host fetch, because block_until_ready on tunnel backends can
+  return early);
+- the per-op candidate lists (`_flash_candidates`, `_paged_candidates`)
+  and the synthetic-workload winner adapters (`flash_winner`,
+  `paged_winner`, `prefill_winner`) that build representative arrays and
+  call `registry.select`.
 
 ``FLAGS_tpu_flash_impl=auto`` routes flash attention through
-:func:`flash_winner`: the first time a (backend, shape, dtype, causal)
-signature is seen, every candidate implementation VIABLE on the current
-backend is compiled and timed (forward + backward, a couple of repetitions,
-best-of), and the winner is cached — exactly the reference's
-measure-once-then-cache policy, keyed the same way its kernel cache keys on
-shapes/dtypes. ``FLAGS_tpu_paged_impl=auto`` does the same for the serving
-engine's paged-attention decode step through :func:`paged_winner`, keyed on
-(backend, B, pages_per_slot, page_size, nh, dh, dtype) — forward only, a
-ragged position mix so the measurement sees the length-aware stop.
-
-Backend viability is decided by NAME, never by probing execution: the
-experimental 'axon' tunnel reports platform "tpu" but cannot lower Mosaic,
-and executing an unsupported op there poisons the device stream
-(kernels/pallas/_compat.py has the same rule). So Pallas candidates are
-offered only on real TPU; everywhere else the XLA flash-style custom-vjp is
-the only (and correct) choice.
+:func:`flash_winner`; ``FLAGS_tpu_paged_impl=auto`` routes the serving
+engine's paged decode step through :func:`paged_winner` (forward only, a
+ragged position mix so the measurement sees the length-aware stop);
+``FLAGS_tpu_prefill_impl=auto`` routes the ragged PREFILL kernel through
+:func:`prefill_winner` the same way.
 
 The measured table can be inspected via :func:`cache_table` and persists
 in-process; set ``FLAGS_autotune_verbose=1`` to log decisions.
 
 **Persistent cache** (``PADDLE_AUTOTUNE_CACHE=/path/table.json``): measured
-winners are additionally written to a small on-disk JSON table keyed by the
-same (backend, shape-class, dtype) signatures, and consulted before
-measuring — a server fleet stops re-paying the measurement wall at every
-startup (cold-start matters at fleet scale, ROADMAP item 5). The file is
-advisory only: corrupt, stale, or unwritable cache files are IGNORED (the
-winner is re-measured and the table rewritten when possible), and a
-persisted winner naming an impl that is not viable on the current backend
-is discarded — a table copied from a TPU host cannot poison a CPU one.
+winners are additionally written to the registry's on-disk JSON table
+keyed by the same (op, backend, shape-class, dtype[, variant]) signatures,
+and consulted before measuring — a server fleet stops re-paying the
+measurement wall at every startup. Legacy tables written before the
+registry load as-is (and the oldest pre-version bare-mapping files are
+migrated on first load); corrupt, stale, or unwritable cache files are
+IGNORED, and a persisted winner naming an impl that is not viable on the
+current backend is discarded — a table copied from a TPU host cannot
+poison a CPU one.
 """
 from __future__ import annotations
 
-import json
 import logging
-import os
 import time
 
 import numpy as np
 
+from paddle_tpu.kernels import registry
+
 _LOG = logging.getLogger("paddle_tpu.autotune")
 
-_CACHE: dict = {}
-
-_DISK_VERSION = 1
-_DISK_STATE: dict = {"path": None, "table": None}   # loaded-once per path
+# the ONE winner table, owned by the registry (alias kept because tests
+# and tooling introspect it here; mutated in place, never rebound)
+_CACHE = registry._TABLE
 
 
 def cache_table():
     """{signature: (winner, {impl: seconds})} — measured decisions."""
-    return dict(_CACHE)
+    return registry.table()
 
 
 def clear_cache():
-    _CACHE.clear()
-    _DISK_STATE["path"] = _DISK_STATE["table"] = None
-
-
-def _disk_path():
-    return os.environ.get("PADDLE_AUTOTUNE_CACHE") or None
-
-
-def _load_disk_table(path) -> dict:
-    """Read the persisted winner table; ANY failure (missing, corrupt,
-    wrong schema) degrades to an empty table — never fatal."""
-    try:
-        with open(path) as f:
-            data = json.load(f)
-        if not isinstance(data, dict) or data.get("version") != _DISK_VERSION:
-            return {}
-        table = data.get("winners")
-        return table if isinstance(table, dict) else {}
-    except Exception as e:  # noqa: BLE001 — a bad cache file is advisory
-        if not isinstance(e, FileNotFoundError):
-            _LOG.info("autotune: ignoring unreadable cache %s: %s", path, e)
-        return {}
-
-
-def _disk_lookup(key, viable):
-    """Persisted winner for ``key``, or None. Winners outside the backend's
-    ``viable`` candidate list are stale (table copied across backends or an
-    impl renamed) and are ignored."""
-    path = _disk_path()
-    if path is None:
-        return None
-    if _DISK_STATE["path"] != path or _DISK_STATE["table"] is None:
-        _DISK_STATE["path"] = path
-        _DISK_STATE["table"] = _load_disk_table(path)
-    win = _DISK_STATE["table"].get(repr(key))
-    if isinstance(win, str) and win in viable:
-        from paddle_tpu.observability import metrics
-        metrics.counter("autotune.disk_hits").inc()
-        return win
-    return None
-
-
-def _disk_store(key, winner):
-    """Merge one measured winner into the on-disk table (atomic replace;
-    re-reads first so concurrent processes lose at most their own entry).
-    Failures are logged and swallowed — persistence is an optimization."""
-    path = _disk_path()
-    if path is None:
-        return
-    try:
-        table = _load_disk_table(path)
-        table[repr(key)] = winner
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump({"version": _DISK_VERSION, "winners": table}, f,
-                      sort_keys=True)
-        os.replace(tmp, path)
-        _DISK_STATE["path"], _DISK_STATE["table"] = path, table
-    except Exception as e:  # noqa: BLE001
-        _LOG.info("autotune: cache write to %s failed: %s", path, e)
+    registry.clear()
 
 
 def _backend_kind():
@@ -128,6 +77,18 @@ def _backend_kind():
     except Exception:
         pass
     return "tpu"
+
+
+def _mosaic_ok() -> bool:
+    """Whether the current tpu-named backend can LOWER Mosaic — the
+    per-process probe (`pallas/_compat.py`), consulted so a tunnel that
+    gains Mosaic support enables the Pallas candidates without a code
+    change. Never executes anything on the device."""
+    try:
+        from paddle_tpu.kernels.pallas._compat import mosaic_supported
+        return mosaic_supported()
+    except Exception:  # noqa: BLE001 — a broken probe must not kill dispatch
+        return False
 
 
 def _sync(out):
@@ -153,12 +114,14 @@ def _measure(fn, args, warmup=1, reps=3):
 
 
 def _flash_candidates(backend, tileable, shape_q, shape_k):
-    """Impl names viable on this backend (by name, never by execution)."""
+    """Impl names viable on this backend (by name/probe, never by
+    execution)."""
     _logits_elems = (shape_q[0] * shape_q[1] * shape_q[2] * shape_k[2])
-    if backend == "axon":
+    if backend == "axon" and not _mosaic_ok():
         # the dev tunnel's ~300ms round trip swamps real kernel deltas, so
         # measured ranking there is noise (it once 'preferred' an impl that
-        # was 2x slower end-to-end) — pin the known-good impl instead
+        # was 2x slower end-to-end) — pin the known-good impl while the
+        # tunnel cannot lower Mosaic anyway
         return ["xla"]
     cands = ["xla"]
     if _logits_elems <= (1 << 28):
@@ -167,11 +130,13 @@ def _flash_candidates(backend, tileable, shape_q, shape_k):
         # not just Sq*Sk — a doomed OOM measurement wastes a compile per
         # shape even though the failure is caught
         cands.append("dense")
-    if backend == "tpu" and tileable:
-        # real TPU: Mosaic lowers — offer every authored/bundled kernel
-        cands += ["mosaic", "splash", "authored"]
-    elif backend == "tpu":
-        cands += ["authored"]          # authored handles non-tiled shapes
+    if backend in ("tpu", "axon"):
+        # Mosaic lowers (real TPU, or a tunnel that passed the probe
+        # above) — offer every authored/bundled kernel
+        if tileable:
+            cands += ["mosaic", "splash", "authored"]
+        else:
+            cands += ["authored"]      # authored handles non-tiled shapes
     return cands
 
 
@@ -184,59 +149,46 @@ def flash_winner(shape_q, shape_k, dtype, causal, tileable, run_impl):
     backend = _backend_kind()
     key = ("flash", backend, tuple(shape_q), tuple(shape_k), str(dtype),
            bool(causal))
-    hit = _CACHE.get(key)
-    if hit is not None:
-        return hit[0]
     cands = _flash_candidates(backend, tileable, shape_q, shape_k)
-    if len(cands) == 1:
-        _CACHE[key] = (cands[0], {})
-        return cands[0]
-    disk = _disk_lookup(key, cands)
-    if disk is not None:
-        _CACHE[key] = (disk, {})
-        return disk
+    if backend == "axon" and len(cands) > 1:
+        # NEVER wall-clock-rank over the tunnel, Mosaic or not: its
+        # ~300ms round trip swamps real kernel deltas (it once
+        # 'preferred' an impl 2x slower end-to-end) and registry.select
+        # would persist that noise fleet-wide. The Pallas arms stay
+        # ACTIVATED — forceable via FLAGS_tpu_flash_impl and compiled,
+        # not interpreted — but auto pins the known-good impl.
+        return registry.select("flash_attention", key, ["xla"], None,
+                               verbose_tag="flash")
+    state = {}
 
-    import jax
-    import jax.numpy as jnp
-    rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.randn(*shape_q).astype(np.float32)).astype(dtype)
-    k = jnp.asarray(rng.randn(*shape_k).astype(np.float32)).astype(dtype)
-    v = jnp.asarray(rng.randn(*shape_k).astype(np.float32)).astype(dtype)
+    def measure(impl):
+        import jax
+        import jax.numpy as jnp
+        if "args" not in state:
+            rng = np.random.RandomState(0)
+            q = jnp.asarray(rng.randn(*shape_q).astype(np.float32)) \
+                .astype(dtype)
+            k = jnp.asarray(rng.randn(*shape_k).astype(np.float32)) \
+                .astype(dtype)
+            v = jnp.asarray(rng.randn(*shape_k).astype(np.float32)) \
+                .astype(dtype)
+            state["args"] = (q, k, v)
+        step = jax.jit(jax.grad(
+            lambda q_, k_, v_, _i=impl: (
+                run_impl(_i, q_, k_, v_).astype(jnp.float32) ** 2
+            ).sum(), argnums=(0, 1, 2)))
+        return _measure(step, state["args"])
 
-    timings = {}
-    for impl in cands:
-        try:
-            step = jax.jit(jax.grad(
-                lambda q_, k_, v_, _i=impl: (
-                    run_impl(_i, q_, k_, v_).astype(jnp.float32) ** 2
-                ).sum(), argnums=(0, 1, 2)))
-            timings[impl] = _measure(step, (q, k, v))
-        except Exception as e:           # a candidate failing to compile is
-            _LOG.info("autotune: %s failed on %s: %s", impl, backend, e)
-            continue                     # data, not an error (ref behavior)
-    if not timings:
-        winner = "xla"
-    else:
-        winner = min(timings, key=timings.get)
-    from paddle_tpu.framework.flags import flag_value
-    try:
-        verbose = flag_value("autotune_verbose")
-    except Exception:
-        verbose = False
-    if verbose:
-        _LOG.warning("autotune flash %s -> %s (%s)", key, winner,
-                     {k_: f"{v_ * 1e3:.2f}ms" for k_, v_ in timings.items()})
-    _CACHE[key] = (winner, timings)
-    _disk_store(key, winner)
-    return winner
+    return registry.select("flash_attention", key, cands, measure,
+                           verbose_tag="flash")
 
 
 def _paged_candidates(backend):
-    """Paged-attention impls viable on this backend (by name, never by
-    execution). Pallas is offered only on real TPU: interpret mode off-TPU
-    is a parity tool, not a serving path, and the axon tunnel cannot lower
-    Mosaic (same rule as _flash_candidates)."""
-    if backend == "tpu":
+    """Paged/prefill attention impls viable on this backend (by
+    name/probe, never by execution). Pallas is offered on real TPU and on
+    any tunnel whose Mosaic lowering probe passed: interpret mode off-TPU
+    is a parity tool, not a serving path."""
+    if backend == "tpu" or (backend == "axon" and _mosaic_ok()):
         return ["xla", "pallas"]
     return ["xla"]
 
@@ -257,52 +209,104 @@ def paged_winner(b, pages_per_slot, page_size, nh, dh, dtype, run_impl,
     backend = _backend_kind()
     key = ("paged", backend, int(b), int(pages_per_slot), int(page_size),
            int(nh), int(dh), str(dtype) + (f"/{variant}" if variant else ""))
-    hit = _CACHE.get(key)
-    if hit is not None:
-        return hit[0]
     cands = _paged_candidates(backend)
-    if len(cands) == 1:
-        _CACHE[key] = (cands[0], {})
-        return cands[0]
-    disk = _disk_lookup(key, cands)
-    if disk is not None:
-        _CACHE[key] = (disk, {})
-        return disk
+    if backend == "axon" and len(cands) > 1:
+        # no measured ranking over the tunnel (RTT noise — see
+        # flash_winner); the length-aware kernel's advantage here is
+        # ARCHITECTURAL (O(true length) vs O(pool capacity) traffic),
+        # so a Mosaic-capable tunnel pins it without a race
+        return registry.select("paged_attention", key, ["pallas"], None,
+                               verbose_tag="paged")
+    state = {}
 
-    import jax
-    import jax.numpy as jnp
-    num_pages = 1 + b * pages_per_slot
-    rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.randn(b, nh, dh).astype(np.float32)).astype(dtype)
-    kp = jnp.asarray(rng.randn(num_pages, page_size, nh, dh)
-                     .astype(np.float32)).astype(dtype)
-    vp = jnp.asarray(rng.randn(num_pages, page_size, nh, dh)
-                     .astype(np.float32)).astype(dtype)
-    pt = jnp.asarray(1 + np.arange(b * pages_per_slot, dtype=np.int32)
-                     .reshape(b, pages_per_slot))
-    # ragged mix spanning 1..pages_per_slot pages — the serving shape the
-    # pallas kernel's length-aware stop is built for
-    pos = jnp.asarray(((np.arange(b) % pages_per_slot) + 1) * page_size - 1,
-                      dtype=jnp.int32)
+    def measure(impl):
+        import jax
+        import jax.numpy as jnp
+        if "args" not in state:
+            num_pages = 1 + b * pages_per_slot
+            rng = np.random.RandomState(0)
+            q = jnp.asarray(rng.randn(b, nh, dh).astype(np.float32)) \
+                .astype(dtype)
+            kp = jnp.asarray(rng.randn(num_pages, page_size, nh, dh)
+                             .astype(np.float32)).astype(dtype)
+            vp = jnp.asarray(rng.randn(num_pages, page_size, nh, dh)
+                             .astype(np.float32)).astype(dtype)
+            pt = jnp.asarray(1 + np.arange(b * pages_per_slot,
+                                           dtype=np.int32)
+                             .reshape(b, pages_per_slot))
+            # ragged mix spanning 1..pages_per_slot pages — the serving
+            # shape the pallas kernel's length-aware stop is built for
+            pos = jnp.asarray(((np.arange(b) % pages_per_slot) + 1)
+                              * page_size - 1, dtype=jnp.int32)
+            state["args"] = (q, kp, vp)
+            state["pt"], state["pos"] = pt, pos
+        pt, pos = state["pt"], state["pos"]
+        step = jax.jit(
+            lambda q_, k_, v_, _i=impl: run_impl(_i, q_, k_, v_, pt, pos))
+        return _measure(step, state["args"])
 
-    timings = {}
-    for impl in cands:
-        try:
-            step = jax.jit(
-                lambda q_, k_, v_, _i=impl: run_impl(_i, q_, k_, v_, pt, pos))
-            timings[impl] = _measure(step, (q, kp, vp))
-        except Exception as e:           # a candidate failing to compile is
-            _LOG.info("autotune: paged %s failed on %s: %s", impl, backend, e)
-            continue                     # data, not an error (ref behavior)
-    winner = min(timings, key=timings.get) if timings else "xla"
-    from paddle_tpu.framework.flags import flag_value
-    try:
-        verbose = flag_value("autotune_verbose")
-    except Exception:
-        verbose = False
-    if verbose:
-        _LOG.warning("autotune paged %s -> %s (%s)", key, winner,
-                     {k_: f"{v_ * 1e3:.2f}ms" for k_, v_ in timings.items()})
-    _CACHE[key] = (winner, timings)
-    _disk_store(key, winner)
-    return winner
+    return registry.select("paged_attention", key, cands, measure,
+                           verbose_tag="paged")
+
+
+def prefill_winner(chunk, pages_per_slot, page_size, nh, dh, dtype,
+                   run_impl, variant="", parity=True):
+    """Pick (and cache) the fastest ragged PREFILL attention impl for this
+    signature — (backend, chunk, pages_per_slot, page_size, nh, dh,
+    dtype[, variant]). Same candidate set and viability rules as the
+    decode kernel; the measurement runs one mid-pool chunk (a page of
+    prior context + a full chunk of fresh queries) so the length-aware
+    stop is exercised.
+
+    ``parity=False`` is the dispatch-level viability gate threaded
+    through (`registry._prefill_cands`): a call whose XLA arm does NOT
+    read the page pool (one-shot prefill over a narrowing pool dtype)
+    must never measure — let alone pick — the pool-reading pallas arm,
+    and the winner is cached under a DISTINCT key so a parity-gated
+    signature can't adopt an ungated one's pallas win.
+
+    run_impl(impl, q, k_pages, v_pages, row, start, valid) must execute
+    the named implementation on a [1, chunk, nh, dh] query block and
+    return the same shape.
+    """
+    backend = _backend_kind()
+    key = ("prefill", backend, int(chunk), int(pages_per_slot),
+           int(page_size), int(nh), int(dh),
+           str(dtype) + (f"/{variant}" if variant else "")
+           + ("" if parity else "/no-parity"))
+    cands = _paged_candidates(backend)
+    if not parity:
+        cands = [c for c in cands if c != "pallas"]
+    if backend == "axon" and len(cands) > 1:
+        # same rule as paged_winner: architectural preference, no
+        # tunnel-noise race (parity-gated calls never reach here with
+        # pallas in the list)
+        return registry.select("prefill_attention", key, ["pallas"], None,
+                               verbose_tag="prefill")
+    state = {}
+
+    def measure(impl):
+        import jax
+        import jax.numpy as jnp
+        if "args" not in state:
+            num_pages = 1 + pages_per_slot
+            rng = np.random.RandomState(0)
+            q = jnp.asarray(rng.randn(1, chunk, nh, dh)
+                            .astype(np.float32)).astype(dtype)
+            kp = jnp.asarray(rng.randn(num_pages, page_size, nh, dh)
+                             .astype(np.float32)).astype(dtype)
+            vp = jnp.asarray(rng.randn(num_pages, page_size, nh, dh)
+                             .astype(np.float32)).astype(dtype)
+            row = jnp.asarray(1 + np.arange(pages_per_slot, dtype=np.int32))
+            state["args"] = (q, kp, vp)
+            state["row"] = row
+        row = state["row"]
+        start = jnp.int32(min(page_size, (pages_per_slot - 1) * page_size))
+        valid = jnp.int32(chunk)
+        step = jax.jit(
+            lambda q_, k_, v_, _i=impl: run_impl(_i, q_, k_, v_, row,
+                                                 start, valid))
+        return _measure(step, state["args"])
+
+    return registry.select("prefill_attention", key, cands, measure,
+                           verbose_tag="prefill")

@@ -346,26 +346,34 @@ def flash_attention_fn(causal=False, scale=None):
 
     def fn(q, k, v):
         from paddle_tpu.framework.flags import flag_value
+        from paddle_tpu.kernels import registry
         # -> [B, H, S, D]
         qt = jnp.swapaxes(q, 1, 2)
         kt = jnp.swapaxes(k, 1, 2)
         vt = jnp.swapaxes(v, 1, 2)
         S, D = qt.shape[2], qt.shape[3]
-        impl = flag_value("tpu_flash_impl")
         tileable = (_try_pallas() and S % 128 == 0 and D % 64 == 0
                     and S == kt.shape[2]
                     and qt.dtype in (jnp.float32, jnp.bfloat16))
-        if impl == "auto":
-            # measured selection, cached per (backend, shape, dtype, causal)
-            # — ref phi/kernels/autotune. Runs eagerly at trace time; the
-            # winner string is baked into this trace (the program cache keys
-            # on the flag + shapes, so retunes key new programs).
+
+        def winner():
+            # measured selection, cached per (backend, shape, dtype,
+            # causal) — ref phi/kernels/autotune. Runs eagerly at trace
+            # time; the winner string is baked into this trace (the
+            # program cache keys on the flag + shapes, so retunes key new
+            # programs).
             from paddle_tpu.kernels.autotune import flash_winner
-            impl = flash_winner(
+            return flash_winner(
                 tuple(qt.shape), tuple(kt.shape), qt.dtype, causal,
                 tileable,
                 lambda i, q_, k_, v_: _impl_call(i, q_, k_, v_, causal,
                                                  scale, tileable))
+
+        impl = registry.dispatch(
+            "flash_attention", forced=flag_value("tpu_flash_impl"),
+            ctx={"tileable": tileable, "shape_q": tuple(qt.shape),
+                 "shape_k": tuple(kt.shape)},
+            winner=winner)
         out = _impl_call(impl, qt, kt, vt, causal, scale, tileable)
         return jnp.swapaxes(out, 1, 2)
 

@@ -26,11 +26,15 @@ contract (token-identical output, enforced by parity tests):
   so traffic scales with each sequence's true length.
 
 ``FLAGS_tpu_paged_impl`` picks: ``auto`` (measured winner per signature on
-real TPU via `kernels/autotune.py`, xla elsewhere — backend viability is
-decided by NAME, `kernels/pallas/_compat.py`), ``xla``, or ``pallas``
-(interpret mode off-TPU: parity tests only). The chosen implementation is
-counted per program build in ``paged_attention.impl.{xla|pallas}``
-(docs/OBSERVABILITY.md).
+real TPU via the kernel registry + `kernels/autotune.py`, xla elsewhere —
+backend viability is decided by NAME/probe, `kernels/pallas/_compat.py`),
+``xla``, or ``pallas`` (interpret mode off-TPU: parity tests only). Every
+selection routes through `kernels/registry.py::dispatch` and is counted
+per program build in ``kernel.dispatch.paged_attention.{xla|pallas}``
+(plus the pre-registry alias ``paged_attention.impl.*``;
+docs/OBSERVABILITY.md). The ragged PREFILL twin (`prefill_attention` /
+`prefill_impl`) dispatches the same way under ``FLAGS_tpu_prefill_impl``
+with counters ``kernel.dispatch.prefill_attention.*``.
 
 Page 0 is RESERVED as the trash page: writes for inactive slots and
 prompt-padding positions are routed there instead of being predicated out
@@ -43,8 +47,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.observability import metrics
-
 # the reserved spill target for masked writes — never allocated to a sequence
 TRASH_PAGE = 0
 
@@ -54,7 +56,8 @@ TRASH_PAGE = 0
 KV_DTYPES = {"f32": jnp.float32, "bf16": jnp.bfloat16, "int8": jnp.int8}
 
 __all__ = ["TRASH_PAGE", "KV_DTYPES", "gather_kv", "quantize_kv",
-           "dequantize_window", "gather_scales", "paged_attention", "token_page_coords",
+           "dequantize_window", "gather_scales", "paged_attention",
+           "prefill_attention", "prefill_impl", "token_page_coords",
            "prompt_page_coords", "chunk_page_coords", "verify_page_coords",
            "write_token_kv", "write_prompt_kv", "export_pages",
            "import_pages"]
@@ -156,12 +159,14 @@ def paged_attention(q, k_pages, v_pages, page_table, pos,
     the ``paged_attention.impl.*`` counters count program builds (once per
     layer per trace), not steps.
     """
+    from paddle_tpu.kernels import registry
     try:
         from paddle_tpu.framework.flags import flag_value
-        impl = flag_value("tpu_paged_impl")
+        forced = flag_value("tpu_paged_impl")
     except Exception:          # flags registry unavailable (early import)
-        impl = "xla"
-    if impl == "auto":
+        forced = "xla"
+
+    def winner():
         from paddle_tpu.kernels.autotune import paged_winner
         run = _impl_call
         variant = ""
@@ -178,12 +183,115 @@ def paged_attention(q, k_pages, v_pages, page_table, pos,
                 return _impl_call(impl_, q_, kp_.astype(jnp.int8),
                                   vp_.astype(jnp.int8), pt_, pos_,
                                   k_scale=ones, v_scale=ones)
-        impl = paged_winner(q.shape[0], page_table.shape[1],
+        return paged_winner(q.shape[0], page_table.shape[1],
                             k_pages.shape[1], q.shape[1], q.shape[2],
                             q.dtype, run, variant=variant)
-    metrics.counter(f"paged_attention.impl.{impl}").inc()
+
+    impl = registry.dispatch("paged_attention", forced=forced,
+                             winner=winner)
     return _impl_call(impl, q, k_pages, v_pages, page_table, pos,
                       k_scale=k_scale, v_scale=v_scale)
+
+
+def _xla_prefill_attention(q, k_pages, v_pages, page_table, start, valid,
+                           k_scale=None, v_scale=None):
+    """The gather + absolute-position-masked f32-softmax PREFILL reference
+    — exactly the math `models/gpt.py::prefill_chunk_step` always ran: the
+    chunk's queries attend over ALL cached positions (previous chunks AND
+    the current one) via the paged gather, masked so a query at position p
+    sees keys 0..p. Traffic and FLOPs scale with the slot's capacity
+    (``pages_per_slot``), which is what the Pallas arm fixes.
+
+    q : [1, C, nh, dh] chunk queries; page_table : [pages_per_slot];
+    start/valid : the chunk's absolute origin and true token count.
+    ``valid`` only matters to the Pallas arm's row masking — padded rows
+    here compute like the real ones (their output is never consumed).
+    """
+    dh = q.shape[-1]
+    c = q.shape[1]
+    scale = 1.0 / (dh ** 0.5)
+    kk = gather_kv(k_pages, page_table[None]).astype(jnp.float32)
+    vv = gather_kv(v_pages, page_table[None]).astype(jnp.float32)
+    if k_scale is not None:
+        kk = kk * gather_scales(k_scale, page_table[None])[..., None]
+        vv = vv * gather_scales(v_scale, page_table[None])[..., None]
+    lmax = kk.shape[1]
+    pos = start + jnp.arange(c)
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, kk)
+    mask = jnp.arange(lmax)[None, :] <= pos[:, None]         # [C, Lmax]
+    sc = jnp.where(mask[None, None], sc, -1e30)
+    pr = jax.nn.softmax(sc, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", pr, vv).astype(q.dtype)
+
+
+def _prefill_impl_call(impl, q, k_pages, v_pages, page_table, start, valid,
+                       k_scale=None, v_scale=None):
+    """Execute one named prefill impl (also the autotuner's run_impl)."""
+    if impl == "pallas":
+        from paddle_tpu.kernels.pallas.prefill_attention import (
+            prefill_attention as pallas_prefill)
+        return pallas_prefill(q[0], k_pages, v_pages, page_table, start,
+                              valid, k_scale=k_scale, v_scale=v_scale)[None]
+    return _xla_prefill_attention(q, k_pages, v_pages, page_table, start,
+                                  valid, k_scale=k_scale, v_scale=v_scale)
+
+
+def prefill_impl(chunk, pages_per_slot, page_size, nh, dh, dtype,
+                 quant=False, parity=True) -> str:
+    """Resolve (and COUNT) the prefill-attention impl for one program
+    build — the registry is the only selector (`kernels/registry.py`;
+    ``FLAGS_tpu_prefill_impl`` forces, ``auto`` measures via
+    `autotune.prefill_winner`). ``parity=False`` marks a call whose XLA
+    arm does NOT read the page pool (the one-shot `prefill_step` over a
+    narrowing pool dtype), which drops the pallas candidate rather than
+    silently changing numerics."""
+    from paddle_tpu.kernels import registry
+    try:
+        from paddle_tpu.framework.flags import flag_value
+        forced = flag_value("tpu_prefill_impl")
+    except Exception:          # flags registry unavailable (early import)
+        forced = "xla"
+
+    def winner():
+        from paddle_tpu.kernels.autotune import prefill_winner
+        run = _prefill_impl_call
+        variant = ""
+        if quant:
+            variant = "kv-int8"
+
+            def run(impl_, q_, kp_, vp_, row_, start_, valid_):
+                ones = jnp.ones(kp_.shape[:3], jnp.float32)
+                return _prefill_impl_call(
+                    impl_, q_, kp_.astype(jnp.int8), vp_.astype(jnp.int8),
+                    row_, start_, valid_, k_scale=ones, v_scale=ones)
+        return prefill_winner(chunk, pages_per_slot, page_size, nh, dh,
+                              dtype, run, variant=variant, parity=parity)
+
+    return registry.dispatch("prefill_attention", forced=forced,
+                             ctx={"parity": parity}, winner=winner)
+
+
+def prefill_attention(q, k_pages, v_pages, page_table, start, valid,
+                      k_scale=None, v_scale=None):
+    """One CHUNK of ragged prefill attention for ONE sequence, over pages
+    the chunk's K/V were just written to — the dispatch switch the
+    registry routes (`prefill_step` / `prefill_chunk_step` / the PTKS1
+    streaming path all land here or on :func:`prefill_impl`):
+
+    q          : [1, C, nh, dh] chunk queries (leading batch of 1 — the
+                 step programs' native layout)
+    k_pages    : [num_pages, page_size, nh, dh] (one layer)
+    page_table : [pages_per_slot] int32 — this sequence's page row
+    start      : scalar int32 absolute position of the chunk's first token
+    valid      : scalar int32 true token count in this chunk
+    returns    : [1, C, nh, dh] in q.dtype — token-identical between arms
+                 (rows < valid; parity-tested in interpret mode off-TPU)
+    """
+    impl = prefill_impl(q.shape[1], page_table.shape[0], k_pages.shape[1],
+                        q.shape[2], q.shape[3], q.dtype,
+                        quant=k_scale is not None)
+    return _prefill_impl_call(impl, q, k_pages, v_pages, page_table, start,
+                              valid, k_scale=k_scale, v_scale=v_scale)
 
 
 def token_page_coords(page_table, pos, active, page_size):

@@ -13,6 +13,11 @@ Kernels:
   2604.15464): grid over (sequence, head), double-buffered page DMA, page
   loop bounded by each sequence's true length. The serving engine's hot
   kernel (`FLAGS_tpu_paged_impl`).
+- :mod:`prefill_attention` — the ragged PREFILL twin (r15): grid over
+  (chunk-row block, head), scalar-prefetched (start, valid), page walk
+  bounded by the request's true uncached tail — chunked prefill, prefix
+  tails, and the PTKS1 prefill-worker stream all ride it
+  (`FLAGS_tpu_prefill_impl`, selection in `kernels/registry.py`).
 - :mod:`fused_layernorm` — single-pass layernorm fwd + analytic bwd
   (≈ `fused_layernorm` kernels in `phi/kernels/fusion/`).
 - :mod:`rotary` — fused rotary position embedding
@@ -25,3 +30,4 @@ from paddle_tpu.kernels.pallas.flash_attention import flash_attention  # noqa: F
 from paddle_tpu.kernels.pallas.fused_layernorm import fused_layer_norm  # noqa: F401
 from paddle_tpu.kernels.pallas.rotary import apply_rotary_emb  # noqa: F401
 from paddle_tpu.kernels.pallas import paged_attention as paged_attention  # noqa: F401,PLC0414
+from paddle_tpu.kernels.pallas import prefill_attention as prefill_attention  # noqa: F401,PLC0414

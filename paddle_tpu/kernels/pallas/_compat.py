@@ -1,21 +1,85 @@
-"""Backend probe shared by the authored Pallas kernels."""
+"""Backend probe shared by the authored Pallas kernels and the kernel
+registry's viability predicates.
+
+Historically this was a hardcoded platform check: any tpu-named backend
+served by the experimental 'axon' dev tunnel was pinned to interpret mode
+forever, because the tunnel could not lower Mosaic (trace-time
+RecursionError) and executing an unsupported op there poisons the device
+stream. That pin had a cost (VERDICT round-5 item 6): the day the tunnel
+gained Mosaic support, nothing would have noticed.
+
+The rule is now RE-PROBED once per process, BY NAME and by LOWERING only:
+
+- non-TPU platforms never probe (interpret mode, as before);
+- a tpu-named backend lowers one trivial Mosaic kernel —
+  ``jax.jit(...).lower(...)`` traces and lowers but never executes, so a
+  tunnel that cannot lower fails the probe harmlessly at trace time while
+  one that CAN enables the compiled Pallas arms (and their registry
+  candidates, `kernels/autotune.py::_paged_candidates`) the day it learns
+  to, with zero code changes;
+- the result is cached per backend NAME for the life of the process
+  (``_PROBED``), so the probe costs one lowering per process, not one per
+  trace.
+"""
 from __future__ import annotations
+
+_PROBED: dict[str, bool] = {}   # backend name -> Mosaic lowering works
+
+
+def _tunnel_name() -> str:
+    """'axon' when the experimental tunnel backs the tpu platform, else
+    'tpu' (probe key only — never used to gate without probing)."""
+    try:
+        from jax._src import xla_bridge
+        if "axon" in xla_bridge.backends():
+            return "axon"
+    except Exception:  # noqa: BLE001
+        pass
+    return "tpu"
+
+
+def probe_mosaic_lowering(name: str) -> bool:
+    """LOWER (never execute, never compile-to-binary) one trivial Mosaic
+    kernel, once per process per backend name. A backend that cannot
+    lower Mosaic raises at trace/lower time without touching the device
+    stream — exactly the safe half of the historical failure mode."""
+    if name in _PROBED:
+        return _PROBED[name]
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _copy(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def _fn(x):
+            return pl.pallas_call(
+                _copy,
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))(x)
+
+        jax.jit(_fn).lower(jax.ShapeDtypeStruct((8, 128), jnp.float32))
+        ok = True
+    except Exception:  # noqa: BLE001 — any lowering failure means interpret
+        ok = False
+    _PROBED[name] = ok
+    return ok
+
+
+def mosaic_supported() -> bool:
+    """True when the current backend can run compiled Mosaic kernels.
+    False on every non-TPU platform; on a tpu-named backend the answer is
+    the per-process lowering probe keyed by backend name."""
+    try:
+        import jax
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:  # noqa: BLE001 — a dead backend interprets
+        return False
+    return probe_mosaic_lowering(_tunnel_name())
 
 
 def default_interpret() -> bool:
-    """True when pallas_call must run in interpreter mode.
-
-    Any non-TPU backend interprets; so does the experimental 'axon' dev
-    tunnel, which reports platform "tpu" but cannot lower Mosaic (trace-time
-    RecursionError). Probe by backend NAME only — executing an op to find out
-    poisons a tunnel's stream (same rule as fft._fft_on_device).
-    """
-    import jax
-
-    if jax.default_backend() != "tpu":
-        return True
-    try:
-        from jax._src import xla_bridge
-        return "axon" in xla_bridge.backends()
-    except Exception:
-        return False
+    """True when pallas_call must run in interpreter mode (the inverse of
+    :func:`mosaic_supported` — kept as the name every kernel imports)."""
+    return not mosaic_supported()

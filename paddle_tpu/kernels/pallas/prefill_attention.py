@@ -1,0 +1,248 @@
+"""Authored Pallas TPU ragged PREFILL attention kernel (the prefill half
+of arxiv 2604.15464 — the decode half is `pallas/paged_attention.py`).
+
+The XLA prefill arm (`kernels/paged_attention.py::_xla_prefill_attention`,
+the math `models/gpt.py::prefill_chunk_step` always ran) gathers the FULL
+padded ``[pages_per_slot * page_size, nh, dh]`` K and V windows per layer
+per chunk — HBM traffic and FLOPs scale with the slot's CAPACITY and the
+chunk's pow-2 bucket, not with the request's true uncached tail. Since
+chunked prefill (PR 6) made bucketed prefill the dominant non-decode cost
+and the PR 13 prefill-worker tier runs nothing else, this kernel is the
+drop-in the registry routes to:
+
+- **grid over (chunk-row block, head)** — one grid cell owns a
+  ``[block_q, dh]`` slice of the chunk's queries for one head;
+- **scalar-prefetched per-slot lengths** — ``start`` (absolute position of
+  the chunk's first token) and ``valid`` (true token count in this chunk)
+  arrive via scalar prefetch with the page-table row, so every bound below
+  is known before the body runs;
+- **length-aware stop** — a q block whose rows all sit past ``valid``
+  (bucket padding) visits ZERO pages; an active block's page loop runs
+  ``ceil((start + last_active_row + 1) / page_size)`` iterations — compute
+  AND DMA scale with the request's true context (cached prefix + real
+  tail), never with ``pages_per_slot`` or the pow-2 bucket. Per-cell trip
+  counts are a kernel output (``return_visits``) so tests assert the
+  scaling;
+- **double-buffered page DMA** — the K/V pools stay in HBM
+  (``memory_space=ANY``); each cell streams one ``[page_size, dh]`` page
+  slice at a time into a two-slot VMEM scratch, next page's DMA in flight
+  while the current page is on the MXU, folding into an f32 online softmax
+  — the same rhythm as the decode kernel;
+- **int8-KV scale slices ride the same operands** — under ``k_scale``/
+  ``v_scale`` the pools are int8 and each visited page's ``[page_size]``
+  f32 scale slice DMAs in the same double-buffered rhythm; the dequant is
+  in-register after the copy lands, so HBM traffic is the int8 bytes.
+
+Numerics match the XLA arm (f32 scores, absolute-position mask, f32
+softmax) to token identity — parity in interpret mode off-TPU is enforced
+by tests/test_prefill_pallas.py; selection lives in the kernel registry
+(``FLAGS_tpu_prefill_impl``, `kernels/registry.py`).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def block_visits(start, valid, row0, block_q, page_size):
+    """Trip count of one q block's page loop — the length-aware stop. A
+    block with no row < ``valid`` visits zero pages; otherwise it walks
+    ``ceil((start + last_active_row_in_block + 1) / page_size)`` pages."""
+    nrows = jnp.clip(valid - row0, 0, block_q)
+    last_pos = start + row0 + nrows - 1
+    return jnp.where(nrows > 0, (last_pos + page_size) // page_size, 0)
+
+
+def default_block_q(c: int) -> int:
+    """Query rows per grid cell: the whole chunk for the small chunk sizes
+    serving uses (<= 256 keeps the [block_q, page_size] score tile modest),
+    capped so giant one-shot buckets still tile."""
+    return min(int(c), 256)
+
+
+def _prefill_kernel(meta_ref, pt_ref, q_ref, k_hbm, v_hbm, o_ref, *rest,
+                    page_size, block_q, scale, quant=False,
+                    has_visits=False):
+    # one grid cell per (q block i, head h): q_ref [block_q, 1, dh] in
+    # VMEM, k_hbm/v_hbm the full [num_pages, page_size, nh, dh] pools in
+    # HBM, meta (start, valid) + the page-table row scalar-prefetched into
+    # SMEM. Operand unpacking mirrors the decode kernel: under ``quant``
+    # two scale pools ride extra HBM operands + scale VMEM buffers, and
+    # the visits output exists only under ``return_visits`` (static flag,
+    # never inferred from argument counts).
+    if quant:
+        ks_hbm, vs_hbm, o_ref, *rest = o_ref, rest[0], rest[1], *rest[2:]
+    else:
+        ks_hbm = vs_hbm = None
+    if has_visits:
+        visits_ref, rest = rest[0], rest[1:]
+    else:
+        visits_ref = None
+    if quant:
+        kbuf, vbuf, ksbuf, vsbuf, sem = rest
+    else:
+        kbuf, vbuf, sem = rest
+        ksbuf = vsbuf = None
+    i = pl.program_id(0)
+    h = pl.program_id(1)
+    start = meta_ref[0]
+    valid = meta_ref[1]
+    row0 = i * block_q
+    nrows = jnp.clip(valid - row0, 0, block_q)     # active rows this block
+    npages = block_visits(start, valid, row0, block_q, page_size)
+    if visits_ref is not None:
+        visits_ref[0, 0] = npages      # the loop bound, exported for tests
+
+    def dma(slot, j):
+        # page j of this sequence: DMA this head's [page_size, dh] slice
+        # (plus its [page_size] scale slice when the pool is int8)
+        pg = pt_ref[j]
+        copies = [pltpu.make_async_copy(k_hbm.at[pg, :, h, :], kbuf.at[slot],
+                                        sem.at[0, slot]),
+                  pltpu.make_async_copy(v_hbm.at[pg, :, h, :], vbuf.at[slot],
+                                        sem.at[1, slot])]
+        if quant:
+            copies += [pltpu.make_async_copy(ks_hbm.at[pg, :, h],
+                                             ksbuf.at[slot],
+                                             sem.at[2, slot]),
+                       pltpu.make_async_copy(vs_hbm.at[pg, :, h],
+                                             vsbuf.at[slot],
+                                             sem.at[3, slot])]
+        return copies
+
+    @pl.when(npages > 0)
+    def _():                           # a fully-padded block DMAs nothing
+        for c in dma(0, 0):
+            c.start()
+
+    q = q_ref[:, 0, :].astype(jnp.float32) * scale         # [block_q, dh]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    pos = start + row0 + rows                              # [block_q, 1]
+    row_ok = rows < nrows
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, jnp.int32(2))
+        nslot = jax.lax.rem(j + jnp.int32(1), jnp.int32(2))
+
+        @pl.when(j + jnp.int32(1) < npages)
+        def _():                       # overlap: next page's DMA in flight
+            for c in dma(nslot, j + jnp.int32(1)):
+                c.start()
+
+        for c in dma(slot, j):
+            c.wait()
+        k = kbuf[slot].astype(jnp.float32)                 # [ps, dh]
+        v = vbuf[slot].astype(jnp.float32)
+        if quant:
+            # dequantize in-register AFTER the page copy: the DMA moved
+            # int8 bytes; only the VMEM-resident working tile widens
+            k = k * ksbuf[slot][:, None]
+            v = v * vsbuf[slot][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        kpos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        # absolute-position causality: query at position p sees keys 0..p
+        # — within-chunk future tokens mask out exactly like unwritten
+        # pages; padded rows (>= valid) contribute nothing
+        s = jnp.where((kpos <= pos) & row_ok, s, NEG_INF)  # [block_q, ps]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    dh = q_ref.shape[-1]
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    a0 = jnp.zeros((block_q, dh), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, npages, body, (m0, l0, a0))
+    out = jnp.where(row_ok, acc / jnp.maximum(l, 1e-30), 0.0)
+    o_ref[:, 0, :] = out.astype(o_ref.dtype)
+
+
+def prefill_attention(q, k_pages, v_pages, page_table, start, valid, *,
+                      interpret=None, return_visits=False, block_q=None,
+                      k_scale=None, v_scale=None):
+    """One CHUNK of ragged prefill attention for ONE sequence over paged
+    K/V (the chunk's own K/V already written to its pages):
+
+    q          : [C, nh, dh] — the chunk's queries (rows >= valid are
+                 bucket padding; their output is zeroed)
+    k_pages    : [num_pages, page_size, nh, dh] (one layer)
+    v_pages    : [num_pages, page_size, nh, dh]
+    page_table : [pages_per_slot] int32 — THIS sequence's page row
+    start      : scalar int32 — absolute position of q[0]
+    valid      : scalar int32 — true token count in this chunk
+    k_scale/v_scale : optional [num_pages, page_size, nh] f32 (int8 pools)
+    returns    : [C, nh, dh] in q.dtype; with ``return_visits=True`` also
+                 the per-(q block, head) page-loop trip counts
+                 [ceil(C / block_q), nh] int32 — the ragged-stop proof.
+
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU (CPU
+    parity tests); on TPU the kernel compiles through Mosaic.
+    """
+    if interpret is None:
+        from paddle_tpu.kernels.pallas._compat import default_interpret
+        interpret = default_interpret()
+    quant = k_scale is not None
+    c, nh, dh = q.shape
+    ps = k_pages.shape[1]
+    bq = default_block_q(c) if block_q is None else min(int(block_q), c)
+    nq = pl.cdiv(c, bq)
+    scale = 1.0 / (dh ** 0.5)
+    kern = functools.partial(_prefill_kernel, page_size=ps, block_q=bq,
+                             scale=float(scale), quant=quant,
+                             has_visits=bool(return_visits))
+    out_specs = [pl.BlockSpec((bq, 1, dh), lambda i, j, *_: (i, j, 0))]
+    out_shape = [jax.ShapeDtypeStruct(q.shape, q.dtype)]
+    if return_visits:
+        out_specs.append(pl.BlockSpec((1, 1), lambda i, j, *_: (i, j)))
+        out_shape.append(jax.ShapeDtypeStruct((nq, nh), jnp.int32))
+    in_specs = [
+        pl.BlockSpec((bq, 1, dh), lambda i, j, *_: (i, j, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),         # K pool stays in HBM
+        pl.BlockSpec(memory_space=pltpu.ANY),         # V pool stays in HBM
+    ]
+    scratch = [
+        pltpu.VMEM((2, ps, dh), k_pages.dtype),       # K double buffer
+        pltpu.VMEM((2, ps, dh), v_pages.dtype),       # V double buffer
+    ]
+    operands = [q, k_pages, v_pages]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY),   # K scales
+                     pl.BlockSpec(memory_space=pltpu.ANY)]   # V scales
+        scratch += [pltpu.VMEM((2, ps), jnp.float32),
+                    pltpu.VMEM((2, ps), jnp.float32)]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
+    # semaphore rows: one per in-flight copy kind (k, v[, ks, vs])
+    scratch.append(pltpu.SemaphoreType.DMA((4 if quant else 2, 2)))
+    meta = jnp.stack([jnp.asarray(start, jnp.int32),
+                      jnp.asarray(valid, jnp.int32)])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nq, nh),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    outs = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=bool(interpret),
+    )(meta, page_table.astype(jnp.int32), *operands)
+    if return_visits:
+        return outs[0], outs[1]
+    return outs[0]

@@ -248,6 +248,18 @@ def prefill_step(params, ids, length, page_table, k_pages, v_pages, *, cfg,
         params["gpt.wpe.weight"][None, :s]               # [1, S, H]
     cmask = jnp.tril(jnp.ones((s, s), bool))
     causal = _causal_attend(scale, cmask, x.dtype)
+    # registry-routed impl for the one-shot prefill's attention
+    # (kernels/registry.py, FLAGS_tpu_prefill_impl): the xla arm is the
+    # dense causal pass over the prompt's own K/V; the pallas arm reads
+    # back the pages just written (start=0, valid=length), which is only
+    # numerics-preserving when the pool dtype carries the compute dtype
+    # (or the pool is int8, where the xla arm already attends the
+    # quantize-dequantize round trip) — the ``parity`` ctx drops the
+    # pallas candidate otherwise
+    quant = k_scale is not None
+    impl = pa.prefill_impl(
+        s, page_table.shape[0], ps, nh, dh, x.dtype, quant=quant,
+        parity=quant or k_pages.dtype == x.dtype)
 
     def attend(i, q, k, v):
         nonlocal k_pages, v_pages, k_scale, v_scale
@@ -266,6 +278,15 @@ def prefill_step(params, ids, length, page_table, k_pages, v_pages, *, cfg,
                 k[0].astype(k_pages.dtype))
             v_pages = v_pages.at[i, page, off].set(
                 v[0].astype(v_pages.dtype))
+        if impl == "pallas":
+            # length-aware: the page walk stops at ceil(length/page_size),
+            # not at the pow-2 bucket the queries are padded to
+            return pa._prefill_impl_call(
+                "pallas", q, k_pages[i], v_pages[i], page_table,
+                jnp.int32(0), length,
+                k_scale=None if k_scale is None else k_scale[i],
+                v_scale=None if v_scale is None else v_scale[i]) \
+                .astype(x.dtype)
         return causal(i, q, k, v)
 
     x = _block_stack(params, x, nl, nh, dh, attend)
@@ -301,7 +322,6 @@ def prefill_chunk_step(params, ids, start, valid, page_table, k_pages,
     from paddle_tpu.kernels import paged_attention as pa
     nl, nh = cfg.num_layers, cfg.num_heads
     dh = cfg.hidden_size // nh
-    scale = 1.0 / (dh ** 0.5)
     ps = k_pages.shape[2]
     c = ids.shape[0]
     pos = start + jnp.arange(c)
@@ -321,20 +341,15 @@ def prefill_chunk_step(params, ids, start, valid, page_table, k_pages,
             k, v = k[0].astype(k_pages.dtype), v[0].astype(v_pages.dtype)
         k_pages = k_pages.at[i, page, off].set(k)
         v_pages = v_pages.at[i, page, off].set(v)
-        kk = pa.gather_kv(k_pages[i], page_table[None]) \
-            .astype(jnp.float32)                             # [1, Lmax, ...]
-        vv = pa.gather_kv(v_pages[i], page_table[None]).astype(jnp.float32)
-        if k_scale is not None:
-            kk = kk * pa.gather_scales(k_scale[i], page_table[None])[..., None]
-            vv = vv * pa.gather_scales(v_scale[i], page_table[None])[..., None]
-        lmax = kk.shape[1]
-        sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, kk)
-        # absolute-position causality: within-chunk future tokens sit at
-        # positions > start+i and mask out exactly like unwritten pages
-        mask = jnp.arange(lmax)[None, :] <= pos[:, None]     # [C, Lmax]
-        sc = jnp.where(mask[None, None], sc, -1e30)
-        pr = jax.nn.softmax(sc, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd", pr, vv).astype(x.dtype)
+        # ragged prefill attention over the paged cache — previous chunks
+        # AND the current one, absolute-position masked. Registry-routed
+        # (kernels/registry.py): xla gathers the full window, pallas
+        # streams only ceil((start+valid)/page_size) pages per (q block,
+        # head) cell
+        return pa.prefill_attention(
+            q, k_pages[i], v_pages[i], page_table, start, valid,
+            k_scale=None if k_scale is None else k_scale[i],
+            v_scale=None if v_scale is None else v_scale[i]).astype(x.dtype)
 
     x = _block_stack(params, x, nl, nh, dh, attend)
     last = x[0, jnp.clip(valid - 1, 0, c - 1)]
@@ -345,7 +360,7 @@ def prefill_chunk_step(params, ids, start, valid, page_table, k_pages,
 
 
 def verify_step(params, tok_seq, draft_len, cache, slot_mask, *, cfg,
-                sampler=None, keys=None):
+                sampler=None, keys=None, sample_state=None):
     """Speculative-decode VERIFY: score k+1 positions per slot in ONE
     fixed-shape step over the paged gather.
 
@@ -374,6 +389,14 @@ def verify_step(params, tok_seq, draft_len, cache, slot_mask, *, cfg,
                 each slot's chain advanced by its n_emitted splits — so
                 sampled speculative decode is bit-identical to plain
                 sampled decode (parity-tested incl. top-k)
+    sample_state : the FUSED per-slot sampler (kernels/sampling.py, the
+                engine's sampling mode): a ``(keys [B, 2] uint32,
+                temperatures [B] f32, top_ks [B] i32)`` triple. Same key
+                discipline as ``sampler``/``keys`` but with DYNAMIC
+                per-slot params riding program inputs — one compiled
+                verify program serves every request's sampling knobs
+                (greedy slots run the argmax arm, chains untouched).
+                Mutually exclusive with ``sampler``
     returns   : (emitted [B, K+1] int32 — positions < n_emitted are the
                  step's output tokens —, n_emitted [B] int32 in 0..K+1,
                  new cache with lengths advanced by n_emitted[, new_keys])
@@ -426,9 +449,28 @@ def verify_step(params, tok_seq, draft_len, cache, slot_mask, *, cfg,
     x = _block_stack(params, x, nl, nh, dh, attend)
     logits = _final_logits(params, x)                          # [B, K+1, V]
 
+    if sampler is not None and sample_state is not None:
+        raise ValueError("verify_step takes sampler= OR sample_state=, "
+                         "not both")
     new_keys = None
-    if sampler is None:
+    if sampler is None and sample_state is None:
         out = jnp.argmax(logits, axis=-1).astype(tok_seq.dtype)
+    elif sample_state is not None:
+        # the fused per-slot sampler: dynamic (temperature, top_k) ride
+        # program inputs, so one warm program serves every request's
+        # sampling knobs (kernels/sampling.py — bit-identical to the
+        # static `sampler` path for matching params)
+        from paddle_tpu.kernels.sampling import sample_one
+        keys, temps, topks = sample_state
+
+        def fchain(key, lg, t, tk):    # one slot: [K+1, V] logits
+            def one(k_, l_):
+                tok, k2 = sample_one(l_, k_, t, tk)
+                return k2, (tok, k2)
+            _, (toks, keys_after) = jax.lax.scan(one, key, lg)
+            return toks, keys_after
+        out, keys_after = jax.vmap(fchain)(keys, logits, temps, topks)
+        out = out.astype(tok_seq.dtype)
     else:
         def chain(key, lg):            # one slot: [K+1, V] logits
             def one(k_, l_):
@@ -439,27 +481,38 @@ def verify_step(params, tok_seq, draft_len, cache, slot_mask, *, cfg,
         out, keys_after = jax.vmap(chain)(keys, logits)
         out = out.astype(tok_seq.dtype)
 
-    k = kp1 - 1
-    if k > 0:
-        match = (tok_seq[:, 1:] == out[:, :-1]) \
-            & (jnp.arange(k)[None] < draft_len[:, None])
-        # contiguous-prefix acceptance: the first mismatch rejects the rest
-        n_acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
-    else:
-        n_acc = jnp.zeros(b, jnp.int32)
-    n_emitted = jnp.where(slot_mask, n_acc + 1, 0).astype(jnp.int32)
+    # the ONE accept-test implementation (kernels/sampling.py): longest
+    # draft prefix matching the model's own emissions + 1 corrected token
+    from paddle_tpu.kernels.sampling import accept_drafts
+    n_emitted = accept_drafts(tok_seq[:, 1:], out, draft_len, slot_mask)
     new_cache = dict(k_pages=kc, v_pages=vc, page_table=page_table,
                      lengths=jnp.where(slot_mask, lengths + n_emitted,
                                        lengths))
     if ks is not None:
         new_cache.update(k_scale=ks, v_scale=vs)
-    if sampler is None:
+    if sampler is None and sample_state is None:
         return out, n_emitted, new_cache
     new_keys = jnp.take_along_axis(
         keys_after, jnp.maximum(n_emitted - 1, 0)[:, None, None], axis=1)[:, 0]
     # an inactive slot emitted nothing: its chain must not move at all
     new_keys = jnp.where((n_emitted > 0)[:, None], new_keys, keys)
     return out, n_emitted, new_cache, new_keys
+
+
+def _fused_ce_impl(cfg) -> str:
+    """Registry-routed LM-head CE selection (`kernels/registry.py`,
+    op ``fused_ce``): "fused" = chunked-vocab fused_linear_cross_entropy
+    (never materializes the [N, V] logits), "dense" = logits +
+    log-softmax. The fused arm is viable only without an mp axis (the
+    vocab is sharded under mp and only the parallel CE is correct);
+    ``cfg.fused_ce=False`` forces dense. Counted per trace in
+    ``kernel.dispatch.fused_ce.{fused|dense}``."""
+    from paddle_tpu.kernels import registry
+    mesh = get_mesh()
+    mp = 1 if mesh is None else mesh.shape.get("mp", 1)
+    return registry.dispatch(
+        "fused_ce", forced="fused" if cfg.fused_ce else "dense",
+        ctx={"mp": mp}, require_viable=True)
 
 
 def _sp_constrain(x, cfg):
@@ -712,8 +765,7 @@ def scan_loss(stacked, ids, labels, cfg, *, loss_mask=None, training=True,
     h = scan_hidden(stacked, ids, cfg, training=training,
                     dropout_key=dropout_key)
     wte = stacked["top"]["gpt.wte.weight"]
-    mesh = get_mesh()
-    use_fused = cfg.fused_ce and (mesh is None or mesh.shape.get("mp", 1) == 1)
+    use_fused = _fused_ce_impl(cfg) == "fused"
     if use_fused:
         from paddle_tpu.kernels.fused_ce import fused_linear_cross_entropy
         n = h.shape[0] * h.shape[1]
@@ -882,10 +934,10 @@ class GPTForCausalLM(nn.Layer):
     def forward(self, input_ids, labels=None, loss_mask=None):
         h = self.gpt(input_ids)
         # tied lm head: logits = h @ wte^T (vocab-sharded over mp like the
-        # reference's parallel lm head + ParallelCrossEntropy)
-        mesh = get_mesh()
-        use_fused = (labels is not None and self.cfg.fused_ce
-                     and (mesh is None or mesh.shape.get("mp", 1) == 1))
+        # reference's parallel lm head + ParallelCrossEntropy); the
+        # fused-vs-dense choice is registry-routed (kernels/registry.py)
+        use_fused = (labels is not None
+                     and _fused_ce_impl(self.cfg) == "fused")
         if use_fused:
             from paddle_tpu.core.autograd import apply
             from paddle_tpu.kernels.fused_ce import fused_linear_cross_entropy

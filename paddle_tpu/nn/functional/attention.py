@@ -66,12 +66,19 @@ def sequence_parallel_attention(query, key, value, is_causal=True, scale=None,
         raise ValueError(f"sequence length {S} not divisible by sp={sp}")
     if impl == "ulysses" and H % sp:
         raise ValueError(f"ulysses needs heads ({H}) divisible by sp ({sp})")
-    if impl not in ("ring", "ulysses"):
+    if impl not in ("ring", "ulysses", "auto"):
         raise ValueError(
             f"unknown sequence-parallel attention impl {impl!r}; "
             "choose 'ring', 'ulysses', or 'none'")
+    from paddle_tpu.kernels import registry
     from paddle_tpu.kernels.ring_attention import (
         ring_attention, ulysses_attention)
+    # registry-routed (kernels/registry.py): the op validates viability
+    # (ulysses needs heads % sp == 0) and counts
+    # kernel.dispatch.sp_attention.{ring|ulysses}; "auto" picks the first
+    # viable candidate (ring — correct for every shape)
+    impl = registry.dispatch("sp_attention", forced=impl,
+                             ctx={"heads": H, "sp": sp})
     kern = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
 
     def prim(qa, ka, va):

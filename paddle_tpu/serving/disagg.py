@@ -37,6 +37,16 @@ arxiv 2412.14374):
   can key the directory without asking an engine. Chained hashes mean a
   replica holding page i's hash holds every page before it too — a
   directory lookup walks the hashes longest-first.
+
+The prefill COMPUTE feeding this stream is registry-routed
+(`kernels/registry.py`, r15): every chunk the stream exports runs
+`models/gpt.py::prefill_chunk_step`, whose attention dispatches between
+the XLA gather arm and the authored Pallas ragged prefill kernel
+(`kernels/pallas/prefill_attention.py`) under ``FLAGS_tpu_prefill_impl``
+— a prefill-worker tier that runs NOTHING ELSE gets the length-scaled
+kernel with zero changes here, and `kernel.dispatch.prefill_attention.*`
+counts which arm each worker compiled (tests/test_prefill_pallas.py pins
+stream-path token identity between arms).
 """
 from __future__ import annotations
 

@@ -1,0 +1,275 @@
+"""Fused on-device sampling (r15, `kernels/sampling.py` +
+`EngineConfig.sampling`): bit-parity with the host sampler's key
+discipline (`fast_generate`), the one-impl spec-decode accept test, the
+d2h-is-token-harvest-only contract (`engine.logits_readback` pinned 0),
+and sampled state riding migration/handoff."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.kernels.sampling import accept_drafts, fused_sample, \
+    sample_one
+from paddle_tpu.models.gpt import _make_sampler
+from paddle_tpu.observability import metrics
+
+PARAMS = [(1.0, 0), (0.8, 0), (1.0, 5), (0.7, 3), (2.5, 1)]
+
+
+class TestSampleOne:
+    @pytest.mark.parametrize("t,k", PARAMS)
+    def test_bit_identical_chain_vs_make_sampler(self, t, k):
+        rng = np.random.RandomState(int(t * 10) + k)
+        host = _make_sampler(t, k)
+        rk = fk = jax.random.PRNGKey(42)
+        for _ in range(5):
+            lg = jnp.asarray(rng.randn(1, 64).astype(np.float32))
+            a, rk = host(lg, rk)
+            b, fk = sample_one(lg[0], fk, jnp.float32(t), jnp.int32(k))
+            assert int(a[0]) == int(b)
+            assert np.array_equal(np.asarray(rk), np.asarray(fk))
+
+    def test_greedy_never_advances_the_chain(self):
+        lg = jnp.asarray(np.random.RandomState(0)
+                         .randn(64).astype(np.float32))
+        key = jax.random.PRNGKey(7)
+        tok, nk = sample_one(lg, key, jnp.float32(1.0), jnp.int32(0))
+        assert int(tok) == int(np.argmax(np.asarray(lg)))
+        assert np.array_equal(np.asarray(nk), np.asarray(key))
+
+    def test_batched_mixed_params(self):
+        rng = np.random.RandomState(1)
+        logits = jnp.asarray(rng.randn(4, 64).astype(np.float32))
+        keys = jnp.stack([jax.random.PRNGKey(i) for i in range(4)])
+        cases = [(1.0, 0), (0.5, 4), (1.0, 7), (2.0, 0)]
+        toks, nkeys = fused_sample(
+            logits, keys,
+            jnp.asarray([t for t, _ in cases], jnp.float32),
+            jnp.asarray([k for _, k in cases], jnp.int32))
+        for i, (t, k) in enumerate(cases):
+            ref, _ = _make_sampler(t, k)(logits[i][None],
+                                         jax.random.PRNGKey(i))
+            assert int(ref[0]) == int(toks[i])
+        assert np.array_equal(np.asarray(nkeys[0]), np.asarray(keys[0]))
+
+
+class TestAcceptDrafts:
+    def test_prefix_acceptance_semantics(self):
+        drafts = jnp.asarray([[5, 6], [5, 6], [9, 9], [1, 1]], jnp.int32)
+        out = jnp.asarray([[5, 6, 7], [5, 9, 7], [1, 9, 7], [1, 1, 1]],
+                          jnp.int32)
+        dl = jnp.asarray([2, 2, 2, 0], jnp.int32)
+        mask = jnp.asarray([True, True, True, True])
+        n = np.asarray(accept_drafts(drafts, out, dl, mask))
+        # full accept+1, first-match+1, first mismatch rejects rest,
+        # zero drafts -> exactly one token
+        assert n.tolist() == [3, 2, 1, 1]
+        n2 = np.asarray(accept_drafts(drafts, out, dl,
+                                      jnp.asarray([False] * 4)))
+        assert n2.tolist() == [0, 0, 0, 0]
+
+
+def _tiny_model():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(31)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=2, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def _ref(model, prompt, t, k, s, n=8):
+    out = model.fast_generate(paddle.Tensor(prompt[None], _internal=True),
+                              max_new_tokens=n, temperature=t, top_k=k,
+                              seed=s)
+    return np.asarray(out.numpy())[0]
+
+
+class TestEngineSampling:
+    """Engine-level parity: the fused sampler IS fast_generate's sampler,
+    threaded through the fixed-shape step programs."""
+
+    def test_concurrent_mixed_params_bit_identical(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        prompt = np.random.RandomState(1).randint(0, 97, 11) \
+            .astype(np.int32)
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=3,
+                                           min_bucket=8, sampling=True))
+        cases = [(0.8, 5, 7), (1.3, 0, 3), (1.0, 4, 11)]
+        reqs = [eng.submit(prompt, max_new_tokens=8, temperature=t,
+                           top_k=k, seed=s) for (t, k, s) in cases]
+        eng.run_until_idle(max_steps=64)
+        for (t, k, s), r in zip(cases, reqs):
+            assert np.array_equal(r.result(30), _ref(m, prompt, t, k, s))
+
+    def test_greedy_on_sampling_engine_matches_plain_engine(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        prompt = np.random.RandomState(2).randint(0, 97, 9) \
+            .astype(np.int32)
+        outs = []
+        for sampling in (False, True):
+            eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                               min_bucket=8,
+                                               sampling=sampling))
+            r = eng.submit(prompt, max_new_tokens=6)
+            eng.run_until_idle(max_steps=40)
+            outs.append(r.result(30))
+        assert np.array_equal(outs[0], outs[1])
+
+    def test_chunked_prefill_samples_final_chunk_only(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        prompt = np.random.RandomState(3).randint(0, 97, 14) \
+            .astype(np.int32)
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8, sampling=True,
+                                           prefill_chunk_tokens=4))
+        r = eng.submit(prompt, max_new_tokens=6, temperature=0.7,
+                       top_k=3, seed=5)
+        eng.run_until_idle(max_steps=64)
+        assert np.array_equal(r.result(30),
+                              _ref(m, prompt, 0.7, 3, 5, n=6))
+
+    def test_speculative_sampled_bit_identical(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        rp = np.tile(np.random.RandomState(4).randint(0, 97, 4), 3) \
+            .astype(np.int32)
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8, sampling=True,
+                                           speculate_k=2))
+        r = eng.submit(rp, max_new_tokens=8, temperature=0.9, top_k=4,
+                       seed=2)
+        eng.run_until_idle(max_steps=64)
+        assert np.array_equal(r.result(30), _ref(m, rp, 0.9, 4, 2))
+        assert metrics.snapshot()["counters"].get("engine.spec_steps",
+                                                  0) >= 1
+
+    def test_d2h_stays_token_harvest_only(self):
+        """The de-sync contract under sampling: EXACTLY one d2h per
+        decode step plus one per prefill — the sampler added zero — and
+        `engine.logits_readback` is 0 (there is no logits path to the
+        host at all)."""
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        prompt = np.random.RandomState(5).randint(0, 97, 7) \
+            .astype(np.int32)
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8, sampling=True))
+        eng.warmup(prompt_lens=[7])
+        c0 = metrics.snapshot()["counters"]
+        r = eng.submit(prompt, max_new_tokens=6, temperature=0.8,
+                       top_k=4, seed=1)
+        eng.run_until_idle(max_steps=40)
+        assert r.done
+        c1 = metrics.snapshot()["counters"]
+        steps = c1.get("engine.steps", 0) - c0.get("engine.steps", 0)
+        d2h = c1.get("engine.d2h_transfers", 0) \
+            - c0.get("engine.d2h_transfers", 0)
+        assert d2h == steps + 1, (d2h, steps)   # +1 = the prefill readback
+        assert c1.get("engine.logits_readback", 0) == 0
+
+    def test_dedup_key_reuse_with_different_sampling_params_refused(self):
+        """Review-round regression: an idempotency key names ONE logical
+        request INCLUDING its distribution — a resubmit of the same key
+        with different temperature/top_k/seed must refuse loudly, never
+        silently attach to (or replay) the original distribution's
+        tokens."""
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8, sampling=True))
+        p = np.arange(5, dtype=np.int32)
+        key = b"k" * 16
+        eng.submit(p, max_new_tokens=4, temperature=0.8, top_k=5, seed=1,
+                   request_key=key)
+        with pytest.raises(ValueError, match="temperature/top_k/seed"):
+            eng.submit(p, max_new_tokens=4, request_key=key)  # greedy now
+        with pytest.raises(ValueError, match="temperature/top_k/seed"):
+            eng.submit(p, max_new_tokens=4, temperature=0.8, top_k=5,
+                       seed=2, request_key=key)
+        # the SAME params attach fine (one generation, two waiters)
+        again = eng.submit(p, max_new_tokens=4, temperature=0.8, top_k=5,
+                           seed=1, request_key=key)
+        eng.run_until_idle(max_steps=40)
+        assert again.result(30) is not None
+
+    def test_non_sampling_engine_refuses_sampled_params(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8))
+        p = np.arange(5, dtype=np.int32)
+        with pytest.raises(ValueError, match="sampling=True"):
+            eng.submit(p, max_new_tokens=4, temperature=0.5)
+        with pytest.raises(ValueError, match="temperature"):
+            eng.submit(p, max_new_tokens=4, temperature=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            eng.submit(p, max_new_tokens=4, top_k=-1)
+
+
+class TestSampledMigration:
+    """A sampled request's chain state rides the handoff: the resumed
+    decode continues the BIT-IDENTICAL sampled sequence."""
+
+    def test_warm_migration_bit_identical(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        prompt = np.random.RandomState(6).randint(0, 97, 11) \
+            .astype(np.int32)
+        want = _ref(m, prompt, 0.8, 5, 9)
+        cfg = dict(page_size=4, max_slots=2, min_bucket=8, sampling=True)
+        src = DecodeEngine(m, EngineConfig(**cfg))
+        dst = DecodeEngine(m, EngineConfig(**cfg))
+        r = src.submit(prompt, max_new_tokens=8, temperature=0.8,
+                       top_k=5, seed=9)
+        for _ in range(3):
+            src.step()
+        assert not r.done
+        src.drain(migrate=True)
+        src.step()
+        (item,) = src.take_migrated(timeout=30)
+        assert item.handoff is not None
+        assert item.handoff.sample["top_k"] == 5
+        rm = dst.submit_import(item.handoff,
+                               max_new_tokens=item.max_new_tokens)
+        dst.run_until_idle(max_steps=64)
+        assert np.array_equal(rm.result(30), want)
+
+    def test_cold_item_carries_seed_and_wire_roundtrip(self):
+        from paddle_tpu.inference.engine import (
+            KVHandoff, MigrationItem, pack_migration, unpack_migration)
+        item = MigrationItem(
+            max_new_tokens=5, prompt=np.arange(3, dtype=np.int32),
+            sample={"temperature": 0.7, "top_k": 2, "seed": 4})
+        it2 = unpack_migration(pack_migration(item))
+        assert it2.sample == {"temperature": 0.7, "top_k": 2, "seed": 4}
+        h = KVHandoff(prompt=np.arange(4, dtype=np.int32), first_token=3,
+                      k_pages=np.zeros((1, 1, 4, 2, 8), np.float32),
+                      v_pages=np.zeros((1, 1, 4, 2, 8), np.float32),
+                      page_size=4, cache_dtype="float32",
+                      sample={"temperature": 0.8, "top_k": 5,
+                              "key": [123, 456]})
+        assert KVHandoff.unpack(h.pack()).sample["key"] == [123, 456]
+
+    def test_sampled_handoff_into_greedy_engine_refused(self):
+        from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+        m = _tiny_model()
+        cfg = dict(page_size=4, max_slots=2, min_bucket=8)
+        src = DecodeEngine(m, EngineConfig(sampling=True, **cfg))
+        prompt = np.random.RandomState(7).randint(0, 97, 9) \
+            .astype(np.int32)
+        r = src.submit(prompt, max_new_tokens=6, temperature=0.8, seed=3)
+        for _ in range(2):
+            src.step()
+        src.drain(migrate=True)
+        src.step()
+        (item,) = src.take_migrated(timeout=30)
+        plain = DecodeEngine(m, EngineConfig(**cfg))
+        with pytest.raises(ValueError, match="sampling=True"):
+            plain.submit_import(item.handoff,
+                                max_new_tokens=item.max_new_tokens)

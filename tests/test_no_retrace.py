@@ -525,3 +525,80 @@ def test_elastic_split_step_compiles_once_then_never():
     assert _compile_counters() == frozen, (
         "jit.compile_count grew on batch/stop-vote churn through the "
         "split grads/apply pipeline")
+
+
+def test_ragged_prefill_pallas_compiles_once_per_bucket_class():
+    """FLAGS_tpu_prefill_impl=pallas (the authored ragged prefill kernel,
+    r15) must be exactly as shape-stable as the XLA arm: one one-shot
+    program per prefill bucket, one chunk program per chunk width, and
+    prompt-length churn WITHIN a bucket class never retraces the Pallas
+    call — the scalar-prefetched (start, valid) carry the raggedness."""
+    from paddle_tpu.framework.flags import set_flags
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    set_flags({"tpu_prefill_impl": "pallas"})
+    try:
+        m = _tiny_model()
+        eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                           min_bucket=8,
+                                           prefill_chunk_tokens=4))
+        rng = np.random.RandomState(5)
+        # warm the chunk program (every prompt > 4 tokens routes through
+        # chunks of 4) and the decode step
+        r = eng.submit(rng.randint(0, 64, 9).astype(np.int32), 3)
+        eng.run_until_idle(max_steps=40)
+        assert r.done
+        frozen = _compile_counters()
+        # ragged churn: different true lengths, different chunk counts,
+        # different (start, valid) per chunk — SAME programs
+        for s0 in (5, 7, 11, 13):
+            rq = eng.submit(rng.randint(0, 64, s0).astype(np.int32), 2)
+            eng.run_until_idle(max_steps=60)
+            assert rq.done
+        assert _compile_counters() == frozen, (
+            "pallas ragged prefill recompiled on prompt-length churn")
+    finally:
+        set_flags({"tpu_prefill_impl": "auto"})
+
+
+def test_fused_sampler_adds_zero_programs():
+    """The fused on-device sampler (EngineConfig.sampling, r15) must add
+    ZERO programs to the decode/verify counts: one decode program serves
+    every (temperature, top_k, seed) — the params ride the packed upload
+    — and per-request knob churn after warmup never recompiles. Same
+    contract for the speculative verify program."""
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    m = _tiny_model()
+    rng = np.random.RandomState(9)
+
+    eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                       min_bucket=8, sampling=True))
+    r = eng.submit(rng.randint(0, 64, 5).astype(np.int32), 3,
+                   temperature=0.8, top_k=5, seed=1)
+    eng.run_until_idle(max_steps=30)
+    assert r.done
+    # exactly the greedy engine's program set: 1 decode + 1 prefill bucket
+    assert len(eng._programs) == 2, sorted(eng._programs)
+    frozen = _compile_counters()
+    for i, (t, k) in enumerate([(1.0, 0), (0.5, 3), (2.0, 0), (1.0, 7)]):
+        rq = eng.submit(rng.randint(0, 64, 4 + i).astype(np.int32), 2,
+                        temperature=t, top_k=k, seed=i)
+        eng.run_until_idle(max_steps=40)
+        assert rq.done
+    assert _compile_counters() == frozen, (
+        "sampling-param churn recompiled a step program")
+
+    spec = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                        min_bucket=8, sampling=True,
+                                        speculate_k=2))
+    r2 = spec.submit(np.tile(rng.randint(0, 64, 3), 3).astype(np.int32), 4,
+                     temperature=0.7, top_k=4, seed=2)
+    spec.run_until_idle(max_steps=40)
+    assert r2.done
+    assert len(spec._programs) == 2, sorted(spec._programs)  # verify+prefill
+    frozen2 = _compile_counters()
+    # greedy mix, same prefill bucket (len 10 pads to 16 like the warmup 9)
+    r3 = spec.submit(rng.randint(0, 64, 10).astype(np.int32), 3)
+    spec.run_until_idle(max_steps=40)
+    assert r3.done
+    assert _compile_counters() == frozen2, (
+        "greedy/sampled mix recompiled the verify program")

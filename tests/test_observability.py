@@ -410,6 +410,45 @@ def test_bench_smoke_emits_structured_json():
     assert d["metrics"]["counters"]["serve.prefill_streams"] >= 1
     assert d["metrics"]["counters"]["serve.kv_stream_in"] >= 1
     assert d["metrics"]["counters"]["engine.kv_stream_exports"] >= 1
+    # r15: the smoke run samples one request through the FUSED ON-DEVICE
+    # sampler (kernels/sampling.py) bit-identically to fast_generate's
+    # host sampler, with zero logits readbacks, and every kernel
+    # selection routed through the ONE registry (kernels/registry.py —
+    # kernel.dispatch.* counters fired for paged/prefill/sampling/ce)
+    assert d["fused_sampler_ok"] is True
+    assert d["logits_readback"] == 0
+    kd = {k: v for k, v in d["metrics"]["counters"].items()
+          if k.startswith("kernel.dispatch.") and v}
+    for op in ("paged_attention", "prefill_attention", "fused_sampling",
+               "fused_ce", "flash_attention"):
+        assert any(k.startswith(f"kernel.dispatch.{op}.") for k in kd), \
+            (op, sorted(kd))
+
+
+def test_bench_preflight_dead_backend_falls_back_to_cpu_rungs():
+    """r15 satellite: the backend PREFLIGHT executes one op BEFORE the
+    ladder — a backend that initializes but dies on first USE (the
+    BENCH_r05 `parsed:null` shape that `_init_backend` alone cannot
+    catch) must fall back to CPU rungs with the original failure
+    recorded. Driven by the `bench.preflight` fault site at times=1 (the
+    CPU re-probe then succeeds) through the fast `--preflight-only`
+    surface: rc 0, ok=true, platform=cpu, the injected error preserved
+    in backend_error."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_FAULTS"] = "bench.preflight:exc=RuntimeError:times=1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--preflight-only"],
+        capture_output=True, text=True, timeout=180, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, (proc.stdout, proc.stderr[-2000:])
+    d = json.loads(lines[-1])
+    assert d["metric"] == "bench_preflight"
+    assert d["ok"] is True and d["platform"] == "cpu"
+    assert "preflight" in (d["backend_error"] or "")
+    assert "RuntimeError" in d["backend_error"]
 
 
 @pytest.mark.slow      # tier-1 wall audit (PR 12): ~19 s — a SECOND full
