@@ -2021,26 +2021,65 @@ def bench_smoke():
 
     # one ROUTED request on CPU (paddle_tpu/serving): an in-process engine
     # replica behind the router front door, static membership — keeps the
-    # multi-replica subsystem import- and wire-clean under tier-1
+    # multi-replica subsystem import- and wire-clean under tier-1. The
+    # second request is TRACED (docs/OBSERVABILITY.md "Fleet tracing"):
+    # the minted context must chain client -> router -> replica spans and
+    # export over the TRACE_EXPORT wire op (`fleet_trace_ok`), and the
+    # router's STATS poll must feed the attached fleet metrics plane —
+    # rollup, re-labeled Prometheus rows, and the shared snapshot API
+    # (`fleet_metrics_ok`); both asserted in tests/test_observability.py
     import threading
     from paddle_tpu.inference.serve import InferenceServer, RemotePredictor
+    from paddle_tpu.observability.fleet import FleetMetrics, TraceCollector
+    from paddle_tpu.observability.tracing import mint_trace
     from paddle_tpu.serving import Router
     r_eng = DecodeEngine(model, EngineConfig(page_size=2, max_slots=2,
                                              min_bucket=4,
                                              prefill_chunk_tokens=2))
     replica = InferenceServer(None, engine=r_eng, auth_name="bench-fleet")
     threading.Thread(target=replica.serve_forever, daemon=True).start()
+    fm = FleetMetrics()
     router = Router(replicas={"r0": f"127.0.0.1:{replica.port}"},
-                    replica_secret="bench-fleet", auth_name="bench-router")
+                    replica_secret="bench-fleet", auth_name="bench-router",
+                    stats_interval_s=0.2).attach_fleet(fm)
     threading.Thread(target=router.serve_forever, daemon=True).start()
     cli = RemotePredictor(port=router.port, secret="bench-router")
     routed = cli.generate(ids[0, :4].astype(np.int32), max_new_tokens=2)
+    tr_id, tr_parent = mint_trace()
+    traced = cli.generate(ids[0, :4].astype(np.int32), max_new_tokens=2,
+                          trace_id=tr_id, parent_span=tr_parent)
+    assert np.array_equal(traced, routed), (traced, routed)
+    tr_export = cli.trace_export(tr_id)
+
+    def _fleet_caught_up():
+        # the router ingests r0 synchronously at construction — wait for
+        # a poll that postdates BOTH requests, not just membership
+        s = fm.snapshot_for(f"127.0.0.1:{replica.port}")
+        return s is not None and s["counters"].get("serve.requests", 0) >= 2
+    t_end = time.monotonic() + 15
+    while not _fleet_caught_up() and time.monotonic() < t_end:
+        time.sleep(0.05)
     cli.close()
     router.stop()
     replica.drain(deadline_s=10.0)
     assert routed.shape == (6,), routed.shape
     router_ok = metrics.snapshot()["counters"].get("router.requests",
                                                    0) >= 1
+    tr_stitched = TraceCollector.stitch([tr_export])
+    tr_names = {e["name"] for e in tr_stitched["traceEvents"]
+                if e.get("ph") == "X"}
+    fleet_trace_ok = (
+        {"client.generate", "router.forward", "request.e2e"} <= tr_names
+        and all(e["args"]["trace_id"] == tr_id
+                for e in tr_stitched["traceEvents"] if e.get("ph") == "X"))
+    assert fleet_trace_ok, sorted(tr_names)
+    fleet_roll = fm.rollup()
+    fleet_metrics_ok = (
+        "r0" in fm.members()
+        and fm.snapshot_for(f"127.0.0.1:{replica.port}") is not None
+        and fleet_roll["counters"].get("serve.requests", 0) >= 2
+        and 'replica="r0"' in fm.to_prometheus())
+    assert fleet_metrics_ok, (sorted(fm.members()), fleet_roll["counters"])
 
     # one DISAGGREGATED request (docs/SERVING.md "Disaggregated
     # serving"): a prefill-role worker streams PTKS1 page records through
@@ -2113,7 +2152,8 @@ def bench_smoke():
     return (dt, batch * seq / dt, snap, slo, wd.dump_count == 0, router_ok,
             prefix_hits, spec_accepted, shed_count, cancelled_count,
             resume_ok, kv_quant_ok, migrate_ok, soak_ok, dedup_replays,
-            disagg_ok, peer_lost_typed_ok, fused_sampler_ok)
+            disagg_ok, peer_lost_typed_ok, fused_sampler_ok,
+            fleet_trace_ok, fleet_metrics_ok)
 
 
 def _retry(fn, attempts=3):
@@ -2173,7 +2213,8 @@ def main(argv=None):
              spec_accepted, shed_count, cancelled_count,
              resume_ok, kv_quant_ok, migrate_ok, soak_ok,
              dedup_replays, disagg_ok, peer_lost_typed_ok,
-             fused_sampler_ok) = bench_smoke()
+             fused_sampler_ok, fleet_trace_ok,
+             fleet_metrics_ok) = bench_smoke()
             impls = {k.rsplit(".", 1)[-1]: v
                      for k, v in snap["counters"].items()
                      if k.startswith("paged_attention.impl.") and v}
@@ -2193,6 +2234,8 @@ def main(argv=None):
                    "disagg_ok": disagg_ok,
                    "peer_lost_typed_ok": peer_lost_typed_ok,
                    "fused_sampler_ok": fused_sampler_ok,
+                   "fleet_trace_ok": fleet_trace_ok,
+                   "fleet_metrics_ok": fleet_metrics_ok,
                    "logits_readback": snap["counters"].get(
                        "engine.logits_readback", 0),
                    "dedup_replays": dedup_replays,

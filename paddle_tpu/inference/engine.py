@@ -680,6 +680,11 @@ class MigrationItem:
     # "seed": i}. WARM items carry their advanced chain inside
     # ``handoff.sample`` instead. None = greedy (every legacy blob).
     sample: dict | None = None
+    # fleet trace context (hex): the ORIGINAL trace id minted at ingress
+    # rides the PTMG1 header so the peer's spans land in the same stitched
+    # trace; ``parent_span`` is the SOURCE process's span id.
+    trace_id: str | None = None
+    parent_span: str | None = None
 
 
 MIG_MAGIC = b"PTMG1\n"
@@ -707,6 +712,10 @@ def pack_migration(item: MigrationItem) -> bytes:
         head["speculate"] = False
     if item.sample is not None:
         head["sample"] = item.sample
+    if item.trace_id is not None:
+        head["trace"] = item.trace_id
+    if item.parent_span is not None:
+        head["parent"] = item.parent_span
     if item.handoff is None:
         if item.prompt is None:
             raise ValueError("cold migration item has no prompt")
@@ -735,16 +744,20 @@ def unpack_migration(buf: bytes) -> MigrationItem:
     cache = bool(head.get("cache", True))
     speculate = bool(head.get("speculate", True))
     sample = head.get("sample")
+    trace_id = head.get("trace")
+    parent_span = head.get("parent")
     if head.get("warm"):
         return MigrationItem(max_new_tokens=mnt, deadline_ms=dl, tag=tag,
                              cache=cache, speculate=speculate,
                              request_key=key, sample=sample,
+                             trace_id=trace_id, parent_span=parent_span,
                              handoff=KVHandoff.unpack(buf[off:]))
     s0 = int(head["prompt_len"])
     prompt = np.frombuffer(buf, np.int32, count=s0, offset=off).copy()
     return MigrationItem(max_new_tokens=mnt, deadline_ms=dl, tag=tag,
                          cache=cache, speculate=speculate,
-                         request_key=key, prompt=prompt, sample=sample)
+                         request_key=key, prompt=prompt, sample=sample,
+                         trace_id=trace_id, parent_span=parent_span)
 
 
 class DecodeEngine:
@@ -2487,7 +2500,8 @@ class DecodeEngine:
 
     # ------------------------------------------------- prefill page stream
 
-    def submit_prefill_stream(self, prompt_ids, cache: bool = True):
+    def submit_prefill_stream(self, prompt_ids, cache: bool = True,
+                              trace_ctx=None):
         """Thread-safe send side of DISAGGREGATED prefill (docs/
         SERVING.md "Disaggregated serving"): post one prompt to the
         prefill-job mailbox and return a queue the DRIVER fills as its
@@ -2503,7 +2517,12 @@ class DecodeEngine:
         leading pages are attached (and exported — the decode replica
         does not share this store) without re-running their prefill, so
         a fleet-shared prompt costs this worker only its uncached tail;
-        ``cache=False`` keeps the prompt out of the store entirely."""
+        ``cache=False`` keeps the prompt out of the store entirely.
+
+        ``trace_ctx`` is an optional ``(trace_id, parent_span)`` hex pair
+        (docs/OBSERVABILITY.md "Fleet tracing"): it rides the PTKS1
+        header so the decode side joins the same stitched trace, and the
+        prefill wall lands as a span in this process's trace ring."""
         ids = np.asarray(
             prompt_ids._data if hasattr(prompt_ids, "_data") else prompt_ids)
         ids = np.ascontiguousarray(ids).reshape(-1).astype(np.int32)
@@ -2516,7 +2535,7 @@ class DecodeEngine:
         sink: _queue.Queue = _queue.Queue()
         with self._work:
             self._refuse_not_accepting()
-            self._prefill_jobs.append((ids, bool(cache), sink))
+            self._prefill_jobs.append((ids, bool(cache), trace_ctx, sink))
             self._work.notify()
         return sink
 
@@ -2532,21 +2551,26 @@ class DecodeEngine:
             with self._qlock:
                 if not self._prefill_jobs:
                     break
-                ids, cache, sink = self._prefill_jobs.popleft()
+                ids, cache, trace_ctx, sink = self._prefill_jobs.popleft()
             ran = True
             try:
-                self._run_prefill_stream(ids, cache, sink)
+                self._run_prefill_stream(ids, cache, sink,
+                                         trace_ctx=trace_ctx)
                 sink.put(("done", None))
             except Exception as e:  # noqa: BLE001 — surface to the sender
                 sink.put(("err", f"{type(e).__name__}: {e}"))
         return ran
 
-    def _run_prefill_stream(self, ids: np.ndarray, cache: bool, sink):
+    def _run_prefill_stream(self, ids: np.ndarray, cache: bool, sink,
+                            trace_ctx=None):
         """Driver-thread body of one prefill-stream job: chunked prefill
         with a PTKS1 record emitted as each chunk completes its pages.
         Pages are borrowed from the pool for the duration and freed
         before returning (the freshly prefilled ones stay indexed in the
-        prefix store, like `prefill_export`)."""
+        prefix store, like `prefill_export`). A ``trace_ctx`` rides the
+        PTKS1 header and records the job's wall as a span in this
+        process's trace ring (zero extra work when None)."""
+        t0_trace = time.perf_counter() if trace_ctx else None
         from paddle_tpu.kernels.paged_attention import export_pages
         from paddle_tpu.serving.disagg import (pack_stream_final,
                                                pack_stream_header,
@@ -2609,7 +2633,7 @@ class DecodeEngine:
             sink.put(("rec", pack_stream_header(
                 seq, ids, ps, np.dtype(self._cdtype).name,
                 [self._nl, ps, self._nh, self._dh], n_src, n_records,
-                self._quant_kv)))
+                self._quant_kv, trace_ctx=trace_ctx)))
             seq += 1
             if shared:
                 sink.put(("rec",
@@ -2636,6 +2660,14 @@ class DecodeEngine:
         metrics.counter("engine.kv_stream_exports").inc()
         flight.record("engine.prefill_stream", prompt_len=s0,
                       records=n_records, cached_pages=len(shared))
+        if trace_ctx:
+            from paddle_tpu.observability.tracing import new_span_id
+            tid, parent = trace_ctx
+            metrics.add_span(
+                "engine.prefill_stream", t0_trace,
+                time.perf_counter() - t0_trace, cat="engine",
+                args={"prompt_len": s0, "records": n_records},
+                trace_id=tid, parent=parent, span_id=new_span_id())
 
     def import_request(self, handoff: KVHandoff, max_new_tokens=32,
                        trace=None, cache=True,
@@ -2933,7 +2965,9 @@ class DecodeEngine:
                                      request=req, cache=req.cache,
                                      speculate=req.speculate,
                                      request_key=req.request_key,
-                                     sample=self._cold_sample(req))
+                                     sample=self._cold_sample(req),
+                                     trace_id=req.trace.trace_id,
+                                     parent_span=req.trace.span_id)
             else:
                 # warm: KV is resident for prompt + generated[:-1] (the
                 # last sampled token's KV is written by the NEXT step,
@@ -2977,7 +3011,9 @@ class DecodeEngine:
                     - len(req.generated) + 1,
                     handoff=handoff, deadline_ms=left, request=req,
                     cache=req.cache, speculate=req.speculate,
-                    request_key=req.request_key)
+                    request_key=req.request_key,
+                    trace_id=req.trace.trace_id,
+                    parent_span=req.trace.span_id)
             flight.record("engine.migrate_out", request_id=req.request_id,
                           warm=item.handoff is not None,
                           delivered=len(req.generated))
@@ -3003,7 +3039,9 @@ class DecodeEngine:
                 deadline_ms=self._deadline_ms_left(req, now), request=req,
                 cache=req.cache, speculate=req.speculate,
                 request_key=req.request_key,
-                sample=self._cold_sample(req)))
+                sample=self._cold_sample(req),
+                trace_id=req.trace.trace_id,
+                parent_span=req.trace.span_id))
         for handoff, req in imports:
             # a warm import this engine never placed migrates onward as-is
             if req.done:
@@ -3012,7 +3050,9 @@ class DecodeEngine:
                 max_new_tokens=req.max_new_tokens, handoff=handoff,
                 deadline_ms=self._deadline_ms_left(req, now), request=req,
                 cache=req.cache, speculate=req.speculate,
-                request_key=req.request_key))
+                request_key=req.request_key,
+                trace_id=req.trace.trace_id,
+                parent_span=req.trace.span_id))
         self._m_mig_out.inc(len(items))
         self._g_occupancy.set(0)
         with self._qlock:
@@ -3124,7 +3164,7 @@ class DecodeEngine:
             req._finish(reason)
         for _, req in imports:          # un-applied migration imports
             req._finish(reason)
-        for _, _, sink in prefill_jobs:  # un-run prefill-stream jobs
+        for *_, sink in prefill_jobs:    # un-run prefill-stream jobs
             sink.put(("err", reason))
         for item in migrated:
             # exported but never taken (take_migrated timed out / was

@@ -111,13 +111,16 @@ from paddle_tpu.inference.errors import (Cancelled, DeadlineExceeded,
                                          HandoffCorrupt, Overloaded,
                                          from_wire)
 from paddle_tpu.observability import metrics
-from paddle_tpu.observability.tracing import RequestTrace
+from paddle_tpu.observability.tracing import (RequestTrace, mint_trace,
+                                              new_span_id, trace_to_words,
+                                              words_to_trace)
 from paddle_tpu.testing import faults
 
 MAGIC = 0x50445250
 (OP_RUN, OP_PING, OP_SHUTDOWN, OP_STATS, OP_GENERATE, OP_PROMETHEUS,
- OP_CANCEL, OP_MIGRATE, OP_PREFILL, OP_KV_STREAM) = \
-    1, 2, 3, 4, 5, 6, 7, 8, 9, 10
+ OP_CANCEL, OP_MIGRATE, OP_PREFILL, OP_KV_STREAM, OP_TRACE_EXPORT,
+ OP_DEBUG_DUMP) = \
+    1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12
 
 # replica tiers (docs/SERVING.md "Disaggregated serving"): "both" is the
 # legacy symmetric replica; a "prefill" worker serves OP_PREFILL only
@@ -324,8 +327,13 @@ class InferenceServer:
         """Hold the elastic-registry lease this replica registered under
         (`distributed/fleet/elastic.py` NodeRegistry/TcpNodeRegistry);
         `drain()` deregisters it so the router stops sending traffic before
-        the process exits."""
+        the process exits. The lease id becomes this process's fleet
+        identity for the observability plane (trace exports + metrics
+        re-labeling, docs/OBSERVABILITY.md)."""
         self._registry = registry
+        rid = getattr(registry, "node_id", None)
+        if rid:
+            metrics.set_node_identity(role=self.role, node_id=rid)
         return self
 
     def drain(self, deadline_s=30.0, migrate_peers=None):
@@ -635,6 +643,11 @@ class InferenceServer:
             # from its intact in-memory item, never to decoding garbage
             metrics.counter("serve.blob_corrupt_refused").inc()
             raise
+        if trace is not None:
+            # the ORIGINAL ingress trace id rode the PTMG1 header: the
+            # peer's spans land in the same stitched trace, parented on
+            # the source replica's span (docs/OBSERVABILITY.md)
+            trace.attach_context(item.trace_id, item.parent_span)
         deadline_s = None if item.deadline_ms is None \
             else item.deadline_ms / 1000.0
         if item.handoff is not None:
@@ -666,8 +679,10 @@ class InferenceServer:
         replica's ``role`` plus the engine's prefix-store export —
         page size and the rolling page hashes it currently indexes —
         the data source of the router's fleet prefix directory
-        (docs/SERVING.md "Disaggregated serving")."""
-        extra: dict = {"role": self.role}
+        (docs/SERVING.md "Disaggregated serving"). ``node`` is the fleet
+        identity (role + registry-lease id + pid) the metrics plane uses
+        to re-label this replica's rows (docs/OBSERVABILITY.md)."""
+        extra: dict = {"role": self.role, "node": metrics.node_identity()}
         if self._engine is not None:
             extra["prefix"] = {
                 "page_size": int(self._engine.ecfg.page_size)}
@@ -714,14 +729,24 @@ class InferenceServer:
                 f"PREFILL wants [prompt_ids[, options]], got "
                 f"{len(arrays)} arrays")
         cache = True
+        trace_ctx = None
         if len(arrays) == 2:
+            # width 7 appends the fleet trace context (4 trace-id words +
+            # 2 parent-span words, all-zero = absent) — the worker's
+            # prefill spans join the stitched trace and the context rides
+            # onward in the PTKS1 header (docs/OBSERVABILITY.md)
             opts = np.asarray(arrays[1]).reshape(-1)
-            if opts.size != 1:
+            if opts.size not in (1, 7):
                 raise ValueError(
-                    f"PREFILL options wants int32 [cache], got "
-                    f"{opts.size} values")
+                    f"PREFILL options wants int32 [cache[, tid0..tid3, "
+                    f"par0..par1]], got {opts.size} values")
             cache = bool(int(opts[0]))
-        sink = self._engine.submit_prefill_stream(arrays[0], cache=cache)
+            if opts.size == 7:
+                tid, parent = words_to_trace([int(w) for w in opts[1:7]])
+                if tid is not None:
+                    trace_ctx = (tid, parent)
+        sink = self._engine.submit_prefill_stream(arrays[0], cache=cache,
+                                                  trace_ctx=trace_ctx)
         kind, val = sink.get(timeout=600.0)
         if kind == "err":
             raise from_wire(val)
@@ -776,16 +801,19 @@ class InferenceServer:
                 f"KV_STREAM wants [options, tag, record...], got "
                 f"{len(arrays)} arrays")
         opts = np.asarray(arrays[0]).reshape(-1)
-        if opts.size not in (4, 8):
+        if opts.size not in (4, 8, 14):
             raise ValueError(
                 f"KV_STREAM options wants int32 [max_new_tokens, cache, "
-                f"speculate, deadline_ms[, key0..key3]], got {opts.size} "
-                f"values")
+                f"speculate, deadline_ms[, key0..key3[, tid0..tid3, "
+                f"par0..par1]]], got {opts.size} values")
         mnt = int(opts[0])
         cache, speculate = bool(int(opts[1])), bool(int(opts[2]))
         deadline_s = int(opts[3]) / 1000.0 if int(opts[3]) > 0 else None
         key = np.ascontiguousarray(opts[4:8], np.int32).tobytes() \
-            if opts.size == 8 else None
+            if opts.size >= 8 and np.any(opts[4:8]) else None
+        if opts.size == 14 and trace is not None:
+            tid, parent = words_to_trace([int(w) for w in opts[8:14]])
+            trace.attach_context(tid, parent)
         tag = np.ascontiguousarray(arrays[1], np.uint8).tobytes() or None
         from paddle_tpu.serving.disagg import KVStreamAssembler
         asm = KVStreamAssembler()
@@ -803,6 +831,11 @@ class InferenceServer:
             # "Wire integrity")
             metrics.counter("serve.blob_corrupt_refused").inc()
             raise
+        if trace is not None and asm.trace_ctx is not None:
+            # header-carried context (idempotent: a context that already
+            # arrived via the options wins) — a direct worker->decode
+            # stream stays traced even without the router's options relay
+            trace.attach_context(*asm.trace_ctx)
         req = self._engine.submit_import(
             handoff, max_new_tokens=mnt, deadline_s=deadline_s,
             trace=trace, cache=cache, speculate=speculate,
@@ -868,6 +901,32 @@ class InferenceServer:
                     send_arrays(conn, [np.frombuffer(
                         metrics.to_prometheus().encode(),
                         dtype=np.uint8).copy()])
+                    continue
+                if op == OP_TRACE_EXPORT:
+                    # fleet tracing pull: one uint8 array carrying the
+                    # 16-byte trace id; response = uint8 JSON {node,
+                    # trace_id, spans} with wall-rebased timestamps — the
+                    # fleet collector (observability/fleet.py) stitches
+                    # these from every registry member into ONE trace
+                    arrays = recv_arrays(conn, n)
+                    if len(arrays) != 1:
+                        self._send_err(conn, "ValueError: TRACE_EXPORT "
+                                             "wants one uint8 trace-id "
+                                             "array")
+                        return
+                    tid = np.ascontiguousarray(
+                        arrays[0], np.uint8).tobytes().hex()
+                    conn.sendall(struct.pack("<III", MAGIC, 0, 1))
+                    send_arrays(conn, [trace_export_payload(tid)])
+                    continue
+                if op == OP_DEBUG_DUMP:
+                    # remote flight-recorder pull (the SIGUSR1 dump,
+                    # minus the shell access): uint8 JSON {node, events,
+                    # metrics} — `router --dump <replica>` relays it so
+                    # an operator can inspect a wedged replica's ring
+                    recv_arrays(conn, n)
+                    conn.sendall(struct.pack("<III", MAGIC, 0, 1))
+                    send_arrays(conn, [debug_dump_payload()])
                     continue
                 if op == OP_SHUTDOWN:
                     conn.sendall(struct.pack("<III", MAGIC, 0, 0))
@@ -997,19 +1056,26 @@ class InferenceServer:
             # at 7 values, a 16-byte client-generated idempotency
             # request key as 4 trailing int32 words (exactly-once
             # resubmission — docs/ROBUSTNESS.md "Control-plane HA"; the
-            # 2/3-wide shapes stay legacy at-least-once)
+            # 2/3-wide shapes stay legacy at-least-once). At 13 values,
+            # six more words carry the fleet trace context — 16-byte
+            # trace id + 8-byte parent span id, all-zero = absent
+            # (docs/OBSERVABILITY.md "Fleet tracing"); zero key words at
+            # this width mean a traced request WITHOUT an idempotency key
             opts = np.asarray(arrays[2]).reshape(-1)
-            if opts.size not in (2, 3, 7):
+            if opts.size not in (2, 3, 7, 13):
                 raise ValueError(
                     f"GENERATE options wants int32 [cache, speculate"
-                    f"[, deadline_ms[, key0..key3]]], got {opts.size} "
-                    f"values")
+                    f"[, deadline_ms[, key0..key3[, tid0..tid3, par0..par1"
+                    f"]]]], got {opts.size} values")
             kw = dict(cache=bool(int(opts[0])), speculate=bool(int(opts[1])))
             if opts.size >= 3 and int(opts[2]) > 0:
                 deadline_s = int(opts[2]) / 1000.0
-            if opts.size == 7:
+            if opts.size >= 7 and np.any(opts[3:7]):
                 kw["request_key"] = np.ascontiguousarray(
                     opts[3:7], np.int32).tobytes()
+            if opts.size == 13 and trace is not None:
+                tid, parent = words_to_trace([int(w) for w in opts[7:13]])
+                trace.attach_context(tid, parent)
         tag = None
         if len(arrays) == 4:
             tag = np.ascontiguousarray(arrays[3], np.uint8).tobytes()
@@ -1178,6 +1244,26 @@ def stats_payload(extra: dict | None = None) -> np.ndarray:
         snap = dict(snap, **extra)
     raw = json.dumps(snap).encode()
     return np.frombuffer(raw, dtype=np.uint8).copy()
+
+
+def trace_export_payload(trace_id: str) -> np.ndarray:
+    """TRACE_EXPORT response body: this process's spans for one trace id
+    (hex) plus its fleet identity, as a uint8 JSON array. Span timestamps
+    are unix-epoch microseconds so exports from different processes land
+    on one timeline (observability/fleet.py stitches them)."""
+    body = {"node": metrics.node_identity(), "trace_id": trace_id,
+            "spans": metrics.spans_for_trace(trace_id)}
+    return np.frombuffer(json.dumps(body).encode(), np.uint8).copy()
+
+
+def debug_dump_payload() -> np.ndarray:
+    """DEBUG_DUMP response body: the process flight-recorder ring + full
+    metrics snapshot + fleet identity as a uint8 JSON array — the same
+    shape `dump_ring` writes locally, pulled over the wire instead."""
+    from paddle_tpu.observability.flight_recorder import flight
+    body = {"node": metrics.node_identity(), "events": flight.events(),
+            "metrics": metrics.snapshot()}
+    return np.frombuffer(json.dumps(body).encode(), np.uint8).copy()
 
 
 class RemotePredictor:
@@ -1395,9 +1481,49 @@ class RemotePredictor:
             return payload.tobytes().decode()
         return self._idempotent(_do)
 
+    def trace_export(self, trace_id: str) -> dict:
+        """Pull this endpoint's span buffer for one fleet trace id (hex):
+        ``{"node": {...}, "trace_id": ..., "spans": [...]}`` with
+        wall-rebased Chrome-trace events. The fleet collector
+        (`observability/fleet.py`) calls this against every registry
+        member and stitches the exports into ONE trace."""
+        def _do():
+            tid = np.frombuffer(bytes.fromhex(trace_id), np.uint8).copy()
+            self._sock.sendall(
+                struct.pack("<III", MAGIC, OP_TRACE_EXPORT, 1))
+            send_arrays(self._sock, [tid])
+            magic, status, n = struct.unpack(
+                "<III", _recv_exact(self._sock, 12))
+            if magic != MAGIC:
+                raise ConnectionError("bad magic in response")
+            if status != 0:
+                raise from_wire(
+                    _recv_exact(self._sock, n).decode(errors="replace"))
+            (payload,) = recv_arrays(self._sock, n)
+            return json.loads(payload.tobytes().decode())
+        return self._idempotent(_do)
+
+    def debug_dump(self) -> dict:
+        """Fetch the remote process's flight-recorder ring + metrics
+        snapshot (DEBUG_DUMP wire op) — the SIGUSR1 dump without shell
+        access; `router --dump <replica>` relays this for operators."""
+        def _do():
+            self._sock.sendall(
+                struct.pack("<III", MAGIC, OP_DEBUG_DUMP, 0))
+            magic, status, n = struct.unpack(
+                "<III", _recv_exact(self._sock, 12))
+            if magic != MAGIC:
+                raise ConnectionError("bad magic in response")
+            if status != 0:
+                raise from_wire(
+                    _recv_exact(self._sock, n).decode(errors="replace"))
+            (payload,) = recv_arrays(self._sock, n)
+            return json.loads(payload.tobytes().decode())
+        return self._idempotent(_do)
+
     def generate(self, prompt_ids, max_new_tokens=32, cache=None,
                  speculate=None, deadline_s=None, tag=None,
-                 request_key=None):
+                 request_key=None, trace_id=None, parent_span=None):
         """Batched server-side decode: ship the prompt, get prompt +
         generated ids back. Concurrent generate() calls from any number of
         clients share the server engine's decode batch.
@@ -1426,7 +1552,16 @@ class RemotePredictor:
         a connection that dies mid-request is RESUBMITTED — through the
         next endpoint under the surviving deadline budget — and the
         fleet's dedup table guarantees the retry attaches to or replays
-        the original generation instead of re-running it."""
+        the original generation instead of re-running it.
+
+        ``trace_id`` (docs/OBSERVABILITY.md "Fleet tracing"): a 16-byte
+        hex trace id — mint one with
+        `paddle_tpu.observability.tracing.mint_trace()` — threads the
+        fleet trace context through every hop this request takes
+        (router, prefill worker, decode replica, migration peer); the
+        same context rides every resubmit, so a failover's spans all
+        land in one stitched trace. ``parent_span`` optionally names
+        this client hop's span id (default: freshly minted)."""
         key = request_key
         if key is None and self._ha:
             key = _secrets.token_bytes(16)
@@ -1439,8 +1574,15 @@ class RemotePredictor:
                     f"request_key must be 16 bytes, got {len(key)}")
         ids = np.ascontiguousarray(np.asarray(prompt_ids).reshape(-1),
                                    np.int32)
+        trace_ctx = None
+        if trace_id:
+            # this hop's span id doubles as the downstream parent; the
+            # SAME context rides every resubmit so a failover's attempts
+            # stitch into one trace
+            trace_ctx = (str(trace_id), parent_span or new_span_id())
         t_deadline = None if deadline_s is None \
             else time.monotonic() + float(deadline_s)
+        t0 = time.perf_counter()
         # one attempt per endpoint plus one (the single-endpoint replay
         # case: the same server answers the resubmit from its dedup
         # table after e.g. an ack-window drop)
@@ -1454,8 +1596,15 @@ class RemotePredictor:
                         f"request deadline ({deadline_s}s) exhausted "
                         f"before an endpoint answered")
             try:
-                return self._generate_once(ids, max_new_tokens, cache,
-                                           speculate, remaining, tag, key)
+                out = self._generate_once(ids, max_new_tokens, cache,
+                                          speculate, remaining, tag, key,
+                                          trace_ctx)
+                if trace_ctx is not None:
+                    metrics.add_span(
+                        "client.generate", t0, time.perf_counter() - t0,
+                        cat="client", trace_id=trace_ctx[0],
+                        span_id=trace_ctx[1])
+                return out
             except (ConnectionError, socket.timeout, OSError):
                 # wire death mid-request. Without a key this is the
                 # legacy contract: surface it (a blind resubmit could
@@ -1467,11 +1616,26 @@ class RemotePredictor:
                 self._failover()
 
     def _generate_once(self, ids, max_new_tokens, cache, speculate,
-                       deadline_s, tag, key):
+                       deadline_s, tag, key, trace_ctx=None):
         """One GENERATE exchange on the current connection (the wire
         body of `generate`; deadline_s here is the REMAINING budget)."""
         arrays = [ids, np.asarray([max_new_tokens], np.int32)]
-        if cache is not None or speculate is not None \
+        if trace_ctx is not None:
+            # traced requests ship the FULL 13-wide options vector: the
+            # trace words sit at fixed trailing positions, so an absent
+            # deadline/key rides as zero words (the server treats an
+            # all-zero key group as "no key" at this width)
+            opts = [1 if cache is None else int(bool(cache)),
+                    1 if speculate is None else int(bool(speculate)),
+                    0 if deadline_s is None
+                    else max(1, int(float(deadline_s) * 1000))]
+            if key is not None:
+                opts.extend(int(w) for w in np.frombuffer(key, np.int32))
+            else:
+                opts.extend([0, 0, 0, 0])
+            opts.extend(trace_to_words(trace_ctx[0], trace_ctx[1]))
+            arrays.append(np.asarray(opts, np.int32))
+        elif cache is not None or speculate is not None \
                 or deadline_s is not None or tag is not None \
                 or key is not None:
             opts = [1 if cache is None else int(bool(cache)),
@@ -1739,6 +1903,11 @@ def main(argv=None):
     srv = InferenceServer(args.model, args.host, args.port, engine=engine,
                           auth_name=args.auth_name, role=args.role)
     srv.migrate_on_drain = bool(args.migrate_on_drain)
+    # fleet identity for the observability plane: the trace collector and
+    # metrics rollups label this process's spans/rows with role + id even
+    # when no registry is attached (docs/OBSERVABILITY.md)
+    metrics.set_node_identity(
+        role=args.role, node_id=args.replica_id or f"replica-{os.getpid()}")
     if args.registry_dir or args.registry_addr:
         from paddle_tpu.distributed.fleet.elastic import (NodeRegistry,
                                                           TcpNodeRegistry,
@@ -1749,6 +1918,7 @@ def main(argv=None):
             # so the router classifies the replica without extra state;
             # unprefixed ids stay the legacy symmetric tier
             rid = role_node_id(args.role, rid)
+        metrics.set_node_identity(node_id=rid)
         endpoint = args.advertise or f"{args.host}:{srv.port}"
         if args.registry_dir:
             registry = NodeRegistry(args.registry_dir, rid, endpoint)

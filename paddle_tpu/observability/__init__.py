@@ -41,14 +41,22 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "metrics",
     "counter", "gauge", "histogram", "timer", "snapshot", "reset",
     "chrome_trace", "export_chrome_trace", "to_prometheus",
+    "set_node_identity", "node_identity", "spans_for_trace",
 ]
 
 # perf_counter origin for span timestamps — one epoch per process so spans
 # from every subsystem land on a shared timeline
 _EPOCH = time.perf_counter()
+# wall clock at the same instant: per-trace span exports are rebased onto
+# unix time so the fleet collector can stitch spans from MANY processes
+# (each with its own perf_counter origin) onto one timeline
+_EPOCH_UNIX_US = time.time() * 1e6
 
 _RESERVOIR = 512       # recent observations kept per histogram (percentiles)
 _MAX_SPANS = 20000     # bounded span ring: old spans drop, process never grows
+_MAX_TRACES = 64       # per-trace span rings kept (LRU; fleet TRACE_EXPORT)
+_MAX_TRACE_SPANS = 256  # spans kept per traced request
+_MAX_LABELED_SERIES = 256  # LRU cap on LABELED series (membership churn)
 
 
 def _labelkey(labels: dict) -> tuple:
@@ -196,10 +204,40 @@ class MetricsRegistry:
         self._histograms: dict = {}
         self._spans = collections.deque(maxlen=_MAX_SPANS)
         self._span_lock = threading.Lock()
+        # fleet tracing: trace-id-hex -> deque of spans, LRU-evicted so a
+        # process pays a bounded footprint no matter how many traced
+        # requests pass through (guarded by _span_lock)
+        self._trace_spans = collections.OrderedDict()
+        # LRU over LABELED series only: (kind, name, labelkey) -> store.
+        # Unlabeled series are module-lifetime handles and never evict;
+        # labeled ones (replica=..., op=...) churn with fleet membership
+        # and must not grow without bound (guarded by _lock).
+        self._labeled = collections.OrderedDict()
+        self._series_evictions = Counter()
+        self._counters[("metrics.series_evictions", ())] = \
+            self._series_evictions
+        # who this process is in the fleet (role + registry-lease id);
+        # stamped by serve/router startup, exported with every trace pull
+        self._node = {"role": None, "node_id": None}
+
+    # ------------------------------------------------------------- identity
+
+    def set_node_identity(self, role=None, node_id=None):
+        """Record this process's fleet identity (role + replica/router id
+        from its registry lease). Rides every TRACE_EXPORT / DEBUG_DUMP
+        payload so the collector can label spans by process."""
+        if role is not None:
+            self._node["role"] = str(role)
+        if node_id is not None:
+            self._node["node_id"] = str(node_id)
+
+    def node_identity(self) -> dict:
+        return {"role": self._node["role"], "node_id": self._node["node_id"],
+                "pid": os.getpid()}
 
     # -------------------------------------------------------------- creation
 
-    def _get(self, store, name, labels, factory):
+    def _get(self, store, kind, name, labels, factory):
         key = (name, _labelkey(labels))
         m = store.get(key)
         if m is None:
@@ -207,16 +245,31 @@ class MetricsRegistry:
                 m = store.get(key)
                 if m is None:
                     m = store[key] = factory()
+                    if key[1]:
+                        self._labeled[(kind,) + key] = store
+                        while len(self._labeled) > _MAX_LABELED_SERIES:
+                            (_, n2, lk2), st2 = \
+                                self._labeled.popitem(last=False)
+                            st2.pop((n2, lk2), None)
+                            self._series_evictions.inc()
+        elif key[1]:
+            # labeled hit: refresh recency so ACTIVE replicas' series
+            # outlive departed ones (labeled access is request-rate at
+            # worst, so the lock here never touches a step-loop hot path)
+            with self._lock:
+                lru_key = (kind,) + key
+                if lru_key in self._labeled:
+                    self._labeled.move_to_end(lru_key)
         return m
 
     def counter(self, name, **labels) -> Counter:
-        return self._get(self._counters, name, labels, Counter)
+        return self._get(self._counters, "c", name, labels, Counter)
 
     def gauge(self, name, **labels) -> Gauge:
-        return self._get(self._gauges, name, labels, Gauge)
+        return self._get(self._gauges, "g", name, labels, Gauge)
 
     def histogram(self, name, **labels) -> Histogram:
-        return self._get(self._histograms, name, labels, Histogram)
+        return self._get(self._histograms, "h", name, labels, Histogram)
 
     def timer(self, name, **labels) -> _Timer:
         return _Timer(self, self.histogram(name, **labels),
@@ -224,15 +277,55 @@ class MetricsRegistry:
 
     # ----------------------------------------------------------------- spans
 
-    def add_span(self, name, t0_perf, dur_s, cat="host", args=None):
+    def add_span(self, name, t0_perf, dur_s, cat="host", args=None,
+                 trace_id=None, parent=None, span_id=None):
         """Record one completed host-side range for Chrome-trace export.
         ``t0_perf`` is a time.perf_counter() value; timestamps are stored in
         microseconds relative to the process epoch. ``args`` (a small dict,
         e.g. ``{"request_id": "req-7"}``) lands on the Chrome-trace event's
-        ``args`` field so Perfetto can group/filter spans by request."""
+        ``args`` field so Perfetto can group/filter spans by request.
+
+        When ``trace_id`` (hex string) is given the span ALSO lands in that
+        trace's bounded ring for the fleet collector (TRACE_EXPORT);
+        ``parent``/``span_id`` are the upstream hop's span id and this
+        process's own (hex). Untraced spans take the exact pre-fleet path —
+        no ring lookup, no allocation beyond the one tuple."""
+        entry = (name, cat, (t0_perf - _EPOCH) * 1e6,
+                 dur_s * 1e6, threading.get_ident(), args)
         with self._span_lock:
-            self._spans.append((name, cat, (t0_perf - _EPOCH) * 1e6,
-                                dur_s * 1e6, threading.get_ident(), args))
+            self._spans.append(entry)
+            if trace_id is not None:
+                ring = self._trace_spans.get(trace_id)
+                if ring is None:
+                    ring = self._trace_spans[trace_id] = \
+                        collections.deque(maxlen=_MAX_TRACE_SPANS)
+                    while len(self._trace_spans) > _MAX_TRACES:
+                        self._trace_spans.popitem(last=False)
+                else:
+                    self._trace_spans.move_to_end(trace_id)
+                ring.append(entry + (parent, span_id))
+
+    def spans_for_trace(self, trace_id) -> list:
+        """Chrome-trace events recorded under ``trace_id`` (hex string) by
+        THIS process. Timestamps are unix-epoch microseconds (wall-rebased),
+        so the fleet collector can merge exports from many processes onto
+        one timeline without knowing their perf_counter origins."""
+        with self._span_lock:
+            ring = self._trace_spans.get(trace_id)
+            spans = list(ring) if ring is not None else []
+        events = []
+        for name, cat, ts, dur, tid, args, parent, span_id in spans:
+            a = dict(args) if args else {}
+            a["trace_id"] = trace_id
+            if parent is not None:
+                a["parent"] = parent
+            if span_id is not None:
+                a["span"] = span_id
+            events.append({"name": name, "cat": cat, "ph": "X",
+                           "pid": os.getpid(), "tid": tid,
+                           "ts": round(ts + _EPOCH_UNIX_US, 3),
+                           "dur": round(dur, 3), "args": a})
+        return events
 
     # --------------------------------------------------------------- exports
 
@@ -301,6 +394,7 @@ class MetricsRegistry:
                 m.reset()
         with self._span_lock:
             self._spans.clear()
+            self._trace_spans.clear()
 
 
 # the process-wide default registry every instrumented layer reports to
@@ -316,3 +410,6 @@ reset = metrics.reset
 chrome_trace = metrics.chrome_trace
 export_chrome_trace = metrics.export_chrome_trace
 to_prometheus = metrics.to_prometheus
+set_node_identity = metrics.set_node_identity
+node_identity = metrics.node_identity
+spans_for_trace = metrics.spans_for_trace

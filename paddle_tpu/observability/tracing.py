@@ -33,12 +33,15 @@ Stdlib-only, like everything under ``observability/``.
 from __future__ import annotations
 
 import itertools
+import os
+import struct
 import threading
 import time
 
 from paddle_tpu.observability import _EPOCH, metrics
 
-__all__ = ["RequestTrace", "new_request_id"]
+__all__ = ["RequestTrace", "new_request_id", "mint_trace", "new_span_id",
+           "trace_to_words", "words_to_trace", "TRACE_WORDS"]
 
 _ids = itertools.count(1)
 
@@ -47,6 +50,45 @@ def new_request_id() -> str:
     """Process-unique monotonic request id (``req-<n>``); `itertools.count`
     is atomic under the GIL, so ids are unique across submitter threads."""
     return f"req-{next(_ids)}"
+
+
+# ------------------------------------------------------------- fleet context
+#
+# A fleet trace context is a 16-byte random trace id plus the 8-byte span id
+# of the upstream hop, minted once at ingress (`RemotePredictor.generate` or
+# the router) and threaded through every wire hop. On the wire it rides as
+# six little-endian int32 words appended to the existing int32 options
+# vectors (GENERATE/PREFILL/KV_STREAM) — all-zero words mean "no trace",
+# which a random 128-bit id never collides with in practice.
+
+TRACE_WORDS = 6  # 4 words trace id + 2 words parent span id
+
+
+def mint_trace() -> tuple[str, str]:
+    """New (trace_id, span_id) hex pair for an ingress request."""
+    return os.urandom(16).hex(), os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    """Fresh 8-byte span id (hex) for one process's hop within a trace."""
+    return os.urandom(8).hex()
+
+
+def trace_to_words(trace_id: str | None, parent: str | None) -> list[int]:
+    """Encode a (trace_id, parent span) hex context as TRACE_WORDS signed
+    int32 words for the wire options vectors. ``None`` encodes as zeros."""
+    tb = bytes.fromhex(trace_id) if trace_id else b"\x00" * 16
+    pb = bytes.fromhex(parent) if parent else b"\x00" * 8
+    return list(struct.unpack("<4i", tb)) + list(struct.unpack("<2i", pb))
+
+
+def words_to_trace(words) -> tuple[str | None, str | None]:
+    """Decode TRACE_WORDS int32 words back to (trace_id, parent) hex;
+    all-zero groups decode to ``None``."""
+    tb = struct.pack("<4i", *(int(w) for w in words[:4]))
+    pb = struct.pack("<2i", *(int(w) for w in words[4:6]))
+    return (tb.hex() if any(tb) else None,
+            pb.hex() if any(pb) else None)
 
 
 class RequestTrace:
@@ -62,9 +104,11 @@ class RequestTrace:
     """
 
     __slots__ = ("request_id", "t_accept", "t_submit", "t_admit",
-                 "t_first_token", "t_done", "n_tokens", "error", "_lock")
+                 "t_first_token", "t_done", "n_tokens", "error", "_lock",
+                 "trace_id", "parent_span", "span_id")
 
-    def __init__(self, request_id: str | None = None):
+    def __init__(self, request_id: str | None = None,
+                 trace_id: str | None = None, parent_span: str | None = None):
         self.request_id = request_id or new_request_id()
         self._lock = threading.Lock()
         self.t_accept = time.perf_counter()
@@ -74,12 +118,28 @@ class RequestTrace:
         self.t_done = None
         self.n_tokens = 0
         self.error = None
+        # fleet trace context (hex strings); absent on local-only requests
+        self.trace_id = trace_id
+        self.parent_span = parent_span
+        self.span_id = new_span_id() if trace_id else None
+
+    def attach_context(self, trace_id: str | None,
+                       parent_span: str | None = None):
+        """Adopt a wire-carried trace context AFTER construction (serve
+        creates the trace before the options vector is parsed). Idempotent;
+        a no-op when no context rode the request."""
+        if trace_id and self.trace_id is None:
+            self.trace_id = trace_id
+            self.parent_span = parent_span
+            self.span_id = new_span_id()
 
     # ------------------------------------------------------------ phase marks
 
     def _span(self, phase, t0, t1):
         metrics.add_span(f"request.{phase}", t0, max(0.0, t1 - t0),
-                         cat="request", args={"request_id": self.request_id})
+                         cat="request", args={"request_id": self.request_id},
+                         trace_id=self.trace_id, parent=self.parent_span,
+                         span_id=self.span_id)
 
     def mark_submit(self):
         """Entered the scheduler queue (engine submit)."""
@@ -159,7 +219,8 @@ class RequestTrace:
         """JSON-ready record (watchdog dumps, debugging). Times are
         process-epoch-relative seconds, matching the Chrome-trace ring."""
         d = {"request_id": self.request_id, "phase": self.phase(),
-             "n_tokens": self.n_tokens, "error": self.error}
+             "n_tokens": self.n_tokens, "error": self.error,
+             "trace_id": self.trace_id, "parent": self.parent_span}
         for k in ("t_accept", "t_submit", "t_admit", "t_first_token",
                   "t_done"):
             v = getattr(self, k)

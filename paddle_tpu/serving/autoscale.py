@@ -135,13 +135,20 @@ class Autoscaler:
     ``stats_fn(endpoint) -> dict | None`` overrides the per-replica STATS
     pull (the default opens one authed STATS exchange per healthy replica
     per tick using ``replica_secret``); tests inject deterministic
-    snapshots. `tick()` is synchronous and returns the action taken
-    (``"up"``/``"down"``/None) so chaos tests drive decisions without a
-    timing-dependent thread."""
+    snapshots. ``fleet`` is the higher-level form of the same injection:
+    pass the `observability.fleet.FleetMetrics` the router's poll loop
+    already feeds (`Router.attach_fleet`) and the controller reads its
+    `snapshot_for` view instead of opening its own per-replica STATS
+    connections — one scrape loop serves routing, the /metrics rollup AND
+    scaling, with identical decisions (the snapshot schema is exactly a
+    direct STATS pull's; a member the plane has not scraped yet reads as
+    a failed pull, which the tick already tolerates). `tick()` is
+    synchronous and returns the action taken (``"up"``/``"down"``/None)
+    so chaos tests drive decisions without a timing-dependent thread."""
 
     def __init__(self, router, launcher, policy: AutoscalePolicy | None
                  = None, interval_s: float = 1.0, replica_secret=None,
-                 stats_fn=None):
+                 stats_fn=None, fleet=None):
         self._routers = list(router) if isinstance(router, (list, tuple)) \
             else [router]
         if not self._routers:
@@ -150,6 +157,10 @@ class Autoscaler:
         self._launcher = launcher
         self.policy = policy or AutoscalePolicy()
         self._interval = float(interval_s)
+        if stats_fn is not None and fleet is not None:
+            raise ValueError("pass stats_fn OR fleet, not both")
+        if fleet is not None:
+            stats_fn = fleet.snapshot_for
         self._stats_fn = stats_fn if stats_fn is not None \
             else self._pull_stats
         from paddle_tpu.inference.serve import auth_token

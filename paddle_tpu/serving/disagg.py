@@ -100,16 +100,23 @@ def _pack_record(head: dict, body: bytes) -> bytes:
 
 def pack_stream_header(seq: int, prompt: np.ndarray, page_size: int,
                        dtype: str, geom, n_pages: int, n_records: int,
-                       scales: bool) -> bytes:
+                       scales: bool, trace_ctx=None) -> bytes:
     """Record 0 of a KV page stream: the handoff's prompt (body) plus
     everything the assembler needs to preallocate — ``geom`` is
     ``[nl, page_size, nh, dh]``, ``n_pages`` the total page count the
     stream will deliver, ``scales`` whether page batches carry int8
-    scale sections."""
+    scale sections. ``trace_ctx`` is an optional ``(trace_id, parent)``
+    hex pair: the fleet trace context rides the header so the decode
+    side's spans join the same stitched trace even when the relayed
+    options carried none (docs/OBSERVABILITY.md "Fleet tracing")."""
     head = {"kind": "head", "seq": int(seq), "page_size": int(page_size),
             "dtype": str(dtype), "prompt_len": int(np.asarray(prompt).size),
             "geom": [int(d) for d in geom], "n_pages": int(n_pages),
             "n_records": int(n_records), "scales": bool(scales)}
+    if trace_ctx and trace_ctx[0]:
+        head["trace"] = trace_ctx[0]
+        if trace_ctx[1]:
+            head["parent"] = trace_ctx[1]
     body = np.ascontiguousarray(prompt, np.int32).tobytes()
     return _pack_record(head, body)
 
@@ -203,6 +210,10 @@ class KVStreamAssembler:
         self._prompt: np.ndarray | None = None
         self._covered: np.ndarray | None = None
         self.complete = False
+        # fleet trace context carried by the stream header, if any:
+        # (trace_id, parent) hex pair the receiving replica attaches to
+        # its RequestTrace when the relayed options carried none
+        self.trace_ctx = None
 
     def _corrupt(self, msg: str):
         raise HandoffCorrupt(f"KV stream: {msg}")
@@ -294,6 +305,8 @@ class KVStreamAssembler:
             self._vs = np.zeros_like(self._ks)
         self._covered = np.zeros(n_pages, bool)
         self._head = head
+        if head.get("trace"):
+            self.trace_ctx = (str(head["trace"]), head.get("parent"))
 
     def _place(self, head: dict, buf: bytes, off: int):
         try:

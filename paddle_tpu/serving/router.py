@@ -100,16 +100,20 @@ import numpy as np
 
 from paddle_tpu.distributed.fleet.elastic import node_role, router_node_id
 from paddle_tpu.inference.errors import DeadlineExceeded, Overloaded
-from paddle_tpu.inference.serve import (MAGIC, OP_CANCEL, OP_GENERATE,
-                                        OP_KV_STREAM, OP_PING, OP_PREFILL,
-                                        OP_PROMETHEUS, OP_RUN, OP_SHUTDOWN,
-                                        OP_STATS, _recv_exact, auth_token,
+from paddle_tpu.inference.serve import (MAGIC, OP_CANCEL, OP_DEBUG_DUMP,
+                                        OP_GENERATE, OP_KV_STREAM, OP_PING,
+                                        OP_PREFILL, OP_PROMETHEUS, OP_RUN,
+                                        OP_SHUTDOWN, OP_STATS,
+                                        OP_TRACE_EXPORT, _recv_exact,
+                                        auth_token, debug_dump_payload,
                                         recv_arrays, retrying_connect,
-                                        send_arrays, stats_payload)
+                                        send_arrays, stats_payload,
+                                        trace_export_payload)
 from paddle_tpu.serving.disagg import PrefixDirectory, prompt_page_hashes
 from paddle_tpu.observability import metrics
 from paddle_tpu.observability.flight_recorder import flight
-from paddle_tpu.observability.tracing import new_request_id
+from paddle_tpu.observability.tracing import (new_request_id, new_span_id,
+                                              trace_to_words, words_to_trace)
 from paddle_tpu.testing import faults
 
 __all__ = ["Router", "ReplicaState", "POLICIES", "ReplicaUnavailable"]
@@ -331,6 +335,7 @@ class Router:
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._lease = None            # router-role registry lease
+        self._fleet = None            # FleetMetrics fed by _refresh_stats
         self._conns: set[socket.socket] = set()   # live client conns
         self._conn_lock = threading.Lock()
         # the membership poll thread ALWAYS runs: beyond registry
@@ -605,6 +610,16 @@ class Router:
                 r.stats = json.loads(snap.tobytes().decode())
             except (OSError, ConnectionError, ValueError):
                 continue
+            if self._fleet is not None:
+                # fleet metrics plane (docs/OBSERVABILITY.md "Fleet
+                # metrics plane"): the SAME pull that feeds slo_aware and
+                # the prefix directory feeds the fleet rollup — no second
+                # scrape loop against the replicas
+                try:
+                    self._fleet.ingest(r.replica_id, r.role, r.endpoint,
+                                       r.stats)
+                except (TypeError, ValueError, KeyError):
+                    pass    # malformed snapshot: the rollup keeps its view
             # disaggregation extras (docs/SERVING.md "Disaggregated
             # serving"): the replica's self-declared role (refines the
             # lease-prefix classification — static fleets with
@@ -675,9 +690,21 @@ class Router:
         7-wide options shape's trailing four int32 words), if present."""
         if len(arrays) >= 3:
             opts = np.asarray(arrays[2]).reshape(-1)
-            if opts.size >= 7:
+            if opts.size >= 7 and np.any(opts[3:7]):
                 return np.ascontiguousarray(opts[3:7], np.int32).tobytes()
         return None
+
+    @staticmethod
+    def _trace_ctx(arrays) -> tuple[str | None, str | None]:
+        """The GENERATE options array's fleet trace context — the 13-wide
+        options shape's trailing TRACE_WORDS int32 words — as a
+        ``(trace_id, parent_span)`` hex pair; ``(None, None)`` when no
+        context rode the request (all-zero words)."""
+        if len(arrays) >= 3:
+            opts = np.asarray(arrays[2]).reshape(-1)
+            if opts.size >= 13:
+                return words_to_trace([int(w) for w in opts[7:13]])
+        return None, None
 
     def _evict(self, r: ReplicaState, reason: str):
         with self._rlock:
@@ -806,6 +833,19 @@ class Router:
         budget = self._max_resubmits
         tried: set[str] = set()
         key = self._request_key(arrays)
+        trace_id, client_span = self._trace_ctx(arrays)
+        router_span = None
+        if trace_id is not None:
+            # re-parent the forwarded context to THIS hop's span id so the
+            # replica's spans chain client -> router -> replica; the trace
+            # id itself is forwarded verbatim on every attempt (resubmits
+            # and ack-retries reuse the rewritten options array)
+            router_span = new_span_id()
+            arrays = list(arrays)
+            opts = np.array(np.asarray(arrays[2]).reshape(-1), np.int32,
+                            copy=True)
+            opts[7:13] = trace_to_words(trace_id, router_span)
+            arrays[2] = opts
         retried_same: set[str] = set()
         forced: ReplicaState | None = None
         t0 = time.perf_counter()
@@ -821,7 +861,8 @@ class Router:
             # prefills on the decode-capable replica itself. Terminal
             # outcomes raise straight through.
             outs = self._route_disagg(arrays, conn, key, t_deadline,
-                                      deadline_ms, rid_req, t0)
+                                      deadline_ms, rid_req, t0,
+                                      (trace_id, client_span, router_span))
             if outs is not None:
                 return outs
             metrics.counter("router.disagg_fallbacks").inc()
@@ -935,7 +976,9 @@ class Router:
             metrics.histogram("router.request_seconds").observe(dt)
             metrics.add_span("router.forward", t0, dt, cat="router",
                              args={"request_id": rid_req,
-                                   "replica": r.replica_id})
+                                   "replica": r.replica_id},
+                             trace_id=trace_id, parent=client_span,
+                             span_id=router_span)
             return outs
 
     # ------------------------------------------------ disaggregated routing
@@ -1014,7 +1057,7 @@ class Router:
         return sock
 
     def _route_disagg(self, arrays, conn, key, t_deadline, deadline_ms,
-                      rid_req, t0):
+                      rid_req, t0, trace3=(None, None, None)):
         """One two-phase GENERATE (docs/SERVING.md "Disaggregated
         serving"): OP_PREFILL to the affinity-picked prefill worker,
         whose PTKS1 page records RELAY to the chosen decode replica's
@@ -1038,6 +1081,13 @@ class Router:
         GENERATE. Terminal per-request outcomes (validation errors,
         DeadlineExceeded, Cancelled, client disconnect) raise through
         verbatim; they would be identical on any route."""
+        trace_id, client_span, router_span = trace3
+        # both tiers' spans parent on the router hop: the prefill worker's
+        # engine.prefill_stream AND the decode replica's request spans
+        # chain under one router.forward — the stitched trace shows the
+        # two-phase fan-out as siblings, not a linear chain
+        twords = trace_to_words(trace_id, router_span) \
+            if trace_id is not None else None
         prompt = np.ascontiguousarray(np.asarray(arrays[0]).reshape(-1),
                                       np.int32)
         mnt = int(np.asarray(arrays[1]).reshape(-1)[0])
@@ -1068,15 +1118,22 @@ class Router:
             remaining_ms = max(1, int(remaining * 1000))
             timeout = min(self._request_timeout, remaining + 10.0)
         opts_kv = [mnt, cache, spec, remaining_ms]
-        if key is not None:
-            opts_kv += [int(w) for w in np.frombuffer(key, np.int32)]
+        if key is not None or twords is not None:
+            # the trace words ride PAST the key slot, so a traced keyless
+            # request pads four zero key words (serve's parser ignores an
+            # all-zero key group)
+            opts_kv += ([int(w) for w in np.frombuffer(key, np.int32)]
+                        if key is not None else [0, 0, 0, 0])
+        if twords is not None:
+            opts_kv += twords
         # 1. start the prefill stream
         psock = None
         try:
             psock = self._open_replica(pre, timeout)
             psock.settimeout(timeout)
             psock.sendall(struct.pack("<III", MAGIC, OP_PREFILL, 2))
-            send_arrays(psock, [prompt, np.asarray([cache], np.int32)])
+            popts = [cache] + twords if twords is not None else [cache]
+            send_arrays(psock, [prompt, np.asarray(popts, np.int32)])
             if conn is not None:
                 # watch the CLIENT while the worker plans the stream —
                 # same disconnect chain as the decode wait
@@ -1217,7 +1274,9 @@ class Router:
         metrics.add_span("router.forward", t0, dt, cat="router",
                          args={"request_id": rid_req,
                                "replica": dec.replica_id,
-                               "prefill": pre.replica_id})
+                               "prefill": pre.replica_id},
+                         trace_id=trace_id, parent=client_span,
+                         span_id=router_span)
         return outs
 
     def _route_cancel(self, arrays) -> np.ndarray:
@@ -1257,6 +1316,15 @@ class Router:
         return np.asarray([1 if any(hits) else 0], np.int32)
 
     # ------------------------------------------------------------ wire side
+
+    def attach_fleet(self, fleet):
+        """Feed ``fleet`` (an `observability.fleet.FleetMetrics`) from
+        this router's STATS poll loop: every per-replica snapshot the
+        loop pulls is ingested with its ``{role, replica}`` identity, so
+        the fleet rollup rides the existing scrape instead of adding a
+        second one. Returns ``self`` for chaining."""
+        self._fleet = fleet
+        return self
 
     def attach_registry(self, lease):
         """Hold the ROUTER-ROLE registry lease this router registered
@@ -1350,13 +1418,35 @@ class Router:
                     # outstanding gauges, plus anything else this process
                     # recorded
                     conn.sendall(struct.pack("<III", MAGIC, 0, 1))
-                    send_arrays(conn, [stats_payload()])
+                    send_arrays(conn, [stats_payload(
+                        {"role": "router",
+                         "node": metrics.node_identity()})])
                     continue
                 if op == OP_PROMETHEUS:
                     conn.sendall(struct.pack("<III", MAGIC, 0, 1))
                     send_arrays(conn, [np.frombuffer(
                         metrics.to_prometheus().encode(),
                         dtype=np.uint8).copy()])
+                    continue
+                if op == OP_TRACE_EXPORT:
+                    # the router is a trace participant too: its
+                    # router.forward spans stitch into the same fleet
+                    # timeline the replicas export
+                    arrays = recv_arrays(conn, n)
+                    if len(arrays) != 1:
+                        self._send_err(conn, "ValueError: TRACE_EXPORT "
+                                             "wants one uint8 trace-id "
+                                             "array")
+                        return
+                    tid = np.ascontiguousarray(
+                        arrays[0], np.uint8).tobytes().hex()
+                    conn.sendall(struct.pack("<III", MAGIC, 0, 1))
+                    send_arrays(conn, [trace_export_payload(tid)])
+                    continue
+                if op == OP_DEBUG_DUMP:
+                    recv_arrays(conn, n)
+                    conn.sendall(struct.pack("<III", MAGIC, 0, 1))
+                    send_arrays(conn, [debug_dump_payload()])
                     continue
                 if op == OP_SHUTDOWN:
                     conn.sendall(struct.pack("<III", MAGIC, 0, 0))
@@ -1446,6 +1536,16 @@ def main(argv=None):
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="also serve GET /metrics (Prometheus text) from "
                          "a stdlib HTTP endpoint on this port")
+    ap.add_argument("--fleet-port", type=int, default=None,
+                    help="serve the FLEET metrics plane on this port: "
+                         "GET /metrics is every replica's registry "
+                         "re-labeled {role,replica} plus fleet rollups, "
+                         "GET /fleet is the JSON snapshot the autoscaler "
+                         "shares (docs/OBSERVABILITY.md)")
+    ap.add_argument("--dump", default=None, metavar="REPLICA_ID",
+                    help="one-shot: pull REPLICA_ID's DEBUG_DUMP (flight "
+                         "ring + metrics snapshot) through the replica "
+                         "auth path, print the JSON, and exit")
     ap.add_argument("--router-id", default=None,
                     help="register THIS router in the registry under the "
                          "'router' role (node id router:<id>) so clients "
@@ -1474,6 +1574,10 @@ def main(argv=None):
     if args.router_id is not None and registry is None:
         ap.error("--router-id needs --registry-dir or --registry-addr "
                  "(the router role is a registry lease)")
+    metrics.set_node_identity(
+        role="router",
+        node_id=router_node_id(args.router_id) if args.router_id
+        else f"router-{os.getpid()}")
     router = Router(registry=registry, replicas=replicas,
                     policy=args.policy, host=args.host, port=args.port,
                     auth_name=args.auth_name,
@@ -1481,6 +1585,23 @@ def main(argv=None):
                     poll_interval_s=args.poll_interval,
                     max_resubmits=args.max_resubmits,
                     page_size=args.page_size)
+    if args.dump is not None:
+        # one-shot debug pull: membership was folded in synchronously by
+        # the constructor, so a static or already-registered replica is
+        # resolvable immediately
+        import json as _json
+        with router._rlock:
+            rep = router._replicas.get(args.dump)
+        if rep is None:
+            router.stop()
+            raise SystemExit(
+                f"--dump: unknown replica {args.dump!r}; have "
+                f"{router.replica_ids()}")
+        payload = router._replica_op(rep, OP_DEBUG_DUMP)
+        print(_json.dumps(_json.loads(payload.tobytes().decode()),
+                          indent=2, sort_keys=True))
+        router.stop()
+        return
     if args.router_id is not None:
         from paddle_tpu.distributed.fleet.elastic import (NodeRegistry,
                                                           TcpNodeRegistry)
@@ -1503,6 +1624,14 @@ def main(argv=None):
         exporter = start_http_exporter(host=args.host,
                                        port=args.metrics_port)
         print(f"METRICS {exporter.server_address[1]}", flush=True)
+    if args.fleet_port is not None:
+        from paddle_tpu.observability.fleet import (FleetMetrics,
+                                                    start_fleet_exporter)
+        fm = FleetMetrics()
+        router.attach_fleet(fm)
+        fexp = start_fleet_exporter(fm, host=args.host,
+                                    port=args.fleet_port)
+        print(f"FLEET {fexp.server_address[1]}", flush=True)
     router.serve_forever()
 
 

@@ -44,6 +44,7 @@ CHAOS_SUITES = (
     "tests/test_migration.py",
     "tests/test_control_plane.py",
     "tests/test_disagg.py",
+    "tests/test_fleet_observability.py",
 )
 
 

@@ -1,0 +1,931 @@
+"""Fleet-wide distributed tracing + metrics aggregation plane.
+
+The tentpole contract under test: a trace context (16-byte trace id +
+parent span id) minted at ingress (`RemotePredictor.generate` /
+`serving/router.py`) rides EVERY wire hop — GENERATE/PREFILL/KV_STREAM
+options words, PTKS1/PTMG1 headers, router resubmits and ack-retries,
+disagg fallback, warm migration — and each process's spans chain
+client -> router -> replica under the one id, pullable over the
+TRACE_EXPORT wire op and stitched into ONE Chrome trace
+(`observability/fleet.py`). On the same pull loop: the fleet metrics
+plane (`FleetMetrics`) whose counter rollups are EXACT sums of the
+per-replica registries and whose JSON snapshot API the autoscaler reuses
+verbatim (docs/OBSERVABILITY.md "Fleet tracing" / "Fleet metrics
+plane").
+
+Replicas are real in-process InferenceServers with real engines on CPU
+(the multi-process stitched drill at the bottom spawns real
+subprocesses); traced requests are checked token-identical against
+dense `fast_generate` wherever determinism allows, so tracing can never
+pass by breaking the answer.
+"""
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import metrics
+from paddle_tpu.observability.tracing import (mint_trace, new_span_id,
+                                              trace_to_words,
+                                              words_to_trace)
+from paddle_tpu.testing import faults
+
+FLEET_SECRET = "obs-fleet"
+
+
+def _tiny_model(seed=7):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=2, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def _fast_ref(model, prompt, n):
+    ids = paddle.Tensor(np.asarray(prompt)[None].astype(np.int32),
+                        _internal=True)
+    return np.asarray(model.fast_generate(ids, max_new_tokens=n).numpy())[0]
+
+
+def _replica(model, role="both", **ekw):
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.inference.serve import InferenceServer
+    ekw.setdefault("page_size", 4)
+    ekw.setdefault("max_slots", 2)
+    ekw.setdefault("min_bucket", 8)
+    srv = InferenceServer(None, engine=DecodeEngine(model,
+                                                    EngineConfig(**ekw)),
+                          auth_name=FLEET_SECRET, role=role)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _router(**kw):
+    from paddle_tpu.serving import Router
+    kw.setdefault("replica_secret", FLEET_SECRET)
+    kw.setdefault("auth_name", FLEET_SECRET)
+    router = Router(**kw)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    return router
+
+
+def _client(port, secret=FLEET_SECRET, **kw):
+    from paddle_tpu.inference.serve import RemotePredictor
+    return RemotePredictor(port=port, secret=secret, **kw)
+
+
+def _counter(name):
+    return metrics.snapshot()["counters"].get(name, 0)
+
+
+def _wait_for(pred, timeout=30.0, msg="condition"):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.02)
+
+
+def _spans(tid):
+    return metrics.spans_for_trace(tid)
+
+
+def _by_name(tid, name):
+    return [e for e in _spans(tid) if e["name"] == name]
+
+
+# ------------------------------------------------------------------ units
+
+
+class TestTraceContextUnits:
+    def test_words_round_trip(self):
+        tid, span = mint_trace()
+        words = trace_to_words(tid, span)
+        assert len(words) == 6
+        assert all(isinstance(w, int) for w in words)
+        assert words_to_trace(words) == (tid, span)
+        # None encodes as zeros and decodes back to None per group
+        assert words_to_trace(trace_to_words(None, None)) == (None, None)
+        assert words_to_trace(trace_to_words(tid, None)) == (tid, None)
+
+    def test_attach_context_is_idempotent_and_mints_span(self):
+        from paddle_tpu.observability.tracing import RequestTrace
+        tr = RequestTrace()
+        assert tr.trace_id is None and tr.span_id is None
+        tid, parent = mint_trace()
+        tr.attach_context(tid, parent)
+        assert (tr.trace_id, tr.parent_span) == (tid, parent)
+        first_span = tr.span_id
+        assert first_span is not None
+        tr.attach_context("ff" * 16, "aa" * 8)   # second attach: no-op
+        assert tr.trace_id == tid and tr.span_id == first_span
+        tr2 = RequestTrace()
+        tr2.attach_context(None)                 # no context: still local
+        assert tr2.trace_id is None and tr2.span_id is None
+
+    def test_migration_item_trace_fields_round_trip(self):
+        from paddle_tpu.inference.engine import (MigrationItem,
+                                                 pack_migration,
+                                                 unpack_migration)
+        tid, span = mint_trace()
+        cold = MigrationItem(max_new_tokens=5,
+                             prompt=np.arange(4, dtype=np.int32),
+                             trace_id=tid, parent_span=span)
+        c2 = unpack_migration(pack_migration(cold))
+        assert (c2.trace_id, c2.parent_span) == (tid, span)
+        # absent context survives as None, not ""
+        c3 = unpack_migration(pack_migration(MigrationItem(
+            max_new_tokens=5, prompt=np.arange(4, dtype=np.int32))))
+        assert c3.trace_id is None and c3.parent_span is None
+
+
+class TestSeriesEviction:
+    """Satellite: the labeled-series LRU cap + eviction counter."""
+
+    def test_labeled_series_lru_cap_and_eviction_counter(self):
+        from paddle_tpu.observability import (_MAX_LABELED_SERIES,
+                                              MetricsRegistry)
+        reg = MetricsRegistry()
+        for i in range(_MAX_LABELED_SERIES + 10):
+            reg.counter("test.labeled", replica=f"r{i}").inc()
+        snap = reg.snapshot()
+        labeled = [k for k in snap["counters"]
+                   if k.startswith("test.labeled{")]
+        assert len(labeled) == _MAX_LABELED_SERIES
+        assert snap["counters"]["metrics.series_evictions"] == 10
+        # the survivors are the most RECENT ids — LRU evicts the head
+        assert "test.labeled{replica=r0}" not in snap["counters"]
+        last = f"test.labeled{{replica=r{_MAX_LABELED_SERIES + 9}}}"
+        assert snap["counters"][last] == 1
+
+    def test_touch_refreshes_recency(self):
+        from paddle_tpu.observability import (_MAX_LABELED_SERIES,
+                                              MetricsRegistry)
+        reg = MetricsRegistry()
+        for i in range(_MAX_LABELED_SERIES):
+            reg.counter("test.lru", shard=f"s{i}").inc()
+        reg.counter("test.lru", shard="s0").inc()   # touch the oldest
+        reg.counter("test.lru", shard="overflow").inc()  # evicts ONE
+        snap = reg.snapshot()["counters"]
+        assert snap["test.lru{shard=s0}"] == 2      # survived the evict
+        assert "test.lru{shard=s1}" not in snap     # s1 was next-oldest
+        assert snap["metrics.series_evictions"] == 1
+
+    def test_unlabeled_series_never_evicted(self):
+        from paddle_tpu.observability import (_MAX_LABELED_SERIES,
+                                              MetricsRegistry)
+        reg = MetricsRegistry()
+        reg.counter("test.precious").inc()
+        for i in range(_MAX_LABELED_SERIES + 50):
+            reg.gauge("test.g", replica=f"r{i}").set(i)
+        snap = reg.snapshot()
+        assert snap["counters"]["test.precious"] == 1
+        assert snap["counters"]["metrics.series_evictions"] == 50
+
+
+# ------------------------------------------------------------- wire hops
+
+
+class TestTracedWire:
+    def test_traced_generate_chains_spans_and_compiles_nothing_new(self):
+        model = _tiny_model()
+        srv = _replica(model)
+        cli = _client(srv.port)
+        try:
+            prompt = np.arange(2, 8, dtype=np.int32)
+            ref = _fast_ref(model, prompt, 6)
+            # warm up UNTRACED — twice, so the repeat-prompt path (prefix
+            # attach -> prefill_chunk) is compiled too — then snapshot
+            np.testing.assert_array_equal(
+                cli.generate(prompt, max_new_tokens=6), ref)
+            np.testing.assert_array_equal(
+                cli.generate(prompt, max_new_tokens=6), ref)
+            programs = set(srv._engine._programs)
+            tid, sc = mint_trace()
+            out = cli.generate(prompt, max_new_tokens=6, trace_id=tid,
+                               parent_span=sc)
+            np.testing.assert_array_equal(out, ref)
+            # tracing is metadata-only: ZERO new programs compiled
+            assert set(srv._engine._programs) == programs
+            evs = _spans(tid)
+            assert evs, "traced request recorded no spans"
+            assert all(e["args"]["trace_id"] == tid for e in evs)
+            (client_span,) = _by_name(tid, "client.generate")
+            assert client_span["args"]["span"] == sc
+            # replica request.* spans parent on the CLIENT's span (no
+            # router hop in between) and share one replica-side span id
+            reqs = [e for e in evs if e["name"].startswith("request.")]
+            assert {e["args"]["parent"] for e in reqs} == {sc}
+            assert len({e["args"]["span"] for e in reqs}) == 1
+            assert {"request.queue", "request.prefill",
+                    "request.e2e"} <= {e["name"] for e in reqs}
+            # the TRACE_EXPORT wire op serves the same spans + identity
+            body = cli.trace_export(tid)
+            assert body["trace_id"] == tid
+            assert body["node"]["pid"] == os.getpid()
+            assert len(body["spans"]) == len(evs)
+            # an UNTRACED request lands nothing new in the trace ring
+            cli.generate(prompt, max_new_tokens=6)
+            assert len(_spans(tid)) == len(evs)
+        finally:
+            cli.close()
+            srv._stop.set()
+
+    def test_router_reparents_span_chain(self):
+        model = _tiny_model()
+        srv = _replica(model)
+        router = _router(replicas={"r0": f"127.0.0.1:{srv.port}"})
+        cli = _client(router.port)
+        try:
+            prompt = np.arange(3, 9, dtype=np.int32)
+            ref = _fast_ref(model, prompt, 6)
+            tid, sc = mint_trace()
+            out = cli.generate(prompt, max_new_tokens=6, trace_id=tid,
+                               parent_span=sc)
+            np.testing.assert_array_equal(out, ref)
+            (fwd,) = _by_name(tid, "router.forward")
+            assert fwd["args"]["parent"] == sc
+            router_span = fwd["args"]["span"]
+            assert router_span and router_span != sc
+            # the replica chains under the ROUTER's span, not the client's
+            reqs = [e for e in _spans(tid)
+                    if e["name"].startswith("request.")]
+            assert reqs and {e["args"]["parent"]
+                             for e in reqs} == {router_span}
+        finally:
+            cli.close()
+            router.stop()
+            srv._stop.set()
+
+    def test_dedup_attach_keeps_one_traced_request(self):
+        """Two concurrent keyed submissions of the SAME request under one
+        trace id: the second ATTACHES to the first's engine request
+        (engine.dedup_hits), both clients get identical tokens, and the
+        trace ring holds one request-span chain, not two."""
+        model = _tiny_model()
+        srv = _replica(model)
+        tid, sc = mint_trace()
+        key = bytes(range(16))
+        prompt = np.arange(4, 10, dtype=np.int32)
+        ref = _fast_ref(model, prompt, 12)
+        outs, errs = {}, []
+
+        def one(i):
+            cli = _client(srv.port)
+            try:
+                outs[i] = cli.generate(prompt, max_new_tokens=12,
+                                       request_key=key, trace_id=tid,
+                                       parent_span=sc)
+            except Exception as e:  # noqa: BLE001 — drill counts these
+                errs.append(f"{type(e).__name__}: {e}")
+            finally:
+                cli.close()
+        h0 = _counter("engine.dedup_hits")
+        with faults.scoped("engine.step_delay", times=-1, delay_s=0.01):
+            ths = [threading.Thread(target=one, args=(i,))
+                   for i in range(2)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout=60)
+        try:
+            assert not errs, errs
+            np.testing.assert_array_equal(outs[0], ref)
+            np.testing.assert_array_equal(outs[1], outs[0])
+            assert _counter("engine.dedup_hits") == h0 + 1
+            e2e = _by_name(tid, "request.e2e")
+            assert len(e2e) == 1, \
+                "dedup attach must not double the request-span chain"
+            assert e2e[0]["args"]["trace_id"] == tid
+        finally:
+            srv._stop.set()
+
+    def test_ack_retry_replays_traced_keyed_request(self):
+        """serve.ack_drop severs the wire AFTER the replica finished the
+        work: the router's one free same-replica retry rides the dedup
+        table, and the retried request carries the ORIGINAL trace words
+        (the router rewrote them once, before the forward loop)."""
+        model = _tiny_model()
+        srv = _replica(model)
+        router = _router(replicas={"r0": f"127.0.0.1:{srv.port}"})
+        cli = _client(router.port)
+        try:
+            prompt = np.arange(5, 11, dtype=np.int32)
+            ref = _fast_ref(model, prompt, 6)
+            tid, sc = mint_trace()
+            a0 = _counter("router.ack_retries")
+            with faults.scoped("serve.ack_drop", times=1):
+                out = cli.generate(prompt, max_new_tokens=6,
+                                   request_key=bytes(range(16)),
+                                   trace_id=tid, parent_span=sc)
+            np.testing.assert_array_equal(out, ref)
+            assert _counter("router.ack_retries") == a0 + 1
+            evs = _spans(tid)
+            assert all(e["args"]["trace_id"] == tid for e in evs)
+            (fwd,) = _by_name(tid, "router.forward")
+            # the replica's spans (first attempt — the work that the
+            # replay answered for) chain under the router hop
+            reqs = [e for e in evs if e["name"].startswith("request.")]
+            assert reqs and {e["args"]["parent"]
+                             for e in reqs} == {fwd["args"]["span"]}
+        finally:
+            cli.close()
+            router.stop()
+            srv._stop.set()
+
+    def _disagg_fleet(self, model, **router_kw):
+        pf = _replica(model, role="prefill", prefill_chunk_tokens=4)
+        dc = _replica(model, role="decode")
+        router = _router(replicas={"prefill:p0": f"127.0.0.1:{pf.port}",
+                                   "decode:d0": f"127.0.0.1:{dc.port}"},
+                         **router_kw)
+        return pf, dc, router
+
+    def test_disagg_two_phase_spans_share_one_trace(self):
+        model = _tiny_model()
+        pf, dc, router = self._disagg_fleet(model)
+        cli = _client(router.port)
+        try:
+            prompt = (np.arange(11) % 60).astype(np.int32)
+            ref = _fast_ref(model, prompt, 6)
+            tid, sc = mint_trace()
+            d0 = _counter("router.disagg_requests")
+            out = cli.generate(prompt, max_new_tokens=6, trace_id=tid,
+                               parent_span=sc)
+            np.testing.assert_array_equal(out, ref)
+            assert _counter("router.disagg_requests") == d0 + 1
+            evs = _spans(tid)
+            names = {e["name"] for e in evs}
+            # all three hops landed spans under the ONE minted id:
+            # client ingress, router forward, the prefill worker's
+            # stream, and the decode replica's request chain
+            assert {"client.generate", "router.forward",
+                    "engine.prefill_stream", "request.e2e"} <= names
+            assert all(e["args"]["trace_id"] == tid for e in evs)
+            (fwd,) = _by_name(tid, "router.forward")
+            router_span = fwd["args"]["span"]
+            # both tiers are CHILDREN of the router hop (two-phase
+            # fan-out, not a linear chain)
+            (pstream,) = _by_name(tid, "engine.prefill_stream")
+            assert pstream["args"]["parent"] == router_span
+            reqs = [e for e in evs if e["name"].startswith("request.")]
+            assert {e["args"]["parent"] for e in reqs} == {router_span}
+        finally:
+            cli.close()
+            router.stop()
+            pf._stop.set()
+            dc._stop.set()
+
+    def test_disagg_midstream_fallback_keeps_trace(self):
+        """The prefill stream dies mid-flight: the router falls back to
+        symmetric — a DIFFERENT propagation path (plain GENERATE to the
+        decode-capable replica) — and the context survives the switch."""
+        model = _tiny_model()
+        pf, dc, router = self._disagg_fleet(model)
+        cli = _client(router.port)
+        try:
+            prompt = (np.arange(11) % 60).astype(np.int32)
+            ref = _fast_ref(model, prompt, 6)
+            tid, sc = mint_trace()
+            f0 = _counter("router.disagg_fallbacks")
+            with faults.scoped("serve.stream_drop", times=1):
+                out = cli.generate(prompt, max_new_tokens=6, trace_id=tid,
+                                   parent_span=sc)
+            np.testing.assert_array_equal(out, ref)
+            assert _counter("router.disagg_fallbacks") == f0 + 1
+            evs = _spans(tid)
+            assert all(e["args"]["trace_id"] == tid for e in evs)
+            # the fallback's symmetric route still chains replica spans
+            # under the router hop and closes the request
+            (fwd,) = _by_name(tid, "router.forward")
+            reqs = [e for e in evs if e["name"] == "request.e2e"]
+            assert len(reqs) == 1
+            assert reqs[0]["args"]["parent"] == fwd["args"]["span"]
+        finally:
+            cli.close()
+            router.stop()
+            pf._stop.set()
+            dc._stop.set()
+
+    def test_warm_migration_peer_carries_original_trace(self):
+        """Drain-migrate a mid-decode TRACED request: the PTMG1 header
+        ships the context, the peer's spans land under the ORIGINAL
+        minted trace id, and the spliced answer is token-identical."""
+        model = _tiny_model()
+        a = _replica(model)
+        b = _replica(model)
+        prompt = np.arange(3, 9, dtype=np.int32)
+        ref = _fast_ref(model, prompt, 16)
+        tid, sc = mint_trace()
+        outs = {}
+
+        def client():
+            cli = _client(a.port)
+            outs["x"] = cli.generate(prompt, max_new_tokens=16,
+                                     trace_id=tid, parent_span=sc)
+            cli.close()
+        t = threading.Thread(target=client)
+        t.start()
+        base_out = _counter("serve.migrations_out")
+        try:
+            with faults.scoped("engine.step_delay", times=-1,
+                               delay_s=0.01):
+                _wait_for(lambda: any(
+                    r is not None and len(r.generated) >= 2
+                    for r in a._engine._slot_req), msg="mid-decode on A")
+                clean = a.drain(migrate_peers=[f"127.0.0.1:{b.port}"])
+            t.join(timeout=60)
+            assert clean is True
+            np.testing.assert_array_equal(outs["x"], ref)
+            assert _counter("serve.migrations_out") == base_out + 1
+            evs = _spans(tid)
+            assert all(e["args"]["trace_id"] == tid for e in evs)
+            # TWO request-span chains under the one id: the victim's and
+            # the peer's (each RequestTrace mints its own span id)
+            req_span_ids = {e["args"]["span"] for e in evs
+                            if e["name"].startswith("request.")}
+            assert len(req_span_ids) >= 2, \
+                "peer recorded no spans under the original trace id"
+        finally:
+            b.drain(deadline_s=5.0)
+
+
+# ------------------------------------------------- debug dump + collector
+
+
+class TestDebugDumpAndCollector:
+    def test_debug_dump_over_wire(self):
+        model = _tiny_model()
+        srv = _replica(model)
+        cli = _client(srv.port)
+        try:
+            cli.generate(np.arange(2, 7, dtype=np.int32),
+                         max_new_tokens=4)
+            dump = cli.debug_dump()
+            assert set(dump) == {"node", "events", "metrics"}
+            assert dump["node"]["pid"] == os.getpid()
+            assert dump["metrics"]["counters"]["serve.requests"] >= 1
+            assert isinstance(dump["events"], list)
+        finally:
+            cli.close()
+            srv._stop.set()
+
+    def test_router_dump_cli_prints_replica_flight_ring(self, capsys):
+        from paddle_tpu.serving import router as router_mod
+        model = _tiny_model()
+        srv = _replica(model)
+        try:
+            router_mod.main(["--replica", f"r0=127.0.0.1:{srv.port}",
+                             "--replica-secret", FLEET_SECRET,
+                             "--auth-name", FLEET_SECRET,
+                             "--dump", "r0"])
+            dump = json.loads(capsys.readouterr().out)
+            assert set(dump) == {"node", "events", "metrics"}
+            with pytest.raises(SystemExit, match="unknown replica"):
+                router_mod.main(["--replica", f"r0=127.0.0.1:{srv.port}",
+                                 "--replica-secret", FLEET_SECRET,
+                                 "--auth-name", FLEET_SECRET,
+                                 "--dump", "nope"])
+        finally:
+            srv._stop.set()
+
+    def test_trace_export_via_router_and_stitch(self):
+        """The router answers TRACE_EXPORT too (its router.forward spans
+        are part of the timeline), and the collector stitches exports
+        into one normalized Chrome trace."""
+        from paddle_tpu.observability.fleet import TraceCollector
+        model = _tiny_model()
+        srv = _replica(model)
+        router = _router(replicas={"r0": f"127.0.0.1:{srv.port}"})
+        cli = _client(router.port)
+        try:
+            tid, sc = mint_trace()
+            cli.generate(np.arange(2, 8, dtype=np.int32),
+                         max_new_tokens=4, trace_id=tid, parent_span=sc)
+            body = cli.trace_export(tid)      # via the ROUTER connection
+            assert "router.forward" in {e["name"] for e in body["spans"]}
+            col = TraceCollector({"router:t": f"127.0.0.1:{router.port}"},
+                                 secret=FLEET_SECRET)
+            trace = col.collect(tid)
+            xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+            assert xs and min(e["ts"] for e in xs) == 0.0
+            assert all(e["args"]["trace_id"] == tid for e in xs)
+            metas = [e for e in trace["traceEvents"] if e.get("ph") == "M"]
+            assert metas and metas[0]["name"] == "process_name"
+        finally:
+            cli.close()
+            router.stop()
+            srv._stop.set()
+
+    def test_stitch_is_pure_and_lane_separated(self):
+        from paddle_tpu.observability.fleet import TraceCollector
+        exports = [
+            {"node": {"role": "router", "node_id": "router:a", "pid": 11},
+             "spans": [{"name": "router.forward", "cat": "router",
+                        "ph": "X", "pid": 11, "tid": 1, "ts": 2000.0,
+                        "dur": 50.0, "args": {}}]},
+            {"node": {"role": "decode", "node_id": "d0", "pid": 22},
+             "spans": [{"name": "request.e2e", "cat": "request",
+                        "ph": "X", "pid": 22, "tid": 2, "ts": 2010.0,
+                        "dur": 30.0, "args": {}}]},
+        ]
+        trace = TraceCollector.stitch(exports)
+        xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        assert len({e["pid"] for e in xs}) == 2
+        assert min(e["ts"] for e in xs) == 0.0
+        lanes = {e["args"]["name"] for e in trace["traceEvents"]
+                 if e.get("ph") == "M"}
+        assert lanes == {"router:router:a", "decode:d0"}
+
+
+# ------------------------------------------------------ fleet metrics
+
+
+class TestFleetMetricsPlane:
+    def _two_registries(self):
+        from paddle_tpu.observability import MetricsRegistry
+        a, b = MetricsRegistry(), MetricsRegistry()
+        for _ in range(3):
+            a.counter("serve.requests").inc()
+        for _ in range(5):
+            b.counter("serve.requests").inc()
+        a.counter("engine.tokens").inc(40)
+        b.counter("engine.tokens").inc(60)
+        a.gauge("engine.pages_in_use").set(4)
+        b.gauge("engine.pages_in_use").set(6)
+        a.gauge("engine.tokens_per_s").set(10.0)
+        b.gauge("engine.tokens_per_s").set(7.5)
+        for v in (0.1, 0.3):
+            a.histogram("serve.ttft_seconds").observe(v)
+        for v in (0.2, 0.6):
+            b.histogram("serve.ttft_seconds").observe(v)
+        return a, b
+
+    def test_rollup_agrees_with_sum_of_per_replica_registries(self):
+        """ISSUE acceptance: the fleet rollup on a 2-replica drill —
+        request counts EXACT sums, histograms merged (count/total exact,
+        extrema exact)."""
+        from paddle_tpu.observability.fleet import FleetMetrics
+        a, b = self._two_registries()
+        fm = FleetMetrics()
+        fm.ingest("d0", "decode", "127.0.0.1:1", a.snapshot())
+        fm.ingest("d1", "decode", "127.0.0.1:2", b.snapshot())
+        roll = fm.rollup()
+        sa, sb = a.snapshot(), b.snapshot()
+        assert roll["counters"]["serve.requests"] == \
+            sa["counters"]["serve.requests"] \
+            + sb["counters"]["serve.requests"] == 8
+        assert roll["counters"]["engine.tokens"] == 100
+        h = roll["histograms"]["serve.ttft_seconds"]
+        assert h["count"] == 4
+        assert abs(h["total"] - 1.2) < 1e-9
+        assert h["min"] == 0.1 and h["max"] == 0.6
+        assert roll["fleet"]["tokens_per_s"] == 17.5
+        assert roll["fleet"]["pages_in_use"] == {"d0": 4, "d1": 6}
+        assert roll["fleet"]["ttft_p99"] is not None
+
+    def test_prometheus_relabels_role_and_replica(self):
+        from paddle_tpu.observability.fleet import FleetMetrics
+        a, b = self._two_registries()
+        fm = FleetMetrics()
+        fm.ingest("d0", "decode", "127.0.0.1:1", a.snapshot())
+        fm.ingest("p0", "prefill", "127.0.0.1:2", b.snapshot())
+        text = fm.to_prometheus()
+        assert 'serve_requests{role="decode",replica="d0"} 3' in text
+        assert 'serve_requests{role="prefill",replica="p0"} 5' in text
+        assert "fleet_members 2" in text
+        assert "fleet_tokens_per_s 17.5" in text
+        assert 'fleet_ttft_seconds{quantile="0.99"}' in text
+        # a member's own labels survive without duplication
+        a.counter("router.replica_requests", replica="r9").inc()
+        fm.ingest("d0", "decode", "127.0.0.1:1", a.snapshot())
+        text = fm.to_prometheus()
+        assert ('router_replica_requests{replica="r9",role="decode"} 1'
+                in text), text
+
+    def test_http_exporter_serves_metrics_and_json(self):
+        from paddle_tpu.observability.fleet import (FleetMetrics,
+                                                    start_fleet_exporter)
+        a, _ = self._two_registries()
+        fm = FleetMetrics()
+        fm.ingest("d0", "decode", "127.0.0.1:1", a.snapshot())
+        srv = start_fleet_exporter(fm)
+        try:
+            port = srv.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10).read()
+            assert b'serve_requests{role="decode",replica="d0"}' in body
+            roll = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/fleet", timeout=10).read())
+            assert roll["counters"]["serve.requests"] == 3
+            assert "d0" in roll["members"]
+        finally:
+            srv.shutdown()
+
+    def test_router_poll_feeds_fleet_plane(self):
+        """`Router.attach_fleet`: the STATS poll the router already runs
+        populates the plane — member identity, role, and the shared
+        snapshot API — with no second scrape loop."""
+        from paddle_tpu.observability.fleet import FleetMetrics
+        model = _tiny_model()
+        srv = _replica(model)
+        fm = FleetMetrics()
+        router = _router(replicas={"r0": f"127.0.0.1:{srv.port}"},
+                         stats_interval_s=0.1, poll_interval_s=0.1)
+        router.attach_fleet(fm)
+        cli = _client(router.port)
+        try:
+            cli.generate(np.arange(2, 7, dtype=np.int32),
+                         max_new_tokens=4)
+            _wait_for(lambda: "r0" in fm.members(),
+                      msg="router poll to feed the fleet plane")
+            mem = fm.members()["r0"]
+            assert mem["role"] == "both"
+            assert mem["endpoint"] == f"127.0.0.1:{srv.port}"
+            snap = fm.snapshot_for(f"127.0.0.1:{srv.port}")
+            assert snap is not None
+            assert snap["counters"]["serve.requests"] >= 1
+            assert fm.snapshot_for("127.0.0.1:9") is None
+        finally:
+            cli.close()
+            router.stop()
+            srv._stop.set()
+
+    def test_autoscaler_observes_identically_via_fleet_snapshot(self):
+        """Cheap sibling of the slow 1->3->1 drill: the controller's
+        observation signal through `fleet=` equals the one through a
+        direct ``stats_fn`` — the shared snapshot API changes NOTHING
+        about decisions."""
+        from paddle_tpu.observability.fleet import FleetMetrics
+        from paddle_tpu.serving import (Autoscaler, AutoscalePolicy,
+                                        CallbackLauncher)
+
+        class _FakeRouter:
+            def replica_view(self):
+                return [{"replica_id": f"r{i}",
+                         "endpoint": f"127.0.0.1:{9000 + i}",
+                         "breaker": "closed", "outstanding": 2}
+                        for i in range(2)]
+
+        snaps = {
+            f"127.0.0.1:{9000 + i}": {
+                "counters": {"engine.shed": 3.0 * i},
+                "gauges": {"engine.queue_depth": 5.0 + i,
+                           "engine.degradation_level": float(i)},
+                "histograms": {}}
+            for i in range(2)}
+        fm = FleetMetrics()
+        for i, (ep, snap) in enumerate(sorted(snaps.items())):
+            fm.ingest(f"r{i}", "both", ep, snap)
+
+        def scaler(**kw):
+            return Autoscaler(_FakeRouter(), CallbackLauncher(
+                lambda: None, lambda *a: True), AutoscalePolicy(), **kw)
+        direct = scaler(stats_fn=lambda ep: snaps.get(ep))
+        shared = scaler(fleet=fm)
+        assert direct.observe() == shared.observe()
+        # a member the plane has not scraped reads as a failed pull
+        fm.drop("r1")
+        sig = scaler(fleet=fm).observe()
+        assert sig["n"] == 2 and sig["queue_depth"] == 5.0
+        with pytest.raises(ValueError, match="stats_fn OR fleet"):
+            scaler(stats_fn=lambda ep: None, fleet=fm)
+
+    @pytest.mark.slow
+    def test_scale_1_3_1_on_shared_fleet_snapshot(self):
+        """ISSUE acceptance: the full 1 -> 3 -> 1 drill with the
+        autoscaler reading the FLEET plane's snapshot API (fed by the
+        router's poll loop) instead of its private STATS pulls — zero
+        client-visible errors, same scale counts."""
+        from paddle_tpu.inference.serve import RemotePredictor
+        from paddle_tpu.observability.fleet import FleetMetrics
+        from paddle_tpu.serving import (Autoscaler, AutoscalePolicy,
+                                        CallbackLauncher)
+        model = _tiny_model()
+        seed = _replica(model)
+        fm = FleetMetrics()
+        router = _router(replicas={"r0": f"127.0.0.1:{seed.port}"},
+                         evict_cooldown_s=600.0, stats_interval_s=0.2,
+                         poll_interval_s=0.1)
+        router.attach_fleet(fm)
+        servers = {}
+        scaler = None
+
+        def spawn():
+            srv = _replica(model)
+            rid = scaler.next_replica_id()
+            servers[rid] = srv
+            return rid, f"127.0.0.1:{srv.port}"
+
+        def drain(rid, ep, peers):
+            return servers.pop(rid).drain(deadline_s=30.0,
+                                          migrate_peers=peers)
+        scaler = Autoscaler(
+            router, CallbackLauncher(spawn, drain),
+            AutoscalePolicy(min_replicas=1, max_replicas=3,
+                            up_outstanding_per_replica=1.0,
+                            down_outstanding_per_replica=0.0,
+                            hysteresis_ticks=1, up_cooldown_s=0.0,
+                            down_cooldown_s=0.0),
+            fleet=fm)
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(1, 97, 5).astype(np.int32)
+                   for _ in range(6)]
+        errs, stop_load = [], threading.Event()
+
+        def client(i):
+            try:
+                cli = RemotePredictor(port=router.port,
+                                      secret=FLEET_SECRET, timeout=120.0)
+                while not stop_load.is_set():
+                    out = cli.generate(prompts[i], max_new_tokens=16)
+                    assert out.size == prompts[i].size + 16
+                cli.close()
+            except Exception as e:  # noqa: BLE001 — the drill counts these
+                errs.append(f"{type(e).__name__}: {e}")
+        base_up = _counter("autoscaler.scale_ups")
+        base_down = _counter("autoscaler.scale_downs")
+        ths = [threading.Thread(target=client, args=(i,))
+               for i in range(len(prompts))]
+        for t in ths:
+            t.start()
+        t_end = time.monotonic() + 60
+        while len(router.replica_ids(healthy_only=True)) < 3 \
+                and time.monotonic() < t_end:
+            scaler.tick()
+            time.sleep(0.05)
+        assert len(router.replica_ids(healthy_only=True)) == 3, \
+            "fleet did not reach max_replicas under load"
+        stop_load.set()
+        for t in ths:
+            t.join(timeout=120)
+        t_end = time.monotonic() + 60
+        while len(router.replica_ids(healthy_only=True)) > 1 \
+                and time.monotonic() < t_end:
+            scaler.tick()
+            time.sleep(0.02)
+        assert router.replica_ids(healthy_only=True) == ["r0"]
+        assert not errs, f"client errors during scale cycle: {errs[:3]}"
+        assert _counter("autoscaler.scale_ups") - base_up == 2
+        assert _counter("autoscaler.scale_downs") - base_down == 2
+        assert not servers, "a spawned replica outlived the scale-down"
+        router.stop()
+        seed.drain(deadline_s=10.0)
+
+
+# ------------------------------------------- multi-process stitched drill
+
+
+_GPT_SPEC = {
+    "vocab_size": 97, "hidden_size": 32, "num_layers": 2, "num_heads": 2,
+    "intermediate_size": 64, "max_position_embeddings": 64,
+    "hidden_dropout": 0.0, "attention_dropout": 0.0,
+    "engine": {"page_size": 4, "max_slots": 2, "min_bucket": 8},
+}
+
+
+def _spawn_serve(cfg_path, reg_dir, role, rid, extra_env=None,
+                 extra_args=()):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_SERVE_TOKEN"] = FLEET_SECRET
+    env.pop("XLA_FLAGS", None)
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.inference.serve",
+         "--gpt-config", str(cfg_path), "--port", "0",
+         "--role", role, "--replica-id", rid,
+         "--registry-dir", str(reg_dir),
+         "--auth-name", FLEET_SECRET, *extra_args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return proc
+
+
+def _await_listening(proc, what, timeout=120):
+    t_end = time.monotonic() + timeout
+    lines = []
+    while time.monotonic() < t_end:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line.strip())
+        if line.startswith("LISTENING"):
+            return int(line.split()[1])
+    proc.kill()
+    raise RuntimeError(f"{what} never listened: {lines[-5:]}")
+
+
+@pytest.mark.slow
+def test_stitched_trace_three_processes_with_migration(tmp_path):
+    """THE acceptance drill: one traced request router -> prefill-worker
+    -> decode-replica, mid-decode drain-migration to a peer, and the
+    collector stitches ONE Chrome trace whose spans come from >= 3
+    distinct OS processes, all under the one minted trace id."""
+    from paddle_tpu.observability.fleet import TraceCollector
+    from paddle_tpu.serving import Router
+    cfg = tmp_path / "gpt.json"
+    cfg.write_text(json.dumps(_GPT_SPEC))
+    reg = tmp_path / "registry"
+    reg.mkdir()
+    # slowed decode steps pin the drill's timing: the SIGTERM lands
+    # MID-decode deterministically, never after the request finished
+    slow = {"PADDLE_FAULTS": "engine.step_delay:delay_s=0.05:times=-1"}
+    procs = {
+        "p0": _spawn_serve(cfg, reg, "prefill", "p0"),
+        "d0": _spawn_serve(cfg, reg, "decode", "d0", extra_env=slow,
+                           extra_args=("--migrate-on-drain",
+                                       "--drain-deadline", "60")),
+        "d1": _spawn_serve(cfg, reg, "decode", "d1", extra_env=slow,
+                           extra_args=("--migrate-on-drain",
+                                       "--drain-deadline", "60")),
+    }
+    router = None
+    try:
+        ports = {rid: _await_listening(p, rid)
+                 for rid, p in procs.items()}
+        from paddle_tpu.distributed.fleet.elastic import NodeRegistry
+        router = Router(registry=NodeRegistry(str(reg)),
+                        replica_secret=FLEET_SECRET,
+                        auth_name=FLEET_SECRET, poll_interval_s=0.2)
+        threading.Thread(target=router.serve_forever,
+                         daemon=True).start()
+        _wait_for(lambda: len(router.replica_ids(healthy_only=True)) == 3,
+                  timeout=60, msg="router to see all three replicas")
+        # keyed request: rendezvous hash makes the decode placement
+        # computable, so the drill SIGTERMs the replica actually decoding
+        import hashlib
+        key = bytes(range(16))
+
+        def hrw(rid):
+            h = hashlib.blake2b(key + rid.encode(),
+                                digest_size=8).digest()
+            return (int.from_bytes(h, "little"), rid)
+        victim_rid = max(["decode:d0", "decode:d1"], key=hrw)
+        victim = victim_rid.split(":", 1)[1]
+        tid, sc = mint_trace()
+        prompt = (np.arange(9) % 60).astype(np.int32)
+        outs, errs = {}, []
+
+        def client():
+            try:
+                cli = _client(router.port, timeout=180.0)
+                outs["x"] = cli.generate(prompt, max_new_tokens=48,
+                                         request_key=key, trace_id=tid,
+                                         parent_span=sc)
+                cli.close()
+            except Exception as e:  # noqa: BLE001 — the drill counts these
+                errs.append(f"{type(e).__name__}: {e}")
+        t = threading.Thread(target=client)
+        t.start()
+        vic_cli = _client(ports[victim], timeout=30.0)
+        _wait_for(lambda: (vic_cli.stats()["gauges"]
+                           .get("engine.pages_in_use") or 0) > 0,
+                  timeout=90, msg="victim decode replica mid-request")
+        time.sleep(0.5)                    # a few decode steps in
+        procs[victim].send_signal(signal.SIGTERM)
+        vic_cli.close()
+        t.join(timeout=180)
+        assert not errs, f"client errors through the migration: {errs}"
+        assert outs["x"].size == prompt.size + 48
+        procs[victim].wait(timeout=120)
+        peer = "d1" if victim == "d0" else "d0"
+        peer_cli = _client(ports[peer], timeout=30.0)
+        assert peer_cli.stats()["counters"].get(
+            "serve.migrations_in", 0) >= 1, \
+            "the drained request never migrated to the peer"
+        peer_cli.close()
+        # pull + stitch: the test process (client + router spans), the
+        # prefill worker, and the migration peer are three distinct OS
+        # processes under the one minted trace id
+        members = {"router:t": f"127.0.0.1:{router.port}",
+                   "prefill:p0": f"127.0.0.1:{ports['p0']}",
+                   f"decode:{peer}": f"127.0.0.1:{ports[peer]}"}
+        trace = TraceCollector(members, secret=FLEET_SECRET).collect(tid)
+        xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        assert all(e["args"]["trace_id"] == tid for e in xs)
+        assert len({e["pid"] for e in xs}) >= 3, \
+            f"stitched trace covers too few processes: {trace}"
+        names = {e["name"] for e in xs}
+        assert {"client.generate", "router.forward",
+                "engine.prefill_stream"} <= names, names
+        assert any(n.startswith("request.") for n in names), names
+        assert min(e["ts"] for e in xs) == 0.0
+    finally:
+        if router is not None:
+            router.stop()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()

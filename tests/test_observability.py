@@ -423,6 +423,14 @@ def test_bench_smoke_emits_structured_json():
                "fused_ce", "flash_attention"):
         assert any(k.startswith(f"kernel.dispatch.{op}.") for k in kd), \
             (op, sorted(kd))
+    # r16: the smoke run routes one TRACED request — the minted context
+    # chains client -> router -> replica spans, exports over the
+    # TRACE_EXPORT wire op, and stitches into one Chrome trace — and the
+    # router's STATS poll feeds the attached fleet metrics plane (rollup,
+    # re-labeled rows, shared snapshot API; docs/OBSERVABILITY.md "Fleet
+    # tracing" / "Fleet metrics plane")
+    assert d["fleet_trace_ok"] is True
+    assert d["fleet_metrics_ok"] is True
 
 
 def test_bench_preflight_dead_backend_falls_back_to_cpu_rungs():

@@ -50,6 +50,13 @@ SLOW_PINNED = {
     "test_train_elastic.py": [
         "test_kill9_one_of_four_relaunches_at_dp2_bit_identical",
         "test_sigterm_any_rank_drains_whole_fleet_to_complete_checkpoint"],
+    # PR 16 audit: the stitched-trace drill spawns 3 serve subprocesses
+    # plus an in-test router (~12 s), and the shared-snapshot autoscale
+    # drill runs the full 1->3->1 cycle under client load (~8 s); both
+    # keep cheap in-process siblings in tier-1 (see the sibling map).
+    "test_fleet_observability.py": [
+        "test_stitched_trace_three_processes_with_migration",
+        "test_scale_1_3_1_on_shared_fleet_snapshot"],
 }
 
 # file -> pytest.param values that MUST carry marks=pytest.mark.slow
@@ -137,6 +144,15 @@ def test_tier1_keeps_a_cheap_sibling_for_each_audited_item():
             "test_multihost_partitioned_save_is_complete_only_with_all_ranks",
             "test_controller_relaunches_at_surviving_world",
             "test_split_step_bit_identical_to_fused"],
+        # the 3-process stitched-trace drill decomposes into these
+        # tier-1 pins: router re-parenting, wire trace export + stitch,
+        # and migration trace carry-over; the shared-snapshot autoscale
+        # drill keeps its observation-equivalence sibling
+        "test_fleet_observability.py": [
+            "test_router_reparents_span_chain",
+            "test_trace_export_via_router_and_stitch",
+            "test_warm_migration_peer_carries_original_trace",
+            "test_autoscaler_observes_identically_via_fleet_snapshot"],
     }
     for fname, names in siblings.items():
         tree = _parse(fname)
