@@ -814,6 +814,109 @@ def bench_prefix_cache():
                      for k in ("hit", "miss", "pages_reused", "evictions")}
 
 
+def bench_kv_tiers():
+    """KV-tiering rung (docs/SERVING.md "KV tiering"): TTFT for one
+    256-token prompt with its prefix (a) resident in HBM, (b) spilled to
+    the host-RAM tier, (c) spilled to the disk tier, (d) cold. A tier
+    hit re-uploads the pages (one batched device_put) and prefills only
+    the 16-token tail, so host/disk TTFT should sit between the HBM hit
+    and the full cold prefill. Asserts the economy's two contracts: a
+    host-tier hit is STRICTLY faster than cold, and every tier hit's
+    prefill work equals the tail (counter-pinned) with token-identical
+    output. Emits its own structured JSON line."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import metrics
+
+    paddle.seed(0)
+    PS, S, N, REPS = 16, 256, 4, 5
+    cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                    intermediate_size=3072, max_position_embeddings=512,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, cfg.vocab_size, S).astype(np.int32)
+    tail = S - ((S - 1) // PS) * PS              # 16 tokens at PS=16
+
+    def engine(**tiers):
+        eng = DecodeEngine(model, EngineConfig(
+            page_size=PS, max_slots=2, max_seq_len=S + N, **tiers))
+        # warm the miss bucket and the hit path's tail-chunk program: a
+        # compile inside a timed admission would dominate every TTFT
+        eng.warmup(prompt_lens=[S], tail_lens=[tail])
+        r = eng.submit(prompt, max_new_tokens=2, cache=False)  # primer
+        eng.run_until_idle(max_steps=100)
+        r.result(timeout=300)
+        return eng
+
+    def ttft(eng, expect_prefill=None):
+        tok0 = metrics.counter("engine.prefill_tokens").value
+        r = eng.submit(prompt, max_new_tokens=N)
+        eng.run_until_idle(max_steps=200)
+        out = r.result(timeout=300)
+        if expect_prefill is not None:
+            got = metrics.counter("engine.prefill_tokens").value - tok0
+            assert got == expect_prefill, (
+                f"tier hit ran {got} prefill tokens, want {expect_prefill}")
+        return r.trace.t_first_token - r.trace.t_submit, out
+
+    def p50(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    disk_dir = tempfile.mkdtemp(prefix="bench_kvtier_")
+    eng_host = engine(kv_host_tier_bytes=1 << 30)
+    # host bound below one blob: every spill lands straight on disk
+    eng_disk = engine(kv_host_tier_bytes=64, kv_disk_tier_bytes=1 << 30,
+                      kv_disk_tier_dir=disk_dir)
+    try:
+        cold_ts, hbm_ts, host_ts, disk_ts, ref = [], [], [], [], None
+        for _ in range(REPS):
+            eng_host._flush_prefix()             # true cold: no HBM, no tier
+            t, out = ttft(eng_host, expect_prefill=S)
+            cold_ts.append(t)
+            ref = out if ref is None else ref
+            assert np.array_equal(out, ref)
+            t, out = ttft(eng_host, expect_prefill=tail)   # HBM hit
+            hbm_ts.append(t)
+            assert np.array_equal(out, ref)
+            eng_host._shrink_prefix()            # evict -> host tier
+            t, out = ttft(eng_host, expect_prefill=tail)   # host-tier hit
+            host_ts.append(t)
+            assert np.array_equal(out, ref), "host-tier hit changed tokens"
+            eng_disk._flush_prefix()
+            r = eng_disk.submit(prompt, max_new_tokens=N)  # register pages
+            eng_disk.run_until_idle(max_steps=200)
+            assert np.array_equal(r.result(timeout=300), ref)
+            eng_disk._shrink_prefix()            # evict -> disk tier
+            t, out = ttft(eng_disk, expect_prefill=tail)   # disk-tier hit
+            disk_ts.append(t)
+            assert np.array_equal(out, ref), "disk-tier hit changed tokens"
+        res = dict(ttft_hbm_p50=p50(hbm_ts), ttft_host_p50=p50(host_ts),
+                   ttft_disk_p50=p50(disk_ts), ttft_cold_p50=p50(cold_ts),
+                   prefill_tokens_hit=tail, prefill_tokens_cold=S)
+        # the economy's reason to exist: recovering spilled warmth beats
+        # re-running the prefill
+        assert res["ttft_host_p50"] < res["ttft_cold_p50"], res
+        snap = metrics.snapshot()
+        stats = {k.split("engine.kvtier.")[1]: v
+                 for k, v in snap["counters"].items()
+                 if k.startswith("engine.kvtier.")}
+        stats["demoted"] = snap["counters"].get(
+            "engine.prefix_evictions_demoted", 0)
+        hists = snap["histograms"]
+        for h in ("engine.kvtier.spill_ms", "engine.kvtier.reupload_ms"):
+            if hists.get(h, {}).get("count"):
+                stats[h.split("engine.kvtier.")[1] + "_p50"] = round(
+                    hists[h]["p50"], 3)
+        return res, stats
+    finally:
+        shutil.rmtree(disk_dir, ignore_errors=True)
+
+
 def bench_spec_decode():
     """Speculative-decoding rung: repetitive-text prompt (the n-gram
     drafter's home turf) decoded with k-token verify steps vs the plain
@@ -1905,6 +2008,31 @@ def bench_smoke():
     prefix_hits = metrics.snapshot()["counters"].get("engine.prefix_hit", 0)
     assert prefix_hits >= 1, "smoke run produced no prefix-cache hit"
 
+    # one KV-TIER spill -> re-upload cycle (docs/SERVING.md "KV tiering"):
+    # evict a cached prefix into the host-RAM tier, resubmit, and the
+    # re-uploaded pages must answer token-identically with tail-only
+    # prefill work and zero typed refusals — emitted as `kvtier_ok`
+    # (asserted in tests/test_observability.py)
+    kt_eng = DecodeEngine(model, EngineConfig(page_size=2, max_slots=2,
+                                              min_bucket=4,
+                                              kv_host_tier_bytes=1 << 20))
+    kt_prompt = ids[0, :5].astype(np.int32)
+    kt_cold = kt_eng.submit(kt_prompt, max_new_tokens=2)
+    kt_eng.run_until_idle(max_steps=32)
+    kt_cold_out = kt_cold.result(timeout=30)
+    kt_eng._shrink_prefix()                    # evict -> spill to host tier
+    kt_tok0 = metrics.snapshot()["counters"].get("engine.prefill_tokens", 0)
+    kt_hit = kt_eng.submit(kt_prompt, max_new_tokens=2)
+    kt_eng.run_until_idle(max_steps=32)
+    kt_hit_out = kt_hit.result(timeout=30)
+    snapk = metrics.snapshot()["counters"]
+    kvtier_ok = bool(np.array_equal(kt_hit_out, kt_cold_out)) \
+        and snapk.get("engine.prefill_tokens", 0) - kt_tok0 == 1 \
+        and snapk.get("engine.kvtier.spills_host", 0) >= 2 \
+        and snapk.get("engine.kvtier.reuploads_host", 0) >= 2 \
+        and snapk.get("engine.kvtier.refusals", 0) == 0
+    assert kvtier_ok, (kt_hit_out, kt_cold_out, dict(snapk))
+
     # one SPECULATIVE step: a repetitive prompt through a k=2 verify-step
     # engine — the n-gram self-drafter proposes, the fixed-shape verify
     # program accepts/rejects, output stays bit-identical to plain decode
@@ -2153,7 +2281,7 @@ def bench_smoke():
             prefix_hits, spec_accepted, shed_count, cancelled_count,
             resume_ok, kv_quant_ok, migrate_ok, soak_ok, dedup_replays,
             disagg_ok, peer_lost_typed_ok, fused_sampler_ok,
-            fleet_trace_ok, fleet_metrics_ok)
+            fleet_trace_ok, fleet_metrics_ok, kvtier_ok)
 
 
 def _retry(fn, attempts=3):
@@ -2214,7 +2342,7 @@ def main(argv=None):
              resume_ok, kv_quant_ok, migrate_ok, soak_ok,
              dedup_replays, disagg_ok, peer_lost_typed_ok,
              fused_sampler_ok, fleet_trace_ok,
-             fleet_metrics_ok) = bench_smoke()
+             fleet_metrics_ok, kvtier_ok) = bench_smoke()
             impls = {k.rsplit(".", 1)[-1]: v
                      for k, v in snap["counters"].items()
                      if k.startswith("paged_attention.impl.") and v}
@@ -2236,6 +2364,7 @@ def main(argv=None):
                    "fused_sampler_ok": fused_sampler_ok,
                    "fleet_trace_ok": fleet_trace_ok,
                    "fleet_metrics_ok": fleet_metrics_ok,
+                   "kvtier_ok": kvtier_ok,
                    "logits_readback": snap["counters"].get(
                        "engine.logits_readback", 0),
                    "dedup_replays": dedup_replays,
@@ -2586,6 +2715,31 @@ def main(argv=None):
     except Exception as e:
         _emit({"metric": "router_ha_goodput_tokens_per_sec", "value": 0.0,
                "unit": "tokens/s", "ok": False, "platform": platform,
+               "backend_error": f"{type(e).__name__}: {e}"})
+    try:
+        kt, kstats = _retry(bench_kv_tiers)
+        _emit({"metric": "kv_tier_host_hit_ttft_p50_seconds",
+               "value": round(kt["ttft_host_p50"], 6), "unit": "s",
+               "ok": True, "platform": platform,
+               "ttft_p50": {k.split("ttft_")[1].rsplit("_", 1)[0]:
+                            round(v, 6) for k, v in kt.items()
+                            if k.startswith("ttft_")},
+               "cold_over_host": round(
+                   kt["ttft_cold_p50"] / kt["ttft_host_p50"], 3),
+               "prefill_tokens_hit": kt["prefill_tokens_hit"],
+               "prefill_tokens_cold": kt["prefill_tokens_cold"],
+               "kvtier": kstats,
+               "mix": "256-token prompt, 4 new tokens, 5 reps per tier"})
+        print(f"# kv_tiers 256-tok prefix: ttft_p50 hbm="
+              f"{kt['ttft_hbm_p50']*1e3:.1f}ms host="
+              f"{kt['ttft_host_p50']*1e3:.1f}ms disk="
+              f"{kt['ttft_disk_p50']*1e3:.1f}ms cold="
+              f"{kt['ttft_cold_p50']*1e3:.1f}ms, tier-hit prefill "
+              f"{kt['prefill_tokens_hit']} vs cold "
+              f"{kt['prefill_tokens_cold']} tok", file=sys.stderr)
+    except Exception as e:
+        _emit({"metric": "kv_tier_host_hit_ttft_p50_seconds", "value": 0.0,
+               "unit": "s", "ok": False, "platform": platform,
                "backend_error": f"{type(e).__name__}: {e}"})
     try:
         # second-to-last: like bench_router below it resets the metrics

@@ -50,6 +50,18 @@ Python owns admission/retirement, the device runs fixed-shape steps:
   uncached tail through the chunk program. Refcount-0 cached pages stay
   resident and are LRU-evicted under pool pressure; eviction can never
   touch a live slot's pages (docs/SERVING.md "Prefix caching").
+- **KV tiering** (`EngineConfig.kv_host_tier_bytes` /
+  ``kv_disk_tier_bytes``): a capacity hierarchy under the prefix store —
+  eviction DEMOTES a page's contents (values + int8 scales) into a
+  bounded host-RAM tier and from there to a bounded disk tier, framed
+  ``PTKT1`` blobs keyed by the same page-chain hashes (`kv_tiers.py`);
+  a submit that misses HBM but hits a tier RE-UPLOADS the pages with one
+  batched `import_pages` scatter and prefills only the remaining tail —
+  token-identical to a cold prefill, zero new programs. Corrupt or stale
+  tier entries refuse typed and read as misses; the serve STATS export
+  (`tier_hashes`) advertises spilled chains so the router's fleet
+  directory routes them to the replica that can re-upload
+  (docs/SERVING.md "KV tiering").
 - **Speculative decoding** (`EngineConfig.speculate_k`): a self-drafting
   n-gram proposer (suffix lookup over each slot's own tokens, zero extra
   model) drafts up to k tokens per slot per step; ONE fixed-shape verify
@@ -154,6 +166,24 @@ class EngineConfig:
                    the uncached tail. Refcount-0 cached pages stay resident
                    and are LRU-evicted under pool pressure. Per-request
                    opt-out via ``submit(..., cache=False)``
+    kv_host_tier_bytes : KV tiering (docs/SERVING.md "KV tiering"): bound
+                   on a host-RAM spill tier under the HBM prefix store.
+                   When set, a prefix page evicted under pool pressure
+                   DEMOTES — its contents (values + int8 scales) spill as
+                   a checksummed ``PTKT1`` blob keyed by the same rolling
+                   page-chain hash — instead of discarding; a later submit
+                   that misses HBM but hits the tier RE-UPLOADS the pages
+                   (one batched device transfer) and prefills only the
+                   remaining tail, token-identical to a cold prefill.
+                   None/0 (default) disables tiering entirely
+    kv_disk_tier_bytes : bound on the disk tier below the host tier (host
+                   LRU overflow demotes here; disk overflow discards).
+                   Works alone too — spills go straight to disk. None/0
+                   (default) disables the disk tier
+    kv_disk_tier_dir : directory for disk-tier blobs (OWNED by the
+                   engine's tier store — stale ``.ptkt`` files are purged
+                   at construction). None with a disk bound set uses a
+                   fresh temp directory
     speculate_k  : when set (>= 1), every decode step drafts up to k tokens
                    per slot from a self-drafting n-gram proposer and
                    verifies all k+1 positions in ONE fixed-shape program
@@ -226,6 +256,9 @@ class EngineConfig:
     inflight: int = 2
     prefill_chunk_tokens: int | None = None
     prefix_cache: bool = True
+    kv_host_tier_bytes: int | None = None
+    kv_disk_tier_bytes: int | None = None
+    kv_disk_tier_dir: str | None = None
     speculate_k: int | None = None
     max_queue_depth: int | None = None
     max_queue_tokens: int | None = None
@@ -921,6 +954,21 @@ class DecodeEngine:
         self._prefix_idle: OrderedDict[int, None] = OrderedDict()
         self.allocator.retain_hook = self._retain_page
         self.allocator.evict_hook = self._evict_prefix_pages
+        # KV tiering (docs/SERVING.md "KV tiering"): bounded host-RAM /
+        # disk spill tiers under the HBM store — eviction demotes page
+        # contents instead of discarding them, and a tier hit re-uploads
+        # via one batched import_pages scatter (kv_tiers.py)
+        self._tiers = None
+        if self._prefix_enabled and (ecfg.kv_host_tier_bytes
+                                     or ecfg.kv_disk_tier_bytes):
+            from paddle_tpu.inference.kv_tiers import KVTierStore
+            self._tiers = KVTierStore(
+                host_bytes=ecfg.kv_host_tier_bytes,
+                disk_bytes=ecfg.kv_disk_tier_bytes,
+                disk_dir=ecfg.kv_disk_tier_dir,
+                page_shape=(self._nl, ps, nh, self._dh),
+                dtype=np.dtype(self._cdtype).name,
+                scales=self._quant_kv)
         self.step_seq = 0             # advances once per step(); the
         #                               watchdog's progress reading
 
@@ -942,6 +990,20 @@ class DecodeEngine:
         self._m_prefix_miss = metrics.counter("engine.prefix_miss")
         self._m_prefix_reused = metrics.counter("engine.prefix_pages_reused")
         self._m_prefix_evict = metrics.counter("engine.prefix_evictions")
+        # the eviction split (docs/OBSERVABILITY.md): demoted pages moved
+        # to a spill tier (recoverable), discarded ones are lost; the
+        # legacy total above stays their sum for existing dashboards
+        self._m_prefix_demote = metrics.counter(
+            "engine.prefix_evictions_demoted")
+        self._m_prefix_discard = metrics.counter(
+            "engine.prefix_evictions_discarded")
+        self._m_spill_fail = metrics.counter("engine.kvtier.spill_fail")
+        self._m_reupload_fail = metrics.counter(
+            "engine.kvtier.reupload_fail")
+        self._m_reup_host = metrics.counter("engine.kvtier.reuploads_host")
+        self._m_reup_disk = metrics.counter("engine.kvtier.reuploads_disk")
+        self._h_spill = metrics.histogram("engine.kvtier.spill_ms")
+        self._h_reupload = metrics.histogram("engine.kvtier.reupload_ms")
         self._g_prefix_pages = metrics.gauge("engine.prefix_pages")
         self._g_prefix_bytes = metrics.gauge("engine.prefix_store_bytes")
         self._m_spec_steps = metrics.counter("engine.spec_steps")
@@ -1343,9 +1405,10 @@ class DecodeEngine:
 
     def refresh_params(self, model):
         """Swap in current weights; programs take params as inputs, so this
-        never recompiles. The prefix store is FLUSHED: cached pages hold KV
-        computed under the old weights, and a hit after the swap would
-        silently condition new-weights decode on stale KV."""
+        never recompiles. The prefix store is FLUSHED — host and disk
+        spill tiers included: cached OR spilled pages hold KV computed
+        under the old weights, and a hit (or tier re-upload) after the
+        swap would silently condition new-weights decode on stale KV."""
         self._params = {k: t._data for k, t in model.state_dict().items()}
         if self.ecfg.weight_dtype not in ("native", None):
             # re-quantize: a QuantizedLeaf is part of the traced pytree
@@ -1392,13 +1455,20 @@ class DecodeEngine:
         indexes stays resident (LRU-tracked) instead of rejoining the free
         list — its contents are a future request's prefill. Under
         degradation level >= 2 retention stops: freed pages go straight
-        back to the free list (capacity over cache warmth) and their
-        store index is dropped."""
+        back to the free list (capacity over cache warmth) — but their
+        contents DEMOTE to the host tier first when one is configured
+        (docs/ROBUSTNESS.md "Pressure ladder"), so shedding HBM warmth no
+        longer throws the prefill work away."""
         if self._deg >= 2:
             h = self._page_hash.pop(page, None)
             if h is not None and self._prefix_pages.get(h) == page:
                 del self._prefix_pages[h]
             self._prefix_idle.pop(page, None)
+            if h is not None:
+                demoted = self._spill_pages([page], [h])
+                self._m_prefix_evict.inc()
+                self._m_prefix_demote.inc(demoted)
+                self._m_prefix_discard.inc(1 - demoted)
             self._update_prefix_gauges()
             return False
         if page in self._page_hash:
@@ -1410,30 +1480,145 @@ class DecodeEngine:
         """Allocator evict hook: surrender up to n LRU refcount-0 cached
         pages under pool pressure, dropping their store entries. Live
         (refcount > 0) pages are never offered — eviction cannot touch a
-        running slot."""
-        out = []
+        running slot. With a tier store configured the surrendered pages'
+        CONTENTS spill to host RAM / disk first (`_spill_pages`), so the
+        eviction is a demotion, not a loss."""
+        out, hashes = [], []
         while len(out) < n and self._prefix_idle:
             page, _ = self._prefix_idle.popitem(last=False)
             h = self._page_hash.pop(page)
             if self._prefix_pages.get(h) == page:
                 del self._prefix_pages[h]
             out.append(page)
-            self._m_prefix_evict.inc()
+            hashes.append(h)
+        if out:
+            demoted = self._spill_pages(out, hashes)
+            self._m_prefix_evict.inc(len(out))
+            self._m_prefix_demote.inc(demoted)
+            self._m_prefix_discard.inc(len(out) - demoted)
         self._update_prefix_gauges()
         return out
+
+    def _spill_pages(self, pages: list[int], hashes: list[bytes]) -> int:
+        """Demote evicted refcount-0 prefix pages into the tier store:
+        ONE batched `export_pages` gather pulls their contents (values +
+        int8 scales) off the device, then each page lands as a framed,
+        checksummed blob under its chain hash (kv_tiers.py). Returns the
+        number of pages demoted — 0 when no tiers are configured or the
+        spill failed (``kvtier.spill_fail`` fault / an I/O error): the
+        economy degrades to plain discard, an eviction NEVER fails."""
+        if self._tiers is None or not pages:
+            return 0
+        t0 = time.perf_counter()
+        try:
+            if faults.ENABLED and faults.fire("kvtier.spill_fail"):
+                raise faults.FaultInjected(
+                    "injected spill failure (kvtier.spill_fail)")
+            from paddle_tpu.kernels.paged_attention import export_pages
+            ksb = vsb = None
+            if self._quant_kv:
+                kb, vb, ksb, vsb = export_pages(
+                    self._kc, self._vc, pages,
+                    k_scales=self._ks, v_scales=self._vs)
+                ksb, vsb = np.asarray(ksb), np.asarray(vsb)
+            else:
+                kb, vb = export_pages(self._kc, self._vc, pages)
+            kb, vb = np.asarray(kb), np.asarray(vb)
+            for i, h in enumerate(hashes):
+                self._tiers.put(h, kb[:, i], vb[:, i],
+                                None if ksb is None else ksb[:, i],
+                                None if vsb is None else vsb[:, i])
+        except Exception as e:  # noqa: BLE001 — spill is best-effort
+            self._m_spill_fail.inc()
+            flight.record("engine.kvtier.spill_fail", pages=len(pages),
+                          error=f"{type(e).__name__}: {e}")
+            return 0
+        self._h_spill.observe((time.perf_counter() - t0) * 1e3)
+        flight.record("engine.kvtier.spill", pages=len(pages))
+        return len(pages)
+
+    def _tier_reupload(self, hashes: list[bytes], prompt_len: int,
+                       shared: list[int], pages: list[int]) -> int:
+        """Continue a prefix lookup PAST the HBM store into the host/disk
+        tiers and re-upload the hits into this request's leading fresh
+        ``pages``: one batched `import_pages` scatter per pool (pages and
+        scales are immutable once full, so the re-uploaded KV is
+        bit-identical to what was spilled). Returns how many leading
+        fresh pages now hold valid KV — the caller starts its prefill
+        after them, exactly like an HBM hit. 0 on miss, typed tier
+        refusal, or an armed ``kvtier.reupload_fail``: the request just
+        cold-prefills, tiers never fail a request."""
+        if self._tiers is None or not hashes or not pages:
+            return 0
+        limit = (int(prompt_len) - 1) // self.ecfg.page_size
+        want = hashes[len(shared):limit][:len(pages)]
+        entries = []
+        for h in want:
+            e = self._tiers.get(h)
+            if e is None:
+                break                 # chained hashes: stop at first miss
+            entries.append(e)
+        if not entries:
+            return 0
+        n = len(entries)
+        t0 = time.perf_counter()
+        try:
+            if faults.ENABLED and faults.fire("kvtier.reupload_fail"):
+                raise faults.FaultInjected(
+                    "injected re-upload failure (kvtier.reupload_fail)")
+            from paddle_tpu.kernels.paged_attention import import_pages
+            kb = jnp.asarray(np.stack([e.k for e in entries], axis=1))
+            vb = jnp.asarray(np.stack([e.v for e in entries], axis=1))
+            if self._quant_kv:
+                self._kc, self._vc, self._ks, self._vs = import_pages(
+                    self._kc, self._vc, kb, vb, pages[:n],
+                    k_scales=self._ks, v_scales=self._vs,
+                    k_s_blob=jnp.asarray(
+                        np.stack([e.ks for e in entries], axis=1)),
+                    v_s_blob=jnp.asarray(
+                        np.stack([e.vs for e in entries], axis=1)))
+            else:
+                self._kc, self._vc = import_pages(
+                    self._kc, self._vc, kb, vb, pages[:n])
+        except Exception as e:  # noqa: BLE001 — degrade to cold prefill
+            self._m_reupload_fail.inc()
+            flight.record("engine.kvtier.reupload_fail", pages=n,
+                          error=f"{type(e).__name__}: {e}")
+            return 0
+        for e in entries:
+            (self._m_reup_host if e.tier == "host"
+             else self._m_reup_disk).inc()
+        self._h_reupload.observe((time.perf_counter() - t0) * 1e3)
+        flight.record("engine.kvtier.reupload", pages=n,
+                      from_host=sum(1 for e in entries
+                                    if e.tier == "host"),
+                      from_disk=sum(1 for e in entries
+                                    if e.tier == "disk"))
+        return n
+
+    def tier_hashes(self) -> list[str]:
+        """Hex chain hashes of every SPILLED page (host tier first) — the
+        serve STATS payload advertises these alongside `prefix_hashes`
+        so the router's fleet directory routes a spilled prefix to the
+        replica that can re-upload it instead of re-prefilling anywhere
+        (docs/SERVING.md "KV tiering")."""
+        return [] if self._tiers is None else self._tiers.hashes()
 
     def _flush_prefix(self):
         """Drop EVERY prefix-store entry: idle cached pages return to the
         free list immediately; pages still owned by live slots merely lose
-        their index (the retain hook declines them at retirement). Used by
-        `refresh_params` — KV cached under old weights must never serve a
-        new-weights request."""
+        their index (the retain hook declines them at retirement). The
+        host/disk tiers flush too — spilled KV is the same stale-weights
+        hazard as resident KV. Used by `refresh_params` — KV cached under
+        old weights must never serve a new-weights request."""
         idle = list(self._prefix_idle)
         self._prefix_idle.clear()
         self._prefix_pages.clear()
         self._page_hash.clear()
         if idle:
             self.allocator.reclaim(idle)
+        if self._tiers is not None:
+            self._tiers.flush()
         self._update_prefix_gauges()
 
     def _prefix_lookup(self, hashes: list[bytes]) -> list[int]:
@@ -1811,7 +1996,10 @@ class DecodeEngine:
         verify-step overhead stops competing with the backlog; level 2
         (>= 0.75) additionally stops retaining prefix-cache pages and
         returns the idle ones to the free list — capacity over cache
-        warmth; level 3 (>= 1.0) is the shed threshold `submit` enforces.
+        warmth — DEMOTING their contents to the host tier first when KV
+        tiering is configured, so the warmth is recoverable by re-upload
+        instead of lost; level 3 (>= 1.0) is the shed threshold `submit`
+        enforces.
         Levels drop back automatically as the queue drains. Driver-thread
         only (mutates the prefix store/allocator)."""
         with self._qlock:
@@ -1829,7 +2017,8 @@ class DecodeEngine:
 
     def _shrink_prefix(self):
         """Degradation level >= 2: return every IDLE cached page to the
-        free list (same store bookkeeping as pressure eviction — live
+        free list (same store bookkeeping as pressure eviction, so their
+        contents demote to the spill tiers first when configured — live
         slots' pages only lose their index via the retain hook declining
         them at retirement)."""
         idle = self._evict_prefix_pages(len(self._prefix_idle))
@@ -1916,7 +2105,14 @@ class DecodeEngine:
                 self._queue_tokens -= int(req.prompt.size)
                 self._g_queue.set(len(self._queue))
             self._h_wait.observe(time.perf_counter() - req.submit_t)
-            self._place(req, slots[0], shared + pages, len(shared))
+            # KV tiering: continue the chain past the HBM store — a
+            # host/disk hit re-uploads into the leading fresh pages and
+            # the prefill below covers only what no tier held
+            n_up = 0
+            if self._prefix_enabled and req.cache:
+                n_up = self._tier_reupload(req.page_hashes,
+                                           req.prompt.size, shared, pages)
+            self._place(req, slots[0], shared + pages, len(shared) + n_up)
 
     def _place(self, req: GenerateRequest, slot: int, pages: list[int],
                n_shared: int = 0):
@@ -2461,17 +2657,20 @@ class DecodeEngine:
                 f"prefill_export needs {n_src} pages "
                 f"({len(shared)} cached), "
                 f"{self.allocator.free_pages} free")
+        n_up = 0
         if self._prefix_enabled:
             # counted only once the export can actually proceed (same rule
             # as _admit): a failed alloc must not inflate hit/reuse stats
             (self._m_prefix_hit if shared else self._m_prefix_miss).inc()
             self._m_prefix_reused.inc(len(shared))
+            n_up = self._tier_reupload(hashes, ids.size, shared, pages)
         all_pages = shared + pages
         row = np.full(self.pages_per_slot, TRASH_PAGE, np.int32)
         row[:n_src] = all_pages
         try:
             first = self._run_prefill(
-                ids, row, start=len(shared) * self.ecfg.page_size)
+                ids, row,
+                start=(len(shared) + n_up) * self.ecfg.page_size)
             from paddle_tpu.kernels.paged_attention import export_pages
             ks_np = vs_np = None
             if self._quant_kv:
@@ -2594,26 +2793,33 @@ class DecodeEngine:
                 f"prefill stream needs {n_src} pages "
                 f"({len(shared)} cached), "
                 f"{self.allocator.free_pages} free")
+        n_up = 0
         if self._prefix_enabled and cache:
             (self._m_prefix_hit if shared else self._m_prefix_miss).inc()
             self._m_prefix_reused.inc(len(shared))
+            # KV tiering: a spilled prefix re-uploads into the leading
+            # fresh pages — the router routed this prompt HERE because
+            # this replica advertised the spilled chain (tier_hashes)
+            n_up = self._tier_reupload(hashes, s0, shared, pages)
+        n_res = len(shared) + n_up    # resident pages needing no prefill
         all_pages = shared + pages
         row = np.full(self.pages_per_slot, TRASH_PAGE, np.int32)
         row[:n_src] = all_pages
-        start = len(shared) * ps
+        start = n_res * ps
         c = int(self.ecfg.prefill_chunk_tokens) \
             if self.ecfg.prefill_chunk_tokens is not None \
             else self.bucket_for(s0 - start)
         # the record plan is fixed before any device work: one page batch
-        # for the cached prefix (already resident), one per chunk that
-        # COMPLETES >= 1 page, and the final record carrying the tail
+        # for the cached + re-uploaded prefix (already resident), one per
+        # chunk that COMPLETES >= 1 page, and the final record carrying
+        # the tail
         chunk_starts = list(range(start, s0, c))
-        batches, cursor = [], len(shared)
+        batches, cursor = [], n_res
         for a in chunk_starts:
             done_pages = min(a + c, s0) // ps
             batches.append((cursor, done_pages - cursor))
             cursor = done_pages
-        n_records = 2 + (1 if shared else 0) \
+        n_records = 2 + (1 if n_res else 0) \
             + sum(1 for _, n in batches if n > 0)
         sink.put(("count", n_records))
 
@@ -2635,10 +2841,10 @@ class DecodeEngine:
                 [self._nl, ps, self._nh, self._dh], n_src, n_records,
                 self._quant_kv, trace_ctx=trace_ctx)))
             seq += 1
-            if shared:
+            if n_res:
                 sink.put(("rec",
                           pack_stream_pages(seq, 0,
-                                            *_blobs(0, len(shared)))))
+                                            *_blobs(0, n_res))))
                 seq += 1
             tok = None
             for a, (p0, n) in zip(chunk_starts, batches):
@@ -2659,7 +2865,8 @@ class DecodeEngine:
             self.allocator.free(all_pages)
         metrics.counter("engine.kv_stream_exports").inc()
         flight.record("engine.prefill_stream", prompt_len=s0,
-                      records=n_records, cached_pages=len(shared))
+                      records=n_records, cached_pages=len(shared),
+                      reuploaded_pages=n_up)
         if trace_ctx:
             from paddle_tpu.observability.tracing import new_span_id
             tid, parent = trace_ctx

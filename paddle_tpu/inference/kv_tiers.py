@@ -1,0 +1,334 @@
+"""Tiered prefix-KV capacity hierarchy: host-RAM and disk spill tiers.
+
+HBM pages are the fleet's scarcest resource, and before this module a
+prefix-cache eviction simply DISCARDED the page — the next hit on that
+prefix re-ran its whole prefill, the single most expensive recoverable
+latency in serving. :class:`KVTierStore` turns eviction into demotion
+(docs/SERVING.md "KV tiering"):
+
+- **Host tier** — a bounded LRU of framed page blobs held in host RAM
+  (on a real accelerator these buffers would sit in pinned memory so the
+  re-upload is a straight DMA; on CPU they are plain bytes). When the
+  byte bound overflows, the LRU entry demotes to the disk tier — or is
+  discarded when no disk tier is configured.
+- **Disk tier** — a bounded directory of one file per page blob, keyed
+  by the page-chain hash hex. Overflow discards LRU files.
+
+Entries are keyed by the SAME rolling page-chain hashes the engine's
+HBM prefix store and the router's fleet directory already use
+(`serving/disagg.py::prompt_page_hashes`), so a chain lookup continues
+seamlessly from HBM into the tiers, and the STATS export
+(`DecodeEngine.tier_hashes`) lets the router route a spilled prefix to
+the one replica that can re-upload it.
+
+Wire integrity follows the ``PTKV1`` discipline (docs/ROBUSTNESS.md
+"Wire integrity"): every blob is framed ``PTKT1\\n | u32 header_len |
+JSON header | body`` with a blake2b body checksum verified BEFORE any
+payload byte is interpreted. A corrupt, truncated, or STALE entry — a
+foreign store's leftover file, a pre-flush epoch, a geometry mismatch —
+is a typed :class:`~paddle_tpu.inference.errors.HandoffCorrupt` refusal
+counted in ``engine.kvtier.refusals`` and reported to the caller as a
+plain MISS: the request cold-prefills, the client never sees an error.
+
+KV pages (and their int8 scales) are immutable once full, so a
+re-uploaded page is bit-identical to the page that was spilled — decode
+over re-uploaded KV is token-identical to decode over the original
+pages by construction (tests/test_kv_tiers.py pins this per tier).
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+
+from paddle_tpu.inference.errors import HandoffCorrupt
+from paddle_tpu.observability import metrics
+from paddle_tpu.observability.flight_recorder import flight
+from paddle_tpu.testing import faults
+
+__all__ = ["KVTierStore", "TierEntry", "MAGIC"]
+
+MAGIC = b"PTKT1\n"
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """The pool dtype by name; ``bfloat16`` needs its ml_dtypes scalar
+    (numpy has no native registration for the name)."""
+    if name == "bfloat16":
+        from ml_dtypes import bfloat16
+        return np.dtype(bfloat16)
+    return np.dtype(name)
+
+
+@dataclass
+class TierEntry:
+    """One re-uploadable page: K/V contents (``[nl, ps, nh, dh]``), the
+    int8 scale planes when the pool is quantized (``[nl, ps, nh]``), and
+    the tier that served it (``"host"`` / ``"disk"`` — the counter
+    split)."""
+    k: np.ndarray
+    v: np.ndarray
+    ks: np.ndarray | None
+    vs: np.ndarray | None
+    tier: str
+
+
+class KVTierStore:
+    """Bounded host-RAM + disk spill tiers under one HBM prefix store.
+
+    ``host_bytes`` / ``disk_bytes`` bound each tier (None or 0 disables
+    it); ``disk_dir`` is OWNED by the store — leftover ``.ptkt`` files
+    from a previous incarnation are removed at construction, and every
+    blob is additionally salted per store instance so a file that
+    somehow survives (or is copied in) refuses as stale rather than
+    serving another engine's KV. All methods are thread-safe; device
+    work never happens here — the engine exports/imports pages, the
+    store only moves framed bytes.
+    """
+
+    def __init__(self, host_bytes=None, disk_bytes=None, disk_dir=None, *,
+                 page_shape, dtype: str, scales: bool):
+        self._host_cap = int(host_bytes or 0)
+        self._disk_cap = int(disk_bytes or 0)
+        self._shape = tuple(int(d) for d in page_shape)  # (nl, ps, nh, dh)
+        self._dtype = str(dtype)
+        self._scales = bool(scales)
+        self._lock = threading.RLock()
+        # hash -> framed blob bytes (host) / blob size on disk (disk),
+        # LRU order: least-recently-used first
+        self._host: OrderedDict[bytes, bytes] = OrderedDict()
+        self._disk: OrderedDict[bytes, int] = OrderedDict()
+        self._host_bytes = 0
+        self._disk_bytes = 0
+        # flush() bumps the epoch; a blob stamped under an older epoch is
+        # STALE (it survived a flush that should have destroyed it) and
+        # refuses typed. The salt pins blobs to THIS store instance.
+        self._epoch = 0
+        self._salt = os.urandom(8).hex()
+        self._dir = None
+        if self._disk_cap:
+            if disk_dir is None:
+                import tempfile
+                disk_dir = tempfile.mkdtemp(prefix="ptkv_tier_")
+            self._dir = str(disk_dir)
+            os.makedirs(self._dir, exist_ok=True)
+            for f in os.listdir(self._dir):          # the store owns it
+                if f.endswith(".ptkt"):
+                    self._unlink(os.path.join(self._dir, f))
+        self._m_hit_host = metrics.counter("engine.kvtier.hits_host")
+        self._m_hit_disk = metrics.counter("engine.kvtier.hits_disk")
+        self._m_spill_host = metrics.counter("engine.kvtier.spills_host")
+        self._m_spill_disk = metrics.counter("engine.kvtier.spills_disk")
+        self._m_bytes_host = metrics.counter("engine.kvtier.bytes_host")
+        self._m_bytes_disk = metrics.counter("engine.kvtier.bytes_disk")
+        self._m_refused = metrics.counter("engine.kvtier.refusals")
+        self._g_host_pages = metrics.gauge("engine.kvtier.host_pages")
+        self._g_host_bytes = metrics.gauge("engine.kvtier.host_bytes")
+        self._g_disk_pages = metrics.gauge("engine.kvtier.disk_pages")
+        self._g_disk_bytes = metrics.gauge("engine.kvtier.disk_bytes")
+        self._update_gauges()
+
+    # --------------------------------------------------------------- framing
+
+    def _pack(self, h: bytes, k, v, ks, vs) -> bytes:
+        from paddle_tpu.inference.engine import _blob_digest
+        parts = [np.ascontiguousarray(k).tobytes(),
+                 np.ascontiguousarray(v).tobytes()]
+        if self._scales:
+            parts += [np.ascontiguousarray(ks, np.float32).tobytes(),
+                      np.ascontiguousarray(vs, np.float32).tobytes()]
+        body = b"".join(parts)
+        head = json.dumps({
+            "sum": _blob_digest(body), "hash": h.hex(),
+            "shape": list(self._shape), "dtype": self._dtype,
+            "scales": self._scales, "epoch": self._epoch,
+            "salt": self._salt}).encode()
+        return MAGIC + struct.pack("<I", len(head)) + head + body
+
+    def _unpack(self, h: bytes, blob: bytes) -> tuple:
+        """Verify + decode one framed blob; raises typed HandoffCorrupt
+        on any integrity or staleness violation."""
+        from paddle_tpu.inference.engine import _read_blob_head
+        if blob[:len(MAGIC)] != MAGIC:
+            raise HandoffCorrupt("KV tier blob has a foreign magic — "
+                                 "not a PTKT1 spill entry")
+        head, off = _read_blob_head(blob, len(MAGIC), "KV tier")
+        if head.get("salt") != self._salt or \
+                int(head.get("epoch", -1)) != self._epoch:
+            raise HandoffCorrupt(
+                "KV tier blob is STALE (pre-flush epoch or a foreign "
+                "store's entry) — its KV may predate a weight refresh, "
+                "refusing to re-upload it")
+        if head.get("hash") != h.hex() \
+                or tuple(head.get("shape", ())) != self._shape \
+                or head.get("dtype") != self._dtype \
+                or bool(head.get("scales")) != self._scales:
+            raise HandoffCorrupt(
+                "KV tier blob does not match its key/geometry — refusing "
+                "a mis-keyed or mis-shaped re-upload")
+        nl, ps, nh, dh = self._shape
+        dt = _np_dtype(self._dtype)
+        n = nl * ps * nh * dh * dt.itemsize
+        body = blob[off:]
+        want = 2 * n + (2 * nl * ps * nh * 4 if self._scales else 0)
+        if len(body) != want:
+            raise HandoffCorrupt(
+                f"KV tier blob body is {len(body)} bytes, geometry says "
+                f"{want} — truncated spill entry")
+        k = np.frombuffer(body[:n], dt).reshape(self._shape)
+        v = np.frombuffer(body[n:2 * n], dt).reshape(self._shape)
+        ks = vs = None
+        if self._scales:
+            m = nl * ps * nh * 4
+            ks = np.frombuffer(body[2 * n:2 * n + m],
+                               np.float32).reshape(nl, ps, nh)
+            vs = np.frombuffer(body[2 * n + m:], np.float32)\
+                .reshape(nl, ps, nh)
+        return k, v, ks, vs
+
+    # ------------------------------------------------------------------ tiers
+
+    def _path(self, h: bytes) -> str:
+        return os.path.join(self._dir, h.hex() + ".ptkt")
+
+    @staticmethod
+    def _unlink(path: str):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def _refuse(self, h: bytes, why: str):
+        self._m_refused.inc()
+        flight.record("engine.kvtier.refused", hash=h.hex(), error=why)
+
+    def _put_disk(self, h: bytes, blob: bytes):
+        if not self._disk_cap or len(blob) > self._disk_cap:
+            return
+        if h in self._disk:
+            self._disk_bytes -= self._disk.pop(h)
+            self._unlink(self._path(h))
+        path = self._path(h)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)                 # never a torn final file
+        self._disk[h] = len(blob)
+        self._disk_bytes += len(blob)
+        self._m_spill_disk.inc()
+        self._m_bytes_disk.inc(len(blob))
+        while self._disk_bytes > self._disk_cap and self._disk:
+            old, sz = self._disk.popitem(last=False)
+            self._disk_bytes -= sz
+            self._unlink(self._path(old))     # capacity over history
+
+    def put(self, h: bytes, k, v, ks=None, vs=None):
+        """Spill one evicted page's contents under its chain hash: into
+        the host tier (LRU overflow demotes to disk), or straight to
+        disk when no host tier is configured. Idempotent per hash —
+        page contents are immutable once full, so a re-spill replaces
+        bit-identical bytes."""
+        h = bytes(h)
+        blob = self._pack(h, k, v, ks, vs)
+        with self._lock:
+            if self._host_cap and len(blob) <= self._host_cap:
+                if h in self._host:
+                    self._host_bytes -= len(self._host.pop(h))
+                self._host[h] = blob
+                self._host_bytes += len(blob)
+                self._m_spill_host.inc()
+                self._m_bytes_host.inc(len(blob))
+                while self._host_bytes > self._host_cap and self._host:
+                    old, old_blob = self._host.popitem(last=False)
+                    self._host_bytes -= len(old_blob)
+                    self._put_disk(old, old_blob)   # demote, else discard
+            else:
+                self._put_disk(h, blob)
+            self._update_gauges()
+
+    def get(self, h: bytes) -> TierEntry | None:
+        """Look one chain hash up, host tier first. Any integrity or
+        staleness violation — bit rot on disk, a foreign or pre-flush
+        blob, the armed ``kvtier.disk_corrupt`` fault — is COUNTED as a
+        typed refusal and returned as a miss: tier trouble degrades to a
+        cold prefill, it never fails a request."""
+        h = bytes(h)
+        with self._lock:
+            blob = self._host.get(h)
+            if blob is not None:
+                self._host.move_to_end(h)
+                try:
+                    k, v, ks, vs = self._unpack(h, blob)
+                except HandoffCorrupt as e:
+                    self._host_bytes -= len(self._host.pop(h))
+                    self._refuse(h, str(e))
+                    self._update_gauges()
+                    return None
+                self._m_hit_host.inc()
+                return TierEntry(k, v, ks, vs, "host")
+            if h in self._disk:
+                self._disk.move_to_end(h)
+                try:
+                    if faults.ENABLED and faults.fire("kvtier.disk_corrupt"):
+                        raise HandoffCorrupt(
+                            "injected disk-tier corruption "
+                            "(kvtier.disk_corrupt)")
+                    with open(self._path(h), "rb") as f:
+                        blob = f.read()
+                    k, v, ks, vs = self._unpack(h, blob)
+                except (HandoffCorrupt, OSError) as e:
+                    self._disk_bytes -= self._disk.pop(h)
+                    self._unlink(self._path(h))
+                    self._refuse(h, f"{type(e).__name__}: {e}")
+                    self._update_gauges()
+                    return None
+                self._m_hit_disk.inc()
+                return TierEntry(k, v, ks, vs, "disk")
+        return None
+
+    # ------------------------------------------------------------- inventory
+
+    def hashes(self) -> list[str]:
+        """Hex chain hashes of every spilled page, host tier first — the
+        STATS advertisement the router's fleet directory ingests so a
+        spilled prefix routes to the replica that can re-upload it."""
+        with self._lock:
+            return [h.hex() for h in self._host] \
+                + [h.hex() for h in self._disk]
+
+    @property
+    def host_pages(self) -> int:
+        with self._lock:
+            return len(self._host)
+
+    @property
+    def disk_pages(self) -> int:
+        with self._lock:
+            return len(self._disk)
+
+    def flush(self):
+        """Drop BOTH tiers and advance the epoch: spilled KV computed
+        under old weights must never re-upload into a new-weights engine
+        (`refresh_params` calls this alongside the HBM-store flush). The
+        epoch bump makes even an undeletable disk file refuse as
+        stale."""
+        with self._lock:
+            self._host.clear()
+            self._host_bytes = 0
+            for h in list(self._disk):
+                self._unlink(self._path(h))
+            self._disk.clear()
+            self._disk_bytes = 0
+            self._epoch += 1
+            self._update_gauges()
+
+    def _update_gauges(self):
+        self._g_host_pages.set(len(self._host))
+        self._g_host_bytes.set(self._host_bytes)
+        self._g_disk_pages.set(len(self._disk))
+        self._g_disk_bytes.set(self._disk_bytes)

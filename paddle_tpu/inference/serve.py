@@ -695,6 +695,15 @@ class InferenceServer:
                 metrics.gauge("engine.prefix_exported_hashes").set(
                     len(hashes))
                 extra["prefix"]["hashes"] = hashes
+                # KV tiering (docs/SERVING.md "KV tiering"): the spilled
+                # chains ride too — a directory hit on a spilled prefix
+                # routes here so THIS replica re-uploads instead of the
+                # fleet re-prefilling
+                spilled = self._engine.tier_hashes()
+                metrics.gauge("engine.kvtier.exported_hashes").set(
+                    len(spilled))
+                if spilled:
+                    extra["prefix"]["spilled"] = spilled
         return extra
 
     def _prefill_stream(self, arrays, conn) -> bool:

@@ -378,7 +378,12 @@ def export_pages(k_pages, v_pages, page_list, k_scales=None, v_scales=None):
     `engine.KVHandoff.pack` stamps a blake2b body checksum the unpack
     side verifies BEFORE any page byte is interpreted — a truncated or
     bit-flipped transfer is a typed ``HandoffCorrupt`` refusal, so the
-    scatter below only ever sees intact pages.
+    scatter below only ever sees intact pages. The KV tier store
+    (`inference/kv_tiers.py`) rides the same pair of primitives: a
+    prefix-page spill is this gather framed as a checksummed ``PTKT1``
+    blob per page, and a tier hit re-uploads through `import_pages` —
+    pages and scales are immutable once full, so the round trip is
+    bit-identical.
 
     k_pages/v_pages : [num_layers, num_pages, page_size, nh, dh]
     page_list       : [n] int page indices (a sequence's allocation,

@@ -365,13 +365,22 @@ class PrefixDirectory:
     Lookups walk the prompt's hashes LONGEST-first: the hashes are
     chained (`prompt_page_hashes`), so a replica holding page i holds
     every page before it — the first hit names both the replica and the
-    cached depth."""
+    cached depth.
+
+    KV tiering (docs/SERVING.md "KV tiering"): replicas advertise their
+    SPILLED pages (host/disk tiers) alongside the resident ones, so a
+    directory hit on a spilled prefix still routes to the one replica
+    that can re-upload it instead of re-prefilling anywhere. The
+    directory tracks which hashes are spilled per replica —
+    `is_spilled` / `spilled_depth` let the router meter how much of its
+    affinity traffic rides the spill tiers."""
 
     def __init__(self, capacity: int = 4096):
         self._cap = max(1, int(capacity))
         self._lock = threading.Lock()
         self._map: OrderedDict[bytes, str] = OrderedDict()
         self._by_replica: dict[str, set[bytes]] = {}
+        self._spilled: dict[str, set[bytes]] = {}
 
     def __len__(self) -> int:
         with self._lock:
@@ -385,6 +394,11 @@ class PrefixDirectory:
                 s.discard(h)
                 if not s:
                     del self._by_replica[rid]
+            sp = self._spilled.get(rid)
+            if sp is not None:
+                sp.discard(h)
+                if not sp:
+                    del self._spilled[rid]
 
     def register(self, hashes, replica_id: str):
         """Record that ``replica_id``'s store holds these pages (the
@@ -401,10 +415,13 @@ class PrefixDirectory:
             while len(self._map) > self._cap:
                 self._drop(next(iter(self._map)))
 
-    def replace(self, replica_id: str, hashes):
+    def replace(self, replica_id: str, hashes, spilled=()):
         """Reconcile with the replica's OWN prefix export (STATS): drop
-        directory entries the replica no longer holds (evicted, flushed
-        on a weight refresh), add the ones it does."""
+        directory entries the replica no longer holds (evicted past its
+        tiers, flushed on a weight refresh), add the ones it does.
+        ``spilled`` marks the subset that lives in the replica's
+        host/disk spill tiers rather than HBM — routable all the same
+        (the replica re-uploads on hit), but metered separately."""
         rid = str(replica_id)
         keep = {bytes(h) for h in hashes}
         with self._lock:
@@ -412,6 +429,11 @@ class PrefixDirectory:
                      keep]
             for h in stale:
                 self._drop(h)
+            sp = {bytes(h) for h in spilled} & keep
+            if sp:
+                self._spilled[rid] = sp
+            else:
+                self._spilled.pop(rid, None)
         self.register(keep, rid)
 
     def invalidate(self, replica_id: str):
@@ -421,6 +443,19 @@ class PrefixDirectory:
         with self._lock:
             for h in list(self._by_replica.get(rid, ())):
                 self._drop(h)
+            self._spilled.pop(rid, None)
+
+    def is_spilled(self, h, replica_id: str) -> bool:
+        """True when the replica advertised this hash from a SPILL tier —
+        an affinity route to it re-uploads instead of reading HBM."""
+        with self._lock:
+            return bytes(h) in self._spilled.get(str(replica_id), ())
+
+    def spilled_depth(self, replica_id: str) -> int:
+        """How many of the replica's advertised pages are spilled — the
+        capacity dashboards' view of each replica's tier economy."""
+        with self._lock:
+            return len(self._spilled.get(str(replica_id), ()))
 
     def lookup(self, hashes) -> tuple[str | None, int]:
         """``(replica_id, cached_pages)`` for the LONGEST prefix any

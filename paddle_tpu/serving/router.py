@@ -633,11 +633,19 @@ class Router:
             pre = r.stats.get("prefix") or {}
             if self._page_size is None and pre.get("page_size"):
                 self._page_size = int(pre["page_size"])
-            if r.role == "prefill" and "hashes" in pre:
+            if r.role == "prefill" and ("hashes" in pre
+                                        or "spilled" in pre):
                 try:
+                    # KV tiering: SPILLED chains route like resident ones
+                    # (the replica re-uploads on hit — docs/SERVING.md
+                    # "KV tiering"); the directory just meters them apart
+                    spilled = [bytes.fromhex(h)
+                               for h in pre.get("spilled", [])]
                     self._directory.replace(
                         r.replica_id,
-                        [bytes.fromhex(h) for h in pre["hashes"]])
+                        [bytes.fromhex(h)
+                         for h in pre.get("hashes", [])] + spilled,
+                        spilled=spilled)
                 except ValueError:
                     pass       # malformed export: keep the old view
 
@@ -1020,8 +1028,17 @@ class Router:
                 if rid is not None:
                     for r in cands:
                         if r.replica_id == rid:
+                            spilled = self._directory.is_spilled(
+                                hashes[depth - 1], rid)
+                            if spilled:
+                                # the hit's deepest page lives in a spill
+                                # tier: this route trades a re-upload for
+                                # a fleet-wide re-prefill
+                                metrics.counter(
+                                    "router.affinity_spilled").inc()
                             flight.record("router.affinity",
-                                          replica=rid, depth=depth)
+                                          replica=rid, depth=depth,
+                                          spilled=spilled)
                             return r, True
             return POLICIES[self._policy](self, cands), False
 

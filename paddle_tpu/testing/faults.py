@@ -57,6 +57,21 @@ Sites currently wired (the catalog lives in docs/ROBUSTNESS.md):
                           directory staleness drill: the worker just
                           prefills the whole prompt — affinity is an
                           optimization, never a correctness dependency)
+``kvtier.spill_fail``     the engine's prefix-page spill to the host/disk
+                          tier fails (`DecodeEngine._spill_pages`): the
+                          eviction degrades to a plain discard —
+                          ``engine.kvtier.spill_fail`` counts it, the
+                          pool reclaim NEVER fails
+``kvtier.disk_corrupt``   the disk-tier read path treats the entry as
+                          rotten (`kv_tiers.KVTierStore.get`): a typed
+                          refusal counted in ``engine.kvtier.refusals``,
+                          reported upward as a plain MISS — the request
+                          cold-prefills, never errors
+``kvtier.reupload_fail``  the batched tier re-upload into fresh pool
+                          pages fails (`DecodeEngine._tier_reupload`):
+                          the request keeps its fresh pages and
+                          cold-prefills the whole prompt
+                          (``engine.kvtier.reupload_fail``)
 ``train.step_nan``        `ScanTrainStep.step` feeds a NaN through the
                           program's finite-reduce INPUT — the bad-step skip
                           path runs in the warm program (no recompile)

@@ -45,6 +45,7 @@ CHAOS_SUITES = (
     "tests/test_control_plane.py",
     "tests/test_disagg.py",
     "tests/test_fleet_observability.py",
+    "tests/test_kv_tiers.py",
 )
 
 

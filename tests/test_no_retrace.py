@@ -186,6 +186,40 @@ def test_prefix_hit_skips_prefill_programs():
         "a warm prefix hit must not compile anything")
 
 
+def test_tier_reupload_zero_recompiles():
+    """The KV-tier round trip — spill to host RAM, re-upload on the next
+    submit — is eager `export_pages`/`import_pages` + framing, no traced
+    program: a tier hit runs the SAME warm tail-chunk program as an HBM
+    prefix hit, with zero new compiles anywhere in the cycle."""
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    m = _tiny_model()
+    eng = DecodeEngine(m, EngineConfig(page_size=4, max_slots=2,
+                                       min_bucket=8,
+                                       kv_host_tier_bytes=1 << 20))
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, 64, 16).astype(np.int32)
+    r = eng.submit(prompt, 3)                    # miss: bucket-16 prefill
+    eng.run_until_idle(max_steps=30)
+    assert r.done
+    r2 = eng.submit(prompt, 3)                   # HBM hit compiles the
+    eng.run_until_idle(max_steps=30)             # tail-chunk program once
+    assert r2.done
+    eng._shrink_prefix()                         # evict -> spill to host
+    base = _compile_counters()
+    tok0 = metrics.snapshot()["counters"].get("engine.prefill_tokens", 0)
+    r3 = eng.submit(prompt, 3)                   # tier hit: re-upload
+    eng.run_until_idle(max_steps=30)
+    assert r3.done
+    assert metrics.snapshot()["counters"]["engine.kvtier.reuploads_host"]
+    toks = metrics.snapshot()["counters"]["engine.prefill_tokens"] - tok0
+    assert toks == 4, (
+        f"tier hit prefilled {toks} tokens — re-uploaded pages must cost "
+        "zero prefill-program work, exactly like an HBM hit")
+    assert _compile_counters() == base, (
+        "the spill/re-upload cycle must not compile anything: export, "
+        "framing, and import are eager ops outside every program cache")
+
+
 def test_int8_engine_zero_recompiles_same_program_count():
     """Quantization keeps the AOT discipline (docs/QUANTIZATION.md): an
     int8-KV + int8-weight engine compiles the SAME number of programs as

@@ -431,6 +431,12 @@ def test_bench_smoke_emits_structured_json():
     # tracing" / "Fleet metrics plane")
     assert d["fleet_trace_ok"] is True
     assert d["fleet_metrics_ok"] is True
+    # round 17: one KV-tier spill -> re-upload cycle answered
+    # token-identically with tail-only prefill work and zero typed
+    # refusals (docs/SERVING.md "KV tiering")
+    assert d["kvtier_ok"] is True
+    assert d["metrics"]["counters"].get("engine.kvtier.reuploads_host",
+                                        0) >= 2
 
 
 def test_bench_preflight_dead_backend_falls_back_to_cpu_rungs():

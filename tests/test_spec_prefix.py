@@ -194,11 +194,19 @@ class TestPrefixCache:
         live = eng.submit(rng.randint(0, 97, 16).astype(np.int32),
                           max_new_tokens=12)             # 7 pages live
         eng.step()
-        ev0 = _counter("engine.prefix_evictions")
+        ev0, disc0, dem0 = _counter("engine.prefix_evictions"), \
+            _counter("engine.prefix_evictions_discarded"), \
+            _counter("engine.prefix_evictions_demoted")
         big = eng.submit(rng.randint(0, 97, 13).astype(np.int32),
                          max_new_tokens=7)               # needs 5 pages
         eng.run_until_idle(max_steps=100)
-        assert _counter("engine.prefix_evictions") > ev0
+        ev = _counter("engine.prefix_evictions") - ev0
+        assert ev > 0
+        # the discarded/demoted split always sums to the total — and with
+        # no spill tiers configured every eviction is a DISCARD
+        # (tests/test_kv_tiers.py pins the demoted arm)
+        assert _counter("engine.prefix_evictions_discarded") - disc0 == ev
+        assert _counter("engine.prefix_evictions_demoted") == dem0
         np.testing.assert_array_equal(live.result(timeout=30),
                                       _fast_ref(m, live.prompt, 12))
         np.testing.assert_array_equal(big.result(timeout=30),

@@ -514,7 +514,18 @@ class _RowsDs:
         return (np.full((3,), i, np.float32), np.array([i], np.int64))
 
 
-def test_loader_stall_retries_once_then_delivers():
+@pytest.fixture
+def _force_workers():
+    """These tests exercise the WORKER-POOL stall ladder; on a
+    single-core host the auto-fallback would silently run in-process and
+    never arm it. Force workers (the flag's documented escape hatch)."""
+    from paddle_tpu.framework.flags import set_flags
+    set_flags({"FLAGS_dataloader_auto_fallback": False})
+    yield
+    set_flags({"FLAGS_dataloader_auto_fallback": True})
+
+
+def test_loader_stall_retries_once_then_delivers(_force_workers):
     """One injected stall (loader.stall): the ladder re-enqueues the
     in-flight batches and the epoch still delivers every sample exactly
     once, counting dataloader.stall_retries."""
@@ -538,7 +549,7 @@ class _WedgedDs(_RowsDs):
         return super().__getitem__(i)
 
 
-def test_loader_stall_twice_in_a_row_is_typed():
+def test_loader_stall_twice_in_a_row_is_typed(_force_workers):
     """A genuinely wedged worker pool: the first silent window spends
     the one bounded retry, the second IN A ROW (no delivery between)
     surfaces as typed DataLoaderStalled instead of hanging fit()

@@ -57,6 +57,12 @@ SLOW_PINNED = {
     "test_fleet_observability.py": [
         "test_stitched_trace_three_processes_with_migration",
         "test_scale_1_3_1_on_shared_fleet_snapshot"],
+    # PR 17 audit: the streaming-prefill tier drill builds TWO engines
+    # and drives the full chunk-record pipeline (~8 s); its invariant
+    # (re-upload is bit-identical, tail-only) keeps the cheap
+    # prefill_export sibling in tier-1 (see the sibling map).
+    "test_kv_tiers.py": [
+        "test_stream_prefill_reuploads_token_identical"],
 }
 
 # file -> pytest.param values that MUST carry marks=pytest.mark.slow
@@ -153,6 +159,13 @@ def test_tier1_keeps_a_cheap_sibling_for_each_audited_item():
             "test_trace_export_via_router_and_stitch",
             "test_warm_migration_peer_carries_original_trace",
             "test_autoscaler_observes_identically_via_fleet_snapshot"],
+        # the streaming-prefill tier drill decomposes into these tier-1
+        # pins: the handoff-export re-upload (same spill -> re-upload ->
+        # bit-identical-pages invariant, one engine, no record stream)
+        # and the submit-path tail-only token-identity headline
+        "test_kv_tiers.py": [
+            "test_prefill_export_reuploads_from_tier",
+            "test_host_tier_hit_token_identical_tail_only"],
     }
     for fname, names in siblings.items():
         tree = _parse(fname)
