@@ -2267,6 +2267,84 @@ def bench_smoke():
     assert dedup_replays >= 1, \
         "soak micro drill exercised no idempotency replay"
 
+    # one SLO ALERT LIFECYCLE (observability/slo.py): a latency objective
+    # evaluated on an INJECTED clock fires while `engine.step_delay` is
+    # armed and resolves once the fault expires — pending -> firing ->
+    # resolved with zero sleeps in the evaluator itself. The threshold
+    # self-calibrates between this host's clean step mean and the armed
+    # delay, so the drill is wall-clock-robust. Emitted as `slo_alert_ok`
+    # (asserted in tests/test_observability.py)
+    from paddle_tpu.observability.slo import SLOEvaluator, SLOSpec
+    from paddle_tpu.testing import faults as _faults
+    sl_eng = DecodeEngine(model, EngineConfig(page_size=2, max_slots=2,
+                                              min_bucket=4))
+    for _ in range(2):
+        # warm BOTH prefill paths (cold + prefix-hit tail) so no compile
+        # wall lands inside a measured window
+        sl_r = sl_eng.submit(ids[0, :3].astype(np.int32), max_new_tokens=3)
+        sl_eng.run_until_idle(max_steps=32)
+        sl_r.result(timeout=30)
+    h_sl0 = metrics.snapshot()["histograms"].get("engine.step_seconds", {})
+    sl_c0 = h_sl0.get("count", 0)
+    sl_t0 = h_sl0.get("total", 0.0)
+    sl_r = sl_eng.submit(ids[0, :3].astype(np.int32), max_new_tokens=3)
+    sl_eng.run_until_idle(max_steps=32)
+    sl_r.result(timeout=30)
+    h_sl1 = metrics.snapshot()["histograms"]["engine.step_seconds"]
+    clean_mean = (h_sl1["total"] - sl_t0) / max(1, h_sl1["count"] - sl_c0)
+    sl_delay = 0.05
+    sl_thr = clean_mean + sl_delay / 2.0
+    sl_ev = SLOEvaluator(
+        [SLOSpec.parse("step_latency",
+                       f"engine.step_seconds mean < {sl_thr:.9f}s",
+                       fast_window_s=5.0, slow_window_s=10.0)],
+        scope="process")
+    sl_ev.evaluate(now=0.0)                       # baseline reference
+    with _faults.scoped("engine.step_delay", times=16, delay_s=sl_delay):
+        sl_r = sl_eng.submit(ids[0, :3].astype(np.int32), max_new_tokens=3)
+        sl_eng.run_until_idle(max_steps=32)
+        sl_r.result(timeout=30)
+    (fire_st,) = sl_ev.evaluate(now=12.0)         # both windows see the burn
+    sl_r = sl_eng.submit(ids[0, 1:4].astype(np.int32), max_new_tokens=3)
+    sl_eng.run_until_idle(max_steps=32)           # clean traffic
+    sl_r.result(timeout=30)
+    (ok_st,) = sl_ev.evaluate(now=24.0)           # windows see only clean
+    sl_states = [e["state"] for e in sl_ev.history()]
+    slo_alert_ok = (fire_st["state"] == "firing"
+                    and ok_st["state"] == "ok"
+                    and sl_states == ["firing", "resolved"]
+                    and sl_ev.active() == [])
+    assert slo_alert_ok, (fire_st, ok_st, sl_states)
+
+    # one USAGE RECORD parity check (observability/usage.py): the record
+    # the terminating request emits must agree with the engine's own
+    # aggregate counters — per-request metering and fleet metering are
+    # the same numbers. Emitted as `usage_ok` (asserted in
+    # tests/test_observability.py)
+    from paddle_tpu.observability.usage import usage_log
+    u_eng = DecodeEngine(model, EngineConfig(page_size=2, max_slots=2,
+                                             min_bucket=4))
+    u_ctr0 = metrics.snapshot()["counters"]
+    u_req = u_eng.submit(ids[0, :4].astype(np.int32), max_new_tokens=3)
+    u_eng.run_until_idle(max_steps=32)
+    u_out = u_req.result(timeout=30)
+    u_ctr1 = metrics.snapshot()["counters"]
+    (u_rec,) = usage_log.last(1)
+    usage_ok = (
+        u_rec["request_id"] == u_req.request_id
+        and u_rec["error"] is None
+        and u_rec["prompt_tokens"] == 4
+        and u_rec["generated"] == int(u_out.size) - 4
+        and u_rec["prefill_computed"]
+        == u_ctr1.get("engine.prefill_tokens", 0)
+        - u_ctr0.get("engine.prefill_tokens", 0)
+        and u_rec["generated"]
+        == u_ctr1.get("usage.generated_tokens", 0)
+        - u_ctr0.get("usage.generated_tokens", 0)
+        and u_rec["kv_page_steps"] > 0
+        and u_rec["e2e_s"] is not None and u_rec["e2e_s"] >= 0.0)
+    assert usage_ok, (u_rec, dict(u_ctr1))
+
     snap = metrics.snapshot()
     hists = snap["histograms"]
     for name in ("serve.ttft_seconds", "serve.tpot_seconds",
@@ -2281,7 +2359,8 @@ def bench_smoke():
             prefix_hits, spec_accepted, shed_count, cancelled_count,
             resume_ok, kv_quant_ok, migrate_ok, soak_ok, dedup_replays,
             disagg_ok, peer_lost_typed_ok, fused_sampler_ok,
-            fleet_trace_ok, fleet_metrics_ok, kvtier_ok)
+            fleet_trace_ok, fleet_metrics_ok, kvtier_ok, slo_alert_ok,
+            usage_ok)
 
 
 def _retry(fn, attempts=3):
@@ -2342,7 +2421,8 @@ def main(argv=None):
              resume_ok, kv_quant_ok, migrate_ok, soak_ok,
              dedup_replays, disagg_ok, peer_lost_typed_ok,
              fused_sampler_ok, fleet_trace_ok,
-             fleet_metrics_ok, kvtier_ok) = bench_smoke()
+             fleet_metrics_ok, kvtier_ok, slo_alert_ok,
+             usage_ok) = bench_smoke()
             impls = {k.rsplit(".", 1)[-1]: v
                      for k, v in snap["counters"].items()
                      if k.startswith("paged_attention.impl.") and v}
@@ -2365,6 +2445,8 @@ def main(argv=None):
                    "fleet_trace_ok": fleet_trace_ok,
                    "fleet_metrics_ok": fleet_metrics_ok,
                    "kvtier_ok": kvtier_ok,
+                   "slo_alert_ok": slo_alert_ok,
+                   "usage_ok": usage_ok,
                    "logits_readback": snap["counters"].get(
                        "engine.logits_readback", 0),
                    "dedup_replays": dedup_replays,

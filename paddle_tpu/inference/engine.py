@@ -114,6 +114,7 @@ from paddle_tpu.observability.flight_recorder import (Watchdog,
                                                       default_deadline,
                                                       flight)
 from paddle_tpu.observability.tracing import RequestTrace
+from paddle_tpu.observability.usage import emit_request as _emit_usage
 from paddle_tpu.testing import faults
 
 __all__ = ["EngineConfig", "PageAllocator", "GenerateRequest", "DecodeEngine",
@@ -422,6 +423,20 @@ class GenerateRequest:
         self.request_key = None if request_key is None \
             else bytes(request_key)
         self.imported = False           # resumed from a KV handoff
+        self.tenant = None              # reserved multi-tenant identity
+        # usage metering (observability/usage.py): per-request mirrors of
+        # the engine's aggregate token counters, folded into ONE
+        # UsageRecord at first _finish. All accounting happens at the
+        # admission/prefill/harvest/detach events that already exist —
+        # never inside the packed step path.
+        self.u_prefill_computed = 0     # prompt tokens a prefill ran over
+        self.u_prefill_saved = 0        # prompt tokens answered from cache
+        self.u_generated = 0            # tokens delivered to the future
+        self.u_spec_accepted = 0        # of those, speculation's surplus
+        self.u_page_steps = 0           # KV pages held x decode steps held
+        self.u_migrations = 0           # times this request moved engines
+        self.u_admit_step = None        # step_seq at slot placement
+        self._usage_emitted = False
         self._waiters = 0               # live result() waiters (serve tier)
         self._wlock = threading.Lock()
         self._done = threading.Event()
@@ -462,6 +477,14 @@ class GenerateRequest:
         self.trace.mark_done(error)
         self._error = error
         self._done.set()
+        # every termination path funnels through here (retire, reap,
+        # abort, deadline, migration splice) — the ONE usage-metering
+        # emission point; the latch keeps a double _finish single-billed
+        with self._wlock:
+            first = not self._usage_emitted
+            self._usage_emitted = True
+        if first:
+            _emit_usage(self, error)
 
     @property
     def done(self) -> bool:
@@ -2130,6 +2153,11 @@ class DecodeEngine:
         self._page_table[slot] = row
         self._slot_req[slot] = req
         self._slot_pages[slot] = pages
+        # usage metering: prompt tokens the caches answered (prefix-store
+        # pages + tier re-uploads) vs the step clock at placement (the
+        # page-step occupancy integral closes at _detach_slot)
+        req.u_prefill_saved = min(cached, int(req.prompt.size))
+        req.u_admit_step = self.step_seq
         if self._sampling:
             self._temps[slot] = req.temperature
             self._topks[slot] = req.top_k
@@ -2211,6 +2239,8 @@ class DecodeEngine:
             exe = self._prefill_exe(bucket)
             self._m_h2d.inc()
             self._m_prefill_tokens.inc(s0)
+            if req is not None:
+                req.u_prefill_computed += int(s0)
             if self._sampling:
                 tok, self._keys_dev = self._adopt_pools(
                     exe(self._params, self._kc, self._vc, self._keys_dev,
@@ -2250,6 +2280,8 @@ class DecodeEngine:
         exe = self._prefill_chunk_exe(c)
         self._m_h2d.inc()
         self._m_prefill_tokens.inc(int(chunk.size))
+        if req is not None:
+            req.u_prefill_computed += int(chunk.size)
         if self._sampling:
             tok, self._keys_dev = self._adopt_pools(
                 exe(self._params, self._kc, self._vc, self._keys_dev,
@@ -2280,6 +2312,7 @@ class DecodeEngine:
             self._slot_draft[slot] = idx
         req.generated.append(first)
         req.trace.mark_first_token()
+        req.u_generated += 1
         self._m_tokens.inc()
         if self._prefix_enabled and req.cache:
             # the prompt's full pages are now resident and correct —
@@ -2326,6 +2359,13 @@ class DecodeEngine:
         by `_retire` (which then finishes the future) and the migration
         export (which hands the future to the serving layer instead)."""
         self._prefilling.pop(slot, None)
+        req = self._slot_req[slot]
+        if req is not None and req.u_admit_step is not None:
+            # close the occupancy integral analytically — pages held x
+            # steps held — so the step loop never does usage work
+            req.u_page_steps += len(self._slot_pages[slot]) * max(
+                0, self.step_seq - req.u_admit_step)
+            req.u_admit_step = None
         self.allocator.free(self._slot_pages[slot])
         self._slot_pages[slot] = []
         self._slot_req[slot] = None
@@ -2487,6 +2527,8 @@ class DecodeEngine:
                 for t in toks:
                     idx.append(t)
             req.trace.mark_tokens(n)
+            req.u_generated += n
+            req.u_spec_accepted += n - 1
             harvested += n
             accepted += n - 1
             self._lengths[slot] += n
@@ -2526,6 +2568,7 @@ class DecodeEngine:
             tok = int(toks_np[slot])
             req.generated.append(tok)
             req.trace.mark_tokens(1)
+            req.u_generated += 1
             n += 1
             if len(req.generated) >= req.max_new_tokens \
                     or tok == self.ecfg.eos_id:
@@ -3024,6 +3067,10 @@ class DecodeEngine:
         self._page_table[slot] = row
         self._slot_req[slot] = req
         self._slot_pages[slot] = pages
+        # usage metering: the whole imported context arrived as resident
+        # KV — all of it is prefill work this engine did NOT run
+        req.u_prefill_saved = int(req.prompt.size)
+        req.u_admit_step = self.step_seq
         if self._sampling:
             self._temps[slot] = req.temperature
             self._topks[slot] = req.top_k
@@ -3261,6 +3308,9 @@ class DecodeEngine:
                 trace_id=req.trace.trace_id,
                 parent_span=req.trace.span_id))
         self._m_mig_out.inc(len(items))
+        for item in items:
+            if item.request is not None:
+                item.request.u_migrations += 1
         self._g_occupancy.set(0)
         with self._qlock:
             self._migrated.extend(items)

@@ -1868,6 +1868,21 @@ def main(argv=None):
                          "them out — the preemptible-VM serving contract "
                          "(docs/SERVING.md \"Live migration\"); needs a "
                          "registry and a fleet-shared --auth-name")
+    ap.add_argument("--slo", action="append", default=[],
+                    metavar="NAME=OBJECTIVE[;OPTS]",
+                    help="declare a process-scope SLO evaluated over this "
+                         "replica's own metrics registry every "
+                         "--slo-interval seconds; e.g. "
+                         "'ttft=serve.ttft_seconds p99 < 2.0s;fast=60;"
+                         "slow=300'. Repeatable. Firing alerts ride "
+                         "/metrics as slo_alert_firing and land in "
+                         "watchdog stall dumps (docs/OBSERVABILITY.md)")
+    ap.add_argument("--slo-interval", type=float, default=5.0,
+                    help="seconds between --slo evaluation passes")
+    ap.add_argument("--usage-log", default=None, metavar="PATH",
+                    help="append one JSON usage record per terminated "
+                         "request to PATH (size-rotated; the in-memory "
+                         "ring and usage.* counters are always on)")
     ap.add_argument("--kv-dtype", default=None,
                     choices=["native", "f32", "bf16", "int8"],
                     help="KV page-pool storage dtype (engine servers; "
@@ -1948,6 +1963,27 @@ def main(argv=None):
         exporter = start_http_exporter(host=args.host,
                                        port=args.metrics_port)
         print(f"METRICS {exporter.server_address[1]}", flush=True)
+    if args.usage_log is not None:
+        from paddle_tpu.observability.usage import usage_log
+        usage_log.configure(args.usage_log)
+    if args.slo:
+        from paddle_tpu.observability.slo import SLOEvaluator, parse_slo
+        slo = SLOEvaluator([parse_slo(s) for s in args.slo],
+                           scope="process")
+
+        def _slo_loop():
+            # daemon evaluation pass: windows this replica's OWN metrics
+            # registry; firing alerts surface via /metrics
+            # (slo_alert_firing) and the watchdog's stall-dump slo section
+            while True:
+                time.sleep(max(0.05, args.slo_interval))
+                try:
+                    slo.evaluate()
+                except Exception:  # noqa: BLE001 — telemetry never
+                    pass           # kills the serving process
+
+        threading.Thread(target=_slo_loop, daemon=True,
+                         name="pt-serve-slo").start()
     srv.serve_forever()
     # serve_forever returns as soon as _stop is set — but a SIGTERM drain
     # (daemon thread) may still be finishing in-flight work, and the

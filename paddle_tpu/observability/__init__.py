@@ -59,6 +59,13 @@ _MAX_TRACE_SPANS = 256  # spans kept per traced request
 _MAX_LABELED_SERIES = 256  # LRU cap on LABELED series (membership churn)
 
 
+def _pct_index(n: int, q: float) -> int:
+    """Clamped nearest-rank reservoir index for the q-th percentile of n
+    sorted values — the ONE place the index math lives, so
+    ``Histogram.percentile`` and ``summary()`` can never drift."""
+    return min(n - 1, max(0, int(round(q / 100.0 * (n - 1)))))
+
+
 def _labelkey(labels: dict) -> tuple:
     return tuple(sorted(labels.items()))
 
@@ -153,8 +160,7 @@ class Histogram:
             vals = sorted(self._recent)
         if not vals:
             return None
-        idx = min(len(vals) - 1, max(0, int(round(q / 100.0 * (len(vals) - 1)))))
-        return vals[idx]
+        return vals[_pct_index(len(vals), q)]
 
     def summary(self):
         with self._lock:
@@ -163,8 +169,8 @@ class Histogram:
         out = {"count": count, "total": total, "min": mn, "max": mx,
                "mean": (total / count) if count else None}
         if vals:
-            out["p50"] = vals[int(round(0.50 * (len(vals) - 1)))]
-            out["p99"] = vals[int(round(0.99 * (len(vals) - 1)))]
+            out["p50"] = vals[_pct_index(len(vals), 50.0)]
+            out["p99"] = vals[_pct_index(len(vals), 99.0)]
         else:
             out["p50"] = out["p99"] = None
         return out

@@ -350,10 +350,14 @@ class FleetMetrics:
 # ------------------------------------------------------------ HTTP + CLI
 
 
-def start_fleet_exporter(fleet: FleetMetrics, host="127.0.0.1", port=0):
+def start_fleet_exporter(fleet: FleetMetrics, host="127.0.0.1", port=0,
+                         slo=None):
     """Serve the fleet plane over stdlib HTTP from a daemon thread:
     ``GET /metrics`` is `FleetMetrics.to_prometheus`, ``GET /fleet`` (and
-    ``/``) the `rollup` JSON. Returns the live ``ThreadingHTTPServer``
+    ``/``) the `rollup` JSON. With an `SLOEvaluator` attached (``slo=``),
+    ``GET /alerts`` answers its ``alerts_payload()`` (specs + live state
+    + the bounded transition ring) and the alert series ride ``/metrics``
+    as ``slo_*`` rows. Returns the live ``ThreadingHTTPServer``
     (``.server_address[1]`` is the bound port, ``.shutdown()`` stops
     it)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -364,8 +368,15 @@ def start_fleet_exporter(fleet: FleetMetrics, host="127.0.0.1", port=0):
         def do_GET(self):  # noqa: N802 — http.server API
             path = self.path.split("?")[0].rstrip("/")
             if path == "/metrics":
-                body = fleet.to_prometheus().encode()
+                body = fleet.to_prometheus()
+                if slo is not None:
+                    body += slo.to_prometheus()
+                body = body.encode()
                 ctype = CONTENT_TYPE
+            elif path == "/alerts" and slo is not None:
+                body = json.dumps(slo.alerts_payload(),
+                                  sort_keys=True).encode()
+                ctype = "application/json"
             elif path in ("", "/fleet"):
                 body = json.dumps(fleet.rollup(), sort_keys=True).encode()
                 ctype = "application/json"
@@ -460,6 +471,12 @@ def main(argv=None):
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0,
                     help="HTTP port for /metrics + /fleet")
+    ap.add_argument("--slo", action="append", default=[],
+                    metavar="NAME=OBJECTIVE",
+                    help="fleet-scope SLO evaluated over the rollup each "
+                         "scrape, e.g. 'ttft=serve.ttft_seconds p99 < "
+                         "2.0s;fast=60;slow=300' (repeatable; alerts on "
+                         "GET /alerts — docs/OBSERVABILITY.md)")
     ap.add_argument("--once", action="store_true",
                     help="one scrape, print the rollup JSON, exit")
     ap.add_argument("--trace", default=None, metavar="TRACE_ID",
@@ -476,11 +493,18 @@ def main(argv=None):
         scrape_once(fleet, members, secret=args.secret)
         print(json.dumps(fleet.rollup(), indent=2, sort_keys=True))
         return
-    srv = start_fleet_exporter(fleet, host=args.host, port=args.port)
+    slo = None
+    if args.slo:
+        from paddle_tpu.observability.slo import SLOEvaluator, parse_slo
+        slo = SLOEvaluator([parse_slo(s) for s in args.slo], scope="fleet")
+    srv = start_fleet_exporter(fleet, host=args.host, port=args.port,
+                               slo=slo)
     print(f"FLEET {srv.server_address[1]}", flush=True)
     try:
         while True:
             scrape_once(fleet, members, secret=args.secret)
+            if slo is not None:
+                slo.evaluate(fleet.rollup())
             time.sleep(args.interval)
     except KeyboardInterrupt:
         srv.shutdown()

@@ -94,6 +94,26 @@ def dump_ring(label, out_dir=None, recorder=None, **extra) -> str:
     return path
 
 
+def _slo_section(n_usage: int = 32) -> dict:
+    """The stall dump's "what was the fleet promising, and to whom" block:
+    currently-firing SLO alerts (every live evaluator), recent alert
+    transitions, and the last N usage records. Lazy imports + a blanket
+    guard: the dump writer must survive anything."""
+    out = {"firing": [], "events": [], "usage": []}
+    try:
+        from paddle_tpu.observability.slo import active_alerts, recent_events
+        out["firing"] = active_alerts()
+        out["events"] = recent_events()
+    except Exception:  # noqa: BLE001 — post-mortems must always land
+        pass
+    try:
+        from paddle_tpu.observability.usage import usage_log
+        out["usage"] = usage_log.last(n_usage)
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
 def default_deadline(fallback: float = 300.0) -> float:
     """Deadline seconds from ``PADDLE_WATCHDOG_S`` (<= 0 disables)."""
     try:
@@ -209,6 +229,7 @@ class Watchdog:
             "events": self._recorder.events(),
             "traces": [t.to_dict() for t in self._traces()],
             "metrics": metrics.snapshot(),
+            "slo": _slo_section(),
         }
         with open(path, "w") as f:
             json.dump(payload, f, indent=1)

@@ -1,0 +1,206 @@
+"""Bench regression ledger: does the newest BENCH run regress the best?
+
+The repo accumulates ``BENCH_rNN.json`` artifacts (one per bench run:
+``{n, cmd, rc, tail, parsed}`` where ``tail`` holds the run's stdout tail
+— including every rung's single-line JSON emission — and ``parsed`` is
+the last such line). The trajectory had no reader; this module is it:
+
+    python -m paddle_tpu.observability.regress [DIR] [--tolerance 0.05]
+
+reads every artifact in DIR, extracts each run's per-rung headline
+metrics (any emitted line with ``metric``/``value``), compares the
+NEWEST run against the BEST prior value per metric, and prints ONE
+single-line JSON verdict::
+
+    {"ok": true|false, "newest": N,
+     "regressions": [{"metric", "value", "best", "best_run", "unit",
+                      "ratio"}],
+     "skipped": [{"note", ...}]}
+
+Direction comes from the metric's ``unit``: rates (``.../s``) regress
+DOWN, times (``s``/``seconds``/``ms``) regress UP; other units are
+skipped with a note. Anything unreadable — a missing directory, corrupt
+JSON, an ``rc != 0`` run, a rung that emitted ``ok: false`` — lands in
+``skipped`` rather than crashing, matching bench.py's crash-proof
+emission discipline. Exit code 1 iff regressions were found.
+
+Stdlib-only, like everything under ``observability/``.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+__all__ = ["load_runs", "extract_metrics", "compare", "main"]
+
+_RUN_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _direction(unit):
+    """+1 = higher is better, -1 = lower is better, None = unknown."""
+    u = str(unit or "").strip().lower()
+    if u.endswith("/s") or u.endswith("/sec"):
+        return 1
+    if u in ("s", "sec", "seconds", "ms", "us"):
+        return -1
+    return None
+
+
+def load_runs(dirpath, pattern="BENCH_r*.json"):
+    """-> (runs, skipped): runs is ``[(run_no, artifact_dict)]`` sorted by
+    run number; unreadable artifacts become skip notes."""
+    runs, skipped = [], []
+    try:
+        paths = sorted(glob.glob(os.path.join(dirpath, pattern)))
+    except Exception as e:  # noqa: BLE001
+        return [], [{"note": f"unreadable dir {dirpath}: "
+                             f"{type(e).__name__}: {e}"}]
+    for path in paths:
+        m = _RUN_RE.search(os.path.basename(path))
+        if not m:
+            skipped.append({"note": f"unrecognized name {path}"})
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except Exception as e:  # noqa: BLE001
+            skipped.append({"note": f"corrupt artifact {path}: "
+                                    f"{type(e).__name__}: {e}"})
+            continue
+        if not isinstance(data, dict):
+            skipped.append({"note": f"not an artifact dict: {path}"})
+            continue
+        runs.append((int(m.group(1)), data))
+    runs.sort(key=lambda r: r[0])
+    return runs, skipped
+
+
+def extract_metrics(run_no, artifact):
+    """-> (metrics, skipped): ``{metric_name: (value, unit)}`` from every
+    rung emission recoverable from the artifact's ``tail`` (fallback: the
+    single ``parsed`` record). Rungs that emitted ``ok: false`` skip with
+    a note — a failed rung's number is noise, not a baseline."""
+    metrics, skipped = {}, []
+    records = []
+    tail = artifact.get("tail") or ""
+    for line in str(tail).splitlines():
+        line = line.strip()
+        if not line.startswith("{") or not line.endswith("}"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            records.append(rec)
+    if not records and isinstance(artifact.get("parsed"), dict) \
+            and "metric" in artifact["parsed"]:
+        records.append(artifact["parsed"])
+    if not records:
+        skipped.append({"note": f"run {run_no}: no rung emissions "
+                                f"(rc={artifact.get('rc')}, parsed="
+                                f"{artifact.get('parsed') is not None})"})
+        return metrics, skipped
+    for rec in records:
+        name = rec.get("metric")
+        value = rec.get("value")
+        if rec.get("ok") is False:
+            skipped.append({"note": f"run {run_no}: rung {name} "
+                                    f"emitted ok:false"})
+            continue
+        if not isinstance(value, (int, float)) or value != value:
+            skipped.append({"note": f"run {run_no}: rung {name} has "
+                                    f"non-numeric value {value!r}"})
+            continue
+        # last emission wins a duplicate name within one run (re-runs)
+        metrics[str(name)] = (float(value), rec.get("unit"))
+    return metrics, skipped
+
+
+def compare(runs, tolerance=0.05):
+    """The verdict dict for a ``[(run_no, {metric: (value, unit)})]``
+    history: newest run vs the best prior value per metric."""
+    verdict = {"ok": True, "newest": None, "regressions": [], "skipped": []}
+    if not runs:
+        verdict["skipped"].append({"note": "no runs found"})
+        return verdict
+    newest_no, newest = runs[-1]
+    verdict["newest"] = newest_no
+    priors = runs[:-1]
+    if not priors:
+        verdict["skipped"].append(
+            {"note": f"run {newest_no}: no prior run to compare against"})
+        return verdict
+    for name, (value, unit) in sorted(newest.items()):
+        sign = _direction(unit)
+        if sign is None:
+            verdict["skipped"].append(
+                {"note": f"{name}: unknown unit {unit!r} — no direction"})
+            continue
+        best = best_run = None
+        for no, m in priors:
+            if name not in m:
+                continue
+            v = m[name][0]
+            if best is None or (v - best) * sign > 0:
+                best, best_run = v, no
+        if best is None:
+            verdict["skipped"].append(
+                {"note": f"{name}: no prior run carries it"})
+            continue
+        if best == 0:
+            verdict["skipped"].append(
+                {"note": f"{name}: best prior is 0 — ratio undefined"})
+            continue
+        ratio = value / best
+        regressed = ratio < (1.0 - tolerance) if sign > 0 \
+            else ratio > (1.0 + tolerance)
+        if regressed:
+            verdict["regressions"].append(
+                {"metric": name, "value": value, "best": best,
+                 "best_run": best_run, "unit": unit,
+                 "ratio": round(ratio, 4)})
+    verdict["ok"] = not verdict["regressions"]
+    return verdict
+
+
+def run_ledger(dirpath, tolerance=0.05, pattern="BENCH_r*.json"):
+    """Load + extract + compare; never raises."""
+    try:
+        raw_runs, skipped = load_runs(dirpath, pattern)
+        runs = []
+        for no, artifact in raw_runs:
+            m, sk = extract_metrics(no, artifact)
+            skipped.extend(sk)
+            if m:
+                runs.append((no, m))
+        verdict = compare(runs, tolerance=tolerance)
+        verdict["skipped"] = skipped + verdict["skipped"]
+        return verdict
+    except Exception as e:  # noqa: BLE001 — the ledger never crashes
+        return {"ok": True, "newest": None, "regressions": [],
+                "skipped": [{"note": f"ledger failed: "
+                                     f"{type(e).__name__}: {e}"}]}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("paddle_tpu.observability.regress")
+    ap.add_argument("dir", nargs="?", default=".",
+                    help="directory holding BENCH_r*.json artifacts")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="allowed fractional slack vs the best prior "
+                         "run before a metric counts as regressed")
+    ap.add_argument("--pattern", default="BENCH_r*.json")
+    args = ap.parse_args(argv)
+    verdict = run_ledger(args.dir, tolerance=args.tolerance,
+                         pattern=args.pattern)
+    print(json.dumps(verdict), flush=True)
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,11 +93,26 @@ _NS_PREFIX = {
 }
 
 
+# everything from this marker to EOF in docs/OPS.md is hand-maintained
+# (runbooks, drills) and survives regeneration — write_docs carries it
+# across instead of clobbering it
+HAND_MARKER = "<!-- hand-maintained below: kept across gen_inventory -->"
+
+
 def write_docs(entries, repo_root):
     import os
 
     os.makedirs(os.path.join(repo_root, "docs"), exist_ok=True)
     path = os.path.join(repo_root, "docs", "OPS.md")
+    hand = ""
+    try:
+        with open(path) as f:
+            old = f.read()
+        idx = old.find(HAND_MARKER)
+        if idx >= 0:
+            hand = old[idx:]
+    except OSError:
+        pass
     by_ns = {}
     for e in entries:
         by_ns.setdefault(e["namespace"], []).append(e)
@@ -112,6 +127,8 @@ def write_docs(entries, repo_root):
                      for e in by_ns[ns]]
             f.write(", ".join(f"`{n}`" for n in names) + "\n")
         f.write("\n`*` = also bound as a Tensor method.\n")
+        if hand:
+            f.write("\n" + hand)
     return path
 
 

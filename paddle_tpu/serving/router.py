@@ -336,6 +336,7 @@ class Router:
         self._stop = threading.Event()
         self._lease = None            # router-role registry lease
         self._fleet = None            # FleetMetrics fed by _refresh_stats
+        self._slo = None              # fleet-scope SLOEvaluator (attach_slo)
         self._conns: set[socket.socket] = set()   # live client conns
         self._conn_lock = threading.Lock()
         # the membership poll thread ALWAYS runs: beyond registry
@@ -583,6 +584,14 @@ class Router:
     def _stats_loop(self):
         while not self._stop.wait(self._poll_interval):
             self._refresh_stats()
+            if self._slo is not None and self._fleet is not None:
+                # fleet-scope burn-rate pass over the rollup the SAME
+                # pull just refreshed — alert evaluation rides the
+                # existing cadence, no second clock
+                try:
+                    self._slo.evaluate(self._fleet.rollup())
+                except Exception:  # noqa: BLE001 — telemetry never
+                    pass           # stalls the stats loop
 
     def _refresh_stats(self):
         """Pull each healthy replica's STATS snapshot (rate-limited per
@@ -1343,6 +1352,14 @@ class Router:
         self._fleet = fleet
         return self
 
+    def attach_slo(self, evaluator):
+        """Evaluate ``evaluator`` (an `observability.slo.SLOEvaluator`,
+        scope ``"fleet"``) against the fleet rollup after every stats
+        poll. Needs `attach_fleet` — the rollup is the snapshot the
+        evaluator windows over. Returns ``self`` for chaining."""
+        self._slo = evaluator
+        return self
+
     def attach_registry(self, lease):
         """Hold the ROUTER-ROLE registry lease this router registered
         under (node id ``router:<id>``, `elastic.router_node_id`):
@@ -1559,6 +1576,14 @@ def main(argv=None):
                          "re-labeled {role,replica} plus fleet rollups, "
                          "GET /fleet is the JSON snapshot the autoscaler "
                          "shares (docs/OBSERVABILITY.md)")
+    ap.add_argument("--slo", action="append", default=[],
+                    metavar="NAME=OBJECTIVE[;OPTS]",
+                    help="declare a fleet-scope SLO evaluated over the "
+                         "fleet rollup after every stats poll (needs "
+                         "--fleet-port); e.g. "
+                         "'ttft=serve.ttft_seconds p99 < 2.0s;fast=60;"
+                         "slow=300'. Repeatable. Alerts ride GET /alerts "
+                         "on the fleet port (docs/OBSERVABILITY.md)")
     ap.add_argument("--dump", default=None, metavar="REPLICA_ID",
                     help="one-shot: pull REPLICA_ID's DEBUG_DUMP (flight "
                          "ring + metrics snapshot) through the replica "
@@ -1641,13 +1666,23 @@ def main(argv=None):
         exporter = start_http_exporter(host=args.host,
                                        port=args.metrics_port)
         print(f"METRICS {exporter.server_address[1]}", flush=True)
+    if args.slo and args.fleet_port is None:
+        ap.error("--slo needs --fleet-port (fleet-scope SLOs window the "
+                 "fleet rollup and serve alerts from the fleet port)")
     if args.fleet_port is not None:
         from paddle_tpu.observability.fleet import (FleetMetrics,
                                                     start_fleet_exporter)
         fm = FleetMetrics()
         router.attach_fleet(fm)
+        slo = None
+        if args.slo:
+            from paddle_tpu.observability.slo import (SLOEvaluator,
+                                                      parse_slo)
+            slo = SLOEvaluator([parse_slo(s) for s in args.slo],
+                               scope="fleet")
+            router.attach_slo(slo)
         fexp = start_fleet_exporter(fm, host=args.host,
-                                    port=args.fleet_port)
+                                    port=args.fleet_port, slo=slo)
         print(f"FLEET {fexp.server_address[1]}", flush=True)
     router.serve_forever()
 

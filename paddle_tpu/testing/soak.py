@@ -46,6 +46,7 @@ CHAOS_SUITES = (
     "tests/test_disagg.py",
     "tests/test_fleet_observability.py",
     "tests/test_kv_tiers.py",
+    "tests/test_slo_usage.py",
 )
 
 

@@ -437,6 +437,17 @@ def test_bench_smoke_emits_structured_json():
     assert d["kvtier_ok"] is True
     assert d["metrics"]["counters"].get("engine.kvtier.reuploads_host",
                                         0) >= 2
+    # round 18: one SLO alert lifecycle on an injected clock — a latency
+    # objective fires under the armed engine.step_delay fault and
+    # resolves on clean traffic (observability/slo.py) — and every
+    # terminated request emitted a usage record whose token fields agree
+    # with the engine's aggregate counters (observability/usage.py)
+    assert d["slo_alert_ok"] is True
+    assert d["usage_ok"] is True
+    assert d["metrics"]["counters"].get("slo.alerts_fired", 0) >= 1
+    assert d["metrics"]["counters"].get("slo.alerts_resolved", 0) >= 1
+    assert d["metrics"]["counters"].get("usage.requests", 0) >= 1
+    assert d["metrics"]["counters"].get("usage.generated_tokens", 0) >= 1
 
 
 def test_bench_preflight_dead_backend_falls_back_to_cpu_rungs():

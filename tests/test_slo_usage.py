@@ -1,0 +1,614 @@
+"""SLO engine + usage metering (observability/slo.py, usage.py, regress.py).
+
+Three contracts pinned here:
+
+- **Deterministic alert lifecycle.** Every burn-rate transition
+  (ok -> pending -> firing -> resolved, dwell hysteresis on both edges)
+  is driven with EXPLICIT ``now`` values and hand-built snapshots — zero
+  sleeps, zero threads, zero wall-clock dependence. The same evaluator
+  runs process snapshots and fleet rollups.
+- **Usage parity.** On a mixed workload (prefix hit + speculative decode
+  + a cancel + a deadline expiry) the per-request UsageRecord token
+  fields sum EXACTLY to the engine's aggregate counters — metering and
+  monitoring are the same numbers, by construction.
+- **Zero cost unconfigured.** Metering adds no compiled programs and no
+  per-step work; the JSONL sink does no file I/O until configured.
+"""
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import MetricsRegistry, metrics
+from paddle_tpu.observability.slo import (SLOEvaluator, SLOSpec,
+                                          active_alerts, parse_slo)
+from paddle_tpu.observability.usage import UsageLog, typed_error, usage_log
+
+
+def _tiny_model(seed=7):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=2, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout=0.0,
+                    attention_dropout=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def _engine(model, **ekw):
+    from paddle_tpu.inference.engine import DecodeEngine, EngineConfig
+    ekw.setdefault("page_size", 4)
+    ekw.setdefault("max_slots", 2)
+    ekw.setdefault("min_bucket", 8)
+    return DecodeEngine(model, EngineConfig(**ekw))
+
+
+def _counters():
+    return dict(metrics.snapshot()["counters"])
+
+
+def _ratio_snap(errors, requests):
+    return {"counters": {"serve.request_errors": errors,
+                         "serve.requests": requests}}
+
+
+RATIO = "serve.request_errors / serve.requests < 10%"
+
+
+# ------------------------------------------------------------------ parsing
+
+
+class TestParsing:
+    def test_ratio_percent(self):
+        s = SLOSpec.parse("err", "serve.request_errors / serve.requests "
+                               "< 0.1%")
+        assert s.kind == "ratio"
+        assert s.num == "serve.request_errors"
+        assert s.den == "serve.requests"
+        assert s.threshold == pytest.approx(0.001)
+
+    def test_percentile_with_unit(self):
+        s = SLOSpec.parse("ttft", "serve.ttft_seconds p99 < 2.0s")
+        assert s.kind == "percentile"
+        assert s.metric == "serve.ttft_seconds"
+        assert s.quantile == "p99"
+        assert s.threshold == 2.0
+
+    def test_mean(self):
+        s = SLOSpec.parse("step", "engine.step_seconds mean < 0.005")
+        assert s.kind == "mean" and s.quantile is None
+        assert s.threshold == 0.005
+
+    def test_parse_slo_options(self):
+        s = parse_slo("ttft=serve.ttft_seconds p99 < 2.0s;fast=30;"
+                      "slow=120;burn=2;pending=15;clear=45")
+        assert s.name == "ttft"
+        assert (s.fast_window_s, s.slow_window_s) == (30.0, 120.0)
+        assert (s.burn, s.pending_for_s, s.clear_for_s) == (2.0, 15.0, 45.0)
+
+    def test_parse_errors(self):
+        with pytest.raises(ValueError, match="unparseable"):
+            SLOSpec.parse("x", "serve.requests > 5")
+        with pytest.raises(ValueError, match="name="):
+            parse_slo("just an objective with no name")
+        with pytest.raises(ValueError, match="unknown SLO option"):
+            parse_slo("a=serve.ttft_seconds p99 < 1s;bogus=3")
+        with pytest.raises(ValueError, match="threshold"):
+            SLOSpec.parse("x", "serve.errors / serve.requests < 0")
+        with pytest.raises(ValueError, match="fast window"):
+            SLOSpec.parse("x", "serve.ttft_seconds p99 < 1s",
+                          fast_window_s=600, slow_window_s=60)
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            SLOEvaluator([SLOSpec.parse("a", RATIO),
+                          SLOSpec.parse("a", RATIO)])
+
+
+# ---------------------------------------------------- deterministic lifecycle
+
+
+class TestLifecycle:
+    def test_fires_then_resolves(self):
+        """Burst -> both windows breach -> firing; clean traffic -> both
+        windows clean -> resolved. Injected clock, zero sleeps."""
+        ev = SLOEvaluator([SLOSpec.parse("err", RATIO, fast_window_s=10,
+                                         slow_window_s=30)])
+        ev.evaluate(_ratio_snap(0, 0), now=0.0)
+        (st,) = ev.evaluate(_ratio_snap(50, 100), now=40.0)
+        assert st["state"] == "firing"
+        assert st["value_fast"] == pytest.approx(0.5)
+        assert [a["slo"] for a in ev.active()] == ["err"]
+        (st,) = ev.evaluate(_ratio_snap(50, 200), now=80.0)
+        assert st["state"] == "ok"
+        assert ev.active() == []
+        assert [e["state"] for e in ev.history()] == ["firing", "resolved"]
+
+    def test_pending_dwell_and_clear_dwell(self):
+        """pending_for_s gates promotion; clear_for_s gates resolution —
+        hysteresis on BOTH edges."""
+        ev = SLOEvaluator([SLOSpec.parse("err", RATIO, fast_window_s=10,
+                                         slow_window_s=30, pending_for_s=15,
+                                         clear_for_s=25)])
+        ev.evaluate(_ratio_snap(0, 0), now=0.0)
+        (st,) = ev.evaluate(_ratio_snap(50, 100), now=40.0)
+        assert st["state"] == "pending"          # breaching, dwell not met
+        (st,) = ev.evaluate(_ratio_snap(60, 110), now=50.0)
+        assert st["state"] == "pending"          # 10s < 15s dwell
+        (st,) = ev.evaluate(_ratio_snap(70, 120), now=60.0)
+        assert st["state"] == "firing"           # 20s >= 15s dwell
+        (st,) = ev.evaluate(_ratio_snap(70, 130), now=70.0)
+        assert st["state"] == "firing"           # clean 0s < 25s dwell
+        (st,) = ev.evaluate(_ratio_snap(70, 160), now=100.0)
+        assert st["state"] == "ok"               # clean 30s >= 25s dwell
+        assert [e["state"] for e in ev.history()] == ["firing", "resolved"]
+
+    def test_pending_blip_reverts_without_event(self):
+        """A breach shorter than pending_for_s goes pending -> ok with NO
+        alert event — the dwell is the false-positive filter."""
+        ev = SLOEvaluator([SLOSpec.parse("err", RATIO, fast_window_s=10,
+                                         slow_window_s=30,
+                                         pending_for_s=15)])
+        ev.evaluate(_ratio_snap(0, 0), now=0.0)
+        (st,) = ev.evaluate(_ratio_snap(50, 100), now=40.0)
+        assert st["state"] == "pending"
+        (st,) = ev.evaluate(_ratio_snap(50, 200), now=50.0)
+        assert st["state"] == "ok"
+        assert ev.history() == []
+
+    def test_unknown_windows_never_fire(self):
+        """No old-enough reference (or zero traffic) reads None — the
+        conservative no-fire reading, even at a 100% error rate."""
+        ev = SLOEvaluator([SLOSpec.parse("err", RATIO, fast_window_s=10,
+                                         slow_window_s=30)])
+        (st,) = ev.evaluate(_ratio_snap(100, 100), now=0.0)
+        assert st["state"] == "ok"
+        assert st["value_fast"] is None and st["value_slow"] is None
+        (st,) = ev.evaluate(_ratio_snap(200, 200), now=5.0)
+        assert st["state"] == "ok"               # still no 10s-old sample
+        # traffic stalls: den delta 0 over the window is also unknown
+        ev.evaluate(_ratio_snap(200, 200), now=40.0)
+        (st,) = ev.evaluate(_ratio_snap(200, 200), now=80.0)
+        assert st["state"] == "ok" and ev.history() == []
+
+    def test_slow_window_suppresses_fast_blip(self):
+        """The multi-window scheme's point: a burst that breaches the fast
+        window but not the slow one never fires."""
+        ev = SLOEvaluator([SLOSpec.parse("err", RATIO, fast_window_s=10,
+                                         slow_window_s=100)])
+        ev.evaluate(_ratio_snap(0, 0), now=0.0)
+        ev.evaluate(_ratio_snap(0, 1000), now=50.0)
+        (st,) = ev.evaluate(_ratio_snap(5, 1010), now=110.0)
+        # fast: 5/10 = 50% breach; slow: 5/1010 ~ 0.5% clean -> no fire
+        assert st["value_fast"] == pytest.approx(0.5)
+        assert st["value_slow"] == pytest.approx(5 / 1010)
+        assert st["state"] == "ok" and ev.history() == []
+
+    def test_percentile_objective(self):
+        ev = SLOEvaluator([SLOSpec.parse(
+            "ttft", "serve.ttft_seconds p99 < 2.0s", fast_window_s=10,
+            slow_window_s=30)])
+        snap = lambda count, p99: {
+            "histograms": {"serve.ttft_seconds": {"count": count,
+                                                  "p99": p99}}}
+        ev.evaluate(snap(10, 0.1), now=0.0)
+        (st,) = ev.evaluate(snap(20, 5.0), now=40.0)
+        assert st["state"] == "firing"
+        # silence: no window traffic -> unknown -> resolves (clear=0)
+        (st,) = ev.evaluate(snap(20, 5.0), now=80.0)
+        assert st["state"] == "ok"
+
+    def test_mean_objective_over_registry(self):
+        """The registry= path: evaluate() with no snapshot argument
+        windows the given registry's own snapshot()."""
+        reg = MetricsRegistry()
+        h = reg.histogram("engine.step_seconds")
+        ev = SLOEvaluator([SLOSpec.parse(
+            "step", "engine.step_seconds mean < 0.01", fast_window_s=5,
+            slow_window_s=10)], registry=reg)
+        ev.evaluate(now=0.0)
+        for _ in range(10):
+            h.observe(0.1)
+        (st,) = ev.evaluate(now=20.0)
+        assert st["state"] == "firing"
+        assert st["value_fast"] == pytest.approx(0.1)
+
+    def test_burn_multiplier(self):
+        """burn=5 means the windowed value must exceed 5x the threshold —
+        2x the bound alone does not fire."""
+        ev = SLOEvaluator([SLOSpec.parse("err", RATIO, fast_window_s=10,
+                                         slow_window_s=30, burn=5.0)])
+        ev.evaluate(_ratio_snap(0, 0), now=0.0)
+        (st,) = ev.evaluate(_ratio_snap(20, 100), now=40.0)   # 20% = 2x
+        assert st["state"] == "ok"
+        ev2 = SLOEvaluator([SLOSpec.parse("err", RATIO, fast_window_s=10,
+                                          slow_window_s=30, burn=1.0)])
+        ev2.evaluate(_ratio_snap(0, 0), now=0.0)
+        (st2,) = ev2.evaluate(_ratio_snap(20, 100), now=40.0)
+        assert st2["state"] == "firing"
+
+    def test_fleet_scope_over_rollup_shape(self):
+        """A FleetMetrics.rollup()-shaped snapshot (same counters/
+        histograms keys) drives the SAME evaluator — one judge, two
+        scopes."""
+        ev = SLOEvaluator([SLOSpec.parse("err", RATIO, fast_window_s=10,
+                                         slow_window_s=30)], scope="fleet")
+        roll0 = {"counters": {"serve.request_errors": 0,
+                              "serve.requests": 0},
+                 "gauges": {}, "histograms": {}, "fleet": {}}
+        roll1 = {"counters": {"serve.request_errors": 30,
+                              "serve.requests": 100},
+                 "gauges": {}, "histograms": {}, "fleet": {}}
+        ev.evaluate(roll0, now=0.0)
+        (st,) = ev.evaluate(roll1, now=40.0)
+        assert st["state"] == "firing" and st["scope"] == "fleet"
+        assert ev.history()[-1]["scope"] == "fleet"
+
+
+# -------------------------------------------------------- /alerts + exporter
+
+
+def test_alerts_endpoint_and_prometheus_rows():
+    """GET /alerts on the fleet exporter serves specs + live state + the
+    transition ring; the /metrics body gains the alert series."""
+    from paddle_tpu.observability.fleet import (FleetMetrics,
+                                                start_fleet_exporter)
+    ev = SLOEvaluator([SLOSpec.parse("err", RATIO, fast_window_s=10,
+                                     slow_window_s=30)], scope="fleet")
+    ev.evaluate(_ratio_snap(0, 0), now=0.0)
+    ev.evaluate(_ratio_snap(50, 100), now=40.0)          # -> firing
+    srv = start_fleet_exporter(FleetMetrics(), slo=ev)
+    try:
+        port = srv.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/alerts", timeout=10).read()
+        payload = json.loads(body.decode())
+        assert payload["scope"] == "fleet"
+        assert [s["name"] for s in payload["specs"]] == ["err"]
+        assert [a["slo"] for a in payload["active"]] == ["err"]
+        assert payload["history"][-1]["state"] == "firing"
+        mbody = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert 'slo_alert_firing{scope="fleet",slo="err"} 1' in mbody
+        assert "slo_burn_rate" in mbody
+    finally:
+        srv.shutdown()
+
+
+def test_alerts_404_without_evaluator():
+    from paddle_tpu.observability.fleet import (FleetMetrics,
+                                                start_fleet_exporter)
+    srv = start_fleet_exporter(FleetMetrics())
+    try:
+        port = srv.server_address[1]
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/alerts",
+                                   timeout=10)
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------- usage parity
+
+
+class TestUsageParity:
+    def test_mixed_workload_exact_parity(self):
+        """The acceptance drill: prefix hit + speculative decode + cancel
+        + deadline expiry, and the four records' token fields sum EXACTLY
+        to the engine's aggregate counter deltas."""
+        from paddle_tpu.inference.engine import Cancelled, DeadlineExceeded
+        m = _tiny_model()
+        eng = _engine(m, speculate_k=2)
+        rng = np.random.RandomState(3)
+        rep = np.tile(np.arange(4, dtype=np.int32), 2)     # spec-friendly
+        other = rng.randint(0, 97, 8).astype(np.int32)
+
+        c0 = _counters()
+        # (a) full prefill + speculative decode
+        r1 = eng.submit(rep, max_new_tokens=6)
+        eng.run_until_idle(max_steps=64)
+        out1 = r1.result(timeout=30)
+        # (b) the same prompt again -> prefix-cache hit
+        r2 = eng.submit(rep, max_new_tokens=4)
+        eng.run_until_idle(max_steps=64)
+        out2 = r2.result(timeout=30)
+        # (c) cancelled while queued -> zero prefill, typed Cancelled
+        r3 = eng.submit(other, max_new_tokens=4)
+        assert eng.cancel(r3.request_id) is True
+        eng.run_until_idle(max_steps=16)
+        with pytest.raises(Cancelled):
+            r3.result(timeout=10)
+        # (d) deadline expires while queued -> typed DeadlineExceeded
+        r4 = eng.submit(rng.randint(0, 97, 8).astype(np.int32),
+                        max_new_tokens=4, deadline_s=0.02)
+        time.sleep(0.05)
+        eng.run_until_idle(max_steps=16)
+        with pytest.raises(DeadlineExceeded):
+            r4.result(timeout=10)
+        c1 = _counters()
+
+        ids = {r.request_id for r in (r1, r2, r3, r4)}
+        recs = {r["request_id"]: r for r in usage_log.records()
+                if r["request_id"] in ids}
+        assert set(recs) == ids, "every terminated request emits a record"
+
+        def delta(name):
+            return c1.get(name, 0) - c0.get(name, 0)
+
+        def total(field):
+            return sum(r[field] for r in recs.values())
+
+        # EXACT parity: per-request metering == aggregate monitoring
+        assert total("prefill_computed") == delta("engine.prefill_tokens")
+        assert total("generated") == delta("engine.tokens")
+        assert total("spec_accepted") == delta("engine.spec_accepted")
+        assert total("generated") == int(out1.size) - 8 + int(out2.size) - 8
+        # the usage.* counters are the same sums again, on the STATS path
+        assert total("prompt_tokens") == delta("usage.prompt_tokens") == 32
+        assert total("prefill_computed") == \
+            delta("usage.prefill_computed_tokens")
+        assert total("prefill_saved") == delta("usage.prefill_saved_tokens")
+        assert total("generated") == delta("usage.generated_tokens")
+        assert total("spec_accepted") == delta("usage.spec_accepted_tokens")
+        assert total("kv_page_steps") == delta("usage.kv_page_steps")
+        assert delta("usage.requests") == 4
+        assert delta("usage.errors") == 2
+
+        # per-record shape
+        assert recs[r2.request_id]["prefill_saved"] > 0, "prefix hit saved"
+        assert recs[r2.request_id]["prefill_computed"] \
+            < recs[r1.request_id]["prefill_computed"]
+        assert recs[r1.request_id]["kv_page_steps"] > 0
+        assert recs[r1.request_id]["error"] is None
+        assert recs[r3.request_id]["error"] == "Cancelled"
+        assert recs[r3.request_id]["prefill_computed"] == 0
+        assert recs[r3.request_id]["generated"] == 0
+        assert recs[r4.request_id]["error"] == "DeadlineExceeded"
+        assert recs[r4.request_id]["prefill_computed"] == 0
+        for r in (r1, r2):
+            rec = recs[r.request_id]
+            assert rec["e2e_s"] is not None and rec["e2e_s"] >= 0
+            assert rec["ttft_s"] is not None and rec["ttft_s"] >= 0
+            assert rec["tenant"] is None and rec["imported"] is False
+
+    def test_metering_adds_zero_compiles(self):
+        """Zero cost: metering rides termination only — a warm engine
+        serves more requests with FROZEN compile counters while records
+        keep flowing."""
+        m = _tiny_model(seed=9)
+        eng = _engine(m)
+        rng = np.random.RandomState(5)
+        r = eng.submit(rng.randint(0, 97, 6).astype(np.int32), 2)
+        eng.run_until_idle(max_steps=32)
+        r.result(timeout=30)
+        snap = metrics.snapshot()["counters"]
+        frozen = (snap.get("engine.compile_count", 0),
+                  snap.get("jit.compile_count", 0))
+        n0 = usage_log.emitted
+        for _ in range(3):
+            r = eng.submit(rng.randint(0, 97, 6).astype(np.int32), 2)
+            eng.run_until_idle(max_steps=32)
+            r.result(timeout=30)
+        snap = metrics.snapshot()["counters"]
+        assert (snap.get("engine.compile_count", 0),
+                snap.get("jit.compile_count", 0)) == frozen
+        assert usage_log.emitted == n0 + 3
+
+
+# --------------------------------------------------------------- usage sink
+
+
+class TestUsageLogSink:
+    def test_unconfigured_never_touches_disk(self, tmp_path):
+        log = UsageLog(capacity=4)
+        log.emit({"request_id": "a", "prompt_tokens": 1})
+        assert log.emitted == 1 and log.last(1)[0]["request_id"] == "a"
+        assert list(tmp_path.iterdir()) == []      # no file I/O happened
+
+    def test_jsonl_rotation(self, tmp_path):
+        path = str(tmp_path / "usage.jsonl")
+        log = UsageLog(capacity=64)
+        log.configure(path, max_bytes=300, keep=2)
+        for i in range(12):
+            log.emit({"request_id": f"r{i:02d}", "prompt_tokens": i,
+                      "pad": "x" * 40})
+        assert os.path.exists(path) and os.path.exists(path + ".1")
+        lines = [json.loads(ln) for ln in open(path)]
+        assert lines, "live file holds the newest records"
+        assert lines[-1]["request_id"] == "r11"
+        for p in (path, path + ".1", path + ".2"):
+            if os.path.exists(p):
+                for ln in open(p):
+                    json.loads(ln)                  # every line parses
+        # disable: subsequent emits leave the file alone
+        log.configure(None)
+        size = os.path.getsize(path)
+        log.emit({"request_id": "after", "prompt_tokens": 1})
+        assert os.path.getsize(path) == size
+
+    def test_ring_is_bounded(self):
+        log = UsageLog(capacity=4)
+        for i in range(10):
+            log.emit({"request_id": f"r{i}"})
+        assert log.emitted == 10
+        assert [r["request_id"] for r in log.records()] == \
+            ["r6", "r7", "r8", "r9"]
+
+    def test_typed_error(self):
+        assert typed_error(None) is None
+        assert typed_error("") is None
+        assert typed_error("Cancelled: client went away") == "Cancelled"
+        assert typed_error("DeadlineExceeded") == "DeadlineExceeded"
+        assert typed_error("?! weird: stuff") == "Error"
+
+
+# ------------------------------------------------------- watchdog stall dump
+
+
+def test_watchdog_dump_carries_slo_section(tmp_path):
+    """A stall dump answers 'what was the fleet promising': firing
+    alerts, recent transitions, and the last usage records ride it."""
+    from paddle_tpu.observability.flight_recorder import Watchdog
+    ev = SLOEvaluator([SLOSpec.parse("dump_err", RATIO, fast_window_s=10,
+                                     slow_window_s=30)])
+    ev.evaluate(_ratio_snap(0, 0), now=0.0)
+    ev.evaluate(_ratio_snap(50, 100), now=40.0)          # -> firing
+    assert any(a["slo"] == "dump_err" for a in active_alerts())
+    usage_log.emit({"request_id": "dump-probe", "prompt_tokens": 1})
+    wd = Watchdog("slo_dump_test", progress=lambda: 0,
+                  dump_dir=str(tmp_path))
+    path = wd.dump(stalled_s=1.0, progress=0)
+    with open(path) as f:
+        payload = json.load(f)
+    assert any(a["slo"] == "dump_err" for a in payload["slo"]["firing"])
+    assert any(e["slo"] == "dump_err" for e in payload["slo"]["events"])
+    assert any(r.get("request_id") == "dump-probe"
+               for r in payload["slo"]["usage"])
+
+
+# -------------------------------------------------------- percentile hoist
+
+
+def test_histogram_percentile_matches_summary():
+    """The hoisted index math: Histogram.percentile and summary() read
+    the SAME reservoir index — they can never drift."""
+    reg = MetricsRegistry()
+    h = reg.histogram("x")
+    vals = [0.5, 0.1, 0.9, 0.3, 0.7, 0.2, 0.8, 0.4, 0.6, 1.0]
+    for v in vals:
+        h.observe(v)
+    s = h.summary()
+    assert h.percentile(50) == s["p50"]
+    assert h.percentile(99) == s["p99"]
+    assert s["p99"] == max(vals)                 # clamped nearest-rank
+    hb = reg.histogram("one")
+    hb.observe(2.5)
+    assert hb.percentile(99) == 2.5 == hb.summary()["p99"]
+
+
+# --------------------------------------------------------- regression ledger
+
+
+def _write_artifact(tmp_path, n, lines, rc=0):
+    tail = "\n".join(json.dumps(d) for d in lines)
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(
+        {"n": n, "cmd": "bench", "rc": rc, "tail": tail,
+         "parsed": lines[-1] if lines else None}))
+
+
+class TestRegressLedger:
+    def test_improvement_is_ok(self, tmp_path):
+        from paddle_tpu.observability.regress import run_ledger
+        _write_artifact(tmp_path, 1, [{"metric": "gpt2_tokens_per_sec",
+                                       "value": 100.0, "unit": "tokens/s",
+                                       "ok": True}])
+        _write_artifact(tmp_path, 2, [{"metric": "gpt2_tokens_per_sec",
+                                       "value": 110.0, "unit": "tokens/s",
+                                       "ok": True}])
+        v = run_ledger(str(tmp_path))
+        assert v["ok"] is True and v["newest"] == 2
+        assert v["regressions"] == []
+
+    def test_rate_regression_flagged(self, tmp_path):
+        from paddle_tpu.observability.regress import main, run_ledger
+        _write_artifact(tmp_path, 1, [{"metric": "gpt2_tokens_per_sec",
+                                       "value": 100.0, "unit": "tokens/s",
+                                       "ok": True}])
+        _write_artifact(tmp_path, 2, [{"metric": "gpt2_tokens_per_sec",
+                                       "value": 110.0, "unit": "tokens/s",
+                                       "ok": True}])
+        _write_artifact(tmp_path, 3, [{"metric": "gpt2_tokens_per_sec",
+                                       "value": 80.0, "unit": "tokens/s",
+                                       "ok": True}])
+        v = run_ledger(str(tmp_path))
+        assert v["ok"] is False
+        (reg,) = v["regressions"]
+        assert reg["metric"] == "gpt2_tokens_per_sec"
+        assert reg["best"] == 110.0 and reg["best_run"] == 2
+        assert main([str(tmp_path)]) == 1          # exit code contract
+
+    def test_time_metric_regresses_upward(self, tmp_path):
+        from paddle_tpu.observability.regress import run_ledger
+        _write_artifact(tmp_path, 1, [{"metric": "smoke_step_time_seconds",
+                                       "value": 1.0, "unit": "s",
+                                       "ok": True}])
+        _write_artifact(tmp_path, 2, [{"metric": "smoke_step_time_seconds",
+                                       "value": 0.8, "unit": "s",
+                                       "ok": True}])
+        _write_artifact(tmp_path, 3, [{"metric": "smoke_step_time_seconds",
+                                       "value": 1.0, "unit": "s",
+                                       "ok": True}])
+        v = run_ledger(str(tmp_path))
+        assert v["ok"] is False
+        assert v["regressions"][0]["best"] == 0.8
+        # within tolerance is fine
+        _write_artifact(tmp_path, 4, [{"metric": "smoke_step_time_seconds",
+                                       "value": 0.82, "unit": "s",
+                                       "ok": True}])
+        assert run_ledger(str(tmp_path))["ok"] is True
+
+    def test_skips_never_crash(self, tmp_path):
+        from paddle_tpu.observability.regress import run_ledger
+        (tmp_path / "BENCH_r01.json").write_text("{corrupt")
+        _write_artifact(tmp_path, 2, [
+            {"metric": "broken_rung", "value": 5.0, "unit": "tokens/s",
+             "ok": False},                          # failed rung: no baseline
+            {"metric": "odd_unit", "value": 5.0, "unit": "widgets",
+             "ok": True},
+            {"metric": "gpt2_tokens_per_sec", "value": 100.0,
+             "unit": "tokens/s", "ok": True}])
+        _write_artifact(tmp_path, 3, [
+            {"metric": "gpt2_tokens_per_sec", "value": 101.0,
+             "unit": "tokens/s", "ok": True},
+            {"metric": "odd_unit", "value": 1.0, "unit": "widgets",
+             "ok": True}])
+        v = run_ledger(str(tmp_path))
+        assert v["ok"] is True
+        notes = " ".join(s["note"] for s in v["skipped"])
+        assert "corrupt" in notes
+        assert "ok:false" in notes
+        assert "unknown unit" in notes
+        # missing directory: verdict, not a crash
+        v = run_ledger(str(tmp_path / "nope"))
+        assert v["ok"] is True and v["regressions"] == []
+
+    def test_single_run_has_no_baseline(self, tmp_path):
+        from paddle_tpu.observability.regress import run_ledger
+        _write_artifact(tmp_path, 7, [{"metric": "gpt2_tokens_per_sec",
+                                       "value": 50.0, "unit": "tokens/s",
+                                       "ok": True}])
+        v = run_ledger(str(tmp_path))
+        assert v["ok"] is True and v["newest"] == 7
+        assert any("no prior run" in s["note"] for s in v["skipped"])
+
+
+# ----------------------------------------------------- OPS.md regeneration
+
+
+def test_gen_inventory_preserves_hand_runbook(tmp_path):
+    """write_docs regenerates the op surface but carries the
+    hand-maintained runbook section (below the marker) across."""
+    from paddle_tpu.ops.gen_inventory import HAND_MARKER, write_docs
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "OPS.md").write_text(
+        "# Op surface\n\nstale generated text\n\n"
+        f"{HAND_MARKER}\n\n# Runbook\n\nkeep me\n")
+    entries = [{"op": "matmul", "namespace": "paddle",
+                "module": "paddle_tpu.ops", "kind": "op",
+                "tensor_method": True}]
+    write_docs(entries, str(tmp_path))
+    out = (docs / "OPS.md").read_text()
+    assert "stale generated text" not in out
+    assert "`matmul*`" in out
+    assert out.count(HAND_MARKER) == 1
+    assert "keep me" in out
+    # idempotent: a second regen keeps exactly one hand section
+    write_docs(entries, str(tmp_path))
+    out2 = (docs / "OPS.md").read_text()
+    assert out2.count(HAND_MARKER) == 1 and "keep me" in out2
